@@ -1,0 +1,32 @@
+"""cometbft_tpu — a TPU-native BFT state-machine-replication framework.
+
+A ground-up re-design of CometBFT's capabilities (Tendermint consensus, ABCI
+application boundary, mempool / block / state sync, light client, evidence,
+RPC, operational tooling) built idiomatically around JAX/XLA/Pallas.
+
+The defining feature is a TPU-resident cryptography backend: validator-set
+wide ed25519 signature batches (vote ingest, commit verification, light-client
+replay, blocksync catch-up) are streamed to HBM and verified in a single
+batched kernel launch behind the engine's ``BatchVerifier`` interface.
+
+Layer map (mirrors reference SURVEY.md §1):
+  ops/        field/curve/hash kernels (JAX, device)     — the compute path
+  parallel/   device mesh + sharding for multi-chip batches
+  crypto/     keys, batch verifier, merkle, hashing       — L1
+  types/      Block/Vote/Commit/ValidatorSet/...          — L2
+  store/      block store, KV abstraction                 — L3
+  state/      BlockExecutor, state store, indexers        — L3/L7
+  abci/       application boundary                        — L4
+  p2p/        transport, secret connection, switch        — L5
+  consensus/, mempool/, blocksync/, statesync/, evidence/ — L6
+  node/       assembly                                    — L8
+  rpc/        JSON-RPC surface                            — L9
+  light/, privval/, inspect, cmd/                         — L10
+"""
+
+__version__ = "0.1.0"
+
+# ABCI protocol compatibility version (reference: version/version.go:6-9).
+ABCI_VERSION = "2.0.0"
+BLOCK_PROTOCOL = 11
+P2P_PROTOCOL = 9
