@@ -163,18 +163,40 @@ def _clamp(a: bytes) -> int:
 
 
 def pubkey_from_seed(seed: bytes) -> bytes:
+    return _expand_seed(seed)[2]
+
+
+_EXPANDED_CACHE: dict[bytes, tuple[int, bytes, bytes]] = {}
+
+
+def _expand_seed(seed: bytes) -> tuple[int, bytes, bytes]:
+    """seed -> (clamped scalar a, prefix, compressed pubkey A), cached.
+
+    Mirrors the reference engine's expanded-pubkey cache
+    (crypto/ed25519/ed25519.go:31,56): the [a]B scalar mult is per-key
+    constant and must not be repaid on every vote signature.
+    """
     if len(seed) != 32:
         raise ValueError("ed25519 seed must be 32 bytes")
-    a = _clamp(_sha512(seed)[:32])
-    return compress(scalar_mult(a, BASE))
+    cached = _EXPANDED_CACHE.get(seed)
+    if cached is None:
+        h = _sha512(seed)
+        a = _clamp(h[:32])
+        cached = (a, h[32:], compress(scalar_mult(a, BASE)))
+        if len(_EXPANDED_CACHE) >= 4096:  # bound like the reference LRU
+            _EXPANDED_CACHE.pop(next(iter(_EXPANDED_CACHE)))
+        _EXPANDED_CACHE[seed] = cached
+    return cached
 
 
 def sign(seed: bytes, msg: bytes) -> bytes:
-    """RFC 8032 deterministic signature; returns 64 bytes R||S."""
-    h = _sha512(seed)
-    a = _clamp(h[:32])
-    prefix = h[32:]
-    A = compress(scalar_mult(a, BASE))
+    """RFC 8032 deterministic signature; returns 64 bytes R||S.
+
+    NOTE: this pure-Python path is variable-time (secret-dependent branches
+    and big-int timing). It is the correctness oracle and test signer; the
+    production privval signing path delegates to a constant-time backend.
+    """
+    a, prefix, A = _expand_seed(seed)
     r = int.from_bytes(_sha512(prefix, msg), "little") % L
     R = compress(scalar_mult(r, BASE))
     k = int.from_bytes(_sha512(R, A, msg), "little") % L

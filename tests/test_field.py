@@ -1,0 +1,99 @@
+"""Field arithmetic tests: JAX limb ops vs Python big-int ground truth."""
+
+import random
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.ops import field
+
+P = field.P
+rng = random.Random(0xC0FFEE)
+
+
+def rand_elems(n, bound=P):
+    return [rng.randrange(bound) for _ in range(n)]
+
+
+def limbs_of(values):
+    return np.stack([field.to_limbs(v) for v in values])
+
+
+def back(arr):
+    return [field.from_limbs(row) % P for row in np.asarray(arr)]
+
+
+def test_roundtrip():
+    vals = rand_elems(32) + [0, 1, P - 1, P, 2**255 - 1]
+    assert back(limbs_of(vals)) == [v % P for v in vals]
+
+
+@pytest.mark.parametrize(
+    "op,ref",
+    [
+        (field.add, lambda a, b: (a + b) % P),
+        (field.sub, lambda a, b: (a - b) % P),
+        (field.mul, lambda a, b: (a * b) % P),
+    ],
+)
+def test_binary_ops(op, ref):
+    a_vals = rand_elems(64) + [0, 0, P - 1, P - 1, 2**255 - 1]
+    b_vals = rand_elems(64) + [0, P - 1, 0, P - 1, 2**255 - 1]
+    got = back(op(limbs_of(a_vals), limbs_of(b_vals)))
+    assert got == [ref(a, b) % P for a, b in zip(a_vals, b_vals)]
+
+
+def test_mul_lazy_input_bounds():
+    """Chained muls must keep limbs inside the int32-safe lazy bound."""
+    a = limbs_of(rand_elems(16))
+    x = a
+    for _ in range(6):
+        x = field.mul(x, a)
+    arr = np.asarray(x)
+    assert arr.max() < 8800 and arr.min() >= 0
+    expect = [pow(v, 7, P) for v in back(a)]
+    assert back(x) == expect
+
+
+def test_neg_sq():
+    vals = rand_elems(16) + [0, 1, P - 1]
+    la = limbs_of(vals)
+    assert back(field.neg(la)) == [(-v) % P for v in vals]
+    assert back(field.sq(la)) == [v * v % P for v in vals]
+
+
+def test_canonical_and_is_zero():
+    vals = [0, 1, P - 1, P, P + 1, 2 * P - 1, 2**255 - 1] + rand_elems(8)
+    la = limbs_of(vals)
+    can = np.asarray(field.canonical(la))
+    assert can.max() <= field.MASK
+    assert [field.from_limbs(r) for r in can] == [v % P for v in vals]
+    zeros = np.asarray(field.is_zero(la))
+    assert list(zeros) == [v % P == 0 for v in vals]
+
+
+def test_eq():
+    a = [5, P + 5, 7]
+    b = [5 + P, 5, 8]
+    assert list(np.asarray(field.eq(limbs_of(a), limbs_of(b)))) == [
+        True,
+        True,
+        False,
+    ]
+
+
+def test_pow_const():
+    vals = rand_elems(8) + [0, 1]
+    la = limbs_of(vals)
+    e = (P - 5) // 8
+    got = back(field.pow_const(la, e))
+    assert got == [pow(v, e, P) for v in vals]
+
+
+def test_extreme_lazy_limbs():
+    """All-max lazy limbs (the worst mul input) stay correct and bounded."""
+    worst = np.full((4, field.NLIMB), 8799, np.int32)
+    got = field.mul(worst, worst)
+    v = field.from_limbs(worst[0])
+    assert back(got) == [v * v % P] * 4
+    assert np.asarray(got).max() < 8800
