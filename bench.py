@@ -1,0 +1,91 @@
+"""North-star benchmark: batched ed25519 verification throughput on chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config: the BASELINE.json "light client replay @ 10k validators" shape —
+a 4096-signature batch (largest bucket below the 10k commit, representative
+of per-launch work). Baseline is single-signature CPU verification via
+OpenSSL ed25519 (the `cryptography` wheel), the same role curve25519-voi
+plays for the reference engine (crypto/ed25519/bench_test.go:31-68).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _make_batch(n: int, seed: int = 3):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    rng = np.random.default_rng(seed)
+    raw = serialization.Encoding.Raw
+    pub_fmt = serialization.PublicFormat.Raw
+    keys = [Ed25519PrivateKey.generate() for _ in range(64)]
+    pubs = [k.public_key().public_bytes(raw, pub_fmt) for k in keys]
+    pubkeys, msgs, sigs = [], [], []
+    for i in range(n):
+        k = keys[i % len(keys)]
+        # Distinct message per lane, like commit vote sign-bytes (timestamps
+        # differ per validator — types/block.go:871-883 in the reference).
+        msg = rng.bytes(112)
+        pubkeys.append(pubs[i % len(keys)])
+        msgs.append(msg)
+        sigs.append(k.sign(msg))
+    return pubkeys, msgs, sigs
+
+
+def _cpu_baseline(pubkeys, msgs, sigs, n_sample: int = 512) -> float:
+    """OpenSSL single-verify throughput (sigs/sec), one core."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    loaded = [Ed25519PublicKey.from_public_bytes(p) for p in pubkeys[:n_sample]]
+    t0 = time.perf_counter()
+    for pk, m, s in zip(loaded, msgs[:n_sample], sigs[:n_sample]):
+        pk.verify(s, m)
+    dt = time.perf_counter() - t0
+    return n_sample / dt
+
+
+def main() -> None:
+    from cometbft_tpu.ops import verify as ov
+
+    n = 4096
+    pubkeys, msgs, sigs = _make_batch(n)
+
+    baseline = _cpu_baseline(pubkeys, msgs, sigs)
+
+    # Warm-up: compile + first execution.
+    ok_all, bitmap = ov.verify_batch(pubkeys, msgs, sigs)
+    assert ok_all and bitmap.all(), "benchmark batch failed verification"
+
+    # Timed: steady-state round trips (host pack + device verify + readback),
+    # i.e. what a consensus round actually pays.
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ok_all, _ = ov.verify_batch(pubkeys, msgs, sigs)
+    dt = (time.perf_counter() - t0) / reps
+    throughput = n / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(throughput, 1),
+                "unit": "sigs/sec",
+                "vs_baseline": round(throughput / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""L1 crypto: keys, hashing, merkle, batch verification dispatch."""
+
+from .keys import (  # noqa: F401
+    Address,
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    ED25519_KEY_TYPE,
+    pubkey_from_type_and_bytes,
+)
+from .batch import (  # noqa: F401
+    BatchVerifier,
+    Ed25519BatchVerifier,
+    create_batch_verifier,
+    supports_batch_verifier,
+)
+from . import merkle, tmhash  # noqa: F401

@@ -1,0 +1,85 @@
+"""Batch-verification dispatch: key type -> batch verifier backend.
+
+Reference surface: crypto/crypto.go:45-54 (BatchVerifier interface) and
+crypto/batch/batch.go:11-32 (CreateBatchVerifier / SupportsBatchVerifier).
+
+The ed25519 backend accumulates (pubkey, msg, sig) triples on host and
+verifies them in ONE TPU kernel launch (ops/verify.py) — the engine-wide
+hot path: commit verification (types/validation.go:153-257), light-client
+replay, blocksync catch-up, and the vote-ingest micro-batching window all
+come through this interface.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import keys
+from .keys import Ed25519PubKey
+
+
+class BatchVerifier:
+    """Add/Verify contract of crypto.BatchVerifier (crypto/crypto.go:45-54).
+
+    ``verify`` returns (all_valid, per_signature_validity); per-lane results
+    let callers attribute failures without the second single-verify pass the
+    reference falls back to (types/validation.go:243-250).
+    """
+
+    def add(self, pub_key, msg: bytes, signature: bytes) -> None:
+        raise NotImplementedError
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class Ed25519BatchVerifier(BatchVerifier):
+    """TPU-backed ed25519 batch verification."""
+
+    def __init__(self) -> None:
+        self._pubkeys: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, pub_key, msg: bytes, signature: bytes) -> None:
+        if not isinstance(pub_key, Ed25519PubKey):
+            raise TypeError("Ed25519BatchVerifier requires ed25519 keys")
+        self._pubkeys.append(pub_key.data)
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(signature))
+
+    def __len__(self) -> int:
+        return len(self._pubkeys)
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from ..ops import verify as ov
+
+        ok_all, bitmap = ov.verify_batch(self._pubkeys, self._msgs, self._sigs)
+        return ok_all, list(np.asarray(bitmap, bool))
+
+
+_BATCH_BACKENDS: dict[str, type] = {
+    keys.ED25519_KEY_TYPE: Ed25519BatchVerifier,
+}
+
+
+def supports_batch_verifier(pub_key) -> bool:
+    return getattr(pub_key, "type", None) in _BATCH_BACKENDS
+
+
+def create_batch_verifier(pub_key) -> BatchVerifier:
+    """Instantiate the batch backend for ``pub_key``'s type.
+
+    Raises ValueError for unsupported types — callers fall back to
+    single-signature verification (types/validation.go:170-176 semantics).
+    """
+    backend = _BATCH_BACKENDS.get(getattr(pub_key, "type", None))
+    if backend is None:
+        raise ValueError(
+            f"batch verification unsupported for key type "
+            f"{getattr(pub_key, 'type', None)!r}"
+        )
+    return backend()

@@ -1,0 +1,113 @@
+"""Key types: ed25519 (validator keys) and the PubKey/PrivKey contracts.
+
+Reference surface: crypto/crypto.go:22-54 (PubKey, PrivKey), with the
+ed25519 implementation semantics of crypto/ed25519/ed25519.go — ZIP-215
+verification, SHA-256[:20] addresses, 32-byte seeds as private keys
+(the wire form is seed || pubkey, 64 bytes, like RFC 8032 / golang's
+crypto/ed25519 private key layout the reference serializes).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from . import tmhash
+from . import ed25519_ref as ref
+
+ED25519_KEY_TYPE = "ed25519"
+SECP256K1_KEY_TYPE = "secp256k1"
+
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 64  # seed || pubkey
+SIGNATURE_SIZE = 64
+
+
+class Address(bytes):
+    """20-byte account/validator address (SHA-256 truncated)."""
+
+    def __str__(self) -> str:  # uppercase hex like the reference's HexBytes
+        return self.hex().upper()
+
+
+@dataclass(frozen=True, slots=True)
+class Ed25519PubKey:
+    data: bytes  # 32-byte compressed point
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("ed25519 pubkey must be 32 bytes")
+
+    @property
+    def type(self) -> str:
+        return ED25519_KEY_TYPE
+
+    def address(self) -> Address:
+        return Address(tmhash.sum_truncated(self.data))
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """Single-signature ZIP-215 verification (host path).
+
+        The batch path (crypto/batch) is preferred wherever >1 signature is
+        in flight; this is the fallback contract of
+        types/validation.go:266 (verifyCommitSingle).
+        """
+        return ref.verify(self.data, msg, sig)
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Ed25519PubKey) and self.data == other.data
+        )
+
+    def __hash__(self) -> int:
+        return hash((ED25519_KEY_TYPE, self.data))
+
+
+@dataclass(frozen=True, slots=True)
+class Ed25519PrivKey:
+    data: bytes  # seed || pubkey (64 bytes)
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("ed25519 privkey must be 64 bytes (seed||pub)")
+
+    @classmethod
+    def generate(cls, rng=os.urandom) -> "Ed25519PrivKey":
+        seed = rng(32)
+        return cls.from_seed(seed)
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "Ed25519PrivKey":
+        return cls(seed + ref.pubkey_from_seed(seed))
+
+    @property
+    def type(self) -> str:
+        return ED25519_KEY_TYPE
+
+    @property
+    def seed(self) -> bytes:
+        return self.data[:32]
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def sign(self, msg: bytes) -> bytes:
+        return ref.sign(self.seed, msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(self.data[32:])
+
+
+# Registry used by serialization (libs/json type registry analog) and the
+# batch dispatch (crypto/batch/batch.go:11).
+PUBKEY_TYPES: dict[str, type] = {ED25519_KEY_TYPE: Ed25519PubKey}
+
+
+def pubkey_from_type_and_bytes(key_type: str, data: bytes):
+    cls = PUBKEY_TYPES.get(key_type)
+    if cls is None:
+        raise ValueError(f"unknown pubkey type {key_type!r}")
+    return cls(data)

@@ -1,0 +1,198 @@
+"""RFC-6962 merkle tree, proofs, and proof-operator chaining.
+
+Reference surface: crypto/merkle/tree.go (HashFromByteSlices), proof.go
+(Proof, ComputeProofs), proof_op.go (ProofOperator chaining). Domain
+separation: leaf = SHA256(0x00 || item), inner = SHA256(0x01 || l || r);
+empty tree hashes to SHA256("").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import tmhash
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def _leaf_hash(item: bytes) -> bytes:
+    return tmhash.sum(LEAF_PREFIX + item)
+
+
+def _inner_hash(left: bytes, right: bytes) -> bytes:
+    return tmhash.sum(INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n (RFC 6962 split)."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Root hash of the RFC-6962 tree over ``items``."""
+    n = len(items)
+    if n == 0:
+        return tmhash.sum(b"")
+    if n == 1:
+        return _leaf_hash(items[0])
+    k = _split_point(n)
+    return _inner_hash(
+        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+    )
+
+
+@dataclass(slots=True)
+class Proof:
+    """Inclusion proof for item ``index`` of ``total`` (crypto/merkle/proof.go)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes]
+
+    def compute_root_hash(self) -> bytes | None:
+        return _root_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+
+    def verify(self, root_hash: bytes, leaf: bytes) -> None:
+        if self.total < 0 or self.index < 0:
+            raise ValueError("proof total/index must be non-negative")
+        if _leaf_hash(leaf) != self.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        if self.compute_root_hash() != root_hash:
+            raise ValueError("invalid merkle proof")
+
+
+def _root_from_aunts(
+    index: int, total: int, leaf: bytes, aunts: list[bytes]
+) -> bytes | None:
+    if index >= total or index < 0 or total <= 0:
+        return None
+    if total == 1:
+        return leaf if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _root_from_aunts(index, k, leaf, aunts[:-1])
+        if left is None:
+            return None
+        return _inner_hash(left, aunts[-1])
+    right = _root_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    if right is None:
+        return None
+    return _inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """(root, per-item proofs) — crypto/merkle/proof.go ProofsFromByteSlices."""
+    trails, root = _trails_from_byte_slices(items)
+    root_hash = root.hash
+    proofs = [
+        Proof(
+            total=len(items),
+            index=i,
+            leaf_hash=trail.hash,
+            aunts=trail.flatten_aunts(),
+        )
+        for i, trail in enumerate(trails)
+    ]
+    return root_hash, proofs
+
+
+class _ProofNode:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, hash_: bytes):
+        self.hash = hash_
+        self.parent = None
+        self.left = None  # sibling on the left
+        self.right = None  # sibling on the right
+
+    def flatten_aunts(self) -> list[bytes]:
+        aunts: list[bytes] = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                aunts.append(node.left.hash)
+            elif node.right is not None:
+                aunts.append(node.right.hash)
+            node = node.parent
+        return aunts
+
+
+def _trails_from_byte_slices(items: list[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], _ProofNode(tmhash.sum(b""))
+    if n == 1:
+        node = _ProofNode(_leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _ProofNode(_inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root
+
+
+# --- Proof operators (crypto/merkle/proof_op.go) -----------------------------
+
+
+class ProofOperator:
+    """One verification step: maps child value(s) -> parent value."""
+
+    def run(self, values: list[bytes]) -> list[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+
+@dataclass(slots=True)
+class ValueOp(ProofOperator):
+    """Leaf-value op: proves SHA256(value)'s inclusion under a root."""
+
+    key: bytes
+    proof: Proof
+
+    def run(self, values: list[bytes]) -> list[bytes]:
+        if len(values) != 1:
+            raise ValueError("ValueOp expects one value")
+        vhash = tmhash.sum(values[0])
+        if _leaf_hash(vhash) != self.proof.leaf_hash:
+            raise ValueError("leaf mismatch")
+        root = self.proof.compute_root_hash()
+        if root is None:
+            raise ValueError("bad proof shape")
+        return [root]
+
+    def get_key(self) -> bytes:
+        return self.key
+
+
+class ProofOperators(list):
+    """Chain of operators verified leaf -> root (proof_op.go Verify)."""
+
+    def verify_value(self, root: bytes, keypath: list[bytes], value: bytes) -> None:
+        self.verify(root, keypath, [value])
+
+    def verify(self, root: bytes, keypath: list[bytes], args: list[bytes]) -> None:
+        keys = list(keypath)
+        for op in self:
+            key = op.get_key()
+            if key:
+                if not keys or keys[-1] != key:
+                    raise ValueError(f"key mismatch on {key!r}")
+                keys.pop()
+            args = op.run(args)
+        if args[0] != root:
+            raise ValueError("computed root does not match")
+        if keys:
+            raise ValueError("keypath not fully consumed")

@@ -133,11 +133,6 @@ def sq(a: jnp.ndarray) -> jnp.ndarray:
     return mul(a, a)
 
 
-def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small non-negative int (k * 8400 must fit int32)."""
-    return carry(a * k, passes=2)
-
-
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
     """Fully reduce to the unique representative in [0, p).
 

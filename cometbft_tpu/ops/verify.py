@@ -17,7 +17,6 @@ types/validation.go:153-257).
 
 from __future__ import annotations
 
-import hashlib
 from functools import lru_cache
 
 import numpy as np
@@ -25,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..crypto import ed25519_ref
 from . import curve, field
 
 L = curve.L
@@ -73,12 +73,7 @@ def pack_inputs(pubkeys, msgs, sigs):
         if s_int >= L:  # S must be canonical even under ZIP-215
             host_ok[i] = False
             continue
-        k = (
-            int.from_bytes(
-                hashlib.sha512(s_i[:32] + p_i + m_i).digest(), "little"
-            )
-            % L
-        )
+        k = ed25519_ref.challenge_scalar(s_i[:32], p_i, m_i)
         pk[i] = np.frombuffer(p_i, np.uint8)
         rr[i] = np.frombuffer(s_i[:32], np.uint8)
         ss[i] = np.frombuffer(s_i[32:], np.uint8)

@@ -7,9 +7,15 @@ must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The env var alone does not displace an already-registered accelerator
+# plugin (e.g. the axon TPU tunnel); the config update does.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

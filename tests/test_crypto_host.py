@@ -1,0 +1,110 @@
+"""Host crypto layer: keys, merkle, batch dispatch."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import (
+    Ed25519PrivKey,
+    Ed25519PubKey,
+    batch,
+    create_batch_verifier,
+    merkle,
+    supports_batch_verifier,
+    tmhash,
+)
+
+
+class TestKeys:
+    def test_sign_verify_roundtrip(self):
+        priv = Ed25519PrivKey.from_seed(b"\x01" * 32)
+        msg = b"vote sign bytes"
+        sig = priv.sign(msg)
+        assert priv.pub_key().verify_signature(msg, sig)
+        assert not priv.pub_key().verify_signature(msg + b"x", sig)
+
+    def test_address_is_truncated_sha256(self):
+        priv = Ed25519PrivKey.from_seed(b"\x02" * 32)
+        pk = priv.pub_key()
+        assert pk.address() == hashlib.sha256(pk.data).digest()[:20]
+        assert len(pk.address()) == 20
+
+    def test_matches_openssl(self):
+        # Cross-check sign path against OpenSSL (same role curve25519-voi
+        # plays as oracle for the reference).
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+        from cryptography.hazmat.primitives import serialization
+
+        seed = b"\x07" * 32
+        ours = Ed25519PrivKey.from_seed(seed)
+        theirs = Ed25519PrivateKey.from_private_bytes(seed)
+        raw = serialization.Encoding.Raw
+        pub = theirs.public_key().public_bytes(
+            raw, serialization.PublicFormat.Raw
+        )
+        assert ours.pub_key().data == pub
+        msg = b"cross-check"
+        assert ours.sign(msg) == theirs.sign(msg)
+
+
+class TestMerkle:
+    def test_empty_tree(self):
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+    def test_rfc6962_vectors(self):
+        # Single leaf = SHA256(0x00 || leaf).
+        assert (
+            merkle.hash_from_byte_slices([b"L123456"])
+            == hashlib.sha256(b"\x00L123456").digest()
+        )
+        # Two leaves = inner(leaf(a), leaf(b)).
+        la = hashlib.sha256(b"\x00" + b"a").digest()
+        lb = hashlib.sha256(b"\x00" + b"b").digest()
+        assert (
+            merkle.hash_from_byte_slices([b"a", b"b"])
+            == hashlib.sha256(b"\x01" + la + lb).digest()
+        )
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+    def test_proofs_verify(self, n):
+        items = [bytes([i]) * (i + 1) for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            proof.verify(root, items[i])
+            with pytest.raises(ValueError):
+                proof.verify(root, items[i] + b"!")
+
+    def test_proof_rejects_wrong_index(self):
+        items = [b"a", b"b", b"c", b"d"]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        with pytest.raises(ValueError):
+            proofs[0].verify(root, items[1])
+
+
+class TestBatchDispatch:
+    def test_supports(self):
+        pk = Ed25519PrivKey.from_seed(b"\x03" * 32).pub_key()
+        assert supports_batch_verifier(pk)
+        assert not supports_batch_verifier(object())
+
+    def test_batch_verify_mixed_validity(self):
+        privs = [Ed25519PrivKey.from_seed(bytes([i]) * 32) for i in range(6)]
+        bv = create_batch_verifier(privs[0].pub_key())
+        for i, priv in enumerate(privs):
+            msg = b"msg%d" % i
+            sig = priv.sign(msg)
+            if i == 4:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])
+            bv.add(priv.pub_key(), msg, sig)
+        assert len(bv) == 6
+        ok, bits = bv.verify()
+        assert not ok
+        assert bits == [True, True, True, True, False, True]
+
+    def test_empty_batch_ok(self):
+        bv = batch.Ed25519BatchVerifier()
+        ok, bits = bv.verify()
+        assert ok and bits == []

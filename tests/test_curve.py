@@ -106,25 +106,54 @@ def test_verify_batch_mixed_invalid():
         assert ref.verify(pk, msg, sig) == e
 
 
-def test_verify_zip215_small_order():
-    """Small-order A/R must verify under the cofactored equation.
+def _order8_point():
+    """Generator of the 8-torsion: [L]P for a random curve point P."""
+    y = 2
+    while True:
+        enc = int.to_bytes(y, 32, "little")
+        pt = ref.decompress(enc)
+        y += 1
+        if pt is None:
+            continue
+        t = ref.scalar_mult(ref.L, pt)
+        # order exactly 8 <=> [4]T != O
+        if not ref.is_identity(
+            ref.point_double(ref.point_double(t))
+        ) and not ref.is_identity(t):
+            return t
 
-    With A = a small-order point and S = k' chosen freely, the cofactored
-    check accepts combos a strict (RFC 8032 cofactorless) verifier rejects;
-    this pins the engine to voi-style ZIP-215 (consensus-critical).
+
+def test_verify_zip215_small_order():
+    """Mixed-order A accepted by the cofactored equation only.
+
+    A = order-8 torsion point, R = [S]B: then [S]B - [k]A - R = [-k]A lies
+    in the 8-torsion, so the cofactored check [8](...) == O accepts for ANY
+    k — while the strict cofactorless equation [S]B == R + [k]A demands
+    [k]A == O, i.e. k ≡ 0 (mod 8). Picking a message where k mod 8 != 0
+    pins the kernel to voi-style ZIP-215 (consensus-critical): a silent
+    switch to RFC 8032 cofactorless semantics fails this test.
     """
-    # order-8 point: y such that point has small order -- use the point with
-    # x recovered from y = 2707385501144840649318225287225658788936804267575313519463743609750303402022
-    # (a known order-8 point on edwards25519); simpler: use identity A.
-    ident_enc = ref.compress(ref.IDENTITY)
-    msg = b"zip215"
-    # A = O: equation [8]([S]B - [k]O - R) == O with R = [S]B * anything...
-    # choose S = 5, R = [5]B so [S]B - R = O regardless of k.
+    a_pt = _order8_point()
+    a_enc = ref.compress(a_pt)
     s = 5
-    r_enc = ref.compress(ref.scalar_mult(s, ref.BASE))
+    r_pt = ref.scalar_mult(s, ref.BASE)
+    r_enc = ref.compress(r_pt)
     sig = r_enc + s.to_bytes(32, "little")
-    assert ref.verify(ident_enc, msg, sig)
-    ok, mask = verify.verify_batch([ident_enc], [msg], [sig])
+    msg = None
+    for i in range(64):  # find a challenge with k % 8 != 0 (7/8 per try)
+        cand = b"zip215-%d" % i
+        if ref.challenge_scalar(r_enc, a_enc, cand) % 8 != 0:
+            msg = cand
+            break
+    assert msg is not None
+    k = ref.challenge_scalar(r_enc, a_enc, msg)
+    # cofactorless check rejects:
+    lhs = ref.scalar_mult(s, ref.BASE)
+    rhs = ref.point_add(r_pt, ref.scalar_mult(k, a_pt))
+    assert not ref.point_equal(lhs, rhs)
+    # cofactored (ZIP-215) accepts — oracle and device agree:
+    assert ref.verify(a_enc, msg, sig)
+    ok, mask = verify.verify_batch([a_enc], [msg], [sig])
     assert ok and mask.all()
 
 

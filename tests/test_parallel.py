@@ -1,0 +1,92 @@
+"""Sharded verification over a virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import verify as ov
+from cometbft_tpu.parallel import mesh as pmesh
+
+
+def _batch(n, seed=11, corrupt=()):
+    rng = np.random.default_rng(seed)
+    seeds = [rng.bytes(32) for _ in range(3)]
+    keys = [(s, ref.pubkey_from_seed(s)) for s in seeds]
+    pubkeys, msgs, sigs = [], [], []
+    for i in range(n):
+        s, pk = keys[i % 3]
+        m = rng.bytes(40)
+        sig = ref.sign(s, m)
+        if i in corrupt:
+            sig = sig[:10] + bytes([sig[10] ^ 0xFF]) + sig[11:]
+        pubkeys.append(pk)
+        msgs.append(m)
+        sigs.append(sig)
+    return pubkeys, msgs, sigs
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return pmesh.make_mesh(jax.devices()[:8], commit_axis=2)
+
+
+def test_sharded_matches_reference(mesh8):
+    n_commits, n_sigs = 2, 8
+    corrupt = {3, 9}
+    pubkeys, msgs, sigs = _batch(n_commits * n_sigs, corrupt=corrupt)
+    arrays, host_ok = ov.pack_inputs(pubkeys, msgs, sigs)
+    assert host_ok.all()
+    ok = pmesh.verify_sharded(arrays, host_ok, mesh8, n_commits, n_sigs)
+    expected = np.array(
+        [ref.verify(pubkeys[i], msgs[i], sigs[i]) for i in range(len(pubkeys))]
+    ).reshape(n_commits, n_sigs)
+    assert (ok == expected).all()
+    assert not expected.flatten()[3] and not expected.flatten()[9]
+
+
+def test_sharded_pads_ragged_shapes(mesh8):
+    # 3 commits x 5 sigs does not divide the (2, 4) mesh: padding path.
+    n_commits, n_sigs = 3, 5
+    pubkeys, msgs, sigs = _batch(n_commits * n_sigs)
+    arrays, host_ok = ov.pack_inputs(pubkeys, msgs, sigs)
+    ok = pmesh.verify_sharded(arrays, host_ok, mesh8, n_commits, n_sigs)
+    assert ok.shape == (n_commits, n_sigs)
+    assert ok.all()
+
+
+def test_sharded_rejects_host_invalid_lanes(mesh8):
+    """Non-canonical S (host-rejected) must NOT verify on the sharded path.
+
+    Regression: a host-rejected lane is zeroed in the packed arrays; the
+    all-zero encoding decompresses to a small-order point the cofactored
+    kernel accepts, so dropping host_ok is a consensus-critical false
+    accept.
+    """
+    from cometbft_tpu.crypto import ed25519_ref as r
+
+    n_commits, n_sigs = 2, 4
+    pubkeys, msgs, sigs = _batch(n_commits * n_sigs)
+    s_big = (int.from_bytes(sigs[2][32:], "little") + r.L).to_bytes(
+        32, "little"
+    )
+    sigs[2] = sigs[2][:32] + s_big  # non-canonical S
+    sigs[5] = sigs[5][:40]  # truncated
+    arrays, host_ok = ov.pack_inputs(pubkeys, msgs, sigs)
+    assert not host_ok[2] and not host_ok[5]
+    ok = pmesh.verify_sharded(arrays, host_ok, mesh8, n_commits, n_sigs)
+    flat = ok.flatten()
+    assert not flat[2] and not flat[5]
+    assert flat[[0, 1, 3, 4, 6, 7]].all()
+
+
+def test_graft_entry_dryrun():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert np.asarray(out).all()
+    ge.dryrun_multichip(min(8, len(jax.devices())))
