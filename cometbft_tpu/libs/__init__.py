@@ -1,0 +1,1 @@
+"""L0 runtime primitives: bit arrays, events, service lifecycle, pubsub."""

@@ -1,0 +1,91 @@
+"""Canonical sign-bytes encodings (consensus-critical, byte-exact).
+
+Reference: types/canonical.go, proto/tendermint/types/canonical.proto,
+types/vote.go:139-161 (VoteSignBytes / VoteExtensionSignBytes),
+types/proposal.go:102-116 (ProposalSignBytes). All sign bytes are uvarint
+length-delimited protobuf (protoio.MarshalDelimited).
+
+Message types: prevote=1, precommit=2, proposal=32
+(proto/tendermint/types/types.proto:17-23).
+"""
+
+from __future__ import annotations
+
+from . import proto
+
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+def canonical_part_set_header(total: int, hash_: bytes) -> bytes:
+    return proto.field_varint(1, total) + proto.field_bytes(2, hash_)
+
+
+def canonical_block_id(block_id) -> bytes:
+    """CanonicalBlockID body; b'' when the block id is nil (field omitted).
+
+    The nested part-set header is gogoproto nullable=false: always emitted.
+    """
+    if block_id is None or block_id.is_nil():
+        return b""
+    psh = block_id.part_set_header
+    return proto.field_bytes(1, block_id.hash) + proto.field_message(
+        2, canonical_part_set_header(psh.total, psh.hash), always=True
+    )
+
+
+def vote_sign_bytes(
+    chain_id: str,
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalVote sign bytes (types/vote.go:139, canonical.proto:30-37)."""
+    cbid = canonical_block_id(block_id)
+    body = (
+        proto.field_varint(1, msg_type)
+        + proto.field_sfixed64(2, height)
+        + proto.field_sfixed64(3, round_)
+        + proto.field_message(4, cbid)
+        + proto.field_message(5, proto.timestamp(timestamp_ns), always=True)
+        + proto.field_string(6, chain_id)
+    )
+    return proto.delimited(body)
+
+
+def proposal_sign_bytes(
+    chain_id: str,
+    height: int,
+    round_: int,
+    pol_round: int,
+    block_id,
+    timestamp_ns: int,
+) -> bytes:
+    """CanonicalProposal sign bytes (types/proposal.go:110)."""
+    cbid = canonical_block_id(block_id)
+    body = (
+        proto.field_varint(1, PROPOSAL_TYPE)
+        + proto.field_sfixed64(2, height)
+        + proto.field_sfixed64(3, round_)
+        + proto.field_varint(4, pol_round)
+        + proto.field_message(5, cbid)
+        + proto.field_message(6, proto.timestamp(timestamp_ns), always=True)
+        + proto.field_string(7, chain_id)
+    )
+    return proto.delimited(body)
+
+
+def vote_extension_sign_bytes(
+    chain_id: str, height: int, round_: int, extension: bytes
+) -> bytes:
+    """CanonicalVoteExtension sign bytes (canonical.proto:41-46)."""
+    body = (
+        proto.field_bytes(1, extension)
+        + proto.field_sfixed64(2, height)
+        + proto.field_sfixed64(3, round_)
+        + proto.field_string(4, chain_id)
+    )
+    return proto.delimited(body)

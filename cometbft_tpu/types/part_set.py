@@ -1,0 +1,84 @@
+"""PartSet: block bytes split into 64KB parts with merkle proofs.
+
+Reference: types/part_set.go. Blocks gossip as parts so a proposal can
+stream from many peers concurrently; each part carries an inclusion proof
+against the PartSetHeader hash in the proposal.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import merkle
+from ..libs.bits import BitArray
+from .block import BLOCK_PART_SIZE_BYTES, PartSetHeader
+
+
+class PartSetError(Exception):
+    pass
+
+
+@dataclass(slots=True)
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def validate_basic(self) -> None:
+        if self.index < 0:
+            raise PartSetError("negative part index")
+        if len(self.bytes_) > BLOCK_PART_SIZE_BYTES:
+            raise PartSetError("part too big")
+
+
+class PartSet:
+    @classmethod
+    def from_data(
+        cls, data: bytes, part_size: int = BLOCK_PART_SIZE_BYTES
+    ) -> "PartSet":
+        """Split ``data`` into parts + proofs (part_set.go NewPartSetFromData)."""
+        chunks = [
+            data[i : i + part_size] for i in range(0, len(data), part_size)
+        ] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        for i, chunk in enumerate(chunks):
+            ps.add_part(Part(index=i, bytes_=chunk, proof=proofs[i]))
+        return ps
+
+    def __init__(self, header: PartSetHeader):
+        self.header = header
+        self.parts: list[Part | None] = [None] * header.total
+        self.parts_bit_array = BitArray(header.total)
+        self.count = 0
+        self.byte_size = 0
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self.header == header
+
+    def add_part(self, part: Part) -> bool:
+        """Verify proof + store (part_set.go AddPart). False if duplicate."""
+        part.validate_basic()
+        if part.index >= self.header.total:
+            raise PartSetError("part index out of range")
+        if self.parts[part.index] is not None:
+            return False
+        if part.proof.index != part.index or part.proof.total != self.header.total:
+            raise PartSetError("part proof index/total mismatch")
+        part.proof.verify(self.header.hash, part.bytes_)
+        self.parts[part.index] = part
+        self.parts_bit_array.set_index(part.index, True)
+        self.count += 1
+        self.byte_size += len(part.bytes_)
+        return True
+
+    def get_part(self, index: int) -> Part | None:
+        return self.parts[index]
+
+    def is_complete(self) -> bool:
+        return self.count == self.header.total
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise PartSetError("incomplete part set")
+        return b"".join(p.bytes_ for p in self.parts)

@@ -1,0 +1,325 @@
+"""VoteSet: 2/3-majority tracking per (height, round, type).
+
+Reference: types/vote_set.go. Key behaviors preserved:
+
+* one "primary" vote per validator (by index); a conflicting vote for a
+  different block is only admitted if some peer claimed a 2/3 majority for
+  that block (set_peer_maj23) — otherwise it surfaces as
+  ConflictingVoteError carrying both votes (evidence input);
+* per-block tallies; ``maj23`` latches the first block to cross 2/3;
+* signature verification happens BEFORE admission. Beyond the reference,
+  ``add_votes_batch`` admits a whole micro-batch through the device
+  verifier in one launch (the SURVEY §7(d) vote-ingest design; single
+  ``add_vote`` keeps the reference's per-vote path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import batch as crypto_batch
+from ..libs.bits import BitArray
+from . import canonical
+from .block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit, NIL_BLOCK_ID
+from .validator_set import ValidatorSet
+from .vote import Vote, VoteError
+
+
+class VoteSetError(Exception):
+    pass
+
+
+@dataclass
+class ConflictingVoteError(VoteSetError):
+    existing: Vote
+    new: Vote
+
+    def __str__(self) -> str:
+        return (
+            f"conflicting votes from validator "
+            f"{self.new.validator_address.hex()}"
+        )
+
+
+class _BlockVotes:
+    __slots__ = ("peer_maj23", "bit_array", "votes", "sum")
+
+    def __init__(self, peer_maj23: bool, num_validators: int):
+        self.peer_maj23 = peer_maj23
+        self.bit_array = BitArray(num_validators)
+        self.votes: list[Vote | None] = [None] * num_validators
+        self.sum = 0
+
+    def add_verified_vote(self, vote: Vote, voting_power: int) -> None:
+        idx = vote.validator_index
+        if self.votes[idx] is None:
+            self.bit_array.set_index(idx, True)
+            self.votes[idx] = vote
+            self.sum += voting_power
+
+    def get_by_index(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        signed_msg_type: int,
+        val_set: ValidatorSet,
+        extensions_enabled: bool = False,
+    ):
+        if height == 0:
+            raise VoteSetError("cannot make VoteSet for height 0")
+        if extensions_enabled and signed_msg_type != canonical.PRECOMMIT_TYPE:
+            raise VoteSetError("extensions require precommit vote set")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.signed_msg_type = signed_msg_type
+        self.val_set = val_set
+        self.extensions_enabled = extensions_enabled
+        self.votes_bit_array = BitArray(len(val_set))
+        self.votes: list[Vote | None] = [None] * len(val_set)
+        self.sum = 0
+        self.maj23: BlockID | None = None
+        self.votes_by_block: dict[bytes, _BlockVotes] = {}
+        self.peer_maj23s: dict[str, BlockID] = {}
+
+    # --- queries -------------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.val_set)
+
+    def get_by_index(self, idx: int) -> Vote | None:
+        return self.votes[idx]
+
+    def get_by_address(self, address: bytes) -> Vote | None:
+        idx, _ = self.val_set.get_by_address(address)
+        return self.votes[idx] if idx >= 0 else None
+
+    def two_thirds_majority(self) -> BlockID | None:
+        return self.maj23
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum > self.val_set.total_voting_power() * 2 / 3
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    def bit_array(self) -> BitArray:
+        return self.votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> BitArray | None:
+        bv = self.votes_by_block.get(block_id.key())
+        return bv.bit_array.copy() if bv is not None else None
+
+    # --- vote admission ------------------------------------------------------
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Validate + verify + admit one vote (vote_set.go:157-266).
+
+        Returns True if the vote was newly added; raises on invalid votes.
+        """
+        self._check_vote(vote)
+        val = self.val_set.get_by_index(vote.validator_index)
+        self._verify_vote_signature(vote, val.pub_key)
+        return self._admit(vote, val)
+
+    def add_votes_batch(self, votes: list[Vote]) -> list[bool]:
+        """Admit many votes with ONE device verification launch.
+
+        TPU-native vote ingest: validates and pre-screens each vote, streams
+        all (pubkey, sign-bytes, sig) triples (plus extension signatures
+        when enabled) to the batch verifier, then admits the valid ones.
+        Per-vote errors don't abort the batch; the return mask marks newly
+        added votes.
+        """
+        screened: list[tuple[Vote, object]] = []
+        for vote in votes:
+            try:
+                self._check_vote(vote)
+            except (VoteError, VoteSetError):
+                screened.append((vote, None))
+                continue
+            val = self.val_set.get_by_index(vote.validator_index)
+            screened.append((vote, val))
+
+        verifier = crypto_batch.create_batch_verifier(
+            self.val_set.get_proposer().pub_key
+        )
+        lanes: list[int] = []
+        for i, (vote, val) in enumerate(screened):
+            if val is None:
+                continue
+            verifier.add(
+                val.pub_key, vote.sign_bytes(self.chain_id), vote.signature
+            )
+            lanes.append(i)
+            if self._needs_extension(vote):
+                verifier.add(
+                    val.pub_key,
+                    vote.extension_sign_bytes(self.chain_id),
+                    vote.extension_signature,
+                )
+                lanes.append(i)  # second lane for the same vote
+
+        added = [False] * len(votes)
+        if lanes:
+            _, bits = verifier.verify()
+            vote_ok: dict[int, bool] = {}
+            for lane, ok in zip(lanes, bits):
+                vote_ok[lane] = vote_ok.get(lane, True) and bool(ok)
+            for i, ok in vote_ok.items():
+                if not ok:
+                    continue
+                vote, val = screened[i]
+                try:
+                    added[i] = self._admit(vote, val)
+                except ConflictingVoteError:
+                    added[i] = False
+        return added
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """Record a peer's claim of 2/3 for a block (vote_set.go:335-378):
+        future conflicting votes for that block become admissible."""
+        existing = self.peer_maj23s.get(peer_id)
+        if existing is not None:
+            if existing == block_id:
+                return
+            raise VoteSetError(
+                f"setPeerMaj23: conflicting claims from {peer_id}"
+            )
+        self.peer_maj23s[peer_id] = block_id
+        key = block_id.key()
+        if key not in self.votes_by_block:
+            self.votes_by_block[key] = _BlockVotes(True, len(self.val_set))
+        else:
+            self.votes_by_block[key].peer_maj23 = True
+
+    # --- internals -----------------------------------------------------------
+
+    def _needs_extension(self, vote: Vote) -> bool:
+        return (
+            self.extensions_enabled
+            and vote.msg_type == canonical.PRECOMMIT_TYPE
+            and not vote.block_id.is_nil()
+        )
+
+    def _check_vote(self, vote: Vote) -> None:
+        vote.validate_basic()
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.msg_type != self.signed_msg_type
+        ):
+            raise VoteSetError(
+                f"vote H/R/T {vote.height}/{vote.round}/{vote.msg_type} "
+                f"does not match set "
+                f"{self.height}/{self.round}/{self.signed_msg_type}"
+            )
+        val = self.val_set.get_by_index(vote.validator_index)
+        if val is None:
+            raise VoteSetError(
+                f"validator index {vote.validator_index} out of range"
+            )
+        if val.address != vote.validator_address:
+            raise VoteSetError("validator address does not match index")
+        if self._needs_extension(vote):
+            if not vote.extension_signature:
+                raise VoteError("missing required extension signature")
+        elif self.extensions_enabled is False and (
+            vote.extension or vote.extension_signature
+        ):
+            if vote.msg_type == canonical.PRECOMMIT_TYPE:
+                raise VoteError("unexpected vote extension data")
+        existing = self.votes[vote.validator_index]
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                if existing.signature != vote.signature:
+                    raise VoteSetError("same block, different signature")
+                # exact duplicate: handled by _admit returning False
+                return
+            # conflicting: only admissible if peer claimed maj23 for it
+            bv = self.votes_by_block.get(vote.block_id.key())
+            if bv is None or not bv.peer_maj23:
+                raise ConflictingVoteError(existing=existing, new=vote)
+
+    def _verify_vote_signature(self, vote: Vote, pub_key) -> None:
+        if self._needs_extension(vote):
+            vote.verify_vote_and_extension(self.chain_id, pub_key)
+        else:
+            vote.verify(self.chain_id, pub_key)
+
+    def _admit(self, vote: Vote, val) -> bool:
+        idx = vote.validator_index
+        existing = self.votes[idx]
+        key = vote.block_id.key()
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                return False  # duplicate
+            # conflicting but peer-claimed: record in block votes only
+            bv = self.votes_by_block.get(key)
+            if bv is None or not bv.peer_maj23:
+                raise ConflictingVoteError(existing=existing, new=vote)
+            bv.add_verified_vote(vote, val.voting_power)
+            self._maybe_latch_maj23(key, vote)
+            return True
+
+        self.votes[idx] = vote
+        self.votes_bit_array.set_index(idx, True)
+        self.sum += val.voting_power
+        bv = self.votes_by_block.get(key)
+        if bv is None:
+            bv = _BlockVotes(False, len(self.val_set))
+            self.votes_by_block[key] = bv
+        bv.add_verified_vote(vote, val.voting_power)
+        self._maybe_latch_maj23(key, vote)
+        return True
+
+    def _maybe_latch_maj23(self, key: bytes, vote: Vote) -> None:
+        bv = self.votes_by_block[key]
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        if bv.sum >= quorum and self.maj23 is None:
+            self.maj23 = vote.block_id
+            # promote block votes into primary slots (vote_set.go:257-263)
+            for i, v in enumerate(bv.votes):
+                if v is not None and self.votes[i] is not v:
+                    if self.votes[i] is None:
+                        self.votes_bit_array.set_index(i, True)
+                        self.sum += self.val_set.get_by_index(i).voting_power
+                    self.votes[i] = v
+
+    # --- commit construction -------------------------------------------------
+
+    def make_commit(self) -> Commit:
+        """Build a Commit from the 2/3 majority (vote_set.go MakeCommit)."""
+        if self.signed_msg_type != canonical.PRECOMMIT_TYPE:
+            raise VoteSetError("cannot MakeCommit from non-precommit set")
+        if self.maj23 is None:
+            raise VoteSetError("cannot MakeCommit: no 2/3 majority")
+        from .block import CommitSig
+
+        sigs = []
+        for i, vote in enumerate(self.votes):
+            if (
+                vote is not None
+                and vote.block_id == self.maj23
+                and vote.block_id.is_complete()
+            ):
+                sigs.append(vote.commit_sig())
+            elif vote is not None and vote.block_id.is_nil():
+                sigs.append(vote.commit_sig())
+            else:
+                sigs.append(CommitSig.absent())
+        return Commit(
+            height=self.height,
+            round=self.round,
+            block_id=self.maj23,
+            signatures=sigs,
+        )

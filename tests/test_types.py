@@ -1,0 +1,451 @@
+"""Types layer: canonical sign bytes (golden vectors), blocks, validator
+sets, vote sets, commit verification.
+
+Golden byte vectors reproduced from the reference test suite
+(types/vote_test.go:63-155 TestVoteSignBytesTestVectors) — the canonical
+encodings are consensus-critical and must match byte-for-byte.
+"""
+
+import pytest
+
+from cometbft_tpu.crypto import Ed25519PrivKey
+from cometbft_tpu.types import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    BlockID,
+    Commit,
+    CommitSig,
+    ConflictingVoteError,
+    Data,
+    Header,
+    MockPV,
+    NIL_BLOCK_ID,
+    NotEnoughVotingPowerError,
+    PartSetHeader,
+    PRECOMMIT_TYPE,
+    PREVOTE_TYPE,
+    PartSet,
+    Validator,
+    ValidatorSet,
+    VerificationError,
+    Version,
+    Vote,
+    VoteSet,
+    verify_commit,
+    verify_commit_light,
+    verify_commit_light_trusting,
+    Fraction,
+)
+from cometbft_tpu.types import canonical, proto
+from cometbft_tpu.types.vote import Proposal
+
+
+# --- canonical sign bytes ----------------------------------------------------
+
+
+class TestSignBytesGoldenVectors:
+    """types/vote_test.go:63-155."""
+
+    def test_zero_vote(self):
+        got = canonical.vote_sign_bytes("", 0, 0, 0, NIL_BLOCK_ID, proto.ZERO_TIME_NS)
+        want = bytes(
+            [0xD, 0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF,
+             0xFF, 0xFF, 0x1]
+        )
+        assert got == want
+
+    def test_precommit(self):
+        got = canonical.vote_sign_bytes(
+            "", PRECOMMIT_TYPE, 1, 1, NIL_BLOCK_ID, proto.ZERO_TIME_NS
+        )
+        want = bytes(
+            [0x21, 0x8, 0x2,
+             0x11, 0x1, 0, 0, 0, 0, 0, 0, 0,
+             0x19, 0x1, 0, 0, 0, 0, 0, 0, 0,
+             0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF,
+             0xFF, 0x1]
+        )
+        assert got == want
+
+    def test_prevote(self):
+        got = canonical.vote_sign_bytes(
+            "", PREVOTE_TYPE, 1, 1, NIL_BLOCK_ID, proto.ZERO_TIME_NS
+        )
+        assert got[1:3] == bytes([0x8, 0x1])
+        assert len(got) == 0x21 + 1
+
+    def test_no_type_with_chain_id(self):
+        got = canonical.vote_sign_bytes(
+            "test_chain_id", 0, 1, 1, NIL_BLOCK_ID, proto.ZERO_TIME_NS
+        )
+        want = bytes(
+            [0x2E,
+             0x11, 0x1, 0, 0, 0, 0, 0, 0, 0,
+             0x19, 0x1, 0, 0, 0, 0, 0, 0, 0,
+             0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF,
+             0xFF, 0x1,
+             0x32, 0xD]
+        ) + b"test_chain_id"
+        assert got == want
+
+    def test_vote_proposal_not_equal(self):
+        v = canonical.vote_sign_bytes("", 0, 1, 1, NIL_BLOCK_ID, proto.ZERO_TIME_NS)
+        p = canonical.proposal_sign_bytes(
+            "", 1, 1, 0, NIL_BLOCK_ID, proto.ZERO_TIME_NS
+        )
+        assert v != p
+
+
+# --- block / header ----------------------------------------------------------
+
+
+def _pv_set(n, power=10):
+    pvs = [MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32)) for i in range(n)]
+    vals = ValidatorSet(
+        [Validator(pub_key=pv.get_pub_key(), voting_power=power) for pv in pvs]
+    )
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+    ordered = [by_addr[v.address] for v in vals.validators]
+    return ordered, vals
+
+
+def _block_id(seed=b"\xaa"):
+    return BlockID(
+        hash=seed * 32, part_set_header=PartSetHeader(total=1, hash=seed * 32)
+    )
+
+
+def _make_commit(chain_id, height, round_, block_id, pvs, vals, *, nil_idx=(),
+                 absent_idx=(), bad_sig_idx=()):
+    sigs = []
+    for i, pv in enumerate(pvs):
+        if i in absent_idx:
+            sigs.append(CommitSig.absent())
+            continue
+        bid = NIL_BLOCK_ID if i in nil_idx else block_id
+        vote = Vote(
+            msg_type=PRECOMMIT_TYPE,
+            height=height,
+            round=round_,
+            block_id=bid,
+            timestamp_ns=1_700_000_000_000_000_000 + i,
+            validator_address=vals.validators[i].address,
+            validator_index=i,
+        )
+        pv.sign_vote(chain_id, vote, sign_extension=False)
+        if i in bad_sig_idx:
+            vote.signature = vote.signature[:-1] + bytes(
+                [vote.signature[-1] ^ 1]
+            )
+        sigs.append(vote.commit_sig())
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)
+
+
+class TestHeaderAndBlock:
+    def test_header_hash_deterministic(self):
+        h = Header(
+            version=Version(block=11, app=1),
+            chain_id="test",
+            height=3,
+            time_ns=1_700_000_000_000_000_000,
+            last_block_id=_block_id(),
+            last_commit_hash=b"\x01" * 32,
+            data_hash=b"\x02" * 32,
+            validators_hash=b"\x03" * 32,
+            next_validators_hash=b"\x04" * 32,
+            consensus_hash=b"\x05" * 32,
+            app_hash=b"\x06" * 32,
+            last_results_hash=b"\x07" * 32,
+            evidence_hash=b"\x08" * 32,
+            proposer_address=b"\x09" * 20,
+        )
+        h1, h2 = h.hash(), h.hash()
+        assert h1 == h2 and len(h1) == 32
+        # any field change changes the hash
+        from dataclasses import replace
+
+        assert replace(h, height=4).hash() != h1
+        assert replace(h, chain_id="other").hash() != h1
+        assert replace(h, app_hash=b"\x0a" * 32).hash() != h1
+
+    def test_header_hash_nil_without_validators_hash(self):
+        h = Header(
+            version=Version(),
+            chain_id="t",
+            height=1,
+            time_ns=0,
+            last_block_id=NIL_BLOCK_ID,
+            last_commit_hash=b"",
+            data_hash=b"",
+            validators_hash=b"",
+            next_validators_hash=b"",
+            consensus_hash=b"",
+            app_hash=b"",
+            last_results_hash=b"",
+            evidence_hash=b"",
+            proposer_address=b"\x01" * 20,
+        )
+        assert h.hash() is None
+
+    def test_part_set_roundtrip(self):
+        data = bytes(range(256)) * 700  # ~ 3 parts at 64KB
+        ps = PartSet.from_data(data)
+        assert ps.is_complete()
+        ps2 = PartSet(ps.header)
+        for i in range(ps.header.total):
+            assert ps2.add_part(ps.get_part(i))
+        assert ps2.assemble() == data
+
+    def test_part_set_rejects_tampered_part(self):
+        from cometbft_tpu.types.part_set import PartSetError
+
+        data = b"x" * 100000
+        ps = PartSet.from_data(data)
+        part = ps.get_part(0)
+        part.bytes_ = b"y" + part.bytes_[1:]
+        ps2 = PartSet(ps.header)
+        with pytest.raises(Exception):
+            ps2.add_part(part)
+
+
+# --- validator set -----------------------------------------------------------
+
+
+class TestValidatorSet:
+    def test_ordering_power_desc_address_asc(self):
+        pvs, vals = _pv_set(5)
+        powers = [v.voting_power for v in vals.validators]
+        assert powers == sorted(powers, reverse=True)
+
+    def test_proposer_rotation_is_fair(self):
+        _, vals = _pv_set(3)
+        counts = {}
+        vs = vals
+        for _ in range(300):
+            p = vs.get_proposer().address
+            counts[p] = counts.get(p, 0) + 1
+            vs = vs.copy_increment_proposer_priority(1)
+        # equal power => each proposes ~100 times
+        assert all(90 <= c <= 110 for c in counts.values()), counts
+
+    def test_proposer_rotation_weighted(self):
+        pv1 = MockPV(Ed25519PrivKey.from_seed(b"\x01" * 32))
+        pv2 = MockPV(Ed25519PrivKey.from_seed(b"\x02" * 32))
+        vals = ValidatorSet(
+            [
+                Validator(pub_key=pv1.get_pub_key(), voting_power=1),
+                Validator(pub_key=pv2.get_pub_key(), voting_power=3),
+            ]
+        )
+        counts = {}
+        vs = vals
+        for _ in range(400):
+            p = vs.get_proposer().address
+            counts[p] = counts.get(p, 0) + 1
+            vs = vs.copy_increment_proposer_priority(1)
+        heavy = counts[bytes(pv2.get_pub_key().address())]
+        assert 280 <= heavy <= 320, counts
+
+    def test_hash_changes_with_power(self):
+        _, vals = _pv_set(3)
+        h1 = vals.hash()
+        vals.validators[0].voting_power += 1
+        assert vals.hash() != h1
+
+    def test_update_add_remove(self):
+        pvs, vals = _pv_set(3)
+        new_pv = MockPV(Ed25519PrivKey.from_seed(b"\x42" * 32))
+        vals.update_with_change_set(
+            [Validator(pub_key=new_pv.get_pub_key(), voting_power=5)]
+        )
+        assert len(vals) == 4
+        assert vals.has_address(bytes(new_pv.get_pub_key().address()))
+        # remove it again
+        vals.update_with_change_set(
+            [Validator(pub_key=new_pv.get_pub_key(), voting_power=0)]
+        )
+        assert len(vals) == 3
+        with pytest.raises(ValueError):
+            vals.update_with_change_set(
+                [Validator(pub_key=new_pv.get_pub_key(), voting_power=0)]
+            )
+
+
+# --- commit verification (hot path) -----------------------------------------
+
+
+CHAIN_ID = "test-chain"
+
+
+class TestVerifyCommit:
+    def test_happy_path_batch(self):
+        pvs, vals = _pv_set(4)
+        bid = _block_id()
+        commit = _make_commit(CHAIN_ID, 5, 0, bid, pvs, vals)
+        verify_commit(CHAIN_ID, vals, bid, 5, commit)
+        verify_commit_light(CHAIN_ID, vals, bid, 5, commit)
+        verify_commit_light_trusting(CHAIN_ID, vals, commit, Fraction(1, 3))
+
+    def test_bad_signature_rejected(self):
+        pvs, vals = _pv_set(4)
+        bid = _block_id()
+        commit = _make_commit(
+            CHAIN_ID, 5, 0, bid, pvs, vals, bad_sig_idx={2}
+        )
+        with pytest.raises(VerificationError, match="wrong signature"):
+            verify_commit(CHAIN_ID, vals, bid, 5, commit)
+
+    def test_insufficient_power(self):
+        pvs, vals = _pv_set(4)
+        bid = _block_id()
+        # 2 of 4 sign => 20/40 <= 2/3
+        commit = _make_commit(
+            CHAIN_ID, 5, 0, bid, pvs, vals, absent_idx={0, 1}
+        )
+        with pytest.raises(NotEnoughVotingPowerError):
+            verify_commit(CHAIN_ID, vals, bid, 5, commit)
+
+    def test_nil_votes_counted_but_not_tallied(self):
+        pvs, vals = _pv_set(4)
+        bid = _block_id()
+        # 3 commit votes + 1 nil: power 30/40 > 2/3 — must pass and verify
+        # the nil vote's signature too (VerifyCommit checks all).
+        commit = _make_commit(CHAIN_ID, 5, 0, bid, pvs, vals, nil_idx={3})
+        verify_commit(CHAIN_ID, vals, bid, 5, commit)
+        # but a bad nil-vote signature still fails the full check
+        commit2 = _make_commit(
+            CHAIN_ID, 5, 0, bid, pvs, vals, nil_idx={3}, bad_sig_idx={3}
+        )
+        with pytest.raises(VerificationError, match="wrong signature"):
+            verify_commit(CHAIN_ID, vals, bid, 5, commit2)
+        # ...while the light check ignores non-commit votes entirely
+        verify_commit_light(CHAIN_ID, vals, bid, 5, commit2)
+
+    def test_wrong_height_or_block(self):
+        pvs, vals = _pv_set(4)
+        bid = _block_id()
+        commit = _make_commit(CHAIN_ID, 5, 0, bid, pvs, vals)
+        with pytest.raises(VerificationError):
+            verify_commit(CHAIN_ID, vals, bid, 6, commit)
+        with pytest.raises(VerificationError):
+            verify_commit(CHAIN_ID, vals, _block_id(b"\xbb"), 5, commit)
+
+    def test_light_trusting_different_valset(self):
+        pvs, vals = _pv_set(6)
+        bid = _block_id()
+        commit = _make_commit(CHAIN_ID, 5, 0, bid, pvs, vals)
+        # trusted set = subset of 4 (overlap enough for 1/3 trust level)
+        subset = ValidatorSet(
+            [
+                Validator(pub_key=v.pub_key, voting_power=v.voting_power)
+                for v in vals.validators[:4]
+            ]
+        )
+        verify_commit_light_trusting(CHAIN_ID, subset, commit, Fraction(1, 3))
+
+    def test_single_fallback_below_threshold(self):
+        pvs, vals = _pv_set(1)
+        bid = _block_id()
+        commit = _make_commit(CHAIN_ID, 5, 0, bid, pvs, vals)
+        # 1 signature < batchVerifyThreshold => single-verify path
+        verify_commit(CHAIN_ID, vals, bid, 5, commit)
+
+
+# --- vote set ----------------------------------------------------------------
+
+
+def _vote(vals, pvs, i, bid, *, h=3, r=0, t=PREVOTE_TYPE, ts=0):
+    v = Vote(
+        msg_type=t,
+        height=h,
+        round=r,
+        block_id=bid,
+        timestamp_ns=ts or 1_700_000_000_000_000_000,
+        validator_address=vals.validators[i].address,
+        validator_index=i,
+    )
+    pvs[i].sign_vote(CHAIN_ID, v, sign_extension=False)
+    return v
+
+
+class TestVoteSet:
+    def test_two_thirds_latch(self):
+        pvs, vals = _pv_set(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+        bid = _block_id()
+        assert vs.add_vote(_vote(vals, pvs, 0, bid))
+        assert vs.add_vote(_vote(vals, pvs, 1, bid))
+        assert vs.two_thirds_majority() is None
+        assert vs.add_vote(_vote(vals, pvs, 2, bid))
+        assert vs.two_thirds_majority() == bid
+
+    def test_duplicate_vote_not_added(self):
+        pvs, vals = _pv_set(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+        v = _vote(vals, pvs, 0, _block_id())
+        assert vs.add_vote(v)
+        assert not vs.add_vote(v)
+
+    def test_conflicting_vote_raises(self):
+        pvs, vals = _pv_set(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+        assert vs.add_vote(_vote(vals, pvs, 0, _block_id(b"\xaa")))
+        with pytest.raises(ConflictingVoteError):
+            vs.add_vote(_vote(vals, pvs, 0, _block_id(b"\xbb")))
+
+    def test_conflicting_vote_admitted_after_peer_maj23(self):
+        pvs, vals = _pv_set(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+        bid_b = _block_id(b"\xbb")
+        assert vs.add_vote(_vote(vals, pvs, 0, _block_id(b"\xaa")))
+        vs.set_peer_maj23("peer1", bid_b)
+        assert vs.add_vote(_vote(vals, pvs, 0, bid_b))
+
+    def test_invalid_signature_rejected(self):
+        pvs, vals = _pv_set(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+        v = _vote(vals, pvs, 0, _block_id())
+        v.signature = bytes(64)
+        from cometbft_tpu.types.vote import VoteError
+
+        with pytest.raises(VoteError):
+            vs.add_vote(v)
+
+    def test_batched_ingest_matches_sequential(self):
+        pvs, vals = _pv_set(6)
+        bid = _block_id()
+        votes = [_vote(vals, pvs, i, bid) for i in range(6)]
+        votes[2].signature = bytes(64)  # invalid
+        vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+        added = vs.add_votes_batch(votes)
+        assert added == [True, True, False, True, True, True]
+        assert vs.two_thirds_majority() == bid
+
+    def test_make_commit(self):
+        pvs, vals = _pv_set(4)
+        vs = VoteSet(CHAIN_ID, 3, 0, PRECOMMIT_TYPE, vals)
+        bid = _block_id()
+        for i in range(3):
+            vs.add_vote(_vote(vals, pvs, i, bid, t=PRECOMMIT_TYPE))
+        commit = vs.make_commit()
+        assert commit.block_id == bid
+        assert commit.signatures[3].block_id_flag == BLOCK_ID_FLAG_ABSENT
+        verify_commit(CHAIN_ID, vals, bid, 3, commit)
+
+
+class TestProposal:
+    def test_sign_and_validate(self):
+        pv = MockPV(Ed25519PrivKey.from_seed(b"\x05" * 32))
+        p = Proposal(
+            height=2,
+            round=1,
+            pol_round=-1,
+            block_id=_block_id(),
+            timestamp_ns=1_700_000_000_000_000_000,
+        )
+        pv.sign_proposal(CHAIN_ID, p)
+        p.validate_basic()
+        assert pv.get_pub_key().verify_signature(
+            p.sign_bytes(CHAIN_ID), p.signature
+        )
