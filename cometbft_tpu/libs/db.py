@@ -1,0 +1,247 @@
+"""Key-value store abstraction (reference: cometbft-db dependency).
+
+The reference stores blocks/state/indexes on a pluggable KV interface
+(goleveldb default — config/config.go:256). Here the same interface is a
+small ABC with two backends:
+
+* ``MemDB`` — sorted in-memory store (tests, ephemeral nodes).
+* ``FileDB`` — persistent append-only log with in-memory index and
+  compaction, durable across restarts. Plays goleveldb's role without a
+  native dependency; the interface leaves room for a C++ backend later.
+
+Iteration is ordered by raw bytes, half-open ``[start, end)``, matching the
+reference semantics that the indexers and stores rely on.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from bisect import bisect_left, insort
+from typing import Iterator
+
+
+class DB:
+    """The cometbft-db interface subset the framework uses."""
+
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def delete_sync(self, key: bytes) -> None:
+        self.delete(key)
+
+    def iterator(
+        self, start: bytes | None = None, end: bytes | None = None
+    ) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def reverse_iterator(
+        self, start: bytes | None = None, end: bytes | None = None
+    ) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def new_batch(self) -> "Batch":
+        return Batch(self)
+
+    def close(self) -> None:
+        pass
+
+
+class Batch:
+    """Write batch: buffered mutations applied atomically on ``write()``."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._ops: list[tuple[bool, bytes, bytes]] = []
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._ops.append((True, bytes(key), bytes(value)))
+
+    def delete(self, key: bytes) -> None:
+        self._ops.append((False, bytes(key), b""))
+
+    def write(self) -> None:
+        self._db.apply_batch(self._ops)
+        self._ops = []
+
+    def write_sync(self) -> None:
+        self.write()
+
+
+class MemDB(DB):
+    def __init__(self) -> None:
+        self._mtx = threading.RLock()
+        self._data: dict[bytes, bytes] = {}
+        self._keys: list[bytes] = []  # sorted view for iteration
+
+    def get(self, key: bytes) -> bytes | None:
+        with self._mtx:
+            return self._data.get(bytes(key))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        key, value = bytes(key), bytes(value)
+        with self._mtx:
+            if key not in self._data:
+                insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        key = bytes(key)
+        with self._mtx:
+            if key in self._data:
+                del self._data[key]
+                i = bisect_left(self._keys, key)
+                del self._keys[i]
+
+    def apply_batch(self, ops: list[tuple[bool, bytes, bytes]]) -> None:
+        with self._mtx:
+            for is_set, k, v in ops:
+                if is_set:
+                    self.set(k, v)
+                else:
+                    self.delete(k)
+
+    def _range_keys(self, start: bytes | None, end: bytes | None) -> list[bytes]:
+        lo = 0 if start is None else bisect_left(self._keys, bytes(start))
+        hi = len(self._keys) if end is None else bisect_left(self._keys, bytes(end))
+        return self._keys[lo:hi]
+
+    def iterator(self, start=None, end=None):
+        with self._mtx:
+            keys = self._range_keys(start, end)
+            snap = [(k, self._data[k]) for k in keys]
+        yield from snap
+
+    def reverse_iterator(self, start=None, end=None):
+        with self._mtx:
+            keys = self._range_keys(start, end)
+            snap = [(k, self._data[k]) for k in reversed(keys)]
+        yield from snap
+
+
+# FileDB record framing: u8 op | u32 klen | u32 vlen | key | value
+_HDR = struct.Struct("<BII")
+_OP_SET, _OP_DEL = 1, 2
+
+
+class FileDB(MemDB):
+    """Durable log-structured store: MemDB index + append-only on-disk log.
+
+    Every mutation appends a framed record; ``compact()`` (run automatically
+    when the log grows past ``compact_factor`` × live size) rewrites the log
+    to just the live records. A torn final record (crash mid-append) is
+    truncated on open — the same recover-to-last-good-record posture the
+    reference's WAL takes (consensus/wal.go).
+    """
+
+    def __init__(self, path: str, compact_factor: int = 4):
+        super().__init__()
+        self._path = path
+        self._compact_factor = compact_factor
+        self._live_bytes = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._replay()
+        self._f = open(path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        good = 0
+        with open(self._path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    break
+                op, klen, vlen = _HDR.unpack(hdr)
+                body = f.read(klen + vlen)
+                if len(body) < klen + vlen or op not in (_OP_SET, _OP_DEL):
+                    break
+                key, value = body[:klen], body[klen:]
+                if op == _OP_SET:
+                    super().set(key, value)
+                else:
+                    super().delete(key)
+                good = f.tell()
+        size = os.path.getsize(self._path)
+        if size > good:
+            with open(self._path, "r+b") as f:
+                f.truncate(good)
+        self._recount()
+
+    def _recount(self) -> None:
+        self._live_bytes = sum(
+            _HDR.size + len(k) + len(v) for k, v in self._data.items()
+        )
+
+    def _append(self, op: int, key: bytes, value: bytes, sync: bool) -> None:
+        self._f.write(_HDR.pack(op, len(key), len(value)) + key + value)
+        self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+
+    def set(self, key: bytes, value: bytes) -> None:
+        key, value = bytes(key), bytes(value)
+        with self._mtx:
+            super().set(key, value)
+            self._append(_OP_SET, key, value, sync=False)
+            self._live_bytes += _HDR.size + len(key) + len(value)
+            self._maybe_compact()
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        key, value = bytes(key), bytes(value)
+        with self._mtx:
+            super().set(key, value)
+            self._append(_OP_SET, key, value, sync=True)
+            self._live_bytes += _HDR.size + len(key) + len(value)
+            self._maybe_compact()
+
+    def delete(self, key: bytes) -> None:
+        key = bytes(key)
+        with self._mtx:
+            super().delete(key)
+            self._append(_OP_DEL, key, b"", sync=False)
+
+    def apply_batch(self, ops: list[tuple[bool, bytes, bytes]]) -> None:
+        with self._mtx:
+            for is_set, k, v in ops:
+                if is_set:
+                    self.set(k, v)
+                else:
+                    self.delete(k)
+            os.fsync(self._f.fileno())
+
+    def _maybe_compact(self) -> None:
+        log_size = self._f.tell()
+        if log_size > max(1 << 16, self._compact_factor * self._live_bytes):
+            self.compact()
+
+    def compact(self) -> None:
+        with self._mtx:
+            tmp = self._path + ".compact"
+            with open(tmp, "wb") as out:
+                for k in self._keys:
+                    v = self._data[k]
+                    out.write(_HDR.pack(_OP_SET, len(k), len(v)) + k + v)
+                out.flush()
+                os.fsync(out.fileno())
+            self._f.close()
+            os.replace(tmp, self._path)
+            self._f = open(self._path, "ab")
+            self._recount()
+
+    def close(self) -> None:
+        with self._mtx:
+            self._f.close()

@@ -1,0 +1,115 @@
+"""Service lifecycle primitives (reference: libs/service/service.go:24,97).
+
+The reference's ``BaseService`` gives every long-lived component a uniform
+start/stop/reset contract with idempotency guarantees (started twice →
+``ErrAlreadyStarted``; stopped before started → error) and a ``Quit`` channel.
+Here the same contract is a small thread-safe state machine; the quit channel
+becomes a ``threading.Event`` that Python code can ``wait()`` on.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ServiceError(Exception):
+    pass
+
+
+class AlreadyStartedError(ServiceError):
+    pass
+
+
+class AlreadyStoppedError(ServiceError):
+    pass
+
+
+class NotStartedError(ServiceError):
+    pass
+
+
+class BaseService:
+    """Uniform lifecycle: ``start() -> on_start()``, ``stop() -> on_stop()``.
+
+    Subclasses override ``on_start``/``on_stop``/``on_reset``. Mirrors
+    libs/service/service.go:97 (BaseService) without the logger plumbing —
+    logging is injected via the ``logger`` attribute.
+    """
+
+    def __init__(self, name: str | None = None, logger=None):
+        self._name = name or type(self).__name__
+        self._mtx = threading.Lock()
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+        self.logger = logger
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        with self._mtx:
+            if self._stopped:
+                raise AlreadyStoppedError(
+                    f"{self._name}: stopped services cannot be restarted; "
+                    "use reset()"
+                )
+            if self._started:
+                raise AlreadyStartedError(self._name)
+            self._started = True
+        try:
+            self.on_start()
+        except BaseException:
+            with self._mtx:
+                self._started = False
+            raise
+
+    def stop(self) -> None:
+        with self._mtx:
+            if self._stopped:
+                raise AlreadyStoppedError(self._name)
+            if not self._started:
+                raise NotStartedError(self._name)
+            self._stopped = True
+        self._quit.set()
+        self.on_stop()
+
+    def reset(self) -> None:
+        with self._mtx:
+            if not self._stopped:
+                raise ServiceError(f"{self._name}: cannot reset a running service")
+            self._started = False
+            self._stopped = False
+            self._quit = threading.Event()
+        self.on_reset()
+
+    # -- queries -----------------------------------------------------------
+
+    def is_running(self) -> bool:
+        with self._mtx:
+            return self._started and not self._stopped
+
+    def quit_event(self) -> threading.Event:
+        """The analog of the reference's ``Quit()`` channel."""
+        return self._quit
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the service stops (Quit closes)."""
+        return self._quit.wait(timeout)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def __str__(self) -> str:
+        return self._name
+
+    # -- overridables ------------------------------------------------------
+
+    def on_start(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def on_stop(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    def on_reset(self) -> None:  # pragma: no cover - trivial default
+        pass

@@ -1,0 +1,286 @@
+"""L0 runtime substrate tests: service, db, pubsub+query, events, autofile."""
+
+import os
+import threading
+
+import pytest
+
+from cometbft_tpu.libs import autofile, db, events, pubsub
+from cometbft_tpu.libs.service import (
+    AlreadyStartedError,
+    AlreadyStoppedError,
+    BaseService,
+    NotStartedError,
+)
+
+
+# -- service ---------------------------------------------------------------
+
+
+class _Svc(BaseService):
+    def __init__(self):
+        super().__init__("test")
+        self.started = 0
+        self.stopped = 0
+
+    def on_start(self):
+        self.started += 1
+
+    def on_stop(self):
+        self.stopped += 1
+
+
+def test_service_lifecycle():
+    s = _Svc()
+    assert not s.is_running()
+    s.start()
+    assert s.is_running()
+    with pytest.raises(AlreadyStartedError):
+        s.start()
+    s.stop()
+    assert not s.is_running()
+    assert s.quit_event().is_set()
+    with pytest.raises(AlreadyStoppedError):
+        s.stop()
+    with pytest.raises(AlreadyStoppedError):
+        s.start()  # stopped services don't restart without reset
+    s.reset()
+    s.start()
+    assert (s.started, s.stopped) == (2, 1)
+    s.stop()
+
+
+def test_service_stop_before_start():
+    s = _Svc()
+    with pytest.raises(NotStartedError):
+        s.stop()
+
+
+def test_service_quit_wakes_waiter():
+    s = _Svc()
+    s.start()
+    t = threading.Thread(target=s.wait)
+    t.start()
+    s.stop()
+    t.join(timeout=2)
+    assert not t.is_alive()
+
+
+# -- db --------------------------------------------------------------------
+
+
+def _exercise_db(d: db.DB):
+    d.set(b"k1", b"v1")
+    d.set(b"k3", b"v3")
+    d.set(b"k2", b"v2")
+    assert d.get(b"k2") == b"v2"
+    assert d.get(b"nope") is None
+    assert d.has(b"k1")
+    d.delete(b"k1")
+    assert not d.has(b"k1")
+    # ordered iteration, half-open range
+    d.set(b"k1", b"v1b")
+    assert [k for k, _ in d.iterator()] == [b"k1", b"k2", b"k3"]
+    assert [k for k, _ in d.iterator(b"k2")] == [b"k2", b"k3"]
+    assert [k for k, _ in d.iterator(b"k1", b"k3")] == [b"k1", b"k2"]
+    assert [k for k, _ in d.reverse_iterator()] == [b"k3", b"k2", b"k1"]
+    # batch atomicity (single-writer view)
+    b = d.new_batch()
+    b.set(b"k4", b"v4")
+    b.delete(b"k2")
+    b.write()
+    assert d.get(b"k4") == b"v4"
+    assert d.get(b"k2") is None
+
+
+def test_memdb():
+    _exercise_db(db.MemDB())
+
+
+def test_filedb_basic(tmp_path):
+    _exercise_db(db.FileDB(str(tmp_path / "test.db")))
+
+
+def test_filedb_durability(tmp_path):
+    path = str(tmp_path / "dur.db")
+    d = db.FileDB(path)
+    d.set(b"a", b"1")
+    d.set_sync(b"b", b"2")
+    d.delete(b"a")
+    d.close()
+    d2 = db.FileDB(path)
+    assert d2.get(b"a") is None
+    assert d2.get(b"b") == b"2"
+    d2.close()
+
+
+def test_filedb_torn_tail_recovery(tmp_path):
+    path = str(tmp_path / "torn.db")
+    d = db.FileDB(path)
+    d.set_sync(b"good", b"yes")
+    d.close()
+    with open(path, "ab") as f:
+        f.write(b"\x01\xff\xff")  # torn record: header cut short
+    d2 = db.FileDB(path)
+    assert d2.get(b"good") == b"yes"
+    d2.set_sync(b"after", b"ok")
+    d2.close()
+    d3 = db.FileDB(path)
+    assert d3.get(b"after") == b"ok"
+    d3.close()
+
+
+def test_filedb_compaction(tmp_path):
+    path = str(tmp_path / "compact.db")
+    d = db.FileDB(path)
+    for i in range(200):
+        d.set(b"hot", b"x" * 1024)  # same key: log grows, live size doesn't
+    d.compact()
+    assert os.path.getsize(path) < 3 * 1024
+    assert d.get(b"hot") == b"x" * 1024
+    d.close()
+    d2 = db.FileDB(path)
+    assert d2.get(b"hot") == b"x" * 1024
+    d2.close()
+
+
+# -- pubsub query language -------------------------------------------------
+
+
+def test_query_equality_and_numbers():
+    q = pubsub.Query.parse("tm.event = 'NewBlock'")
+    assert q.matches({"tm.event": ["NewBlock"]})
+    assert not q.matches({"tm.event": ["Tx"]})
+    assert not q.matches({})
+
+    q = pubsub.Query.parse("tx.height > 5 AND tx.height <= 10")
+    assert q.matches({"tx.height": ["7"]})
+    assert not q.matches({"tx.height": ["5"]})
+    assert q.matches({"tx.height": ["10"]})
+    assert not q.matches({"tx.height": ["11"]})
+
+
+def test_query_contains_exists():
+    q = pubsub.Query.parse("abci.owner.name CONTAINS 'ana'")
+    assert q.matches({"abci.owner.name": ["banana"]})
+    assert not q.matches({"abci.owner.name": ["apple"]})
+
+    q = pubsub.Query.parse("tx.hash EXISTS")
+    assert q.matches({"tx.hash": ["deadbeef"]})
+    assert not q.matches({"other": ["x"]})
+
+
+def test_query_multivalue_any_semantics():
+    # A condition passes if ANY value under the key satisfies it.
+    q = pubsub.Query.parse("transfer.amount > 100")
+    assert q.matches({"transfer.amount": ["7", "250"]})
+    assert not q.matches({"transfer.amount": ["7", "9"]})
+
+
+def test_query_syntax_errors():
+    for bad in ["= 'x'", "tm.event =", "a = 'x' OR b = 'y'", "a CONTAINS 5"]:
+        with pytest.raises(pubsub.QuerySyntaxError):
+            pubsub.Query.parse(bad)
+
+
+def test_query_equality_of_parsed():
+    a = pubsub.Query.parse("tm.event = 'Vote'")
+    b = pubsub.Query.parse("tm.event = 'Vote'")
+    assert a == b and hash(a) == hash(b)
+
+
+# -- pubsub server ---------------------------------------------------------
+
+
+def test_pubsub_basic_flow():
+    s = pubsub.Server()
+    sub = s.subscribe("client1", pubsub.Query.parse("tm.event = 'Tx'"))
+    s.publish("tx-data", {"tm.event": ["Tx"], "tx.height": ["1"]})
+    s.publish("block-data", {"tm.event": ["NewBlock"]})
+    msg = sub.out.get_nowait()
+    assert msg.data == "tx-data"
+    assert sub.out.empty()
+
+
+def test_pubsub_duplicate_and_unsubscribe():
+    s = pubsub.Server()
+    q = pubsub.Query.parse("tm.event = 'Tx'")
+    s.subscribe("c", q)
+    with pytest.raises(pubsub.AlreadySubscribedError):
+        s.subscribe("c", q)
+    s.unsubscribe("c", q)
+    with pytest.raises(pubsub.NotSubscribedError):
+        s.unsubscribe("c", q)
+    assert s.num_clients() == 0
+
+
+def test_pubsub_slow_subscriber_canceled():
+    s = pubsub.Server()
+    sub = s.subscribe("slow", pubsub.Empty(), capacity=1)
+    s.publish("a", {})
+    s.publish("b", {})  # overflows capacity-1 queue
+    assert sub.canceled.is_set()
+    assert s.num_clients() == 0
+
+
+def test_pubsub_stop_cancels_all():
+    s = pubsub.Server()
+    sub = s.subscribe("c", pubsub.Empty())
+    s.stop()
+    assert sub.canceled.is_set()
+
+
+# -- event switch ----------------------------------------------------------
+
+
+def test_event_switch():
+    sw = events.EventSwitch()
+    got = []
+    sw.add_listener_for_event("l1", "step", lambda d: got.append(("l1", d)))
+    sw.add_listener_for_event("l2", "step", lambda d: got.append(("l2", d)))
+    sw.fire_event("step", 42)
+    assert got == [("l1", 42), ("l2", 42)]
+    sw.remove_listener("l1")
+    sw.fire_event("step", 43)
+    assert got[-1] == ("l2", 43)
+    sw.fire_event("unknown", 1)  # no listeners: no-op
+
+
+# -- autofile --------------------------------------------------------------
+
+
+def test_autofile_write_and_read(tmp_path):
+    g = autofile.Group(str(tmp_path / "wal"))
+    g.write(b"hello ")
+    g.write(b"world")
+    g.flush_and_sync()
+    r = autofile.GroupReader(g)
+    assert r.read(100) == b"hello world"
+    r.close()
+    g.close()
+
+
+def test_autofile_rotation(tmp_path):
+    g = autofile.Group(str(tmp_path / "wal"), head_size_limit=64)
+    for i in range(10):
+        g.write(bytes([65 + i]) * 32)
+        g.check_head_size_limit()
+    assert g.max_index() >= 0  # rotated at least once
+    r = autofile.GroupReader(g)
+    data = r.read(10 * 32)
+    assert data == b"".join(bytes([65 + i]) * 32 for i in range(10))
+    r.close()
+    g.close()
+
+
+def test_autofile_group_size_eviction(tmp_path):
+    g = autofile.Group(
+        str(tmp_path / "wal"), head_size_limit=64, group_size_limit=200
+    )
+    for i in range(20):
+        g.write(b"x" * 64)
+        g.check_head_size_limit()
+    paths = g.all_paths()
+    total = sum(os.path.getsize(p) for p in paths if os.path.exists(p))
+    assert total <= 200 + 64  # bounded by limit (+ one head write)
+    g.close()
