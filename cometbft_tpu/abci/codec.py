@@ -1,0 +1,100 @@
+"""Wire codec for the ABCI socket protocol.
+
+The reference frames length-prefixed protobuf ``Request``/``Response``
+oneofs over a unix/tcp socket (abci/client/socket_client.go,
+abci/server/socket_server.go). Here the framing is identical (uvarint
+length prefix, libs/protoio) but the payload is self-describing JSON:
+dataclasses carry a ``__t`` type tag, bytes are hex-tagged. The codec is
+an internal boundary between this framework's node and app processes —
+swapping in a protobuf payload for Go-app interop only touches this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from enum import IntEnum
+
+from ..types import proto
+from . import types as abci
+
+# Registry of every dataclass the protocol can carry, by class name.
+_TYPES = {
+    name: obj
+    for name, obj in vars(abci).items()
+    if dataclasses.is_dataclass(obj)
+}
+
+
+def _to_jsonable(v):
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        d = {"__t": type(v).__name__}
+        for f in dataclasses.fields(v):
+            d[f.name] = _to_jsonable(getattr(v, f.name))
+        return d
+    if isinstance(v, bytes):
+        return {"__b": v.hex()}
+    if isinstance(v, IntEnum):
+        return int(v)
+    if isinstance(v, list):
+        return [_to_jsonable(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    raise TypeError(f"cannot encode {type(v).__name__} over ABCI socket")
+
+
+def _from_jsonable(v):
+    if isinstance(v, dict):
+        if "__b" in v:
+            return bytes.fromhex(v["__b"])
+        if "__t" in v:
+            cls = _TYPES[v["__t"]]
+            kwargs = {k: _from_jsonable(x) for k, x in v.items() if k != "__t"}
+            obj = cls(**kwargs)
+            # Restore enum types declared on the dataclass.
+            for f in dataclasses.fields(cls):
+                cur = getattr(obj, f.name)
+                if isinstance(f.type, str) and isinstance(cur, int):
+                    enum_cls = getattr(abci, f.type, None)
+                    if isinstance(enum_cls, type) and issubclass(
+                        enum_cls, IntEnum
+                    ):
+                        setattr(obj, f.name, enum_cls(cur))
+            return obj
+        raise ValueError(f"unknown tagged value {v.keys()}")
+    if isinstance(v, list):
+        return [_from_jsonable(x) for x in v]
+    return v
+
+
+def encode_frame(method: str, msg) -> bytes:
+    """One protocol frame: uvarint length + JSON {method, msg}."""
+    payload = json.dumps(
+        {"method": method, "msg": _to_jsonable(msg)}, separators=(",", ":")
+    ).encode()
+    return proto.delimited(payload)
+
+
+def read_frame(sock_file) -> tuple[str, object] | None:
+    """Read one frame from a file-like socket; None on clean EOF."""
+    # uvarint length prefix, byte at a time
+    length = 0
+    shift = 0
+    while True:
+        b = sock_file.read(1)
+        if not b:
+            return None
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("frame length uvarint overflow")
+    payload = b""
+    while len(payload) < length:
+        chunk = sock_file.read(length - len(payload))
+        if not chunk:
+            raise EOFError("truncated ABCI frame")
+        payload += chunk
+    obj = json.loads(payload)
+    return obj["method"], _from_jsonable(obj["msg"])

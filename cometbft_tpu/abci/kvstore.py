@@ -1,0 +1,270 @@
+"""The canonical in-process test application (reference:
+abci/example/kvstore/kvstore.go:552).
+
+Transactions are ``key=value`` byte strings; ``val:<pubkey_hex>!<power>``
+transactions update the validator set (the mechanism consensus tests use
+to exercise validator-set changes). App hash commits to the total tx
+count, matching the reference's size-based hash, so two nodes diverge the
+moment they disagree on history. State persists to a KV db under a
+dedicated prefix — restart + handshake-replay tests depend on it.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+
+from ..libs import db as dbm
+from . import types as abci
+from .application import BaseApplication
+
+_STATE_KEY = b"kvstore:state"
+_KV_PREFIX = b"kvstore:k:"
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApplication(BaseApplication):
+    def __init__(self, db: dbm.DB | None = None):
+        self.db = db if db is not None else dbm.MemDB()
+        self._mtx = threading.Lock()
+        self._staged: dict[bytes, bytes] = {}
+        self._val_updates: list[abci.ValidatorUpdate] = []
+        self._validators: dict[str, int] = {}  # pubkey hex -> power
+        raw = self.db.get(_STATE_KEY)
+        if raw:
+            st = json.loads(raw)
+            self.height = st["height"]
+            self.size = st["size"]
+            self.app_hash = bytes.fromhex(st["app_hash"])
+            self._validators = st.get("validators", {})
+        else:
+            self.height = 0
+            self.size = 0
+            self.app_hash = b""
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _parse_tx(tx: bytes) -> tuple[bytes, bytes] | None:
+        if b"=" not in tx:
+            return None
+        k, _, v = tx.partition(b"=")
+        if not k:
+            return None
+        return k, v
+
+    @staticmethod
+    def _parse_validator_tx(tx: bytes) -> abci.ValidatorUpdate | None:
+        # val:<pubkey_hex>!<power>
+        body = tx[len(VALIDATOR_TX_PREFIX) :]
+        if b"!" not in body:
+            return None
+        pk_hex, _, power = body.partition(b"!")
+        try:
+            pk = bytes.fromhex(pk_hex.decode())
+            return abci.ValidatorUpdate("ed25519", pk, int(power))
+        except ValueError:
+            return None
+
+    def _compute_app_hash(self) -> bytes:
+        return struct.pack(">Q", self.size)
+
+    # -- Info/Query --------------------------------------------------------
+
+    def info(self, req):
+        with self._mtx:
+            return abci.ResponseInfo(
+                data=json.dumps({"size": self.size}),
+                version="kvstore-tpu/1",
+                app_version=1,
+                last_block_height=self.height,
+                last_block_app_hash=self.app_hash,
+            )
+
+    def query(self, req):
+        with self._mtx:
+            value = self.db.get(_KV_PREFIX + req.data)
+            return abci.ResponseQuery(
+                code=abci.OK,
+                key=req.data,
+                value=value or b"",
+                log="exists" if value is not None else "does not exist",
+                height=self.height,
+            )
+
+    # -- Mempool -----------------------------------------------------------
+
+    def check_tx(self, req):
+        tx = req.tx
+        if tx.startswith(VALIDATOR_TX_PREFIX):
+            ok = self._parse_validator_tx(tx) is not None
+        else:
+            ok = self._parse_tx(tx) is not None
+        if ok:
+            return abci.ResponseCheckTx(code=abci.OK, gas_wanted=1)
+        return abci.ResponseCheckTx(code=1, log="invalid tx format")
+
+    # -- Consensus ---------------------------------------------------------
+
+    def init_chain(self, req):
+        with self._mtx:
+            for vu in req.validators:
+                self._validators[vu.pub_key_bytes.hex()] = vu.power
+        return abci.ResponseInitChain(app_hash=self._compute_app_hash())
+
+    def process_proposal(self, req):
+        for tx in req.txs:
+            bad_val = tx.startswith(VALIDATOR_TX_PREFIX) and (
+                self._parse_validator_tx(tx) is None
+            )
+            if bad_val or (
+                not tx.startswith(VALIDATOR_TX_PREFIX)
+                and self._parse_tx(tx) is None
+            ):
+                return abci.ResponseProcessProposal(
+                    status=abci.ProcessProposalStatus.REJECT
+                )
+        return abci.ResponseProcessProposal(
+            status=abci.ProcessProposalStatus.ACCEPT
+        )
+
+    def finalize_block(self, req):
+        with self._mtx:
+            self._staged = {}
+            self._val_updates = []
+            results = []
+            for tx in req.txs:
+                if tx.startswith(VALIDATOR_TX_PREFIX):
+                    vu = self._parse_validator_tx(tx)
+                    if vu is None:
+                        results.append(
+                            abci.ExecTxResult(code=1, log="bad val tx")
+                        )
+                        continue
+                    self._val_updates.append(vu)
+                    self._validators[vu.pub_key_bytes.hex()] = vu.power
+                    results.append(abci.ExecTxResult(code=abci.OK))
+                    continue
+                parsed = self._parse_tx(tx)
+                if parsed is None:
+                    results.append(abci.ExecTxResult(code=1, log="bad tx"))
+                    continue
+                k, v = parsed
+                self._staged[k] = v
+                self.size += 1
+                results.append(
+                    abci.ExecTxResult(
+                        code=abci.OK,
+                        events=[
+                            abci.Event(
+                                "app",
+                                [
+                                    abci.EventAttribute(
+                                        "key", k.decode(errors="replace"), True
+                                    ),
+                                    abci.EventAttribute("creator", "kvstore"),
+                                ],
+                            )
+                        ],
+                    )
+                )
+            self.height = req.height
+            self.app_hash = self._compute_app_hash()
+            return abci.ResponseFinalizeBlock(
+                tx_results=results,
+                validator_updates=list(self._val_updates),
+                app_hash=self.app_hash,
+            )
+
+    def commit(self, req=None):
+        with self._mtx:
+            batch = self.db.new_batch()
+            for k, v in self._staged.items():
+                batch.set(_KV_PREFIX + k, v)
+            batch.set(
+                _STATE_KEY,
+                json.dumps(
+                    {
+                        "height": self.height,
+                        "size": self.size,
+                        "app_hash": self.app_hash.hex(),
+                        "validators": self._validators,
+                    }
+                ).encode(),
+            )
+            batch.write()
+            self._staged = {}
+            retain = self.height - 500 if self.height > 500 else 0
+            return abci.ResponseCommit(retain_height=max(retain, 0))
+
+    # -- Snapshots (whole state in one chunk) ------------------------------
+
+    def list_snapshots(self, req):
+        with self._mtx:
+            if self.height == 0:
+                return abci.ResponseListSnapshots()
+            return abci.ResponseListSnapshots(
+                snapshots=[
+                    abci.Snapshot(
+                        height=self.height,
+                        format=1,
+                        chunks=1,
+                        hash=self.app_hash,
+                    )
+                ]
+            )
+
+    def load_snapshot_chunk(self, req):
+        with self._mtx:
+            kvs = {
+                k[len(_KV_PREFIX) :].hex(): v.hex()
+                for k, v in self.db.iterator(
+                    _KV_PREFIX, dbm.prefix_end(_KV_PREFIX)
+                )
+            }
+            blob = json.dumps(
+                {
+                    "height": self.height,
+                    "size": self.size,
+                    "validators": self._validators,
+                    "kvs": kvs,
+                }
+            ).encode()
+            return abci.ResponseLoadSnapshotChunk(chunk=blob)
+
+    def offer_snapshot(self, req):
+        if req.snapshot.format != 1 or req.snapshot.chunks != 1:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OfferSnapshotResult.REJECT_FORMAT
+            )
+        self._restore_target = req.snapshot
+        return abci.ResponseOfferSnapshot(
+            result=abci.OfferSnapshotResult.ACCEPT
+        )
+
+    def apply_snapshot_chunk(self, req):
+        st = json.loads(req.chunk)
+        with self._mtx:
+            batch = self.db.new_batch()
+            for k_hex, v_hex in st["kvs"].items():
+                batch.set(_KV_PREFIX + bytes.fromhex(k_hex), bytes.fromhex(v_hex))
+            self.height = st["height"]
+            self.size = st["size"]
+            self._validators = st["validators"]
+            self.app_hash = self._compute_app_hash()
+            batch.set(
+                _STATE_KEY,
+                json.dumps(
+                    {
+                        "height": self.height,
+                        "size": self.size,
+                        "app_hash": self.app_hash.hex(),
+                        "validators": self._validators,
+                    }
+                ).encode(),
+            )
+            batch.write()
+        return abci.ResponseApplySnapshotChunk(
+            result=abci.ApplySnapshotChunkResult.ACCEPT
+        )

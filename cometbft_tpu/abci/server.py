@@ -1,0 +1,122 @@
+"""ABCI socket server (reference: abci/server/socket_server.go:335).
+
+Serves one ``Application`` to any number of node connections over TCP or
+unix sockets. Per-connection reader thread handles requests strictly in
+order (the ABCI protocol is FIFO; responses are matched positionally by
+the client) and writes each response immediately — ``flush`` is a no-op
+acknowledgement frame retained for protocol compatibility.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+from ..libs.service import BaseService
+from . import codec
+from .application import Application
+
+
+def _parse_addr(addr: str) -> tuple[str, object]:
+    """'tcp://host:port' or 'unix:///path' → (family, bind target)."""
+    if addr.startswith("unix://"):
+        return "unix", addr[len("unix://") :]
+    if addr.startswith("tcp://"):
+        host, _, port = addr[len("tcp://") :].rpartition(":")
+        return "tcp", (host or "127.0.0.1", int(port))
+    raise ValueError(f"unsupported ABCI address {addr!r}")
+
+
+class SocketServer(BaseService):
+    def __init__(self, addr: str, app: Application):
+        super().__init__("abci-socket-server")
+        self.addr = addr
+        self.app = app
+        self._app_mtx = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._conns: list[socket.socket] = []
+        self._threads: list[threading.Thread] = []
+
+    def on_start(self) -> None:
+        family, target = _parse_addr(self.addr)
+        if family == "unix":
+            if os.path.exists(target):
+                os.unlink(target)  # stale socket from a previous run
+            self._listener = socket.socket(socket.AF_UNIX)
+        else:
+            self._listener = socket.socket(socket.AF_INET)
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+            )
+        self._listener.bind(target)
+        self._listener.listen(8)
+        t = threading.Thread(
+            target=self._accept_loop, name="abci-accept", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+
+    @property
+    def bound_addr(self) -> str:
+        """Actual address after bind (useful with tcp port 0 in tests)."""
+        family, _ = _parse_addr(self.addr)
+        if family == "unix":
+            return self.addr
+        host, port = self._listener.getsockname()
+        return f"tcp://{host}:{port}"
+
+    def _accept_loop(self) -> None:
+        while not self.quit_event().is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        try:
+            while True:
+                frame = codec.read_frame(rfile)
+                if frame is None:
+                    return
+                method, req = frame
+                if method == "echo":
+                    res = req
+                elif method == "flush":
+                    res = None
+                else:
+                    with self._app_mtx:
+                        res = getattr(self.app, method)(req)
+                wfile.write(codec.encode_frame(method, res))
+                wfile.flush()
+        except (EOFError, OSError, BrokenPipeError):
+            return
+        finally:
+            conn.close()
+
+    def on_stop(self) -> None:
+        if self._listener:
+            self._listener.close()
+        family, target = _parse_addr(self.addr)
+        if family == "unix" and os.path.exists(target):
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+        for c in self._conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -1,0 +1,190 @@
+"""ABCI socket client (reference: abci/client/socket_client.go:515).
+
+A send thread drains a request queue; a recv thread matches responses to
+in-flight ``ReqRes`` entries in FIFO order (the protocol guarantee).
+Sync methods enqueue + wait. A transport error completes all in-flight
+requests with an error and stops the client — the proxy layer then kills
+the node (proxy/multi_app_conn.go:129 semantics).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+
+from . import codec
+from . import types as abci
+from .client import Client, ReqRes
+from .server import _parse_addr
+
+
+class SocketClientError(Exception):
+    pass
+
+
+class SocketClient(Client):
+    def __init__(self, addr: str, timeout: float = 10.0):
+        super().__init__("abci-socket-client")
+        self.addr = addr
+        self.timeout = timeout
+        self._sock: socket.socket | None = None
+        self._wfile = None
+        self._rfile = None
+        self._send_q: queue.Queue[ReqRes | None] = queue.Queue()
+        self._inflight: queue.Queue[ReqRes] = queue.Queue()
+        # Guards the (_inflight, _send_q) enqueue pair: both queues must see
+        # requests in the same order or FIFO response matching breaks.
+        self._queue_mtx = threading.Lock()
+
+    def on_start(self) -> None:
+        family, target = _parse_addr(self.addr)
+        if family == "unix":
+            self._sock = socket.socket(socket.AF_UNIX)
+        else:
+            self._sock = socket.socket(socket.AF_INET)
+            self._sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+            )
+        self._sock.connect(target)
+        self._wfile = self._sock.makefile("wb")
+        self._rfile = self._sock.makefile("rb")
+        threading.Thread(
+            target=self._send_loop, name="abci-send", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._recv_loop, name="abci-recv", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        self._send_q.put(None)
+        if self._sock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- io loops ----------------------------------------------------------
+
+    def _send_loop(self) -> None:
+        while True:
+            rr = self._send_q.get()
+            if rr is None:
+                return
+            try:
+                self._wfile.write(codec.encode_frame(rr.method, rr.request))
+                self._wfile.flush()
+            except (OSError, ValueError) as e:
+                self._fail(e)
+                return
+
+    def _recv_loop(self) -> None:
+        while True:
+            try:
+                frame = codec.read_frame(self._rfile)
+            except (OSError, EOFError, ValueError) as e:
+                self._fail(e)
+                return
+            if frame is None:
+                if not self.quit_event().is_set():
+                    self._fail(EOFError("server closed ABCI connection"))
+                return
+            method, res = frame
+            try:
+                rr = self._inflight.get_nowait()
+            except queue.Empty:
+                self._fail(SocketClientError(f"unsolicited {method} response"))
+                return
+            if rr.method != method:
+                self._fail(
+                    SocketClientError(
+                        f"response order mismatch: want {rr.method}, got {method}"
+                    )
+                )
+                return
+            rr._complete(res)
+            if self._global_cb and rr.method == "check_tx":
+                self._global_cb(rr.request, res)
+
+    def _fail(self, err: Exception) -> None:
+        self._err = err
+        while True:
+            try:
+                rr = self._inflight.get_nowait()
+            except queue.Empty:
+                break
+            rr._complete_error(err)
+        if self.is_running():
+            try:
+                self.stop()
+            except Exception:
+                pass
+        if self._on_error is not None:
+            self._on_error(err)
+
+    # -- request plumbing --------------------------------------------------
+
+    def _queue(self, method: str, req) -> ReqRes:
+        if self._err is not None:
+            raise SocketClientError(f"client in error state: {self._err}")
+        rr = ReqRes(method, req)
+        with self._queue_mtx:
+            self._inflight.put(rr)
+            self._send_q.put(rr)
+        return rr
+
+    def _sync(self, method: str, req):
+        return self._queue(method, req).wait(self.timeout)
+
+    # -- API ---------------------------------------------------------------
+
+    def echo(self, msg: str) -> str:
+        return self._sync("echo", msg)
+
+    def flush(self) -> None:
+        self._sync("flush", None)
+
+    def info(self, req):
+        return self._sync("info", req)
+
+    def query(self, req):
+        return self._sync("query", req)
+
+    def check_tx(self, req):
+        return self._sync("check_tx", req)
+
+    def check_tx_async(self, req) -> ReqRes:
+        return self._queue("check_tx", req)
+
+    def init_chain(self, req):
+        return self._sync("init_chain", req)
+
+    def prepare_proposal(self, req):
+        return self._sync("prepare_proposal", req)
+
+    def process_proposal(self, req):
+        return self._sync("process_proposal", req)
+
+    def finalize_block(self, req):
+        return self._sync("finalize_block", req)
+
+    def extend_vote(self, req):
+        return self._sync("extend_vote", req)
+
+    def verify_vote_extension(self, req):
+        return self._sync("verify_vote_extension", req)
+
+    def commit(self, req=None):
+        return self._sync("commit", req or abci.RequestCommit())
+
+    def list_snapshots(self, req):
+        return self._sync("list_snapshots", req)
+
+    def offer_snapshot(self, req):
+        return self._sync("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req):
+        return self._sync("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req):
+        return self._sync("apply_snapshot_chunk", req)

@@ -132,9 +132,23 @@ class MemDB(DB):
         yield from snap
 
 
+def prefix_end(prefix: bytes) -> bytes | None:
+    """Smallest key greater than every key with the given prefix, or None
+    if no such key exists (prefix is all 0xff). For prefix iteration:
+    ``db.iterator(p, prefix_end(p))`` covers exactly the keys under ``p``.
+    """
+    p = bytearray(prefix)
+    while p and p[-1] == 0xFF:
+        p.pop()
+    if not p:
+        return None
+    p[-1] += 1
+    return bytes(p)
+
+
 # FileDB record framing: u8 op | u32 klen | u32 vlen | key | value
 _HDR = struct.Struct("<BII")
-_OP_SET, _OP_DEL = 1, 2
+_OP_SET, _OP_DEL, _OP_BATCH = 1, 2, 3
 
 
 class FileDB(MemDB):
@@ -144,7 +158,9 @@ class FileDB(MemDB):
     when the log grows past ``compact_factor`` × live size) rewrites the log
     to just the live records. A torn final record (crash mid-append) is
     truncated on open — the same recover-to-last-good-record posture the
-    reference's WAL takes (consensus/wal.go).
+    reference's WAL takes (consensus/wal.go). Batches are one BATCH record
+    (sub-records nested in its value), so a batch is atomic under crash:
+    either the whole record replays or the torn tail is dropped.
     """
 
     def __init__(self, path: str, compact_factor: int = 4):
@@ -167,13 +183,27 @@ class FileDB(MemDB):
                     break
                 op, klen, vlen = _HDR.unpack(hdr)
                 body = f.read(klen + vlen)
-                if len(body) < klen + vlen or op not in (_OP_SET, _OP_DEL):
+                if len(body) < klen + vlen or op not in (
+                    _OP_SET,
+                    _OP_DEL,
+                    _OP_BATCH,
+                ):
                     break
                 key, value = body[:klen], body[klen:]
                 if op == _OP_SET:
                     super().set(key, value)
-                else:
+                elif op == _OP_DEL:
                     super().delete(key)
+                else:
+                    try:
+                        sub = self._decode_batch(value)
+                    except ValueError:
+                        break
+                    for is_set, k, v in sub:
+                        if is_set:
+                            super().set(k, v)
+                        else:
+                            super().delete(k)
                 good = f.tell()
         size = os.path.getsize(self._path)
         if size > good:
@@ -192,36 +222,66 @@ class FileDB(MemDB):
         if sync:
             os.fsync(self._f.fileno())
 
+    def _account(self, key: bytes, new_value: bytes | None) -> None:
+        """Update the live-size estimate across an overwrite or delete.
+        Must run BEFORE the in-memory update (needs the old value)."""
+        old = self._data.get(key)
+        if old is not None:
+            self._live_bytes -= _HDR.size + len(key) + len(old)
+        if new_value is not None:
+            self._live_bytes += _HDR.size + len(key) + len(new_value)
+
+    def _set_locked(self, key: bytes, value: bytes, sync: bool) -> None:
+        self._account(key, value)
+        super().set(key, value)
+        self._append(_OP_SET, key, value, sync=sync)
+        self._maybe_compact()
+
     def set(self, key: bytes, value: bytes) -> None:
-        key, value = bytes(key), bytes(value)
         with self._mtx:
-            super().set(key, value)
-            self._append(_OP_SET, key, value, sync=False)
-            self._live_bytes += _HDR.size + len(key) + len(value)
-            self._maybe_compact()
+            self._set_locked(bytes(key), bytes(value), sync=False)
 
     def set_sync(self, key: bytes, value: bytes) -> None:
-        key, value = bytes(key), bytes(value)
         with self._mtx:
-            super().set(key, value)
-            self._append(_OP_SET, key, value, sync=True)
-            self._live_bytes += _HDR.size + len(key) + len(value)
-            self._maybe_compact()
+            self._set_locked(bytes(key), bytes(value), sync=True)
 
     def delete(self, key: bytes) -> None:
         key = bytes(key)
         with self._mtx:
+            self._account(key, None)
             super().delete(key)
             self._append(_OP_DEL, key, b"", sync=False)
 
+    @staticmethod
+    def _decode_batch(blob: bytes) -> list[tuple[bool, bytes, bytes]]:
+        ops, pos = [], 0
+        while pos < len(blob):
+            if pos + _HDR.size > len(blob):
+                raise ValueError("truncated batch sub-record")
+            op, klen, vlen = _HDR.unpack_from(blob, pos)
+            pos += _HDR.size
+            if pos + klen + vlen > len(blob) or op not in (_OP_SET, _OP_DEL):
+                raise ValueError("corrupt batch sub-record")
+            ops.append(
+                (op == _OP_SET, blob[pos : pos + klen], blob[pos + klen : pos + klen + vlen])
+            )
+            pos += klen + vlen
+        return ops
+
     def apply_batch(self, ops: list[tuple[bool, bytes, bytes]]) -> None:
+        blob = b"".join(
+            _HDR.pack(_OP_SET if is_set else _OP_DEL, len(k), len(v)) + k + v
+            for is_set, k, v in ops
+        )
         with self._mtx:
             for is_set, k, v in ops:
+                self._account(k, v if is_set else None)
                 if is_set:
-                    self.set(k, v)
+                    MemDB.set(self, k, v)
                 else:
-                    self.delete(k)
-            os.fsync(self._f.fileno())
+                    MemDB.delete(self, k)
+            self._append(_OP_BATCH, b"", blob, sync=True)
+            self._maybe_compact()
 
     def _maybe_compact(self) -> None:
         log_size = self._f.tell()

@@ -56,6 +56,8 @@ _TOKEN_RE = re.compile(
       | (?P<timeword>TIME\b|DATE\b)
       | (?P<op><=|>=|=|<|>)
       | (?P<string>'[^']*')
+      | (?P<rfc3339>\d{4}-\d{2}-\d{2}
+           (?:T\d{2}:\d{2}:\d{2}(?:\.\d+)?(?:Z|[+-]\d{2}:\d{2})?)?)
       | (?P<number>-?\d+(?:\.\d+)?)
       | (?P<key>[A-Za-z_][A-Za-z0-9_.-]*)
     )""",
@@ -140,9 +142,11 @@ class Query:
                 op = val
                 i += 1
                 if i < len(tokens) and tokens[i][0] == "timeword":
-                    i += 1  # TIME/DATE prefix: operand is an RFC3339 key token
-                    if i >= len(tokens) or tokens[i][0] not in ("key", "number"):
-                        raise QuerySyntaxError("TIME/DATE needs a literal")
+                    # TIME/DATE prefix: RFC3339 literal, compared as a
+                    # string (RFC3339 sorts chronologically).
+                    i += 1
+                    if i >= len(tokens) or tokens[i][0] != "rfc3339":
+                        raise QuerySyntaxError("TIME/DATE needs an RFC3339 literal")
                     conds.append(Condition(key, op, tokens[i][1], is_number=False))
                     i += 1
                 elif i < len(tokens) and tokens[i][0] == "string":

@@ -1,0 +1,252 @@
+"""ABCI layer tests: kvstore app semantics, local client, socket
+client/server round-trip, proxy AppConns."""
+
+import threading
+
+import pytest
+
+from cometbft_tpu import proxy
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.server import SocketServer
+from cometbft_tpu.abci.socket_client import SocketClient
+from cometbft_tpu.libs import db as dbm
+
+
+def _finalize(app, height, txs):
+    return app.finalize_block(
+        abci.RequestFinalizeBlock(
+            txs=txs,
+            decided_last_commit=abci.CommitInfo(round=0),
+            misbehavior=[],
+            hash=b"\x01" * 32,
+            height=height,
+            time_ns=0,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+
+
+# -- kvstore ---------------------------------------------------------------
+
+
+def test_kvstore_check_tx():
+    app = KVStoreApplication()
+    assert app.check_tx(abci.RequestCheckTx(tx=b"a=1")).is_ok
+    assert not app.check_tx(abci.RequestCheckTx(tx=b"no-equals")).is_ok
+    assert app.check_tx(abci.RequestCheckTx(tx=b"val:" + b"aa" * 32 + b"!5")).is_ok
+    assert not app.check_tx(abci.RequestCheckTx(tx=b"val:zz!5")).is_ok
+
+
+def test_kvstore_finalize_commit_query():
+    app = KVStoreApplication()
+    res = _finalize(app, 1, [b"name=satoshi", b"bad"])
+    assert res.tx_results[0].is_ok
+    assert not res.tx_results[1].is_ok
+    assert res.app_hash != b""
+    app.commit()
+    q = app.query(abci.RequestQuery(data=b"name"))
+    assert q.value == b"satoshi"
+    q = app.query(abci.RequestQuery(data=b"missing"))
+    assert q.value == b""
+
+
+def test_kvstore_app_hash_tracks_size():
+    app = KVStoreApplication()
+    h1 = _finalize(app, 1, [b"a=1"]).app_hash
+    app.commit()
+    h2 = _finalize(app, 2, [b"b=2"]).app_hash
+    app.commit()
+    assert h1 != h2  # size advanced
+
+
+def test_kvstore_validator_updates():
+    app = KVStoreApplication()
+    pk = b"\xaa" * 32
+    res = _finalize(app, 1, [b"val:" + pk.hex().encode() + b"!7"])
+    assert len(res.validator_updates) == 1
+    vu = res.validator_updates[0]
+    assert (vu.pub_key_bytes, vu.power) == (pk, 7)
+
+
+def test_kvstore_persistence_and_handshake_info(tmp_path):
+    db = dbm.FileDB(str(tmp_path / "app.db"))
+    app = KVStoreApplication(db)
+    _finalize(app, 1, [b"k=v"])
+    app.commit()
+    db.close()
+
+    db2 = dbm.FileDB(str(tmp_path / "app.db"))
+    app2 = KVStoreApplication(db2)
+    info = app2.info(abci.RequestInfo())
+    assert info.last_block_height == 1
+    assert info.last_block_app_hash == app.app_hash
+    assert app2.query(abci.RequestQuery(data=b"k")).value == b"v"
+    db2.close()
+
+
+def test_kvstore_snapshot_roundtrip():
+    src = KVStoreApplication()
+    _finalize(src, 1, [b"x=1", b"y=2"])
+    src.commit()
+    snaps = src.list_snapshots(abci.RequestListSnapshots()).snapshots
+    assert len(snaps) == 1
+    chunk = src.load_snapshot_chunk(
+        abci.RequestLoadSnapshotChunk(height=1, format=1, chunk=0)
+    ).chunk
+
+    dst = KVStoreApplication()
+    offer = dst.offer_snapshot(
+        abci.RequestOfferSnapshot(snapshot=snaps[0], app_hash=src.app_hash)
+    )
+    assert offer.result == abci.OfferSnapshotResult.ACCEPT
+    res = dst.apply_snapshot_chunk(
+        abci.RequestApplySnapshotChunk(index=0, chunk=chunk)
+    )
+    assert res.result == abci.ApplySnapshotChunkResult.ACCEPT
+    assert dst.app_hash == src.app_hash
+    assert dst.query(abci.RequestQuery(data=b"y")).value == b"2"
+
+
+# -- local client ----------------------------------------------------------
+
+
+def test_local_client_sync_and_async():
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    got = []
+    client.set_response_callback(lambda req, res: got.append((req, res)))
+    res = client.check_tx(abci.RequestCheckTx(tx=b"a=1"))
+    assert res.is_ok
+    rr = client.check_tx_async(abci.RequestCheckTx(tx=b"b=2"))
+    assert rr.wait(1).is_ok
+    assert len(got) == 1  # only the async path fires the global callback
+    client.stop()
+
+
+# -- socket client/server --------------------------------------------------
+
+
+@pytest.fixture
+def socket_pair(tmp_path):
+    app = KVStoreApplication()
+    server = SocketServer("unix://" + str(tmp_path / "abci.sock"), app)
+    server.start()
+    client = SocketClient(server.bound_addr, timeout=5)
+    client.start()
+    yield app, client
+    client.stop()
+    server.stop()
+
+
+def test_socket_roundtrip(socket_pair):
+    app, client = socket_pair
+    assert client.echo("hello") == "hello"
+    client.flush()
+    info = client.info(abci.RequestInfo(version="x"))
+    assert info.last_block_height == 0
+
+    res = client.finalize_block(
+        abci.RequestFinalizeBlock(
+            txs=[b"a=1"],
+            decided_last_commit=abci.CommitInfo(round=0),
+            misbehavior=[],
+            hash=b"\x02" * 32,
+            height=1,
+            time_ns=123,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+    assert res.tx_results[0].is_ok
+    assert res.app_hash == app.app_hash
+    client.commit()
+    assert client.query(abci.RequestQuery(data=b"a")).value == b"1"
+
+
+def test_socket_async_check_tx_callbacks(socket_pair):
+    _, client = socket_pair
+    got = []
+    done = threading.Event()
+
+    def cb(req, res):
+        got.append(res)
+        if len(got) == 3:
+            done.set()
+
+    client.set_response_callback(cb)
+    for tx in (b"a=1", b"b=2", b"not-a-tx"):
+        client.check_tx_async(abci.RequestCheckTx(tx=tx))
+    assert done.wait(5)
+    assert [r.is_ok for r in got] == [True, True, False]
+
+
+def test_socket_error_on_server_death(tmp_path):
+    app = KVStoreApplication()
+    server = SocketServer("unix://" + str(tmp_path / "die.sock"), app)
+    server.start()
+    client = SocketClient(server.bound_addr, timeout=2)
+    client.start()
+    assert client.echo("ping") == "ping"
+    server.stop()
+    with pytest.raises(Exception):
+        for _ in range(10):
+            client.echo("dead")
+
+
+# -- proxy -----------------------------------------------------------------
+
+
+def test_proxy_four_connections():
+    app = KVStoreApplication()
+    conns = proxy.AppConns(proxy.local_client_creator(app))
+    conns.start()
+    assert all(
+        c is not None and c.is_running()
+        for c in (conns.consensus, conns.mempool, conns.query, conns.snapshot)
+    )
+    # mempool + consensus reach the same app state
+    conns.mempool.check_tx(abci.RequestCheckTx(tx=b"a=1"))
+    res = conns.consensus.finalize_block(
+        abci.RequestFinalizeBlock(
+            txs=[b"a=1"],
+            decided_last_commit=abci.CommitInfo(round=0),
+            misbehavior=[],
+            hash=b"\x03" * 32,
+            height=1,
+            time_ns=0,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+    conns.consensus.commit()
+    assert res.app_hash == app.app_hash
+    assert conns.query.query(abci.RequestQuery(data=b"a")).value == b"1"
+    conns.stop()
+    assert not conns.consensus.is_running()
+
+
+def test_socket_server_restart_same_unix_addr(tmp_path):
+    addr = "unix://" + str(tmp_path / "reuse.sock")
+    for _ in range(2):
+        s = SocketServer(addr, KVStoreApplication())
+        s.start()
+        c = SocketClient(addr, timeout=2)
+        c.start()
+        assert c.echo("x") == "x"
+        c.stop()
+        s.stop()
+
+
+def test_kvstore_snapshot_includes_high_byte_keys():
+    src = KVStoreApplication()
+    _finalize(src, 1, [b"\xff\x01=edge"])
+    src.commit()
+    chunk = src.load_snapshot_chunk(
+        abci.RequestLoadSnapshotChunk(height=1, format=1, chunk=0)
+    ).chunk
+    dst = KVStoreApplication()
+    dst.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(index=0, chunk=chunk))
+    assert dst.query(abci.RequestQuery(data=b"\xff\x01")).value == b"edge"

@@ -284,3 +284,56 @@ def test_autofile_group_size_eviction(tmp_path):
     total = sum(os.path.getsize(p) for p in paths if os.path.exists(p))
     assert total <= 200 + 64  # bounded by limit (+ one head write)
     g.close()
+
+
+# -- regressions from code review ------------------------------------------
+
+
+def test_query_time_date_literals():
+    q = pubsub.Query.parse("block.time >= TIME 2023-05-03T14:45:00Z")
+    assert q.matches({"block.time": ["2024-01-01T00:00:00Z"]})
+    assert not q.matches({"block.time": ["2022-01-01T00:00:00Z"]})
+    q = pubsub.Query.parse("block.date = DATE 2023-05-03")
+    assert q.matches({"block.date": ["2023-05-03"]})
+
+
+def test_filedb_overwrite_compaction(tmp_path):
+    # Overwriting one key must not inflate the live-size estimate
+    # (else auto-compaction never fires and the log grows unbounded).
+    path = str(tmp_path / "ow.db")
+    d = db.FileDB(path)
+    for _ in range(300):
+        d.set(b"state", b"x" * 512)
+    assert os.path.getsize(path) < 300 * 512  # auto-compaction kicked in
+    assert d.get(b"state") == b"x" * 512
+    d.close()
+
+
+def test_filedb_batch_atomic_under_torn_tail(tmp_path):
+    path = str(tmp_path / "batch.db")
+    d = db.FileDB(path)
+    b = d.new_batch()
+    b.set(b"k1", b"v1")
+    b.set(b"k2", b"v2")
+    b.write()
+    size_after_batch = os.path.getsize(path)
+    d.close()
+    # Simulate a crash mid-batch-append: truncate into the batch record.
+    with open(path, "r+b") as f:
+        f.truncate(size_after_batch - 3)
+    d2 = db.FileDB(path)
+    # The whole batch is gone — not half of it.
+    assert d2.get(b"k1") is None and d2.get(b"k2") is None
+    d2.close()
+
+
+def test_prefix_end():
+    assert db.prefix_end(b"abc") == b"abd"
+    assert db.prefix_end(b"a\xff") == b"b"
+    assert db.prefix_end(b"\xff\xff") is None
+    d = db.MemDB()
+    d.set(b"p:\xff\x01", b"edge")
+    d.set(b"p:a", b"x")
+    d.set(b"q", b"other")
+    keys = [k for k, _ in d.iterator(b"p:", db.prefix_end(b"p:"))]
+    assert keys == [b"p:a", b"p:\xff\x01"]
