@@ -75,6 +75,11 @@ def encode_frame(method: str, msg) -> bytes:
     return proto.delimited(payload)
 
 
+# Frames beyond this are protocol corruption or abuse, not real traffic
+# (the reference caps reads the same way — libs/protoio reader limit).
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+
 def read_frame(sock_file) -> tuple[str, object] | None:
     """Read one frame from a file-like socket; None on clean EOF."""
     # uvarint length prefix, byte at a time
@@ -90,6 +95,8 @@ def read_frame(sock_file) -> tuple[str, object] | None:
         shift += 7
         if shift > 35:
             raise ValueError("frame length uvarint overflow")
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"frame of {length} bytes exceeds limit")
     payload = b""
     while len(payload) < length:
         chunk = sock_file.read(length - len(payload))

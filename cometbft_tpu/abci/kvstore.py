@@ -177,22 +177,25 @@ class KVStoreApplication(BaseApplication):
                 app_hash=self.app_hash,
             )
 
+    def _stage_state(self, batch) -> None:
+        batch.set(
+            _STATE_KEY,
+            json.dumps(
+                {
+                    "height": self.height,
+                    "size": self.size,
+                    "app_hash": self.app_hash.hex(),
+                    "validators": self._validators,
+                }
+            ).encode(),
+        )
+
     def commit(self, req=None):
         with self._mtx:
             batch = self.db.new_batch()
             for k, v in self._staged.items():
                 batch.set(_KV_PREFIX + k, v)
-            batch.set(
-                _STATE_KEY,
-                json.dumps(
-                    {
-                        "height": self.height,
-                        "size": self.size,
-                        "app_hash": self.app_hash.hex(),
-                        "validators": self._validators,
-                    }
-                ).encode(),
-            )
+            self._stage_state(batch)
             batch.write()
             self._staged = {}
             retain = self.height - 500 if self.height > 500 else 0
@@ -253,17 +256,7 @@ class KVStoreApplication(BaseApplication):
             self.size = st["size"]
             self._validators = st["validators"]
             self.app_hash = self._compute_app_hash()
-            batch.set(
-                _STATE_KEY,
-                json.dumps(
-                    {
-                        "height": self.height,
-                        "size": self.size,
-                        "app_hash": self.app_hash.hex(),
-                        "validators": self._validators,
-                    }
-                ).encode(),
-            )
+            self._stage_state(batch)
             batch.write()
         return abci.ResponseApplySnapshotChunk(
             result=abci.ApplySnapshotChunkResult.ACCEPT

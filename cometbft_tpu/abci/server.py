@@ -79,6 +79,17 @@ class SocketServer(BaseService):
             t.start()
             self._threads.append(t)
 
+    # The 14 ABCI methods + protocol control frames; nothing else is
+    # reachable over the wire (socket_server.go handleRequest's oneof).
+    _METHODS = frozenset(
+        {
+            "info", "query", "check_tx", "init_chain", "prepare_proposal",
+            "process_proposal", "finalize_block", "extend_vote",
+            "verify_vote_extension", "commit", "list_snapshots",
+            "offer_snapshot", "load_snapshot_chunk", "apply_snapshot_chunk",
+        }
+    )
+
     def _serve_conn(self, conn: socket.socket) -> None:
         rfile = conn.makefile("rb")
         wfile = conn.makefile("wb")
@@ -89,15 +100,21 @@ class SocketServer(BaseService):
                     return
                 method, req = frame
                 if method == "echo":
-                    res = req
+                    method_out, res = method, req
                 elif method == "flush":
-                    res = None
+                    method_out, res = method, None
+                elif method not in self._METHODS:
+                    method_out, res = "exception", f"unknown method {method!r}"
                 else:
-                    with self._app_mtx:
-                        res = getattr(self.app, method)(req)
-                wfile.write(codec.encode_frame(method, res))
+                    try:
+                        with self._app_mtx:
+                            res = getattr(self.app, method)(req)
+                        method_out = method
+                    except Exception as e:  # app bug: report, keep serving
+                        method_out, res = "exception", f"{method}: {e!r}"
+                wfile.write(codec.encode_frame(method_out, res))
                 wfile.flush()
-        except (EOFError, OSError, BrokenPipeError):
+        except (EOFError, OSError, ValueError, BrokenPipeError):
             return
         finally:
             conn.close()

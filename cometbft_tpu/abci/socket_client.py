@@ -90,6 +90,16 @@ class SocketClient(Client):
                     self._fail(EOFError("server closed ABCI connection"))
                 return
             method, res = frame
+            if method == "exception":
+                # Application-level failure: fatal, like the reference's
+                # ResponseException handling (socket_client.go).
+                err = SocketClientError(str(res))
+                try:
+                    self._inflight.get_nowait()._complete_error(err)
+                except queue.Empty:
+                    pass
+                self._fail(err)
+                return
             try:
                 rr = self._inflight.get_nowait()
             except queue.Empty:
@@ -107,13 +117,21 @@ class SocketClient(Client):
                 self._global_cb(rr.request, res)
 
     def _fail(self, err: Exception) -> None:
-        self._err = err
-        while True:
-            try:
-                rr = self._inflight.get_nowait()
-            except queue.Empty:
-                break
+        # During an orderly stop the dying socket raises in the io loops;
+        # that is not a transport failure — don't fail-stop the node.
+        closing = self.quit_event().is_set()
+        with self._queue_mtx:
+            self._err = err
+            pending = []
+            while True:
+                try:
+                    pending.append(self._inflight.get_nowait())
+                except queue.Empty:
+                    break
+        for rr in pending:
             rr._complete_error(err)
+        if closing:
+            return
         if self.is_running():
             try:
                 self.stop()
@@ -125,10 +143,10 @@ class SocketClient(Client):
     # -- request plumbing --------------------------------------------------
 
     def _queue(self, method: str, req) -> ReqRes:
-        if self._err is not None:
-            raise SocketClientError(f"client in error state: {self._err}")
         rr = ReqRes(method, req)
         with self._queue_mtx:
+            if self._err is not None:
+                raise SocketClientError(f"client in error state: {self._err}")
             self._inflight.put(rr)
             self._send_q.put(rr)
         return rr

@@ -1,0 +1,379 @@
+"""Block execution — the consensus→application bridge (reference:
+state/execution.go:25-737).
+
+``BlockExecutor`` turns consensus decisions into application state:
+``create_proposal_block`` (reap mempool → ABCI PrepareProposal),
+``process_proposal``, ``apply_block`` (validate → FinalizeBlock → derive
+next State → Commit with the mempool locked → prune → fire events), and
+the vote-extension hooks.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..abci import types as abci
+from ..types import BlockID, ExtendedCommit
+from ..types.block import Block
+from ..types.event_bus import (
+    EventDataNewBlock,
+    EventDataNewBlockEvents,
+    EventDataNewBlockHeader,
+    EventDataTx,
+    EventDataValidatorSetUpdates,
+    NopEventBus,
+)
+from ..types.validator_set import Validator, ValidatorSet
+from ..crypto import keys as crypto_keys
+from .state import State, results_hash
+from .validation import BlockValidationError, validate_block
+
+
+class NopMempool:
+    """Placeholder until the mempool service lands (mempool/)."""
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        return []
+
+    def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    def update(self, height, txs, tx_results, *a, **k) -> None:
+        pass
+
+
+class NopEvidencePool:
+    def pending_evidence(self, max_bytes: int) -> list:
+        return []
+
+    def update(self, state, evidence_list) -> None:
+        pass
+
+    def check_evidence(self, evidence_list) -> None:
+        pass
+
+
+def _commit_info(block: Block, last_validators: ValidatorSet) -> abci.CommitInfo:
+    """ABCI view of the block's LastCommit (execution.go buildLastCommitInfo)."""
+    votes = []
+    if block.last_commit is not None and block.last_commit.size() > 0:
+        for i, cs in enumerate(block.last_commit.signatures):
+            val = last_validators.get_by_index(i)
+            votes.append(
+                abci.VoteInfo(
+                    validator=abci.Validator(
+                        address=val.address, power=val.voting_power
+                    ),
+                    block_id_flag=cs.block_id_flag,
+                )
+            )
+    return abci.CommitInfo(
+        round=block.last_commit.round if block.last_commit else 0, votes=votes
+    )
+
+
+def extended_commit_info(ec: ExtendedCommit, validators: ValidatorSet):
+    votes = []
+    for i, es in enumerate(ec.extended_signatures):
+        val = validators.get_by_index(i)
+        votes.append(
+            abci.ExtendedVoteInfo(
+                validator=abci.Validator(
+                    address=val.address, power=val.voting_power
+                ),
+                vote_extension=es.extension,
+                extension_signature=es.extension_signature,
+                block_id_flag=es.commit_sig.block_id_flag,
+            )
+        )
+    return abci.ExtendedCommitInfo(round=ec.round, votes=votes)
+
+
+def _abci_misbehavior(evidence_list, state: State) -> list[abci.Misbehavior]:
+    out = []
+    for ev in evidence_list or ():
+        try:
+            out.append(ev.abci(state))
+        except AttributeError:
+            pass
+    return out
+
+
+def validator_updates_to_validators(updates: list[abci.ValidatorUpdate]):
+    """ABCI ValidatorUpdate list → Validator list (power 0 = removal)."""
+    out = []
+    for vu in updates:
+        pk = crypto_keys.pubkey_from_type_and_bytes(
+            vu.pub_key_type, vu.pub_key_bytes
+        )
+        out.append(Validator(pub_key=pk, voting_power=vu.power))
+    return out
+
+
+class BlockExecutor:
+    def __init__(
+        self,
+        state_store,
+        proxy_app,  # consensus-connection ABCI client
+        mempool=None,
+        evidence_pool=None,
+        block_store=None,
+        event_bus=None,
+        metrics=None,
+    ):
+        self.state_store = state_store
+        self.proxy_app = proxy_app
+        self.mempool = mempool if mempool is not None else NopMempool()
+        self.evidence_pool = (
+            evidence_pool if evidence_pool is not None else NopEvidencePool()
+        )
+        self.block_store = block_store
+        self.event_bus = event_bus if event_bus is not None else NopEventBus()
+        self.metrics = metrics
+
+    # -- proposal ----------------------------------------------------------
+
+    def create_proposal_block(
+        self,
+        height: int,
+        state: State,
+        last_ext_commit: ExtendedCommit | None,
+        proposer_address: bytes,
+        time_ns: int | None = None,
+    ) -> Block:
+        """execution.go:101 CreateProposalBlock."""
+        max_bytes = state.consensus_params.block.max_bytes
+        max_gas = state.consensus_params.block.max_gas
+        evidence = self.evidence_pool.pending_evidence(
+            state.consensus_params.evidence.max_bytes
+        )
+        # Data budget: block max minus header/commit/evidence overhead
+        # (types.MaxDataBytes — approximated; parts cap enforces the rest).
+        max_data_bytes = (
+            max_bytes - 2048 if max_bytes > 0 else 104857600
+        )
+        txs = self.mempool.reap_max_bytes_max_gas(max_data_bytes, max_gas)
+        last_commit = (
+            last_ext_commit.to_commit()
+            if last_ext_commit is not None
+            else None
+        )
+        if time_ns is None:
+            time_ns = time.time_ns()
+        rpp = self.proxy_app.prepare_proposal(
+            abci.RequestPrepareProposal(
+                max_tx_bytes=max_data_bytes,
+                txs=list(txs),
+                local_last_commit=(
+                    extended_commit_info(last_ext_commit, state.last_validators)
+                    if last_ext_commit is not None and last_ext_commit.size()
+                    else abci.ExtendedCommitInfo(round=0)
+                ),
+                misbehavior=_abci_misbehavior(evidence, state),
+                height=height,
+                time_ns=time_ns,
+                next_validators_hash=state.next_validators.hash(),
+                proposer_address=proposer_address,
+            )
+        )
+        return state.make_block(
+            height, list(rpp.txs), last_commit, evidence, proposer_address,
+            time_ns,
+        )
+
+    def process_proposal(self, block: Block, state: State) -> bool:
+        """execution.go:162 ProcessProposal."""
+        resp = self.proxy_app.process_proposal(
+            abci.RequestProcessProposal(
+                txs=list(block.data.txs),
+                proposed_last_commit=_commit_info(block, state.last_validators),
+                misbehavior=_abci_misbehavior(block.evidence, state),
+                hash=block.hash(),
+                height=block.header.height,
+                time_ns=block.header.time_ns,
+                next_validators_hash=block.header.next_validators_hash,
+                proposer_address=block.header.proposer_address,
+            )
+        )
+        if resp.status == abci.ProcessProposalStatus.UNKNOWN:
+            raise RuntimeError("ProcessProposal returned UNKNOWN status")
+        return resp.is_accepted
+
+    # -- validation --------------------------------------------------------
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block)
+        self.evidence_pool.check_evidence(block.evidence)
+
+    # -- apply -------------------------------------------------------------
+
+    def apply_block(
+        self, state: State, block_id: BlockID, block: Block
+    ) -> State:
+        """execution.go:204 ApplyBlock: validate → FinalizeBlock → update
+        state → Commit → prune → events. Returns the next State."""
+        t0 = time.perf_counter()
+        self.validate_block(state, block)
+
+        resp = self.proxy_app.finalize_block(
+            abci.RequestFinalizeBlock(
+                txs=list(block.data.txs),
+                decided_last_commit=_commit_info(block, state.last_validators),
+                misbehavior=_abci_misbehavior(block.evidence, state),
+                hash=block.hash(),
+                height=block.header.height,
+                time_ns=block.header.time_ns,
+                next_validators_hash=block.header.next_validators_hash,
+                proposer_address=block.header.proposer_address,
+            )
+        )
+        if len(resp.tx_results) != len(block.data.txs):
+            raise RuntimeError(
+                "FinalizeBlock returned wrong number of tx results"
+            )
+
+        self.state_store.save_finalize_block_response(
+            block.header.height, resp
+        )
+
+        new_state = self._update_state(state, block_id, block, resp)
+
+        # Commit: lock mempool so no CheckTx races the app's state commit
+        # (execution.go:360).
+        app_hash = self._commit(new_state, block, resp)
+        new_state.app_hash = resp.app_hash
+        assert app_hash is not None
+
+        self.state_store.save(new_state)
+
+        self._prune(new_state)
+        self._fire_events(block, block_id, resp)
+        if self.metrics is not None:
+            self.metrics.block_processing_time.observe(
+                time.perf_counter() - t0
+            )
+        return new_state
+
+    def _commit(self, state: State, block: Block, resp) -> bytes:
+        self.mempool.lock()
+        try:
+            cres = self.proxy_app.commit()
+            self.mempool.update(
+                block.header.height,
+                list(block.data.txs),
+                list(resp.tx_results),
+            )
+            self._retain_height = cres.retain_height
+            return resp.app_hash
+        finally:
+            self.mempool.unlock()
+
+    def _prune(self, state: State) -> None:
+        retain = getattr(self, "_retain_height", 0)
+        if retain > 0 and self.block_store is not None:
+            base = self.block_store.base()
+            if retain > base:
+                pruned = self.block_store.prune_blocks(retain)
+                if pruned > 0:
+                    self.state_store.prune_states(retain)
+
+    def _update_state(
+        self, state: State, block_id: BlockID, block: Block, resp
+    ) -> State:
+        """execution.go:541 updateState — derive State(H+1)."""
+        height = block.header.height
+        next_vals = state.next_validators.copy()
+        last_height_vals_changed = state.last_height_validators_changed
+        if resp.validator_updates:
+            changes = validator_updates_to_validators(resp.validator_updates)
+            next_vals.update_with_change_set(changes)
+            last_height_vals_changed = height + 1 + 1
+
+        params = state.consensus_params
+        last_height_params_changed = state.last_height_consensus_params_changed
+        if resp.consensus_param_updates is not None:
+            params = params.update(resp.consensus_param_updates)
+            params.validate_basic()
+            last_height_params_changed = height + 1
+
+        # validators(H+1) = previous next_validators (unchanged); updates
+        # land in next_validators(H+2) with rotated priorities
+        # (execution.go updateState: nValSet).
+        next_vals.increment_proposer_priority(1)
+        return State(
+            chain_id=state.chain_id,
+            initial_height=state.initial_height,
+            last_block_height=height,
+            last_block_id=block_id,
+            last_block_time_ns=block.header.time_ns,
+            next_validators=next_vals,
+            validators=state.next_validators.copy(),
+            last_validators=state.validators.copy(),
+            last_height_validators_changed=last_height_vals_changed,
+            consensus_params=params,
+            last_height_consensus_params_changed=last_height_params_changed,
+            last_results_hash=results_hash(resp.tx_results),
+            app_hash=b"",  # filled after Commit
+            app_version=params.version.app,
+        )
+
+    def _fire_events(self, block: Block, block_id: BlockID, resp) -> None:
+        """execution.go:614 fireEvents."""
+        self.event_bus.publish_new_block(
+            EventDataNewBlock(
+                block=block, block_id=block_id, result_finalize_block=resp
+            )
+        )
+        self.event_bus.publish_new_block_header(
+            EventDataNewBlockHeader(header=block.header)
+        )
+        if resp.events:
+            self.event_bus.publish_new_block_events(
+                EventDataNewBlockEvents(
+                    height=block.header.height,
+                    events=list(resp.events),
+                    num_txs=len(block.data.txs),
+                )
+            )
+        for i, tx in enumerate(block.data.txs):
+            self.event_bus.publish_tx(
+                EventDataTx(
+                    height=block.header.height,
+                    index=i,
+                    tx=tx,
+                    result=resp.tx_results[i],
+                )
+            )
+        if resp.validator_updates:
+            self.event_bus.publish_validator_set_updates(
+                EventDataValidatorSetUpdates(
+                    validator_updates=list(resp.validator_updates)
+                )
+            )
+
+    # -- vote extensions ---------------------------------------------------
+
+    def extend_vote(self, vote, state: State) -> bytes:
+        resp = self.proxy_app.extend_vote(
+            abci.RequestExtendVote(
+                hash=vote.block_id.hash,
+                height=vote.height,
+            )
+        )
+        return resp.vote_extension
+
+    def verify_vote_extension(self, vote, state: State) -> bool:
+        resp = self.proxy_app.verify_vote_extension(
+            abci.RequestVerifyVoteExtension(
+                hash=vote.block_id.hash,
+                validator_address=vote.validator_address,
+                height=vote.height,
+                vote_extension=vote.extension,
+            )
+        )
+        if resp.status == abci.VerifyVoteExtensionStatus.UNKNOWN:
+            raise RuntimeError("VerifyVoteExtension returned UNKNOWN")
+        return resp.is_accepted

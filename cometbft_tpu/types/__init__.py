@@ -6,9 +6,12 @@ from .block import (  # noqa: F401
     BLOCK_ID_FLAG_NIL,
     Block,
     BlockID,
+    BlockMeta,
     Commit,
     CommitSig,
     Data,
+    ExtendedCommit,
+    ExtendedCommitSig,
     Header,
     NIL_BLOCK_ID,
     PartSetHeader,

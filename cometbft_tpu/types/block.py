@@ -270,6 +270,61 @@ class Commit:
                 cs.validate_basic()
 
 
+@dataclass(frozen=True, slots=True)
+class ExtendedCommitSig:
+    """CommitSig + the vote extension it carried (types/block.go:646+)."""
+
+    commit_sig: CommitSig
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    def validate_basic(self) -> None:
+        self.commit_sig.validate_basic()
+        if self.commit_sig.block_id_flag != BLOCK_ID_FLAG_COMMIT and (
+            self.extension or self.extension_signature
+        ):
+            raise ValueError("non-commit sig cannot carry an extension")
+
+    def ensure_extension(self) -> None:
+        if (
+            self.commit_sig.block_id_flag == BLOCK_ID_FLAG_COMMIT
+            and not self.extension_signature
+        ):
+            raise ValueError("commit sig missing required vote extension")
+
+
+@dataclass(slots=True)
+class ExtendedCommit:
+    """Commit carrying vote extensions, persisted so a restarting proposer
+    can re-inject them into PrepareProposal (types/block.go:736+)."""
+
+    height: int
+    round: int
+    block_id: BlockID
+    extended_signatures: list[ExtendedCommitSig]
+
+    def to_commit(self) -> Commit:
+        return Commit(
+            height=self.height,
+            round=self.round,
+            block_id=self.block_id,
+            signatures=[es.commit_sig for es in self.extended_signatures],
+        )
+
+    def size(self) -> int:
+        return len(self.extended_signatures)
+
+    def ensure_extensions(self, required: bool) -> None:
+        if required:
+            for es in self.extended_signatures:
+                es.ensure_extension()
+
+    def validate_basic(self) -> None:
+        self.to_commit().validate_basic()
+        for es in self.extended_signatures:
+            es.validate_basic()
+
+
 @dataclass(slots=True)
 class Data:
     """Block transactions; hash is the merkle root of tx hashes."""
@@ -302,6 +357,16 @@ class Block:
                 raise ValueError("last commit hash mismatch")
         if self.header.data_hash != self.data.hash():
             raise ValueError("data hash mismatch")
+
+
+@dataclass(slots=True)
+class BlockMeta:
+    """Block summary stored per height (types/block_meta.go)."""
+
+    block_id: BlockID
+    block_size: int
+    header: Header
+    num_txs: int
 
 
 def make_block(

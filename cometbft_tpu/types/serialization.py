@@ -1,0 +1,101 @@
+"""Storage/wire codec for the data model: one shared ``Codec`` with every
+persistable type registered (reference analog: proto/tendermint marshaling
+used by store/store.go and state/store.go).
+
+``ValidatorSet`` restores exactly (validator order, proposer, priorities) —
+its constructor rotates priorities, so decode bypasses it.
+"""
+
+from __future__ import annotations
+
+from ..crypto import keys
+from ..crypto.merkle import Proof
+from ..libs.jsoncodec import Codec
+from . import evidence as ev
+from .block import (
+    Block,
+    BlockID,
+    BlockMeta,
+    Commit,
+    CommitSig,
+    Data,
+    ExtendedCommit,
+    ExtendedCommitSig,
+    Header,
+    PartSetHeader,
+    Version,
+)
+from .params import (
+    ABCIParams,
+    BlockParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+    VersionParams,
+)
+from .part_set import Part
+from .validator_set import Validator, ValidatorSet
+from .vote import Proposal, Vote
+
+codec = Codec()
+
+codec.register(
+    Proof,
+    PartSetHeader,
+    BlockID,
+    Version,
+    Header,
+    CommitSig,
+    Commit,
+    Data,
+    Block,
+    BlockMeta,
+    ExtendedCommitSig,
+    ExtendedCommit,
+    Part,
+    Vote,
+    Proposal,
+    Validator,
+    BlockParams,
+    EvidenceParams,
+    ValidatorParams,
+    VersionParams,
+    ABCIParams,
+    ConsensusParams,
+    ev.DuplicateVoteEvidence,
+    ev.LightClientAttackEvidence,
+)
+
+codec.register_adapter(
+    keys.Ed25519PubKey,
+    "ed25519.pub",
+    lambda pk: pk.bytes(),
+    lambda raw: keys.Ed25519PubKey(raw),
+)
+
+
+def _valset_enc(vs: ValidatorSet) -> dict:
+    return {
+        "validators": list(vs.validators),
+        "proposer_address": vs.proposer.address if vs.proposer else b"",
+    }
+
+
+def _valset_dec(payload: dict) -> ValidatorSet:
+    vs = ValidatorSet.__new__(ValidatorSet)
+    vs.validators = list(payload["validators"])
+    vs._total = None
+    vs.proposer = None
+    addr = payload["proposer_address"]
+    if addr:
+        for v in vs.validators:
+            if v.address == addr:
+                vs.proposer = v
+                break
+    return vs
+
+
+codec.register_adapter(ValidatorSet, "valset", _valset_enc, _valset_dec)
+
+dumps = codec.dumps
+loads = codec.loads

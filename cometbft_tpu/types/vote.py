@@ -122,6 +122,9 @@ class Vote:
             raise VoteError("signature too long")
         if self.msg_type == canonical.PREVOTE_TYPE and self.extension:
             raise VoteError("prevotes cannot carry extensions")
+        if self.is_nil() and (self.extension or self.extension_signature):
+            # issue #8487: nil precommits must not carry extension data
+            raise VoteError("nil votes cannot carry extensions")
         if len(self.extension) > MAX_VOTE_EXTENSION_SIZE:
             raise VoteError("extension too large")
 

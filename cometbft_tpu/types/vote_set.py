@@ -20,7 +20,13 @@ from dataclasses import dataclass
 from ..crypto import batch as crypto_batch
 from ..libs.bits import BitArray
 from . import canonical
-from .block import BLOCK_ID_FLAG_COMMIT, BlockID, Commit, NIL_BLOCK_ID
+from .block import (
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BlockID,
+    Commit,
+    NIL_BLOCK_ID,
+)
 from .validator_set import ValidatorSet
 from .vote import Vote, VoteError
 
@@ -323,3 +329,32 @@ class VoteSet:
             block_id=self.maj23,
             signatures=sigs,
         )
+
+    def make_extended_commit(self, require_extensions: bool = False):
+        """Commit + vote extensions (vote_set.go MakeExtendedCommit:636)."""
+        from .block import ExtendedCommit, ExtendedCommitSig
+
+        commit = self.make_commit()
+        ext_sigs = []
+        for i, cs in enumerate(commit.signatures):
+            vote = self.votes[i]
+            # Only COMMIT-flag sigs may carry extension data
+            # (types/block.go EnsureExtensions / issue #8487).
+            if vote is not None and cs.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+                ext_sigs.append(
+                    ExtendedCommitSig(
+                        commit_sig=cs,
+                        extension=vote.extension,
+                        extension_signature=vote.extension_signature,
+                    )
+                )
+            else:
+                ext_sigs.append(ExtendedCommitSig(commit_sig=cs))
+        ec = ExtendedCommit(
+            height=commit.height,
+            round=commit.round,
+            block_id=commit.block_id,
+            extended_signatures=ext_sigs,
+        )
+        ec.ensure_extensions(require_extensions)
+        return ec

@@ -1,0 +1,128 @@
+"""Shared multi-validator test fixtures (reference analog:
+consensus/common_test.go — validatorStub + randState builders)."""
+
+from __future__ import annotations
+
+import time
+
+from cometbft_tpu.types import (
+    BlockID,
+    Commit,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    PartSet,
+    Vote,
+)
+from cometbft_tpu.types import canonical
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.state import make_genesis_state
+
+CHAIN_ID = "test-chain-tpu"
+
+
+def make_genesis(n_vals: int, chain_id: str = CHAIN_ID, power: int = 10):
+    """Deterministic genesis with n validators; returns (doc, priv_vals)
+    with priv_vals ordered to match the ValidatorSet order."""
+    pvs = [
+        MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
+        for i in range(n_vals)
+    ]
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=pv.get_pub_key(), power=power)
+            for pv in pvs
+        ],
+    )
+    vs = doc.validator_set()
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return doc, ordered
+
+
+def sign_commit(
+    chain_id: str,
+    validators,
+    priv_vals,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    time_ns: int | None = None,
+) -> Commit:
+    """All validators precommit for block_id → Commit (ordered by valset)."""
+    if time_ns is None:
+        time_ns = time.time_ns()
+    sigs = []
+    for idx, (val, pv) in enumerate(zip(validators.validators, priv_vals)):
+        vote = Vote(
+            msg_type=canonical.PRECOMMIT_TYPE,
+            height=height,
+            round=round_,
+            block_id=block_id,
+            timestamp_ns=time_ns + idx,  # distinct per validator, like prod
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        pv.sign_vote(chain_id, vote, sign_extension=False)
+        sigs.append(vote.commit_sig())
+    return Commit(
+        height=height, round=round_, block_id=block_id, signatures=sigs
+    )
+
+
+class ChainDriver:
+    """Produces a valid chain against a BlockExecutor, signing commits with
+    all validators each height."""
+
+    def __init__(self, genesis: GenesisDoc, priv_vals, executor, state=None):
+        self.genesis = genesis
+        self.priv_vals = priv_vals
+        self.executor = executor
+        self.state = state or make_genesis_state(genesis)
+        self.last_commit: Commit | None = None
+        self.last_block_id: BlockID | None = None
+
+    def next_block(self, txs: list[bytes]):
+        height = self.state.last_block_height + 1 or self.state.initial_height
+        if height == self.state.initial_height:
+            last_commit = None
+        else:
+            last_commit = self.last_commit
+        proposer = self.state.validators.get_proposer()
+        block = self.state.make_block(
+            height=height,
+            txs=txs,
+            last_commit=last_commit,
+            evidence=[],
+            proposer_address=proposer.address,
+            time_ns=self.state.last_block_time_ns + 1_000_000_000,
+        )
+        parts = PartSet.from_data(
+            __import__(
+                "cometbft_tpu.types.serialization", fromlist=["dumps"]
+            ).dumps(block)
+        )
+        block_id = BlockID(block.hash(), parts.header)
+        return block, parts, block_id
+
+    def commit_block(self, block, parts, block_id):
+        commit = sign_commit(
+            self.genesis.chain_id,
+            self.state.validators,
+            self.priv_vals,
+            block.header.height,
+            0,
+            block_id,
+            time_ns=block.header.time_ns + 1,
+        )
+        self.state = self.executor.apply_block(self.state, block_id, block)
+        self.last_commit = commit
+        self.last_block_id = block_id
+        return self.state
+
+    def produce(self, txs: list[bytes]):
+        block, parts, block_id = self.next_block(txs)
+        state = self.commit_block(block, parts, block_id)
+        return block, parts, block_id, state

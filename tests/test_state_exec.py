@@ -1,0 +1,271 @@
+"""Storage + execution tests: BlockStore, state Store, BlockExecutor
+(reference analogs: store/store_test.go, state/state_test.go,
+state/execution_test.go, state/validation_test.go)."""
+
+import pytest
+
+from cometbft_tpu import proxy
+from cometbft_tpu.abci import types as abci_types
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.libs import db as dbm
+from cometbft_tpu.state import (
+    BlockExecutor,
+    Store,
+    make_genesis_state,
+)
+from cometbft_tpu.state.validation import BlockValidationError, validate_block
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import serialization as ser
+from cometbft_tpu.types.event_bus import EventBus, QUERY_TX
+from cometbft_tpu.libs import pubsub
+
+from helpers import ChainDriver, make_genesis, sign_commit
+
+
+@pytest.fixture
+def rig():
+    """A 4-validator single-node execution rig over kvstore."""
+    genesis, pvs = make_genesis(4)
+    app = KVStoreApplication()
+    conns = proxy.AppConns(proxy.local_client_creator(app))
+    conns.start()
+    state_store = Store(dbm.MemDB())
+    block_store = BlockStore(dbm.MemDB())
+    bus = EventBus()
+    bus.start()
+    executor = BlockExecutor(
+        state_store,
+        conns.consensus,
+        block_store=block_store,
+        event_bus=bus,
+    )
+    driver = ChainDriver(genesis, pvs, executor)
+    yield driver, executor, state_store, block_store, bus, app
+    bus.stop()
+    conns.stop()
+
+
+# -- serialization round-trips --------------------------------------------
+
+
+def test_block_serialization_roundtrip(rig):
+    driver = rig[0]
+    block, parts, block_id = driver.next_block([b"a=1", b"b=2"])
+    raw = ser.dumps(block)
+    block2 = ser.loads(raw)
+    assert block2.hash() == block.hash()
+    assert block2.data.txs == block.data.txs
+    assert block2.header == block.header
+
+
+def test_validator_set_roundtrip(rig):
+    driver = rig[0]
+    vs = driver.state.validators
+    vs2 = ser.loads(ser.dumps(vs))
+    assert vs2.hash() == vs.hash()
+    assert vs2.get_proposer().address == vs.get_proposer().address
+    assert [v.proposer_priority for v in vs2.validators] == [
+        v.proposer_priority for v in vs.validators
+    ]
+
+
+# -- block store -----------------------------------------------------------
+
+
+def test_block_store_save_load(rig):
+    driver, executor, state_store, block_store, bus, app = rig
+    block, parts, block_id = driver.next_block([b"k=v"])
+    commit = sign_commit(
+        driver.genesis.chain_id,
+        driver.state.validators,
+        driver.priv_vals,
+        1,
+        0,
+        block_id,
+    )
+    block_store.save_block(block, parts, commit)
+    assert block_store.height() == 1
+    assert block_store.base() == 1
+    assert block_store.size() == 1
+
+    loaded = block_store.load_block(1)
+    assert loaded.hash() == block.hash()
+    assert block_store.load_block_by_hash(block.hash()).header == block.header
+    meta = block_store.load_block_meta(1)
+    assert meta.block_id == block_id
+    assert meta.num_txs == 1
+    assert block_store.load_seen_commit().block_id == block_id
+    part = block_store.load_block_part(1, 0)
+    assert part.index == 0
+
+
+def test_block_store_wrong_height_rejected(rig):
+    driver, _, _, block_store, _, _ = rig
+    block, parts, block_id = driver.next_block([b"k=v"])
+    commit = sign_commit(
+        driver.genesis.chain_id, driver.state.validators, driver.priv_vals,
+        1, 0, block_id,
+    )
+    block_store.save_block(block, parts, commit)
+    with pytest.raises(ValueError):
+        block_store.save_block(block, parts, commit)  # height 1 again
+
+
+# -- state store -----------------------------------------------------------
+
+
+def test_state_store_roundtrip(rig):
+    driver, _, state_store, _, _, _ = rig
+    state_store.save(driver.state)
+    loaded = state_store.load()
+    assert loaded.chain_id == driver.state.chain_id
+    assert loaded.last_block_height == 0
+    assert loaded.validators.hash() == driver.state.validators.hash()
+    assert (
+        loaded.next_validators.hash() == driver.state.next_validators.hash()
+    )
+    assert loaded.consensus_params == driver.state.consensus_params
+    # validators recorded for the initial height
+    vs = state_store.load_validators(1)
+    assert vs is not None and vs.hash() == driver.state.validators.hash()
+
+
+# -- executor: the end-to-end slice ---------------------------------------
+
+
+def test_apply_block_advances_state_and_app(rig):
+    driver, executor, state_store, block_store, bus, app = rig
+    sub = bus.subscribe("test", QUERY_TX)
+
+    block, parts, block_id, state = driver.produce([b"name=satoshi"])
+    assert state.last_block_height == 1
+    assert state.last_block_id == block_id
+    assert state.app_hash == app.app_hash
+    assert app.height == 1
+    # event published with tx attributes
+    msg = sub.out.get(timeout=2)
+    assert msg.data.height == 1
+    assert msg.events["app.key"] == ["name"]
+
+    # height 2 applies on top, carrying the height-1 commit
+    block2, _, block_id2, state2 = driver.produce([b"k2=v2"])
+    assert state2.last_block_height == 2
+    assert block2.last_commit.block_id == block_id
+    assert state2.app_hash == app.app_hash
+    # persisted state matches
+    assert state_store.load().last_block_height == 2
+
+
+def test_apply_block_rejects_invalid(rig):
+    driver, executor, *_ = rig
+    block, parts, block_id = driver.next_block([b"a=1"])
+    # tamper: wrong app hash in header
+    import dataclasses
+
+    bad_header = dataclasses.replace(block.header, app_hash=b"\x09" * 8)
+    bad_block = dataclasses.replace(  # Block isn't frozen; copy manually
+        block
+    ) if False else block
+    bad_block = type(block)(
+        header=bad_header,
+        data=block.data,
+        evidence=block.evidence,
+        last_commit=block.last_commit,
+    )
+    with pytest.raises(BlockValidationError):
+        executor.apply_block(driver.state, block_id, bad_block)
+
+
+def test_validate_block_bad_last_commit(rig):
+    driver, executor, *_ = rig
+    driver.produce([b"a=1"])
+    block, parts, block_id = driver.next_block([b"b=2"])
+    # Corrupt one signature in the last commit: batch verify must fail it.
+    import dataclasses
+
+    sigs = list(block.last_commit.signatures)
+    sigs[0] = dataclasses.replace(sigs[0], signature=b"\x01" * 64)
+    bad_commit = type(block.last_commit)(
+        height=block.last_commit.height,
+        round=block.last_commit.round,
+        block_id=block.last_commit.block_id,
+        signatures=sigs,
+    )
+    bad_block = type(block)(
+        header=block.header,
+        data=block.data,
+        evidence=block.evidence,
+        last_commit=bad_commit,
+    )
+    # data_hash/last_commit_hash mismatch is caught by validate_basic;
+    # rebuild header hashes so the signature check itself is what fails
+    hdr = dataclasses.replace(
+        block.header, last_commit_hash=bad_commit.hash()
+    )
+    bad_block = type(block)(
+        header=hdr,
+        data=block.data,
+        evidence=block.evidence,
+        last_commit=bad_commit,
+    )
+    with pytest.raises(BlockValidationError, match="invalid last commit"):
+        validate_block(driver.state, bad_block)
+
+
+def test_process_proposal_rejects_bad_txs(rig):
+    driver, executor, *_ = rig
+    block, parts, block_id = driver.next_block([b"not-a-kv-tx"])
+    assert executor.process_proposal(block, driver.state) is False
+    good, _, _ = driver.next_block([b"ok=1"])
+    assert executor.process_proposal(good, driver.state) is True
+
+
+def test_create_proposal_block(rig):
+    driver, executor, *_ = rig
+
+    class StubMempool(executor.mempool.__class__):
+        def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+            return [b"from=mempool"]
+
+    executor.mempool = StubMempool()
+    proposer = driver.state.validators.get_proposer()
+    block = executor.create_proposal_block(
+        1, driver.state, None, proposer.address
+    )
+    assert block.data.txs == [b"from=mempool"]
+    assert block.header.height == 1
+    assert block.header.proposer_address == proposer.address
+    # the proposal is applyable
+    import cometbft_tpu.types.serialization as s
+
+    from cometbft_tpu.types import PartSet, BlockID
+
+    parts = PartSet.from_data(s.dumps(block))
+    state = executor.apply_block(
+        driver.state, BlockID(block.hash(), parts.header), block
+    )
+    assert state.last_block_height == 1
+
+
+def test_validator_update_via_tx(rig):
+    driver, executor, *_ = rig
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+    new_key = Ed25519PrivKey.from_seed(b"\x77" * 32).pub_key()
+    tx = b"val:" + new_key.bytes().hex().encode() + b"!5"
+    _, _, _, state1 = driver.produce([tx])
+    # update lands in next_validators at H+2
+    assert len(state1.validators) == 4  # H+1 set unchanged
+    assert len(state1.next_validators) == 5
+    assert state1.last_height_validators_changed == 3
+    _, _, _, state2 = driver.produce([b"a=1"])
+    assert len(state2.validators) == 5
+
+
+def test_finalize_block_response_persisted(rig):
+    driver, executor, state_store, *_ = rig
+    driver.produce([b"x=1", b"y=2"])
+    resp = state_store.load_finalize_block_response(1)
+    assert resp is not None
+    assert len(resp.tx_results) == 2
+    assert all(r.code == 0 for r in resp.tx_results)
