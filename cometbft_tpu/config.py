@@ -1,0 +1,189 @@
+"""Node configuration (reference: config/config.go:73-1135).
+
+The master ``Config`` has the reference's 9 sections; consensus timeouts
+follow config.go:908-945. ``test_config()`` mirrors ``TestConfig()``
+(config.go:106) — millisecond timeouts so in-process consensus nets
+converge fast.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, replace
+
+_MS = 1_000_000  # ns per ms
+
+
+@dataclass(slots=True)
+class BaseConfig:
+    home: str = "~/.cometbft-tpu"
+    moniker: str = "anonymous"
+    proxy_app: str = "kvstore"  # in-process app name or tcp://|unix:// addr
+    abci: str = "local"  # local | socket
+    db_backend: str = "file"  # file | mem
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    block_sync: bool = True
+    state_sync: bool = False
+
+    def resolve(self, path: str) -> str:
+        p = os.path.expanduser(path)
+        return p if os.path.isabs(p) else os.path.join(
+            os.path.expanduser(self.home), p
+        )
+
+
+@dataclass(slots=True)
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ns: int = 10_000 * _MS
+    max_body_bytes: int = 1_000_000
+    pprof_laddr: str = ""
+
+
+@dataclass(slots=True)
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout_ns: int = 100 * _MS
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    pex: bool = True
+    seed_mode: bool = False
+    allow_duplicate_ip: bool = False
+    handshake_timeout_ns: int = 20_000 * _MS
+    dial_timeout_ns: int = 3_000 * _MS
+
+
+@dataclass(slots=True)
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    size: int = 5000
+    max_txs_bytes: int = 1024 * 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1024 * 1024
+
+
+@dataclass(slots=True)
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_ns: int = 168 * 3600 * 1_000_000_000  # 1 week
+    discovery_time_ns: int = 15_000 * _MS
+    chunk_request_timeout_ns: int = 10_000 * _MS
+    chunk_fetchers: int = 4
+
+
+@dataclass(slots=True)
+class BlockSyncConfig:
+    version: str = "v0"
+
+
+@dataclass(slots=True)
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal/wal"
+    # timeouts (config.go:908-945); _delta grows per round
+    timeout_propose_ns: int = 3_000 * _MS
+    timeout_propose_delta_ns: int = 500 * _MS
+    timeout_prevote_ns: int = 1_000 * _MS
+    timeout_prevote_delta_ns: int = 500 * _MS
+    timeout_precommit_ns: int = 1_000 * _MS
+    timeout_precommit_delta_ns: int = 500 * _MS
+    timeout_commit_ns: int = 1_000 * _MS
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ns: int = 0
+    peer_gossip_sleep_duration_ns: int = 100 * _MS
+    peer_query_maj23_sleep_duration_ns: int = 2_000 * _MS
+    double_sign_check_height: int = 0
+
+    def propose_timeout(self, round_: int) -> float:
+        """Seconds; grows linearly with round (state.go proposeTimeout)."""
+        return (
+            self.timeout_propose_ns + round_ * self.timeout_propose_delta_ns
+        ) / 1e9
+
+    def prevote_timeout(self, round_: int) -> float:
+        return (
+            self.timeout_prevote_ns + round_ * self.timeout_prevote_delta_ns
+        ) / 1e9
+
+    def precommit_timeout(self, round_: int) -> float:
+        return (
+            self.timeout_precommit_ns
+            + round_ * self.timeout_precommit_delta_ns
+        ) / 1e9
+
+    def commit_timeout(self) -> float:
+        return self.timeout_commit_ns / 1e9
+
+
+@dataclass(slots=True)
+class StorageConfig:
+    discard_abci_responses: bool = False
+
+
+@dataclass(slots=True)
+class TxIndexConfig:
+    indexer: str = "kv"  # kv | null | psql
+
+
+@dataclass(slots=True)
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "cometbft"
+
+
+@dataclass(slots=True)
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig
+    )
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Millisecond consensus timeouts (config.go TestConfig:106)."""
+    c = Config()
+    c.consensus = replace(
+        c.consensus,
+        timeout_propose_ns=40 * _MS,
+        timeout_propose_delta_ns=1 * _MS,
+        timeout_prevote_ns=10 * _MS,
+        timeout_prevote_delta_ns=1 * _MS,
+        timeout_precommit_ns=10 * _MS,
+        timeout_precommit_delta_ns=1 * _MS,
+        timeout_commit_ns=10 * _MS,
+        skip_timeout_commit=True,
+        peer_gossip_sleep_duration_ns=5 * _MS,
+        peer_query_maj23_sleep_duration_ns=250 * _MS,
+    )
+    return c

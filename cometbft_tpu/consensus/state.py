@@ -1,0 +1,1008 @@
+"""The Tendermint consensus state machine (reference: consensus/state.go).
+
+Single-writer core: one ``_receive_routine`` thread owns ALL round state
+(state.go:750) and consumes a merged queue of peer messages, own messages,
+and timeouts. Every message is WAL-logged before processing; own messages
+are fsynced so a crash cannot double-sign (state.go:797-805).
+
+Step functions mirror the reference: ``enter_new_round:1018``,
+``enter_propose:1105``, ``enter_prevote`` (defaultDoPrevote:1313),
+``enter_precommit:1489``, ``enter_commit:1624``, ``try_finalize_commit:1687``,
+``finalize_commit:1715``; vote ingest ``try_add_vote:2086``/``add_vote:2137``;
+own-vote signing ``sign_vote:2355``/``sign_add_vote:2426``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..config import ConsensusConfig
+from ..libs.events import EventSwitch
+from ..libs.service import BaseService
+from ..types import BlockID, PartSet, canonical
+from ..types.block import Block
+from ..types.event_bus import (
+    EventDataCompleteProposal,
+    EventDataNewRound,
+    EventDataRoundState,
+    EventDataVote,
+    NopEventBus,
+)
+from ..types.part_set import PartSetError
+from ..types.vote import Proposal, Vote
+from ..types.vote_set import ConflictingVoteError, VoteSet
+from ..types import serialization as ser
+from .height_vote_set import HeightVoteSet
+from .messages import BlockPartMessage, ProposalMessage, VoteMessage
+from .round_state import RoundState, RoundStep
+from .ticker import TimeoutTicker
+from .wal import MsgInfo, NopWAL, TimeoutInfo
+
+# evsw event names the reactor listens on (consensus/events.go)
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_VALID_BLOCK = "ValidBlock"
+EVENT_VOTE = "Vote"
+EVENT_PROPOSAL_BLOCK_PART = "ProposalBlockPart"
+
+
+class ConsensusError(Exception):
+    pass
+
+
+def commit_to_vote_set(chain_id: str, commit, validators) -> VoteSet:
+    """Rebuild the precommit VoteSet a commit came from
+    (types/block.go CommitToVoteSet / Commit.ToVoteSet:1088)."""
+    vs = VoteSet(
+        chain_id, commit.height, commit.round, canonical.PRECOMMIT_TYPE,
+        validators,
+    )
+    from ..types.block import BLOCK_ID_FLAG_ABSENT
+
+    votes = []
+    for idx, cs in enumerate(commit.signatures):
+        if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            continue
+        votes.append(
+            Vote(
+                msg_type=canonical.PRECOMMIT_TYPE,
+                height=commit.height,
+                round=commit.round,
+                block_id=cs.block_id(commit.block_id),
+                timestamp_ns=cs.timestamp_ns,
+                validator_address=cs.validator_address,
+                validator_index=idx,
+                signature=cs.signature,
+            )
+        )
+    oks = vs.add_votes_batch(votes)  # one batched verify (TPU path)
+    if not all(oks):
+        raise ConsensusError("failed to reconstruct seen-commit votes")
+    return vs
+
+
+class ConsensusState(BaseService):
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state,  # sm.State
+        block_exec,
+        block_store,
+        tx_notifier=None,  # mempool (TxsAvailable signal)
+        evidence_pool=None,
+        event_bus=None,
+        wal=None,
+        options=None,
+    ):
+        super().__init__("consensus")
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.tx_notifier = tx_notifier
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus if event_bus is not None else NopEventBus()
+        self.wal = wal if wal is not None else NopWAL()
+        self.evsw = EventSwitch()
+
+        self.priv_validator = None
+        self.priv_validator_pub_key = None
+
+        self.rs = RoundState()
+        self.state = None  # sm.State, set by update_to_state
+        self._mtx = threading.RLock()  # guards rs reads from other threads
+
+        # merged inbox: ("peer"|"internal"|"timeout", payload)
+        self._queue: queue.Queue = queue.Queue(maxsize=1000)
+        self.ticker = TimeoutTicker()
+        self._n_started = 0
+        self.replay_mode = False
+        self.do_wal_catchup = True
+        self._on_block_committed = []  # test/metrics hooks: f(height)
+
+        self.update_to_state(state)
+        self.reconstruct_last_commit_if_needed(state)
+
+    # ------------------------------------------------------------------
+    # public surface
+    # ------------------------------------------------------------------
+
+    def set_priv_validator(self, pv) -> None:
+        with self._mtx:
+            self.priv_validator = pv
+            if pv is not None:
+                self.priv_validator_pub_key = pv.get_pub_key()
+
+    def get_round_state(self) -> RoundState:
+        """Shallow snapshot — never the live object (state.go GetRoundState
+        returns rs.Copy(); field-by-field mutation would tear readers)."""
+        import dataclasses
+
+        with self._mtx:
+            return dataclasses.replace(self.rs)
+
+    def height(self) -> int:
+        with self._mtx:
+            return self.rs.height
+
+    # -- message entry points (thread-safe) --------------------------------
+
+    def add_vote_from_peer(self, vote: Vote, peer_id: str) -> None:
+        self._queue.put(("peer", MsgInfo(VoteMessage(vote), peer_id)))
+
+    def set_proposal_from_peer(self, proposal: Proposal, peer_id: str) -> None:
+        self._queue.put(("peer", MsgInfo(ProposalMessage(proposal), peer_id)))
+
+    def add_block_part_from_peer(
+        self, height: int, round_: int, part, peer_id: str
+    ) -> None:
+        self._queue.put(
+            ("peer", MsgInfo(BlockPartMessage(height, round_, part), peer_id))
+        )
+
+    def _send_internal(self, msg) -> None:
+        """Never block the receive thread on its own queue
+        (state.go sendInternalMessage's select/default + goroutine)."""
+        item = ("internal", MsgInfo(msg, ""))
+        try:
+            self._queue.put_nowait(item)
+        except queue.Full:
+            threading.Thread(
+                target=self._queue.put, args=(item,), daemon=True
+            ).start()
+
+    def handle_txs_available(self) -> None:
+        """Mempool signal (state.go:981) — used with create_empty_blocks=False."""
+        self._queue.put(("txs_available", None))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.do_wal_catchup and not isinstance(self.wal, NopWAL):
+            self._catchup_replay()
+        self.ticker.start()
+        threading.Thread(
+            target=self._tock_forwarder, name="cs-tock", daemon=True
+        ).start()
+        self._receive_thread = threading.Thread(
+            target=self._receive_routine, name="cs-receive", daemon=True
+        )
+        self._receive_thread.start()
+        self._schedule_round0()
+
+    def on_stop(self) -> None:
+        if self.ticker.is_running():
+            self.ticker.stop()
+        self._queue.put(("quit", None))
+        # Drain the loop before the WAL can be closed under it.
+        if getattr(self, "_receive_thread", None) is not None:
+            self._receive_thread.join(timeout=5)
+        self.wal.flush_and_sync()
+
+    def _tock_forwarder(self) -> None:
+        while not self.quit_event().is_set():
+            try:
+                ti = self.ticker.tock_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._queue.put(("timeout", ti))
+
+    def _schedule_round0(self) -> None:
+        sleep_s = max(0.0, (self.rs.start_time_ns - time.time_ns()) / 1e9)
+        self._schedule_timeout(
+            sleep_s, self.rs.height, 0, RoundStep.NEW_HEIGHT
+        )
+
+    def _schedule_timeout(
+        self, duration_s: float, height: int, round_: int, step: RoundStep
+    ) -> None:
+        self.ticker.schedule_timeout(
+            TimeoutInfo(duration_s, height, round_, int(step))
+        )
+
+    # ------------------------------------------------------------------
+    # the single-writer loop
+    # ------------------------------------------------------------------
+
+    def _receive_routine(self) -> None:
+        while True:
+            kind, payload = self._queue.get()
+            if kind == "quit":
+                return
+            try:
+                if kind == "peer":
+                    self.wal.write(payload)
+                    with self._mtx:
+                        self._handle_msg(payload)
+                elif kind == "internal":
+                    self.wal.write_sync(payload)
+                    with self._mtx:
+                        self._handle_msg(payload)
+                elif kind == "timeout":
+                    self.wal.write(payload)
+                    with self._mtx:
+                        self._handle_timeout(payload)
+                elif kind == "txs_available":
+                    with self._mtx:
+                        self._handle_txs_available()
+            except Exception:
+                if self.replay_mode:
+                    raise
+                import traceback
+
+                traceback.print_exc()
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        msg, peer_id = mi.msg, mi.peer_id
+        if isinstance(msg, ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            self._add_proposal_block_part(msg, peer_id)
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < int(rs.step)
+        ):
+            return  # stale
+        step = RoundStep(ti.step)
+        if step == RoundStep.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif step == RoundStep.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif step == RoundStep.PROPOSE:
+            self.event_bus.publish_timeout_propose(
+                EventDataRoundState(**rs.event_fields())
+            )
+            self._enter_prevote(ti.height, ti.round)
+        elif step == RoundStep.PREVOTE_WAIT:
+            self.event_bus.publish_timeout_wait(
+                EventDataRoundState(**rs.event_fields())
+            )
+            self._enter_precommit(ti.height, ti.round)
+        elif step == RoundStep.PRECOMMIT_WAIT:
+            self.event_bus.publish_timeout_wait(
+                EventDataRoundState(**rs.event_fields())
+            )
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        elif step == RoundStep.COMMIT:
+            # timeout_commit elapsed → next height round 0
+            self._enter_new_round(ti.height, 0)
+
+    def _handle_txs_available(self) -> None:
+        rs = self.rs
+        if rs.step != RoundStep.NEW_ROUND:
+            return
+        self._enter_propose(rs.height, rs.round)
+
+    # ------------------------------------------------------------------
+    # state transitions
+    # ------------------------------------------------------------------
+
+    def update_to_state(self, state) -> None:
+        """state.go:593 updateToState — prep RoundState for the next height."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and state is not None:
+            if rs.height != state.last_block_height:
+                raise ConsensusError(
+                    f"updateToState at height {rs.height} but state is at "
+                    f"{state.last_block_height}"
+                )
+        if (
+            self.state is not None
+            and state.last_block_height <= self.state.last_block_height
+        ):
+            return  # stale state (blocksync overlap)
+
+        # Extract last_commit from this height's precommits.
+        last_commit = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if precommits is None or not precommits.has_two_thirds_majority():
+                raise ConsensusError("updateToState without +2/3 precommits")
+            last_commit = precommits
+
+        height = (
+            state.initial_height
+            if state.last_block_height == 0
+            else state.last_block_height + 1
+        )
+
+        rs.height = height
+        if rs.commit_time_ns == 0:
+            rs.start_time_ns = (
+                state.last_block_time_ns
+                + int(self.config.commit_timeout() * 1e9)
+            )
+        else:
+            rs.start_time_ns = rs.commit_time_ns + int(
+                self.config.commit_timeout() * 1e9
+            )
+        rs.round = 0
+        rs.step = RoundStep.NEW_HEIGHT
+        rs.validators = state.validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(
+            state.chain_id,
+            height,
+            state.validators,
+            extensions_enabled=state.consensus_params.vote_extensions_enabled(
+                height
+            ),
+        )
+        rs.commit_round = -1
+        rs.last_commit = last_commit
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.state = state
+        self._new_step()
+
+    def reconstruct_last_commit_if_needed(self, state) -> None:
+        """After restart: rebuild rs.last_commit from the stored seen
+        commit (state.go reconstructLastCommit)."""
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        seen = self.block_store.load_seen_commit() if self.block_store else None
+        if seen is None or seen.height != state.last_block_height:
+            return
+        self.rs.last_commit = commit_to_vote_set(
+            state.chain_id, seen, state.last_validators
+        )
+
+    def _new_step(self) -> None:
+        rs = self.rs
+        ev = EventDataRoundState(**rs.event_fields())
+        self.event_bus.publish_new_round_step(ev)
+        self.evsw.fire_event(EVENT_NEW_ROUND_STEP, rs)
+
+    # -- NewRound (state.go:1018) ------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy_increment_proposer_priority(
+                round_ - rs.round
+            )
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            # round 0 keeps proposal from NEW_HEIGHT reset
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.triggered_timeout_precommit = False
+        rs.votes.set_round(round_ + 1)
+        self.event_bus.publish_new_round(
+            EventDataNewRound(
+                height=height,
+                round=round_,
+                step=rs.step.short,
+                proposer_address=validators.get_proposer().address,
+            )
+        )
+        wait_for_txs = (
+            not self.config.create_empty_blocks and round_ == 0
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval_ns > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval_ns / 1e9,
+                    height, round_, RoundStep.NEW_ROUND,
+                )
+            # else wait for handle_txs_available
+        else:
+            self._enter_propose(height, round_)
+
+    # -- Propose (state.go:1105) -------------------------------------------
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PROPOSE
+        ):
+            return
+        rs.round = round_
+        rs.step = RoundStep.PROPOSE
+        self._new_step()
+        self._schedule_timeout(
+            self.config.propose_timeout(round_), height, round_,
+            RoundStep.PROPOSE,
+        )
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            # Not a validator — just wait for the proposal.
+            if rs.proposal_complete():
+                self._enter_prevote(height, round_)
+            return
+        addr = bytes(self.priv_validator_pub_key.address())
+        if not rs.validators.has_address(addr):
+            if rs.proposal_complete():
+                self._enter_prevote(height, round_)
+            return
+        if rs.validators.get_proposer().address == addr:
+            self._decide_proposal(height, round_)
+        if rs.proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1244 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block = self._create_proposal_block(height)
+            if block is None:
+                return
+            parts = PartSet.from_data(ser.dumps(block))
+        block_id = BlockID(block.hash(), parts.header)
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=block_id,
+            timestamp_ns=time.time_ns(),
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            if not self.replay_mode:
+                return
+            raise
+        self._send_internal(ProposalMessage(proposal))
+        for i in range(parts.header.total):
+            self._send_internal(
+                BlockPartMessage(height, round_, parts.get_part(i))
+            )
+
+    def _create_proposal_block(self, height: int) -> Block | None:
+        rs = self.rs
+        if height == self.state.initial_height:
+            last_ext_commit = None
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            last_ext_commit = rs.last_commit.make_extended_commit()
+        else:
+            return None  # don't have the commit for the last block
+        proposer = bytes(self.priv_validator_pub_key.address())
+        return self.block_exec.create_proposal_block(
+            height, self.state, last_ext_commit, proposer
+        )
+
+    # -- proposal ingest ---------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """state.go setProposal / defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ConsensusError("invalid POL round in proposal")
+        proposer = rs.validators.get_proposer()
+        sign_bytes = proposal.sign_bytes(self.state.chain_id)
+        if not proposer.pub_key.verify_signature(
+            sign_bytes, proposal.signature
+        ):
+            raise ConsensusError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header
+            )
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> None:
+        """state.go addProposalBlockPart."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return
+        if rs.proposal_block_parts is None:
+            return  # no proposal yet; parts are re-gossiped
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except PartSetError:
+            if peer_id:
+                return  # bad peer part; ignore (reactor may punish)
+            raise
+        if not added:
+            return
+        self.evsw.fire_event(EVENT_PROPOSAL_BLOCK_PART, msg)
+        if not rs.proposal_block_parts.is_complete():
+            return
+        block = ser.loads(rs.proposal_block_parts.assemble())
+        rs.proposal_block = block
+        self.event_bus.publish_complete_proposal(
+            EventDataCompleteProposal(
+                height=rs.height,
+                round=rs.round,
+                step=rs.step.short,
+                block_id=BlockID(block.hash(), rs.proposal_block_parts.header),
+            )
+        )
+        prevotes = rs.votes.prevotes(rs.round)
+        maj23 = prevotes.two_thirds_majority() if prevotes else None
+        if maj23 is not None and not maj23.is_nil() and rs.valid_round < rs.round:
+            if block.hash() == maj23.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= RoundStep.PROPOSE and rs.proposal_complete():
+            self._enter_prevote(rs.height, rs.round)
+        elif rs.step == RoundStep.COMMIT:
+            self._try_finalize_commit(rs.height)
+
+    # -- Prevote (state.go:1264,1313) --------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE
+        ):
+            return
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+        self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """defaultDoPrevote:1313."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(
+                canonical.PREVOTE_TYPE,
+                rs.locked_block.hash(),
+                rs.locked_block_parts.header,
+            )
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            accepted = self.block_exec.process_proposal(
+                rs.proposal_block, self.state
+            )
+        except Exception:
+            accepted = False
+        if accepted:
+            self._sign_add_vote(
+                canonical.PREVOTE_TYPE,
+                rs.proposal_block.hash(),
+                rs.proposal_block_parts.header,
+            )
+        else:
+            self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise ConsensusError("enterPrevoteWait without any +2/3 prevotes")
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.prevote_timeout(round_), height, round_,
+            RoundStep.PREVOTE_WAIT,
+        )
+
+    # -- Precommit (state.go:1489) -----------------------------------------
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        rs.round = round_
+        rs.step = RoundStep.PRECOMMIT
+        self._new_step()
+        prevotes = rs.votes.prevotes(round_)
+        maj23 = prevotes.two_thirds_majority() if prevotes else None
+
+        if maj23 is None:
+            # No polka → precommit nil.
+            self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"", None)
+            return
+
+        self.event_bus.publish_polka(EventDataRoundState(**rs.event_fields()))
+
+        pol_round, _ = rs.votes.pol_info()
+        if pol_round < round_:
+            raise ConsensusError("POL round inconsistent with +2/3 prevotes")
+
+        if maj23.is_nil():
+            # +2/3 prevoted nil → unlock and precommit nil.
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"", None)
+            return
+
+        if rs.locked_block is not None and rs.locked_block.hash() == maj23.hash:
+            # Relock.
+            rs.locked_round = round_
+            self.event_bus.publish_relock(
+                EventDataRoundState(**rs.event_fields())
+            )
+            self._sign_add_vote(
+                canonical.PRECOMMIT_TYPE, maj23.hash, maj23.part_set_header
+            )
+            return
+
+        if rs.proposal_block is not None and rs.proposal_block.hash() == maj23.hash:
+            # Lock the proposal block (validate first — must never lock an
+            # invalid block).
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self.event_bus.publish_lock(
+                EventDataRoundState(**rs.event_fields())
+            )
+            self._sign_add_vote(
+                canonical.PRECOMMIT_TYPE, maj23.hash, maj23.part_set_header
+            )
+            return
+
+        # +2/3 prevoted a block we don't have → unlock, fetch it, precommit nil.
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block is None or rs.proposal_block.hash() != maj23.hash:
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(maj23.part_set_header)
+        self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"", None)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise ConsensusError("enterPrecommitWait without +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            self.config.precommit_timeout(round_), height, round_,
+            RoundStep.PRECOMMIT_WAIT,
+        )
+
+    # -- Commit (state.go:1624) --------------------------------------------
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        precommits = rs.votes.precommits(commit_round)
+        maj23 = precommits.two_thirds_majority()
+        if maj23 is None or maj23.is_nil():
+            raise ConsensusError("enterCommit without +2/3 for a block")
+        rs.step = RoundStep.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = time.time_ns()
+        self._new_step()
+
+        if rs.locked_block is not None and rs.locked_block.hash() == maj23.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or rs.proposal_block.hash() != maj23.hash:
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(maj23.part_set_header)
+            self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        maj23 = precommits.two_thirds_majority() if precommits else None
+        if maj23 is None or maj23.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != maj23.hash:
+            return  # still waiting for block parts
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """state.go:1715 — save, apply, advance."""
+        rs = self.rs
+        if rs.height != height or rs.step != RoundStep.COMMIT:
+            return
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id = precommits.two_thirds_majority()
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+        block.validate_basic()
+        self.block_exec.validate_block(self.state, block)
+
+        if self.block_store.height() < block.header.height:
+            seen_commit = precommits.make_commit()
+            if self.state.consensus_params.vote_extensions_enabled(height):
+                self.block_store.save_block_with_extended_commit(
+                    block, parts, precommits.make_extended_commit(True)
+                )
+            else:
+                self.block_store.save_block(block, parts, seen_commit)
+
+        # EndHeight AFTER the block is saved, BEFORE ApplyBlock: a crash
+        # in between recovers via the ABCI handshake replay, not the WAL
+        # (state.go:1753-1820 fail points).
+        self.wal.write_end_height(height)
+
+        new_state = self.block_exec.apply_block(self.state, block_id, block)
+
+        for hook in self._on_block_committed:
+            hook(height)
+
+        # Next height.
+        rs.commit_time_ns = time.time_ns()
+        self.update_to_state(new_state)
+        self._schedule_round0()
+
+    # ------------------------------------------------------------------
+    # votes
+    # ------------------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:2086."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if (
+                self.priv_validator_pub_key is not None
+                and vote.validator_address
+                == bytes(self.priv_validator_pub_key.address())
+            ):
+                return False  # our own double-sign?! do not gossip evidence
+            if self.evidence_pool is not None:
+                self.evidence_pool.report_conflicting_votes(e.new, e.existing)
+            return False
+        except Exception:
+            if self.replay_mode:
+                raise
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """state.go:2137."""
+        rs = self.rs
+
+        # Late precommit for the previous height completes rs.last_commit.
+        if (
+            vote.height + 1 == rs.height
+            and vote.msg_type == canonical.PRECOMMIT_TYPE
+        ):
+            if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
+                return False
+            if not rs.last_commit.add_vote(vote):
+                return False
+            self.event_bus.publish_vote(EventDataVote(vote))
+            self.evsw.fire_event(EVENT_VOTE, vote)
+            if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                self._enter_new_round(rs.height, 0)
+            return True
+
+        if vote.height != rs.height:
+            return False
+
+        extensions_enabled = rs.votes.extensions_enabled
+        if (
+            extensions_enabled
+            and vote.msg_type == canonical.PRECOMMIT_TYPE
+            and not vote.block_id.is_nil()
+            and (
+                self.priv_validator_pub_key is None
+                or vote.validator_address
+                != bytes(self.priv_validator_pub_key.address())
+            )
+        ):
+            # App-level extension check (sig checked in VoteSet).
+            val = rs.validators.get_by_index(vote.validator_index)
+            if val is None:
+                return False
+            vote.verify_extension(self.state.chain_id, val.pub_key)
+            if not self.block_exec.verify_vote_extension(vote, self.state):
+                raise ConsensusError("rejected vote extension")
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self.event_bus.publish_vote(EventDataVote(vote))
+        self.evsw.fire_event(EVENT_VOTE, vote)
+
+        if vote.msg_type == canonical.PREVOTE_TYPE:
+            self._on_prevote_added(vote)
+        else:
+            self._on_precommit_added(vote)
+        return True
+
+    def _on_prevote_added(self, vote: Vote) -> None:
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        maj23 = prevotes.two_thirds_majority()
+        if maj23 is not None:
+            # Unlock on a later polka for a different block.
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round <= rs.round
+                and rs.locked_block.hash() != maj23.hash
+            ):
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # Track the latest valid block.
+            if (
+                not maj23.is_nil()
+                and rs.valid_round < vote.round == rs.round
+            ):
+                if (
+                    rs.proposal_block is not None
+                    and rs.proposal_block.hash() == maj23.hash
+                ):
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(maj23.part_set_header)
+                self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
+
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+            if maj23 is not None and (
+                rs.proposal_complete() or maj23.is_nil()
+            ):
+                self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                self._enter_prevote_wait(rs.height, vote.round)
+        elif (
+            rs.proposal is not None
+            and 0 <= rs.proposal.pol_round == vote.round
+        ):
+            if rs.proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+
+    def _on_precommit_added(self, vote: Vote) -> None:
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        maj23 = precommits.two_thirds_majority()
+        if maj23 is not None:
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit(rs.height, vote.round)
+            if not maj23.is_nil():
+                self._enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    self._enter_new_round(rs.height, 0)
+            else:
+                self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit_wait(rs.height, vote.round)
+
+    # -- own votes ---------------------------------------------------------
+
+    def _sign_vote(
+        self, msg_type: int, block_hash: bytes, part_set_header
+    ) -> Vote | None:
+        """state.go:2355 signVote."""
+        rs = self.rs
+        addr = bytes(self.priv_validator_pub_key.address())
+        idx, val = rs.validators.get_by_address(addr)
+        if val is None:
+            return None
+        block_id = (
+            BlockID(block_hash, part_set_header) if block_hash else BlockID()
+        )
+        vote = Vote(
+            msg_type=msg_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=block_id,
+            timestamp_ns=time.time_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        extensions_enabled = rs.votes.extensions_enabled
+        if (
+            extensions_enabled
+            and msg_type == canonical.PRECOMMIT_TYPE
+            and not block_id.is_nil()
+        ):
+            vote.extension = self.block_exec.extend_vote(vote, self.state)
+        self.priv_validator.sign_vote(
+            self.state.chain_id, vote,
+            sign_extension=extensions_enabled,
+        )
+        return vote
+
+    def _sign_add_vote(
+        self, msg_type: int, block_hash: bytes, part_set_header
+    ) -> None:
+        """state.go:2426 signAddVote."""
+        rs = self.rs
+        if self.priv_validator is None or self.priv_validator_pub_key is None:
+            return
+        if not rs.validators.has_address(
+            bytes(self.priv_validator_pub_key.address())
+        ):
+            return
+        try:
+            vote = self._sign_vote(msg_type, block_hash, part_set_header)
+        except Exception:
+            if self.replay_mode:
+                raise
+            return
+        if vote is not None:
+            self._send_internal(VoteMessage(vote))
+
+    # ------------------------------------------------------------------
+    # WAL crash recovery (replay.go catchupReplay:94)
+    # ------------------------------------------------------------------
+
+    def _catchup_replay(self) -> None:
+        height = self.rs.height
+        msgs = self.wal.search_for_end_height(height - 1)
+        if msgs is None:
+            # The WAL is seeded with EndHeight(0) at creation, so a missing
+            # marker means corruption — refusing to sign blindly is the
+            # whole point of the WAL (replay.go:94 returns an error here).
+            raise ConsensusError(
+                f"WAL has no #ENDHEIGHT marker for height {height - 1}; "
+                "refusing to start (possible WAL corruption)"
+            )
+        self.replay_mode = True
+        live_wal, self.wal = self.wal, NopWAL()
+        try:
+            for msg in msgs:
+                if isinstance(msg, MsgInfo):
+                    self._handle_msg(msg)
+                elif isinstance(msg, TimeoutInfo):
+                    self._handle_timeout(msg)
+        finally:
+            self.wal = live_wal
+            self.replay_mode = False

@@ -1,0 +1,166 @@
+"""Consensus write-ahead log (reference: consensus/wal.go:59-435).
+
+Every message the consensus loop consumes (peer msgs, own msgs, timeouts)
+is written BEFORE processing; own messages are fsynced (state.go:805) so a
+crash cannot double-sign. Records are CRC-framed over a rotating autofile
+``Group``; ``EndHeightMessage`` marks height boundaries for
+``search_for_end_height`` (replay start discovery, wal.go:232).
+
+Record frame: ``crc32(payload) u32 | len u32 | payload`` where payload is
+tagged JSON of one of the message dataclasses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+import zlib
+
+from ..libs import autofile
+from ..libs.jsoncodec import Codec
+from ..types import serialization as ser
+
+_FRAME = struct.Struct("<II")
+MAX_MSG_BYTES = 1 << 20  # wal.go maxMsgSizeBytes
+
+
+@dataclasses.dataclass(slots=True)
+class EndHeightMessage:
+    """Marks that ``height`` is fully committed (wal.go:38)."""
+
+    height: int
+
+
+@dataclasses.dataclass(slots=True)
+class MsgInfo:
+    """A consensus message + where it came from ("" = internal)."""
+
+    msg: object
+    peer_id: str = ""
+
+
+@dataclasses.dataclass(slots=True)
+class TimeoutInfo:
+    duration_s: float
+    height: int
+    round: int
+    step: int  # RoundStep value
+
+
+# WAL codec shares the types codec so Vote/Proposal/Block payloads nest.
+wal_codec: Codec = ser.codec
+wal_codec.register(EndHeightMessage, MsgInfo, TimeoutInfo)
+
+
+class WALError(Exception):
+    pass
+
+
+class WAL:
+    """BaseWAL (wal.go:77): framed records over an autofile Group."""
+
+    def __init__(self, path: str, head_size_limit: int | None = None):
+        kwargs = {}
+        if head_size_limit is not None:
+            kwargs["head_size_limit"] = head_size_limit
+        self.group = autofile.Group(path, **kwargs)
+        self._mtx = threading.Lock()
+        self._msgs_since_sync = 0
+        # Seed a brand-new WAL with #ENDHEIGHT 0 so replay can always find
+        # a marker (wal.go OnStart); absence later = corruption.
+        if self.group.max_index() < 0 and os.path.getsize(path) == 0:
+            self.write_end_height(0)
+
+    # -- write -------------------------------------------------------------
+
+    def write(self, msg) -> None:
+        payload = wal_codec.dumps(msg)
+        if len(payload) > MAX_MSG_BYTES:
+            raise WALError(f"msg of {len(payload)}B exceeds WAL limit")
+        frame = _FRAME.pack(zlib.crc32(payload), len(payload)) + payload
+        with self._mtx:
+            self.group.write(frame)
+            self.group.flush()
+
+    def write_sync(self, msg) -> None:
+        """fsync before returning — required before signing own msgs."""
+        self.write(msg)
+        with self._mtx:
+            self.group.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            self.group.flush_and_sync()
+
+    def write_end_height(self, height: int) -> None:
+        self.write_sync(EndHeightMessage(height))
+        self.group.check_head_size_limit()
+
+    # -- read --------------------------------------------------------------
+
+    def iter_messages(self):
+        """Yield every decodable message in order; stops at the first torn
+        or corrupt record (crash tail)."""
+        reader = autofile.GroupReader(self.group)
+        try:
+            while True:
+                hdr = reader.read(_FRAME.size)
+                if len(hdr) < _FRAME.size:
+                    return
+                crc, length = _FRAME.unpack(hdr)
+                if length > MAX_MSG_BYTES:
+                    return
+                payload = reader.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    return
+                try:
+                    yield wal_codec.loads(payload)
+                except Exception:
+                    return
+        finally:
+            reader.close()
+
+    def search_for_end_height(self, height: int) -> list | None:
+        """Messages AFTER ``EndHeightMessage(height)``, or None if that
+        marker never appears (wal.go SearchForEndHeight:232)."""
+        found = False
+        out: list = []
+        for msg in self.iter_messages():
+            if isinstance(msg, EndHeightMessage):
+                if msg.height == height:
+                    found = True
+                    out = []
+                continue
+            if found:
+                out.append(msg)
+        return out if found else None
+
+    def close(self) -> None:
+        self.group.close()
+
+
+class NopWAL:
+    """WAL that drops everything (wal.go nilWAL — used by tools/tests)."""
+
+    def write(self, msg) -> None:
+        pass
+
+    def write_sync(self, msg) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def write_end_height(self, height: int) -> None:
+        pass
+
+    def iter_messages(self):
+        return iter(())
+
+    def search_for_end_height(self, height: int):
+        return None
+
+    def close(self) -> None:
+        pass

@@ -28,6 +28,26 @@ class BitArray:
     def size(self) -> int:
         return self.bits
 
+    def to_bytes(self) -> bytes:
+        with self._mtx:
+            return bytes(self._elems)
+
+    @classmethod
+    def from_bytes(cls, bits: int, data: bytes) -> "BitArray":
+        ba = cls(bits)
+        if len(data) != len(ba._elems):
+            raise ValueError(
+                f"bit array of {bits} bits needs {len(ba._elems)} bytes, "
+                f"got {len(data)}"
+            )
+        ba._elems[:] = data
+        # Zero-tail invariant: every predicate (is_full/__eq__/or_) assumes
+        # bits past `bits` are 0.
+        rem = bits % 8
+        if rem and ba._elems:
+            ba._elems[-1] &= (1 << rem) - 1
+        return ba
+
     def get_index(self, i: int) -> bool:
         if i < 0 or i >= self.bits:
             return False

@@ -18,6 +18,10 @@ PRECOMMIT_TYPE = 2
 PROPOSAL_TYPE = 32
 
 
+def is_vote_type(msg_type: int) -> bool:
+    return msg_type in (PREVOTE_TYPE, PRECOMMIT_TYPE)
+
+
 def canonical_part_set_header(total: int, hash_: bytes) -> bytes:
     return proto.field_varint(1, total) + proto.field_bytes(2, hash_)
 

@@ -85,7 +85,11 @@ class ChainDriver:
         self.last_block_id: BlockID | None = None
 
     def next_block(self, txs: list[bytes]):
-        height = self.state.last_block_height + 1 or self.state.initial_height
+        height = (
+            self.state.initial_height
+            if self.state.last_block_height == 0
+            else self.state.last_block_height + 1
+        )
         if height == self.state.initial_height:
             last_commit = None
         else:
@@ -126,3 +130,138 @@ class ChainDriver:
         block, parts, block_id = self.next_block(txs)
         state = self.commit_block(block, parts, block_id)
         return block, parts, block_id, state
+
+
+# -- in-process consensus net (reference analog: randConsensusNet,
+# consensus/common_test.go:765 — perfect-gossip wiring instead of p2p) ----
+
+
+def make_consensus_node(genesis, pv, config=None, home=None):
+    """One full single-process node core: kvstore app + stores + executor
+    + consensus state. Returns (cs, parts) where parts has handles."""
+    from cometbft_tpu import proxy
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.consensus import ConsensusState
+    from cometbft_tpu.consensus.wal import WAL
+    from cometbft_tpu.libs import db as dbm
+    from cometbft_tpu.state import BlockExecutor, Store
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.types.event_bus import EventBus
+
+    cfg = config or test_config()
+    if home is None:
+        app_db = dbm.MemDB()
+        state_db = dbm.MemDB()
+        block_db = dbm.MemDB()
+        wal = None
+    else:
+        import os
+
+        os.makedirs(home, exist_ok=True)
+        app_db = dbm.FileDB(f"{home}/app.db")
+        state_db = dbm.FileDB(f"{home}/state.db")
+        block_db = dbm.FileDB(f"{home}/blocks.db")
+        wal = WAL(f"{home}/cs.wal/wal")
+    app = KVStoreApplication(app_db)
+    conns = proxy.AppConns(proxy.local_client_creator(app))
+    conns.start()
+    state_store = Store(state_db)
+    block_store = BlockStore(block_db)
+    bus = EventBus()
+    bus.start()
+    state = state_store.load()
+    if state is None:
+        state = make_genesis_state(genesis)
+        state_store.save(state)
+    executor = BlockExecutor(
+        state_store, conns.consensus, block_store=block_store, event_bus=bus
+    )
+    cs = ConsensusState(
+        cfg.consensus,
+        state,
+        executor,
+        block_store,
+        event_bus=bus,
+        wal=wal,
+    )
+    cs.set_priv_validator(pv)
+    parts = dict(
+        app=app, conns=conns, state_store=state_store,
+        block_store=block_store, bus=bus, executor=executor, config=cfg,
+        dbs=(app_db, state_db, block_db),
+    )
+    return cs, parts
+
+
+def wire_perfect_gossip(nodes):
+    """Forward every internally-generated consensus message to all peers,
+    emulating the gossip mesh for in-process tests."""
+    from cometbft_tpu.consensus.messages import (
+        BlockPartMessage,
+        ProposalMessage,
+        VoteMessage,
+    )
+
+    css = [cs for cs, _ in nodes]
+    for i, cs in enumerate(css):
+        orig = cs._send_internal
+
+        def send(msg, cs=cs, orig=orig, me=i):
+            orig(msg)
+            for j, other in enumerate(css):
+                if j == me:
+                    continue
+                if isinstance(msg, VoteMessage):
+                    other.add_vote_from_peer(msg.vote, f"node{me}")
+                elif isinstance(msg, ProposalMessage):
+                    other.set_proposal_from_peer(msg.proposal, f"node{me}")
+                elif isinstance(msg, BlockPartMessage):
+                    other.add_block_part_from_peer(
+                        msg.height, msg.round, msg.part, f"node{me}"
+                    )
+
+        cs._send_internal = send
+
+
+def stop_node(cs, parts):
+    try:
+        if cs.is_running():
+            cs.stop()
+    except Exception:
+        pass
+    try:
+        parts["bus"].stop()
+    except Exception:
+        pass
+    try:
+        parts["conns"].stop()
+    except Exception:
+        pass
+    for db in parts.get("dbs", ()):
+        try:
+            db.close()
+        except Exception:
+            pass
+    if cs.wal is not None:
+        try:
+            cs.wal.close()
+        except Exception:
+            pass
+
+
+def wait_for_height(parts_or_store, height: int, timeout: float = 30.0):
+    """Block until the node's block store reaches ``height``."""
+    import time as _t
+
+    store = (
+        parts_or_store["block_store"]
+        if isinstance(parts_or_store, dict)
+        else parts_or_store
+    )
+    deadline = _t.monotonic() + timeout
+    while _t.monotonic() < deadline:
+        if store.height() >= height:
+            return True
+        _t.sleep(0.02)
+    return False
