@@ -19,9 +19,13 @@ from ..types import proto
 from . import types as abci
 
 # Registry of every dataclass the protocol can carry, by class name.
+# RequestInitChain/ResponseFinalizeBlock embed the consensus-params types.
+from ..types import params as _params  # noqa: E402
+
 _TYPES = {
     name: obj
-    for name, obj in vars(abci).items()
+    for mod in (abci, _params)
+    for name, obj in vars(mod).items()
     if dataclasses.is_dataclass(obj)
 }
 
@@ -36,7 +40,7 @@ def _to_jsonable(v):
         return {"__b": v.hex()}
     if isinstance(v, IntEnum):
         return int(v)
-    if isinstance(v, list):
+    if isinstance(v, (list, tuple)):
         return [_to_jsonable(x) for x in v]
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v

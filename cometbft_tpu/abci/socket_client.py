@@ -74,7 +74,7 @@ class SocketClient(Client):
             try:
                 self._wfile.write(codec.encode_frame(rr.method, rr.request))
                 self._wfile.flush()
-            except (OSError, ValueError) as e:
+            except Exception as e:  # incl. codec errors — fail loudly
                 self._fail(e)
                 return
 

@@ -1,0 +1,155 @@
+"""``python -m cometbft_tpu.cmd`` — the node CLI (reference:
+cmd/cometbft/main.go:14-52 + commands/).
+
+Commands: init, start, unsafe-reset-all, show-validator, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+
+def _config(args):
+    from ..config import default_config
+
+    cfg = default_config()
+    cfg.base.home = args.home
+    if getattr(args, "proxy_app", None):
+        cfg.base.proxy_app = args.proxy_app
+    return cfg
+
+
+def cmd_version(args) -> int:
+    from ..state.state import ABCI_SEMVER, BLOCK_PROTOCOL, SOFTWARE_VERSION
+
+    print(
+        json.dumps(
+            {
+                "version": SOFTWARE_VERSION,
+                "block_protocol": BLOCK_PROTOCOL,
+                "abci": ABCI_SEMVER,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_init(args) -> int:
+    from ..node import init_files
+
+    cfg = _config(args)
+    out = init_files(cfg)
+    print(f"initialized home at {os.path.expanduser(cfg.base.home)}")
+    if out["created_genesis"]:
+        print(f"generated genesis at {out['genesis_file']}")
+    print(
+        "validator address:",
+        bytes(out["pv"].get_pub_key().address()).hex().upper(),
+    )
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from ..privval import FilePV
+
+    cfg = _config(args)
+    pv = FilePV.load(
+        cfg.base.resolve(cfg.base.priv_validator_key_file),
+        cfg.base.resolve(cfg.base.priv_validator_state_file),
+    )
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.type, "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset.go — wipe data, keep keys, reset sign state."""
+    from ..privval import FilePV, LastSignState
+
+    cfg = _config(args)
+    data_dir = cfg.base.resolve("data")
+    if os.path.isdir(data_dir):
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    key_file = cfg.base.resolve(cfg.base.priv_validator_key_file)
+    state_file = cfg.base.resolve(cfg.base.priv_validator_state_file)
+    if os.path.exists(key_file):
+        LastSignState(file_path=state_file).save()
+    print(f"reset data dir {data_dir}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    from ..node import default_new_node
+
+    cfg = _config(args)
+    node = default_new_node(cfg)
+    node.start()
+    print(
+        f"node started: chain={node.genesis.chain_id} "
+        f"height={node.state.last_block_height}",
+        flush=True,
+    )
+
+    stop = {"flag": False}
+
+    def _sig(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    last = -1
+    while not stop["flag"]:
+        h = node.block_store.height()
+        if h != last:
+            print(
+                f"committed height={h} "
+                f"app_hash={node.block_store.load_block_meta(h).header.app_hash.hex() if h > 1 else ''}",
+                flush=True,
+            )
+            last = h
+        time.sleep(0.25)
+    node.stop()
+    print("node stopped")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cometbft-tpu")
+    p.add_argument(
+        "--home",
+        default=os.environ.get("CMTHOME", "~/.cometbft-tpu"),
+        help="node home directory",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version")
+    sub.add_parser("init")
+    sub.add_parser("show-validator")
+    sub.add_parser("unsafe-reset-all")
+    sp = sub.add_parser("start")
+    sp.add_argument(
+        "--proxy-app",
+        dest="proxy_app",
+        default=None,
+        help="kvstore | noop | tcp://... | unix://...",
+    )
+
+    args = p.parse_args(argv)
+    return {
+        "version": cmd_version,
+        "init": cmd_init,
+        "show-validator": cmd_show_validator,
+        "unsafe-reset-all": cmd_unsafe_reset_all,
+        "start": cmd_start,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

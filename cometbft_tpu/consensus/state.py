@@ -295,10 +295,21 @@ class ConsensusState(BaseService):
             self._enter_new_round(ti.height, 0)
 
     def _handle_txs_available(self) -> None:
+        """state.go:981 handleTxsAvailable — round 0 only."""
         rs = self.rs
-        if rs.step != RoundStep.NEW_ROUND:
+        if rs.round != 0:
             return
-        self._enter_propose(rs.height, rs.round)
+        if rs.step == RoundStep.NEW_HEIGHT:
+            # Still inside the timeout_commit window: arm a NEW_ROUND
+            # timeout for when it expires instead of dropping the signal.
+            remaining = max(
+                0.001, (rs.start_time_ns - time.time_ns()) / 1e9 + 0.001
+            )
+            self._schedule_timeout(
+                remaining, rs.height, 0, RoundStep.NEW_ROUND
+            )
+        elif rs.step == RoundStep.NEW_ROUND:
+            self._enter_propose(rs.height, 0)
 
     # ------------------------------------------------------------------
     # state transitions
@@ -483,9 +494,10 @@ class ConsensusState(BaseService):
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
         except Exception:
-            if not self.replay_mode:
-                return
-            raise
+            # Expected during WAL replay: FilePV refuses to re-sign an
+            # already-signed HRS with different data (state.go:1217 logs
+            # only outside replay mode).
+            return
         self._send_internal(ProposalMessage(proposal))
         for i in range(parts.header.total):
             self._send_internal(
@@ -974,8 +986,8 @@ class ConsensusState(BaseService):
         try:
             vote = self._sign_vote(msg_type, block_hash, part_set_header)
         except Exception:
-            if self.replay_mode:
-                raise
+            # FilePV double-sign refusal — silent in replay, where the WAL
+            # already carries the originally-signed vote (state.go:2426+).
             return
         if vote is not None:
             self._send_internal(VoteMessage(vote))

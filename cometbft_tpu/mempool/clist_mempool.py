@@ -1,0 +1,294 @@
+"""CList mempool (reference: mempool/clist_mempool.go:26-671).
+
+Tx pool on a concurrent list so per-peer broadcast routines can tail it.
+``check_tx`` pushes through the async ABCI mempool connection; the global
+response callback admits valid txs (``resCbFirstTime:373``). After every
+block commit, ``update`` removes committed txs and re-checks the remainder
+(``resCbRecheck:438``). Consensus gets ``TxsAvailable`` edge signals.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ..abci import types as abci
+from ..config import MempoolConfig
+from ..crypto import tmhash
+from ..libs.clist import CList
+
+
+def TxKey(tx: bytes) -> bytes:
+    return tmhash.sum(tx)
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    pass
+
+
+class MempoolFullError(MempoolError):
+    pass
+
+
+@dataclass(slots=True)
+class MempoolTx:
+    tx: bytes
+    height: int  # height when validated
+    gas_wanted: int = 0
+    senders: set = field(default_factory=set)  # peer ids that sent it
+
+
+class CListMempool:
+    def __init__(
+        self,
+        config: MempoolConfig,
+        proxy_app,  # mempool-connection ABCI client
+        height: int = 0,
+        pre_check=None,
+        post_check=None,
+    ):
+        self.config = config
+        self.proxy_app = proxy_app
+        self.height = height
+        self.pre_check = pre_check
+        self.post_check = post_check
+        self.txs = CList()
+        self.tx_map: dict[bytes, object] = {}  # TxKey -> CElement
+        self.cache = (
+            __import__(
+                "cometbft_tpu.mempool.cache", fromlist=["LRUTxCache"]
+            ).LRUTxCache(config.cache_size)
+            if config.cache_size > 0
+            else __import__(
+                "cometbft_tpu.mempool.cache", fromlist=["NopTxCache"]
+            ).NopTxCache()
+        )
+        # Consensus lock: held across Commit so no CheckTx races app state
+        self._update_mtx = threading.RLock()
+        self._size_bytes = 0
+        self._recheck_cursor = None  # next element expecting a recheck result
+        self._recheck_end = None
+        self._txs_available: threading.Event | None = None
+        self._notified_txs_available = False
+        self._pending_senders: dict[bytes, str] = {}
+        proxy_app.set_response_callback(self._global_cb)
+
+    # -- config hooks ------------------------------------------------------
+
+    def enable_txs_available(self) -> None:
+        self._txs_available = threading.Event()
+
+    def txs_available(self) -> threading.Event:
+        return self._txs_available
+
+    # -- sizes -------------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.txs)
+
+    def size_bytes(self) -> int:
+        with self._update_mtx:
+            return self._size_bytes
+
+    def is_full(self, tx_len: int) -> MempoolFullError | None:
+        if (
+            self.size() >= self.config.size
+            or tx_len + self.size_bytes() > self.config.max_txs_bytes
+        ):
+            return MempoolFullError(
+                f"mempool full: {self.size()} txs, {self.size_bytes()}B"
+            )
+        return None
+
+    # -- CheckTx ingress (clist_mempool.go:247) ----------------------------
+
+    def check_tx(self, tx: bytes, cb=None, sender: str = "") -> None:
+        with self._update_mtx:
+            if len(tx) > self.config.max_tx_bytes:
+                raise MempoolError(
+                    f"tx too large: {len(tx)} > {self.config.max_tx_bytes}"
+                )
+            if self.pre_check is not None:
+                self.pre_check(tx)
+            err = self.is_full(len(tx))
+            if err is not None:
+                raise err
+            key = TxKey(tx)
+            if not self.cache.push(key):
+                # Seen before: record the extra sender for gossip dedup.
+                el = self.tx_map.get(key)
+                if el is not None and sender:
+                    el.value.senders.add(sender)
+                raise TxInCacheError(key.hex())
+            if sender:
+                self._pending_senders[key] = sender
+            reqres = self.proxy_app.check_tx_async(
+                abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW)
+            )
+            if cb is not None:
+                reqres.set_callback(cb)
+
+    def _global_cb(self, req, res) -> None:
+        """proxy_app's global callback (resCbFirstTime / resCbRecheck)."""
+        if self._recheck_cursor is not None:
+            self._res_cb_recheck(req, res)
+        else:
+            self._res_cb_first_time(req, res)
+
+    def _res_cb_first_time(self, req, res) -> None:
+        tx = req.tx
+        key = TxKey(tx)
+        with self._update_mtx:
+            post_ok = True
+            if self.post_check is not None:
+                try:
+                    self.post_check(tx, res)
+                except Exception:
+                    post_ok = False
+            if res.code == abci.OK and post_ok:
+                if self.is_full(len(tx)) is not None:
+                    self.cache.remove(key)
+                    self._pending_senders.pop(key, None)
+                    return
+                sender = self._pending_senders.pop(key, "")
+                memtx = MempoolTx(
+                    tx=tx,
+                    height=self.height,
+                    gas_wanted=res.gas_wanted,
+                )
+                if sender:
+                    memtx.senders.add(sender)
+                el = self.txs.push_back(memtx)
+                self.tx_map[key] = el
+                self._size_bytes += len(tx)
+                self._notify_txs_available()
+            else:
+                self._pending_senders.pop(key, None)
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(key)
+
+    def _res_cb_recheck(self, req, res) -> None:
+        with self._update_mtx:
+            el = self._recheck_cursor
+            if el is None:
+                return
+            # responses come back in recheck submission order
+            if el.value.tx != req.tx:
+                # out-of-sync; drop cursor to stop recheck gracefully
+                self._recheck_cursor = None
+                return
+            if res.code != abci.OK:
+                self._remove_tx_el(el)
+                if not self.config.keep_invalid_txs_in_cache:
+                    self.cache.remove(TxKey(req.tx))
+            if el is self._recheck_end:
+                self._recheck_cursor = None
+                if self.size() > 0:
+                    self._notify_txs_available()
+            else:
+                self._recheck_cursor = el.next()
+
+    # -- reap (clist_mempool.go ReapMaxBytesMaxGas) ------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        with self._update_mtx:
+            out, total_bytes, total_gas = [], 0, 0
+            for el in self.txs:
+                memtx = el.value
+                if max_bytes > -1 and total_bytes + len(memtx.tx) > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + memtx.gas_wanted > max_gas:
+                    break
+                out.append(memtx.tx)
+                total_bytes += len(memtx.tx)
+                total_gas += memtx.gas_wanted
+            return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        with self._update_mtx:
+            out = []
+            for el in self.txs:
+                if 0 <= n <= len(out):
+                    break
+                out.append(el.value.tx)
+            return out
+
+    # -- consensus integration ---------------------------------------------
+
+    def lock(self) -> None:
+        self._update_mtx.acquire()
+
+    def unlock(self) -> None:
+        self._update_mtx.release()
+
+    def flush(self) -> None:
+        with self._update_mtx:
+            for el in list(self.txs):
+                self.txs.remove(el)
+            self.tx_map.clear()
+            self._size_bytes = 0
+            self.cache.reset()
+            self._recheck_cursor = None
+
+    def _remove_tx_el(self, el) -> None:
+        self.txs.remove(el)
+        self.tx_map.pop(TxKey(el.value.tx), None)
+        self._size_bytes -= len(el.value.tx)
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        with self._update_mtx:
+            el = self.tx_map.get(key)
+            if el is not None:
+                self._remove_tx_el(el)
+
+    def update(
+        self,
+        height: int,
+        txs: list[bytes],
+        tx_results: list,
+        pre_check=None,
+        post_check=None,
+    ) -> None:
+        """Called with the lock HELD, inside BlockExecutor.Commit
+        (clist_mempool.go Update:584)."""
+        self.height = height
+        self._notified_txs_available = False
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
+        for tx, res in zip(txs, tx_results):
+            key = TxKey(tx)
+            if res.code == abci.OK:
+                self.cache.push(key)  # committed: never re-admit
+            elif not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            self.remove_tx_by_key(key)
+        if self.size() > 0:
+            if self.config.recheck:
+                self._recheck_txs()
+            else:
+                self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        # No sync flush here: we hold _update_mtx and the socket client's
+        # recv thread needs it to process the recheck responses — a
+        # synchronous flush would deadlock (the reference uses FlushAsync,
+        # clist_mempool.go:476). Requests are written eagerly.
+        self._recheck_cursor = self.txs.front()
+        self._recheck_end = self.txs.back()
+        for el in self.txs:
+            self.proxy_app.check_tx_async(
+                abci.RequestCheckTx(
+                    tx=el.value.tx, type=abci.CheckTxType.RECHECK
+                )
+            )
+
+    def _notify_txs_available(self) -> None:
+        if self._txs_available is not None and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()

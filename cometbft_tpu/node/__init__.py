@@ -1,0 +1,8 @@
+"""L8 node assembly (reference: node/)."""
+
+from .node import (  # noqa: F401
+    Node,
+    default_new_node,
+    init_files,
+    load_genesis,
+)

@@ -1,0 +1,221 @@
+"""Node assembly (reference: node/node.go:138 NewNode, node/setup.go).
+
+Wiring order mirrors the reference: DBs → state → proxy app (4 conns) →
+event bus → handshake (app replay) → mempool → consensus → RPC/p2p (as
+those layers land). ``Node.start`` boots services in dependency order;
+``stop`` unwinds them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from .. import proxy
+from ..abci.kvstore import KVStoreApplication
+from ..config import Config
+from ..consensus import ConsensusState
+from ..consensus.replay import Handshaker
+from ..consensus.wal import WAL
+from ..libs import db as dbm
+from ..libs.service import BaseService
+from ..mempool import CListMempool
+from ..privval import FilePV
+from ..state import BlockExecutor, Store, make_genesis_state
+from ..state.execution import NopEvidencePool
+from ..store import BlockStore
+from ..types import GenesisDoc
+from ..types.event_bus import EventBus
+
+
+def init_files(config: Config) -> dict:
+    """``cometbft init`` (cmd/cometbft/commands/init.go): write config dir,
+    node key, validator key, and a single-validator genesis if absent."""
+    home = os.path.expanduser(config.base.home)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+
+    pv_key_file = config.base.resolve(config.base.priv_validator_key_file)
+    pv_state_file = config.base.resolve(config.base.priv_validator_state_file)
+    pv = FilePV.load_or_generate(pv_key_file, pv_state_file)
+
+    genesis_file = config.base.resolve(config.base.genesis_file)
+    created_genesis = False
+    if not os.path.exists(genesis_file):
+        from ..types import GenesisValidator
+
+        doc = GenesisDoc(
+            chain_id=f"test-chain-{os.urandom(3).hex()}",
+            validators=[
+                GenesisValidator(pub_key=pv.get_pub_key(), power=10)
+            ],
+        )
+        doc.validate_and_complete()
+        with open(genesis_file, "w") as f:
+            f.write(doc.to_json())
+        created_genesis = True
+    return {
+        "pv": pv,
+        "genesis_file": genesis_file,
+        "created_genesis": created_genesis,
+    }
+
+
+def load_genesis(config: Config) -> GenesisDoc:
+    with open(config.base.resolve(config.base.genesis_file)) as f:
+        return GenesisDoc.from_json(f.read())
+
+
+def _make_db(config: Config, name: str) -> dbm.DB:
+    if config.base.db_backend == "mem":
+        return dbm.MemDB()
+    data_dir = config.base.resolve("data")
+    return dbm.FileDB(os.path.join(data_dir, f"{name}.db"))
+
+
+def _app_client_creator(config: Config, app_db: dbm.DB):
+    """proxy/client.go DefaultClientCreator."""
+    pa = config.base.proxy_app
+    if pa in ("kvstore", "persistent_kvstore"):
+        return proxy.local_client_creator(KVStoreApplication(app_db)), True
+    if pa == "noop":
+        from ..abci.application import BaseApplication
+
+        return proxy.local_client_creator(BaseApplication()), True
+    if pa.startswith(("tcp://", "unix://")):
+        return proxy.socket_client_creator(pa), False
+    raise ValueError(f"unknown proxy_app {pa!r}")
+
+
+class Node(BaseService):
+    def __init__(self, config: Config, genesis: GenesisDoc, priv_validator):
+        super().__init__("node")
+        self.config = config
+        self.genesis = genesis
+
+        # 1. DBs (setup.go initDBs:107)
+        self.app_db = _make_db(config, "app")
+        self.block_db = _make_db(config, "blockstore")
+        self.state_db = _make_db(config, "state")
+        self.block_store = BlockStore(self.block_db)
+        self.state_store = Store(self.state_db)
+
+        # 2. State from DB or genesis (setup.go:537)
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(genesis)
+            self.state_store.save(state)
+
+        # 3. Proxy app — 4 connections (setup.go:123)
+        creator, _in_process = _app_client_creator(config, self.app_db)
+        self.proxy_app = proxy.AppConns(
+            creator, on_error=self._on_app_error
+        )
+        self.proxy_app.start()
+
+        # 4. EventBus (setup.go:132)
+        self.event_bus = EventBus()
+        self.event_bus.start()
+
+        # 5. Handshake: sync app to store (setup.go:169 doHandshake)
+        executor_for_replay = BlockExecutor(
+            self.state_store, self.proxy_app.consensus,
+            block_store=self.block_store,
+        )
+        handshaker = Handshaker(
+            self.state_store, state, self.block_store, genesis,
+            block_exec=executor_for_replay,
+        )
+        handshaker.handshake(self.proxy_app)
+        state = handshaker.state
+
+        # 6. Mempool (setup.go:223)
+        self.mempool = CListMempool(
+            config.mempool,
+            self.proxy_app.mempool,
+            height=state.last_block_height,
+        )
+        if config.consensus.create_empty_blocks is False:
+            self.mempool.enable_txs_available()
+
+        # 7. Evidence (real pool lands with the evidence milestone)
+        self.evidence_pool = NopEvidencePool()
+
+        # 8. Block executor + consensus (setup.go:254-292)
+        self.block_exec = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+        wal_path = config.base.resolve(config.consensus.wal_file)
+        os.makedirs(os.path.dirname(wal_path), exist_ok=True)
+        self.consensus = ConsensusState(
+            config.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            tx_notifier=self.mempool,
+            evidence_pool=None,
+            event_bus=self.event_bus,
+            wal=WAL(wal_path),
+        )
+        if priv_validator is not None:
+            self.consensus.set_priv_validator(priv_validator)
+        self.state = state
+        self._txs_available_thread: threading.Thread | None = None
+
+    def _on_app_error(self, err: Exception) -> None:
+        # Fail-stop: the app is the source of truth (multi_app_conn.go:129).
+        if self.is_running():
+            try:
+                self.stop()
+            except Exception:
+                os._exit(1)
+
+    # -- lifecycle (node.go:364 OnStart) -----------------------------------
+
+    def on_start(self) -> None:
+        self.consensus.start()
+        if self.mempool.txs_available() is not None:
+            self._txs_available_thread = threading.Thread(
+                target=self._forward_txs_available, daemon=True
+            )
+            self._txs_available_thread.start()
+
+    def _forward_txs_available(self) -> None:
+        ev = self.mempool.txs_available()
+        while not self.quit_event().is_set():
+            if ev.wait(timeout=0.2):
+                ev.clear()
+                self.consensus.handle_txs_available()
+
+    def on_stop(self) -> None:
+        for svc in (self.consensus, self.event_bus, self.proxy_app):
+            try:
+                if svc.is_running():
+                    svc.stop()
+            except Exception:
+                pass
+        try:
+            self.consensus.wal.close()
+        except Exception:
+            pass
+        for db in (self.app_db, self.block_db, self.state_db):
+            try:
+                db.close()
+            except Exception:
+                pass
+
+
+def default_new_node(config: Config) -> Node:
+    """node/setup.go:64 DefaultNewNode."""
+    pv = FilePV.load_or_generate(
+        config.base.resolve(config.base.priv_validator_key_file),
+        config.base.resolve(config.base.priv_validator_state_file),
+    )
+    genesis = load_genesis(config)
+    return Node(config, genesis, pv)

@@ -1,0 +1,3 @@
+"""Private validator implementations (reference: privval/)."""
+
+from .file_pv import FilePV, LastSignState  # noqa: F401

@@ -1,0 +1,420 @@
+"""FilePV double-sign protection, CList mempool, handshake replay, and
+full-node assembly tests (reference analogs: privval/file_test.go,
+mempool/clist_mempool_test.go, consensus/replay_test.go, node/node_test.go).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.config import (
+    MempoolConfig,
+    default_config,
+    test_config as make_test_config,
+)
+from cometbft_tpu.consensus.replay import Handshaker
+from cometbft_tpu.libs import db as dbm
+from cometbft_tpu.libs.clist import CList
+from cometbft_tpu.mempool import CListMempool, TxKey
+from cometbft_tpu.mempool.clist_mempool import (
+    MempoolFullError,
+    TxInCacheError,
+)
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.privval.file_pv import DoubleSignError
+from cometbft_tpu.types import BlockID, PartSetHeader, Vote, canonical
+from cometbft_tpu import proxy as proxy_mod
+
+from helpers import ChainDriver, make_genesis
+
+
+# -- clist -----------------------------------------------------------------
+
+
+def test_clist_basic_and_wait():
+    cl = CList()
+    assert len(cl) == 0 and cl.front() is None
+    e1 = cl.push_back(1)
+    e2 = cl.push_back(2)
+    assert [el.value for el in cl] == [1, 2]
+    cl.remove(e1)
+    assert [el.value for el in cl] == [2]
+    # next_wait wakes when a successor arrives
+    got = []
+
+    def waiter():
+        nxt = e2.next_wait(timeout=5)
+        got.append(nxt.value if nxt else None)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    cl.push_back(3)
+    t.join(timeout=5)
+    assert got == [3]
+
+
+def test_clist_iteration_during_removal():
+    cl = CList()
+    els = [cl.push_back(i) for i in range(10)]
+    seen = []
+    for el in cl:
+        seen.append(el.value)
+        if el.value == 3:
+            cl.remove(els[5])  # remove ahead of the cursor
+    assert 5 not in seen
+    assert seen == [0, 1, 2, 3, 4, 6, 7, 8, 9]
+
+
+# -- FilePV ----------------------------------------------------------------
+
+
+def _vote(height, round_, msg_type=canonical.PRECOMMIT_TYPE, block_hash=b"\xab" * 32):
+    bid = (
+        BlockID(block_hash, PartSetHeader(1, b"\xcd" * 32))
+        if block_hash
+        else BlockID()
+    )
+    return Vote(
+        msg_type=msg_type,
+        height=height,
+        round=round_,
+        block_id=bid,
+        timestamp_ns=time.time_ns(),
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+def test_filepv_generates_and_persists(tmp_path):
+    kf, sf = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kf, sf)
+    pv2 = FilePV.load(kf, sf)
+    assert pv.get_pub_key() == pv2.get_pub_key()
+
+
+def test_filepv_signs_and_blocks_regression(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+    chain = "test-chain"
+    v = _vote(5, 2)
+    pv.sign_vote(chain, v, sign_extension=False)
+    assert pv.get_pub_key().verify_signature(v.sign_bytes(chain), v.signature)
+
+    # lower height → refuse
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(chain, _vote(4, 0), sign_extension=False)
+    # same height, lower round → refuse
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(chain, _vote(5, 1), sign_extension=False)
+    # same HRS, different block → refuse (the double-sign case)
+    with pytest.raises(DoubleSignError):
+        pv.sign_vote(chain, _vote(5, 2, block_hash=b"\xee" * 32),
+                     sign_extension=False)
+
+
+def test_filepv_same_hrs_timestamp_only_reuses_sig(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+    chain = "test-chain"
+    v1 = _vote(7, 0)
+    pv.sign_vote(chain, v1, sign_extension=False)
+    v2 = _vote(7, 0)  # identical but a fresh timestamp
+    pv.sign_vote(chain, v2, sign_extension=False)
+    assert v2.signature == v1.signature
+    assert v2.timestamp_ns == v1.timestamp_ns  # old timestamp restored
+    assert pv.get_pub_key().verify_signature(v2.sign_bytes(chain), v2.signature)
+
+
+def test_filepv_state_survives_restart(tmp_path):
+    kf, sf = str(tmp_path / "k.json"), str(tmp_path / "s.json")
+    pv = FilePV.generate(kf, sf)
+    pv.sign_vote("c", _vote(9, 1), sign_extension=False)
+    pv2 = FilePV.load(kf, sf)  # "restart"
+    with pytest.raises(DoubleSignError):
+        pv2.sign_vote("c", _vote(9, 0), sign_extension=False)
+
+
+def test_filepv_step_ordering(tmp_path):
+    pv = FilePV.generate(str(tmp_path / "k.json"), str(tmp_path / "s.json"))
+    prevote = _vote(3, 0, msg_type=canonical.PREVOTE_TYPE)
+    pv.sign_vote("c", prevote, sign_extension=False)
+    precommit = _vote(3, 0, msg_type=canonical.PRECOMMIT_TYPE)
+    pv.sign_vote("c", precommit, sign_extension=False)  # later step: fine
+    with pytest.raises(DoubleSignError):  # back to prevote: refuse
+        pv.sign_vote("c", _vote(3, 0, msg_type=canonical.PREVOTE_TYPE,
+                                block_hash=b"\x99" * 32),
+                     sign_extension=False)
+
+
+# -- mempool ---------------------------------------------------------------
+
+
+@pytest.fixture
+def pool():
+    app = KVStoreApplication()
+    client = LocalClient(app)
+    client.start()
+    mp = CListMempool(MempoolConfig(), client)
+    yield mp, app, client
+    client.stop()
+
+
+def test_mempool_check_add_reap(pool):
+    mp, app, _ = pool
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1")  # dedup
+    mp.check_tx(b"bad-tx")  # app rejects → not added
+    assert mp.size() == 2
+    assert mp.reap_max_bytes_max_gas(-1, -1) == [b"a=1", b"b=2"]
+    assert mp.reap_max_bytes_max_gas(3, -1) == [b"a=1"]
+    assert mp.reap_max_txs(1) == [b"a=1"]
+
+
+def test_mempool_update_removes_committed(pool):
+    mp, _, _ = pool
+    from cometbft_tpu.abci.types import ExecTxResult
+
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    mp.lock()
+    try:
+        mp.update(1, [b"a=1"], [ExecTxResult(code=0)])
+    finally:
+        mp.unlock()
+    assert mp.reap_max_txs(-1) == [b"b=2"]
+    # committed txs stay cached: re-adding is rejected
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1")
+
+
+def test_mempool_txs_available_signal(pool):
+    mp, _, _ = pool
+    mp.enable_txs_available()
+    assert not mp.txs_available().is_set()
+    mp.check_tx(b"x=1")
+    assert mp.txs_available().is_set()
+
+
+def test_mempool_full(pool):
+    mp, _, _ = pool
+    mp.config.size = 1
+    mp.check_tx(b"a=1")
+    with pytest.raises(MempoolFullError):
+        mp.check_tx(b"b=2")
+
+
+def test_mempool_sender_tracking(pool):
+    mp, _, _ = pool
+    mp.check_tx(b"a=1", sender="peer1")
+    with pytest.raises(TxInCacheError):
+        mp.check_tx(b"a=1", sender="peer2")
+    el = mp.tx_map[TxKey(b"a=1")]
+    assert el.value.senders == {"peer1", "peer2"}
+
+
+# -- handshake replay ------------------------------------------------------
+
+
+def _fresh_stack(app_db=None):
+    from cometbft_tpu.state import BlockExecutor, Store
+    from cometbft_tpu.store import BlockStore
+
+    app = KVStoreApplication(app_db if app_db is not None else dbm.MemDB())
+    conns = proxy_mod.AppConns(proxy_mod.local_client_creator(app))
+    conns.start()
+    ss = Store(dbm.MemDB())
+    bs = BlockStore(dbm.MemDB())
+    ex = BlockExecutor(ss, conns.consensus, block_store=bs)
+    return app, conns, ss, bs, ex
+
+
+def test_handshake_fresh_chain_initchain():
+    genesis, pvs = make_genesis(2)
+    app, conns, ss, bs, ex = _fresh_stack()
+    from cometbft_tpu.state import make_genesis_state
+
+    state = make_genesis_state(genesis)
+    ss.save(state)
+    h = Handshaker(ss, state, bs, genesis, block_exec=ex)
+    h.handshake(conns)
+    # InitChain delivered the genesis validators to the app
+    assert len(app._validators) == 2
+    conns.stop()
+
+
+def test_handshake_replays_app_behind_store():
+    genesis, pvs = make_genesis(4)
+    # build a 3-block chain, keeping store+state but wiping the app
+    app, conns, ss, bs, ex = _fresh_stack()
+    from helpers import sign_commit
+
+    driver = ChainDriver(genesis, pvs, ex)
+    for i in range(1, 4):
+        block, parts, bid = driver.next_block([f"k{i}=v{i}".encode()])
+        commit = sign_commit(
+            genesis.chain_id, driver.state.validators, pvs, i, 0, bid,
+            time_ns=block.header.time_ns + 1,
+        )
+        bs.save_block(block, parts, commit)
+        driver.commit_block(block, parts, bid)
+    final_hash = driver.state.app_hash
+    conns.stop()
+
+    # fresh app (height 0) + old store/state → handshake must replay 1-3
+    app2, conns2, ss2, bs2, ex2 = _fresh_stack()
+    h = Handshaker(ss, ss.load(), bs, genesis, block_exec=ex2)
+    app_hash = h.handshake(conns2)
+    assert h.n_blocks == 3
+    assert app2.height == 3
+    assert app_hash == final_hash
+    conns2.stop()
+
+
+# -- full node assembly ----------------------------------------------------
+
+
+def test_node_init_start_produce_restart(tmp_path):
+    from cometbft_tpu.node import Node, init_files, load_genesis
+
+    cfg = default_config()
+    cfg.base.home = str(tmp_path / "home")
+    cfg.consensus = make_test_config().consensus
+    out = init_files(cfg)
+    genesis = load_genesis(cfg)
+    assert genesis.chain_id.startswith("test-chain-")
+
+    node = Node(cfg, genesis, out["pv"])
+    node.start()
+    try:
+        deadline = time.monotonic() + 30
+        while node.block_store.height() < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert node.block_store.height() >= 3
+    finally:
+        node.stop()
+
+    # restart: same home, chain continues (handshake + WAL + FilePV)
+    node2 = Node(cfg, genesis, out["pv"])
+    h0 = node2.block_store.height()
+    assert h0 >= 3
+    node2.start()
+    try:
+        deadline = time.monotonic() + 30
+        while node2.block_store.height() < h0 + 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert node2.block_store.height() >= h0 + 2
+    finally:
+        node2.stop()
+
+
+def test_node_tx_flows_into_block(tmp_path):
+    """broadcast-tx path: mempool CheckTx → reap → proposal → committed
+    block → app query (rpc/core/mempool.go analog, minus HTTP)."""
+    from cometbft_tpu.node import Node, init_files, load_genesis
+    from cometbft_tpu.abci.types import RequestQuery
+
+    cfg = default_config()
+    cfg.base.home = str(tmp_path / "home")
+    cfg.consensus = make_test_config().consensus
+    out = init_files(cfg)
+    node = Node(cfg, load_genesis(cfg), out["pv"])
+    node.start()
+    try:
+        node.mempool.check_tx(b"city=zurich")
+        deadline = time.monotonic() + 30
+        committed = False
+        while time.monotonic() < deadline:
+            q = node.proxy_app.query.query(RequestQuery(data=b"city"))
+            if q.value == b"zurich":
+                committed = True
+                break
+            time.sleep(0.05)
+        assert committed, "tx never committed"
+        # the tx is no longer pending
+        assert node.mempool.size() == 0
+        # and it's inside a stored block
+        found = any(
+            b"city=zurich" in (node.block_store.load_block(h).data.txs)
+            for h in range(1, node.block_store.height() + 1)
+            if node.block_store.load_block(h) is not None
+        )
+        assert found
+    finally:
+        node.stop()
+
+
+def test_node_no_empty_blocks_waits_for_txs(tmp_path):
+    """create_empty_blocks=False: chain idles until a tx arrives, then
+    commits it — exercises handleTxsAvailable incl. the NEW_HEIGHT window
+    (state.go:981)."""
+    from cometbft_tpu.node import Node, init_files, load_genesis
+
+    cfg = default_config()
+    cfg.base.home = str(tmp_path / "home")
+    cfg.consensus = make_test_config().consensus
+    cfg.consensus.create_empty_blocks = False
+    out = init_files(cfg)
+    node = Node(cfg, load_genesis(cfg), out["pv"])
+    node.start()
+    try:
+        time.sleep(0.8)
+        assert node.block_store.height() == 0  # no empty blocks
+        node.mempool.check_tx(b"first=tx")
+        deadline = time.monotonic() + 20
+        while node.block_store.height() < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert node.block_store.height() >= 1
+        blk = node.block_store.load_block(1)
+        assert b"first=tx" in blk.data.txs
+
+        # second round: signal must survive the NEW_HEIGHT commit window
+        node.mempool.check_tx(b"second=tx")
+        h = node.block_store.height()
+        deadline = time.monotonic() + 20
+        while node.block_store.height() <= h and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert node.block_store.height() > h
+    finally:
+        node.stop()
+
+
+def test_node_with_socket_app_and_recheck(tmp_path):
+    """Full node against an out-of-process-style socket ABCI app with
+    recheck enabled: commit must not deadlock on the mempool lock
+    (clist_mempool.go FlushAsync semantics)."""
+    from cometbft_tpu.abci.server import SocketServer
+    from cometbft_tpu.node import Node, init_files, load_genesis
+
+    addr = "unix://" + str(tmp_path / "app.sock")
+    server = SocketServer(addr, KVStoreApplication())
+    server.start()
+    try:
+        cfg = default_config()
+        cfg.base.home = str(tmp_path / "home")
+        cfg.consensus = make_test_config().consensus
+        cfg.base.proxy_app = addr
+        out = init_files(cfg)
+        node = Node(cfg, load_genesis(cfg), out["pv"])
+        node.start()
+        try:
+            # keep txs flowing so commits always run update+recheck with a
+            # non-empty mempool
+            for i in range(8):
+                try:
+                    node.mempool.check_tx(f"k{i}=v{i}".encode())
+                except Exception:
+                    pass
+            deadline = time.monotonic() + 30
+            while node.block_store.height() < 3 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert node.block_store.height() >= 3, (
+                f"stalled at {node.block_store.height()}"
+            )
+        finally:
+            node.stop()
+    finally:
+        server.stop()
