@@ -22,6 +22,10 @@ def _config(args):
     cfg.base.home = args.home
     if getattr(args, "proxy_app", None):
         cfg.base.proxy_app = args.proxy_app
+    if getattr(args, "p2p_laddr", None):
+        cfg.p2p.laddr = args.p2p_laddr
+    if getattr(args, "persistent_peers", None):
+        cfg.p2p.persistent_peers = args.persistent_peers
     return cfg
 
 
@@ -65,6 +69,15 @@ def cmd_show_validator(args) -> int:
     )
     pub = pv.get_pub_key()
     print(json.dumps({"type": pub.type, "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from ..p2p import NodeKey
+
+    cfg = _config(args)
+    nk = NodeKey.load_or_generate(cfg.base.resolve(cfg.base.node_key_file))
+    print(nk.node_id)
     return 0
 
 
@@ -140,12 +153,21 @@ def main(argv=None) -> int:
         default=None,
         help="kvstore | noop | tcp://... | unix://...",
     )
+    sp.add_argument("--p2p-laddr", dest="p2p_laddr", default=None)
+    sp.add_argument(
+        "--p2p-persistent-peers",
+        dest="persistent_peers",
+        default=None,
+        help="comma-separated id@host:port",
+    )
+    sub.add_parser("show-node-id")
 
     args = p.parse_args(argv)
     return {
         "version": cmd_version,
         "init": cmd_init,
         "show-validator": cmd_show_validator,
+        "show-node-id": cmd_show_node_id,
         "unsafe-reset-all": cmd_unsafe_reset_all,
         "start": cmd_start,
     }[args.command](args)

@@ -10,6 +10,8 @@ without re-validation for fully-committed heights, the full
 
 from __future__ import annotations
 
+import json
+
 from ..abci import types as abci
 from ..state.execution import (
     _commit_info,
@@ -97,9 +99,9 @@ class Handshaker:
                         )
                         for gv in self.genesis.validators
                     ],
-                    app_state_bytes=__import__("json")
-                    .dumps(self.genesis.app_state)
-                    .encode(),
+                    app_state_bytes=json.dumps(
+                        self.genesis.app_state
+                    ).encode(),
                     initial_height=self.genesis.initial_height,
                 )
             )

@@ -16,6 +16,7 @@ from ..abci import types as abci
 from ..config import MempoolConfig
 from ..crypto import tmhash
 from ..libs.clist import CList
+from .cache import LRUTxCache, NopTxCache
 
 
 def TxKey(tx: bytes) -> bytes:
@@ -59,13 +60,9 @@ class CListMempool:
         self.txs = CList()
         self.tx_map: dict[bytes, object] = {}  # TxKey -> CElement
         self.cache = (
-            __import__(
-                "cometbft_tpu.mempool.cache", fromlist=["LRUTxCache"]
-            ).LRUTxCache(config.cache_size)
+            LRUTxCache(config.cache_size)
             if config.cache_size > 0
-            else __import__(
-                "cometbft_tpu.mempool.cache", fromlist=["NopTxCache"]
-            ).NopTxCache()
+            else NopTxCache()
         )
         # Consensus lock: held across Commit so no CheckTx races app state
         self._update_mtx = threading.RLock()
@@ -133,8 +130,10 @@ class CListMempool:
                 reqres.set_callback(cb)
 
     def _global_cb(self, req, res) -> None:
-        """proxy_app's global callback (resCbFirstTime / resCbRecheck)."""
-        if self._recheck_cursor is not None:
+        """proxy_app's global callback. Routed by the REQUEST type, not by
+        whether a recheck is in flight — a NEW response racing a recheck
+        window must not consume the recheck cursor."""
+        if req.type == abci.CheckTxType.RECHECK:
             self._res_cb_recheck(req, res)
         else:
             self._res_cb_first_time(req, res)

@@ -16,11 +16,15 @@ from .. import proxy
 from ..abci.kvstore import KVStoreApplication
 from ..config import Config
 from ..consensus import ConsensusState
+from ..consensus.reactor import ConsensusReactor
 from ..consensus.replay import Handshaker
 from ..consensus.wal import WAL
 from ..libs import db as dbm
 from ..libs.service import BaseService
 from ..mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p import MultiplexTransport, NodeInfo, NodeKey, Switch
+from ..p2p.conn.connection import MConnConfig
 from ..privval import FilePV
 from ..state import BlockExecutor, Store, make_genesis_state
 from ..state.execution import NopEvidencePool
@@ -168,6 +172,38 @@ class Node(BaseService):
         self.state = state
         self._txs_available_thread: threading.Thread | None = None
 
+        # 9. P2P: transport + switch + reactors (setup.go:325,394)
+        self.node_key = NodeKey.load_or_generate(
+            config.base.resolve(config.base.node_key_file)
+        )
+        self.consensus_reactor = ConsensusReactor(self.consensus)
+        self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+        self.node_info = NodeInfo(
+            node_id=self.node_key.node_id,
+            listen_addr="",
+            network=genesis.chain_id,
+            moniker=config.base.moniker,
+        )
+        self.transport = MultiplexTransport(
+            self.node_key,
+            self.node_info,
+            handshake_timeout=config.p2p.handshake_timeout_ns / 1e9,
+            dial_timeout=config.p2p.dial_timeout_ns / 1e9,
+        )
+        self.switch = Switch(
+            self.transport,
+            mconn_config=MConnConfig(
+                send_rate=config.p2p.send_rate,
+                recv_rate=config.p2p.recv_rate,
+                flush_throttle=config.p2p.flush_throttle_timeout_ns / 1e9,
+            ),
+            max_inbound=config.p2p.max_num_inbound_peers,
+            max_outbound=config.p2p.max_num_outbound_peers,
+        )
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.node_info.channels = self.switch.channel_ids()
+
     def _on_app_error(self, err: Exception) -> None:
         # Fail-stop: the app is the source of truth (multi_app_conn.go:129).
         if self.is_running():
@@ -179,7 +215,19 @@ class Node(BaseService):
     # -- lifecycle (node.go:364 OnStart) -----------------------------------
 
     def on_start(self) -> None:
-        self.consensus.start()
+        # boot order (node.go:364): transport listen → switch (starts
+        # reactors, which start consensus) → dial persistent peers
+        self.transport.listen(self.config.p2p.laddr)
+        self.node_info.listen_addr = self.transport.listen_addr
+        self.switch.start()
+        persistent = [
+            a.strip()
+            for a in self.config.p2p.persistent_peers.split(",")
+            if a.strip()
+        ]
+        if persistent:
+            self.switch.set_persistent_peers(persistent)
+            self.switch.dial_peers_async(persistent)
         if self.mempool.txs_available() is not None:
             self._txs_available_thread = threading.Thread(
                 target=self._forward_txs_available, daemon=True
@@ -194,7 +242,7 @@ class Node(BaseService):
                 self.consensus.handle_txs_available()
 
     def on_stop(self) -> None:
-        for svc in (self.consensus, self.event_bus, self.proxy_app):
+        for svc in (self.switch, self.event_bus, self.proxy_app):
             try:
                 if svc.is_running():
                     svc.stop()

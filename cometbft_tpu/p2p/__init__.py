@@ -1,0 +1,8 @@
+"""L5 distributed communication backend (reference: p2p/)."""
+
+from .key import NodeKey, node_id_from_pubkey  # noqa: F401
+from .node_info import NodeInfo  # noqa: F401
+from .base_reactor import Reactor, ChannelDescriptor  # noqa: F401
+from .peer import Peer  # noqa: F401
+from .switch import Switch  # noqa: F401
+from .transport import MultiplexTransport  # noqa: F401

@@ -1,0 +1,172 @@
+"""Authenticated encryption handshake + framing (reference:
+p2p/conn/secret_connection.go:63-120).
+
+Station-to-Station protocol:
+1. exchange ephemeral X25519 pubkeys (unencrypted, 32B each);
+2. ECDH → HKDF-SHA256 (secret_connection.go:335) expands 96 bytes: two
+   ChaCha20-Poly1305 keys (low/high by ephemeral key order) + a 32-byte
+   challenge;
+3. each side signs the challenge with its persistent ed25519 key and
+   sends (pubkey, sig) over the now-encrypted link (:389);
+4. all traffic flows in sealed frames: 4-byte LE length + payload padded
+   to 1024 bytes, 16-byte Poly1305 tag; 96-bit little-endian counter
+   nonces (:453).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from ...crypto.keys import Ed25519PubKey
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+TAG_SIZE = 16
+SEALED_FRAME_SIZE = TOTAL_FRAME_SIZE + TAG_SIZE
+
+HKDF_INFO = b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+CHALLENGE_CONTEXT = b"TENDERMINT_SECRET_CONNECTION_KEY_CHALLENGE"
+
+
+class SecretConnectionError(Exception):
+    pass
+
+
+class _Nonce:
+    """96-bit LE counter nonce (secret_connection.go:446-458)."""
+
+    __slots__ = ("n",)
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def next(self) -> bytes:
+        out = b"\x00\x00\x00\x00" + struct.pack("<Q", self.n)
+        self.n += 1
+        if self.n >= 1 << 64:
+            raise SecretConnectionError("nonce wrapped")
+        return out
+
+
+class SecretConnection:
+    """Wraps a socket-like object (needs sendall/recv) post-handshake."""
+
+    def __init__(self, sock, priv_key):
+        """priv_key: our persistent ed25519 key (node key)."""
+        self._sock = sock
+        self._send_mtx = threading.Lock()
+        self._recv_mtx = threading.Lock()
+        self._recv_buf = b""
+
+        # 1. ephemeral key exchange
+        eph_priv = X25519PrivateKey.generate()
+        eph_pub = eph_priv.public_key().public_bytes_raw()
+        self._write_all(eph_pub)
+        remote_eph = self._read_exact(32)
+
+        # 2. shared secret → keys + challenge
+        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
+        okm = HKDF(
+            algorithm=hashes.SHA256(),
+            length=96,
+            salt=None,
+            info=HKDF_INFO,
+        ).derive(shared)
+        # Key order: the side with the smaller ephemeral pubkey uses okm[:32]
+        # to receive (secret_connection.go:312-333).
+        loc_is_least = eph_pub < remote_eph
+        if loc_is_least:
+            recv_key, send_key = okm[:32], okm[32:64]
+        else:
+            send_key, recv_key = okm[:32], okm[32:64]
+        challenge = okm[64:96]
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+
+        # 3. authenticate: sign challenge, swap (pubkey, sig) encrypted
+        sig = priv_key.sign(CHALLENGE_CONTEXT + challenge)
+        self.write(priv_key.pub_key().bytes() + sig)
+        auth = self.read_exact_msg(32 + 64)
+        remote_pub_bytes, remote_sig = auth[:32], auth[32:]
+        self.remote_pub_key = Ed25519PubKey(remote_pub_bytes)
+        if not self.remote_pub_key.verify_signature(
+            CHALLENGE_CONTEXT + challenge, remote_sig
+        ):
+            raise SecretConnectionError("challenge signature invalid")
+
+    # -- raw io ------------------------------------------------------------
+
+    def _write_all(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def _read_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise EOFError("secret connection closed")
+            out += chunk
+        return out
+
+    # -- sealed framing ----------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        """Encrypt+send; fragments into 1024-byte frames."""
+        n = 0
+        with self._send_mtx:
+            for i in range(0, max(len(data), 1), DATA_MAX_SIZE):
+                chunk = data[i : i + DATA_MAX_SIZE]
+                frame = struct.pack("<I", len(chunk)) + chunk
+                frame += b"\x00" * (TOTAL_FRAME_SIZE - len(frame))
+                sealed = self._send_aead.encrypt(
+                    self._send_nonce.next(), frame, None
+                )
+                self._write_all(sealed)
+                n += len(chunk)
+        return n
+
+    def _read_frame(self) -> bytes:
+        sealed = self._read_exact(SEALED_FRAME_SIZE)
+        try:
+            frame = self._recv_aead.decrypt(
+                self._recv_nonce.next(), sealed, None
+            )
+        except Exception as e:
+            raise SecretConnectionError(f"frame decryption failed: {e}") from e
+        (length,) = struct.unpack("<I", frame[:DATA_LEN_SIZE])
+        if length > DATA_MAX_SIZE:
+            raise SecretConnectionError("frame length corrupt")
+        return frame[DATA_LEN_SIZE : DATA_LEN_SIZE + length]
+
+    def read(self, n: int) -> bytes:
+        """Read up to n plaintext bytes (at least 1)."""
+        with self._recv_mtx:
+            if not self._recv_buf:
+                self._recv_buf = self._read_frame()
+            out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]
+            return out
+
+    def read_exact_msg(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            out += self.read(n - len(out))
+        return out
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,72 @@
+"""Peer: one connected remote node (reference: p2p/peer.go).
+
+Wraps the MConnection, carries the exchanged NodeInfo, and a small kv
+store reactors use for per-peer state (p2p/peer.go Set/Get).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..libs.service import BaseService
+from .conn.connection import MConnection
+from .node_info import NodeInfo
+
+
+class Peer(BaseService):
+    def __init__(
+        self,
+        secret_conn,
+        node_info: NodeInfo,
+        channels,  # list[ChannelDescriptor]
+        on_receive,  # f(ch_id, peer, msg_bytes)
+        on_error,  # f(peer, err)
+        outbound: bool,
+        persistent: bool = False,
+        socket_addr: str = "",
+        mconn_config=None,
+    ):
+        super().__init__(f"peer-{node_info.node_id[:10]}")
+        self.node_info = node_info
+        self.outbound = outbound
+        self.persistent = persistent
+        self.socket_addr = socket_addr
+        self._data: dict[str, object] = {}
+        self._data_mtx = threading.Lock()
+        self.mconn = MConnection(
+            secret_conn,
+            channels,
+            on_receive=lambda ch, msg: on_receive(ch, self, msg),
+            on_error=lambda err: on_error(self, err),
+            config=mconn_config,
+        )
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def on_start(self) -> None:
+        self.mconn.start()
+
+    def on_stop(self) -> None:
+        if self.mconn.is_running():
+            self.mconn.stop()
+
+    def send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.send(ch_id, msg)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.mconn.try_send(ch_id, msg)
+
+    # per-peer kv store used by reactors (peer.go Set/Get)
+    def set(self, key: str, value) -> None:
+        with self._data_mtx:
+            self._data[key] = value
+
+    def get(self, key: str):
+        with self._data_mtx:
+            return self._data.get(key)
+
+    def __repr__(self) -> str:
+        arrow = "out" if self.outbound else "in"
+        return f"Peer<{arrow} {self.id[:10]}@{self.socket_addr}>"

@@ -1,0 +1,277 @@
+"""P2P layer tests (reference analogs: p2p/conn/secret_connection_test.go,
+p2p/conn/connection_test.go, p2p/{transport,switch}_test.go)."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.p2p import (
+    ChannelDescriptor,
+    MultiplexTransport,
+    NodeInfo,
+    NodeKey,
+    Reactor,
+    Switch,
+)
+from cometbft_tpu.p2p.conn.connection import MConnConfig, MConnection
+from cometbft_tpu.p2p.conn.secret_connection import (
+    SecretConnection,
+    SecretConnectionError,
+)
+from cometbft_tpu.p2p.transport import TransportError
+
+
+def _sc_pair():
+    """Two SecretConnections over a real socketpair."""
+    a, b = socket.socketpair()
+    ka, kb = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    out = {}
+
+    def left():
+        out["a"] = SecretConnection(a, ka)
+
+    t = threading.Thread(target=left)
+    t.start()
+    out["b"] = SecretConnection(b, kb)
+    t.join(timeout=10)
+    return out["a"], out["b"], ka, kb
+
+
+# -- secret connection -----------------------------------------------------
+
+
+def test_secret_connection_handshake_and_roundtrip():
+    sa, sb, ka, kb = _sc_pair()
+    # each side authenticated the other's persistent key
+    assert sa.remote_pub_key == kb.pub_key()
+    assert sb.remote_pub_key == ka.pub_key()
+    sa.write(b"hello bob")
+    assert sb.read_exact_msg(9) == b"hello bob"
+    # large message: fragments across frames
+    blob = bytes(range(256)) * 20  # 5120 bytes > 4 frames
+    sb.write(blob)
+    assert sa.read_exact_msg(len(blob)) == blob
+    sa.close()
+    sb.close()
+
+
+def test_secret_connection_tamper_detected():
+    a, b = socket.socketpair()
+    ka, kb = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(a=SecretConnection(a, ka))
+    )
+    t.start()
+    sb = SecretConnection(b, kb)
+    t.join(timeout=10)
+    sa = out["a"]
+    sa.write(b"x" * 10)
+    # tamper: peek and corrupt one sealed frame in transit is hard with a
+    # socketpair; instead corrupt the recv nonce to simulate reordering
+    sb._recv_nonce.n += 1
+    with pytest.raises((SecretConnectionError, EOFError)):
+        sb.read_exact_msg(10)
+    sa.close()
+    sb.close()
+
+
+# -- mconnection -----------------------------------------------------------
+
+
+def _mconn_pair(channels=None):
+    sa, sb, *_ = _sc_pair()
+    channels = channels or [ChannelDescriptor(id=0x01, priority=1)]
+    got_a, got_b = [], []
+    errs = []
+    ma = MConnection(
+        sa, channels, lambda ch, m: got_a.append((ch, m)), errs.append
+    )
+    mb = MConnection(
+        sb, channels, lambda ch, m: got_b.append((ch, m)), errs.append
+    )
+    ma.start()
+    mb.start()
+    return ma, mb, got_a, got_b, errs
+
+
+def _wait_for(pred, timeout=10):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_mconnection_roundtrip():
+    ma, mb, got_a, got_b, errs = _mconn_pair()
+    assert ma.send(0x01, b"ping over channel 1")
+    assert _wait_for(lambda: got_b)
+    assert got_b[0] == (0x01, b"ping over channel 1")
+    # big message fragments + reassembles
+    big = b"z" * 5000
+    assert mb.send(0x01, big)
+    assert _wait_for(lambda: got_a)
+    assert got_a[0] == (0x01, big)
+    assert not errs
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_multiple_channels():
+    chans = [
+        ChannelDescriptor(id=0x10, priority=5, send_queue_capacity=10),
+        ChannelDescriptor(id=0x20, priority=1, send_queue_capacity=10),
+    ]
+    ma, mb, got_a, got_b, errs = _mconn_pair(chans)
+    for i in range(5):
+        assert ma.send(0x10, b"hi%d" % i)
+        assert ma.send(0x20, b"lo%d" % i)
+    assert _wait_for(lambda: len(got_b) == 10)
+    assert {ch for ch, _ in got_b} == {0x10, 0x20}
+    assert [m for ch, m in got_b if ch == 0x10] == [
+        b"hi%d" % i for i in range(5)
+    ]
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_unknown_channel_send_fails():
+    ma, mb, *_ = _mconn_pair()
+    assert not ma.send(0x99, b"nope")
+    ma.stop()
+    mb.stop()
+
+
+def test_mconnection_peer_death_triggers_error():
+    ma, mb, got_a, got_b, errs = _mconn_pair()
+    mb.conn.close()
+    assert ma.send(0x01, b"into the void") or True
+    assert _wait_for(lambda: errs, timeout=10)
+    for m in (ma, mb):
+        if m.is_running():
+            m.stop()
+
+
+# -- transport + switch ----------------------------------------------------
+
+
+class EchoReactor(Reactor):
+    """Echoes every message back on the same channel; records receipts."""
+
+    def __init__(self, name="echo", channel=0x42, echo=True):
+        super().__init__(name)
+        self.channel = channel
+        self.echo = echo
+        self.received = []
+        self.peers_seen = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.channel, send_queue_capacity=16)]
+
+    def add_peer(self, peer):
+        self.peers_seen.append(peer.id)
+
+    def receive(self, ch_id, peer, msg_bytes):
+        self.received.append((peer.id, msg_bytes))
+        if self.echo:
+            peer.try_send(ch_id, b"echo:" + msg_bytes)
+
+
+def _make_switch(network="testnet", echo=True):
+    nk = NodeKey(Ed25519PrivKey.generate())
+    reactor = EchoReactor(echo=echo)
+    info = NodeInfo(
+        node_id=nk.node_id,
+        listen_addr="",
+        network=network,
+        channels=bytes([reactor.channel]),
+    )
+    transport = MultiplexTransport(nk, info)
+    transport.listen("tcp://127.0.0.1:0")
+    info.listen_addr = transport.listen_addr
+    sw = Switch(transport)
+    sw.add_reactor("echo", reactor)
+    return sw, reactor, nk
+
+
+def test_switch_connect_and_exchange():
+    sw1, r1, nk1 = _make_switch()
+    sw2, r2, nk2 = _make_switch(echo=False)
+    sw1.start()
+    sw2.start()
+    try:
+        addr = f"{nk1.node_id}@{sw1.transport.listen_addr[len('tcp://'):]}"
+        sw2.dial_peers_async([addr])
+        assert _wait_for(lambda: sw1.peers() and sw2.peers())
+        peer = sw2.peers()[0]
+        assert peer.id == nk1.node_id
+        assert peer.send(0x42, b"hello switch")
+        assert _wait_for(lambda: r1.received)
+        assert r1.received[0] == (nk2.node_id, b"hello switch")
+        assert _wait_for(lambda: r2.received)  # echo came back
+        assert r2.received[0][1] == b"echo:hello switch"
+    finally:
+        sw1.stop()
+        sw2.stop()
+
+
+def test_switch_rejects_wrong_network():
+    sw1, _, nk1 = _make_switch(network="chain-A")
+    sw2, _, nk2 = _make_switch(network="chain-B")
+    sw1.start()
+    sw2.start()
+    try:
+        addr = f"{nk1.node_id}@{sw1.transport.listen_addr[len('tcp://'):]}"
+        sw2.dial_peers_async([addr])
+        time.sleep(1.0)
+        assert not sw2.peers()
+        assert not sw1.peers()
+    finally:
+        sw1.stop()
+        sw2.stop()
+
+
+def test_transport_rejects_wrong_id():
+    sw1, _, nk1 = _make_switch()
+    sw1.start()
+    nk3 = NodeKey(Ed25519PrivKey.generate())
+    info3 = NodeInfo(
+        node_id=nk3.node_id, listen_addr="", network="testnet",
+        channels=bytes([0x42]),
+    )
+    t3 = MultiplexTransport(nk3, info3)
+    try:
+        wrong_id = NodeKey(Ed25519PrivKey.generate()).node_id
+        addr = f"{wrong_id}@{sw1.transport.listen_addr[len('tcp://'):]}"
+        with pytest.raises(TransportError):
+            t3.dial(addr)
+    finally:
+        sw1.stop()
+
+
+def test_switch_broadcast():
+    hub, rhub, nkh = _make_switch(echo=False)
+    spokes = [_make_switch(echo=False) for _ in range(3)]
+    hub.start()
+    for sw, _, _ in spokes:
+        sw.start()
+    try:
+        addr = f"{nkh.node_id}@{hub.transport.listen_addr[len('tcp://'):]}"
+        for sw, _, _ in spokes:
+            sw.dial_peers_async([addr])
+        assert _wait_for(lambda: len(hub.peers()) == 3)
+        hub.broadcast(0x42, b"to everyone")
+        assert _wait_for(
+            lambda: all(r.received for _, r, _ in spokes), timeout=10
+        )
+        for _, r, _ in spokes:
+            assert r.received[0][1] == b"to everyone"
+    finally:
+        hub.stop()
+        for sw, _, _ in spokes:
+            sw.stop()

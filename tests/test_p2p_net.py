@@ -1,0 +1,160 @@
+"""Multi-validator network over real TCP p2p (reference analog:
+consensus/reactor_test.go + e2e ci topology, in-process tier)."""
+
+import dataclasses
+import time
+
+import pytest
+
+from cometbft_tpu.config import default_config
+from cometbft_tpu.node import Node
+from cometbft_tpu.types import GenesisDoc
+
+from helpers import make_genesis
+
+_MS = 1_000_000
+
+
+def _net_config(home: str) -> "Config":
+    cfg = default_config()
+    cfg.base.home = home
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    # Single-core-friendly timeouts: pure-python single-verify is ~10ms,
+    # so sub-50ms rounds starve under 4 in-process nodes.
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=800 * _MS,
+        timeout_propose_delta_ns=100 * _MS,
+        timeout_prevote_ns=400 * _MS,
+        timeout_prevote_delta_ns=100 * _MS,
+        timeout_precommit_ns=400 * _MS,
+        timeout_precommit_delta_ns=100 * _MS,
+        timeout_commit_ns=200 * _MS,
+        skip_timeout_commit=True,
+        peer_gossip_sleep_duration_ns=20 * _MS,
+    )
+    return cfg
+
+
+def _wait_height(nodes, h, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(n.block_store.height() >= h for n in nodes):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.mark.slow
+def test_four_validators_over_tcp(tmp_path):
+    genesis, pvs = make_genesis(4)
+    nodes = []
+    try:
+        for i, pv in enumerate(pvs):
+            cfg = _net_config(str(tmp_path / f"node{i}"))
+            from cometbft_tpu.node import init_files
+
+            init_files(cfg)  # dirs (keys replaced by MockPV)
+            node = Node(cfg, genesis, pv)
+            nodes.append(node)
+        # star topology around node0; gossip relays the rest
+        nodes[0].start()
+        seed_addr = (
+            f"{nodes[0].node_key.node_id}@"
+            f"{nodes[0].transport.listen_addr[len('tcp://'):]}"
+        )
+        for node in nodes[1:]:
+            node.config.p2p.persistent_peers = seed_addr
+            node.start()
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if len(nodes[0].switch.peers()) == 3:
+                break
+            time.sleep(0.1)
+        assert len(nodes[0].switch.peers()) == 3, "peers failed to connect"
+
+        assert _wait_height(nodes, 2, timeout=90), (
+            "heights: "
+            + str([n.block_store.height() for n in nodes])
+            + " steps: "
+            + str(
+                [
+                    (
+                        n.consensus.get_round_state().step_name(),
+                        n.consensus.get_round_state().round,
+                    )
+                    for n in nodes
+                ]
+            )
+        )
+        # identical block 1 everywhere
+        hashes = {n.block_store.load_block(1).hash() for n in nodes}
+        assert len(hashes) == 1
+
+        # a tx submitted at node3 commits and reaches node1's app
+        nodes[3].mempool.check_tx(b"net=works")
+        deadline = time.monotonic() + 60
+        ok = False
+        from cometbft_tpu.abci.types import RequestQuery
+
+        while time.monotonic() < deadline:
+            q = nodes[1].proxy_app.query.query(RequestQuery(data=b"net"))
+            if q.value == b"works":
+                ok = True
+                break
+            time.sleep(0.1)
+        assert ok, "tx gossip → block → replication failed"
+    finally:
+        for node in nodes:
+            try:
+                if node.is_running():
+                    node.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.slow
+def test_late_joiner_catches_up_via_consensus_gossip(tmp_path):
+    genesis, pvs = make_genesis(4)
+    nodes = []
+    try:
+        for i in range(3):  # 3 of 4 validators: power 30/40 > 2/3
+            cfg = _net_config(str(tmp_path / f"node{i}"))
+            from cometbft_tpu.node import init_files
+
+            init_files(cfg)
+            nodes.append(Node(cfg, genesis, pvs[i]))
+        nodes[0].start()
+        seed_addr = (
+            f"{nodes[0].node_key.node_id}@"
+            f"{nodes[0].transport.listen_addr[len('tcp://'):]}"
+        )
+        for node in nodes[1:3]:
+            node.config.p2p.persistent_peers = seed_addr
+            node.start()
+        assert _wait_height(nodes, 3, timeout=90), [
+            n.block_store.height() for n in nodes
+        ]
+
+        # fourth validator joins late at height 0
+        cfg = _net_config(str(tmp_path / "node3"))
+        from cometbft_tpu.node import init_files
+
+        init_files(cfg)
+        late = Node(cfg, genesis, pvs[3])
+        nodes.append(late)
+        late.config.p2p.persistent_peers = seed_addr
+        late.start()
+        target = nodes[0].block_store.height() + 1
+        assert _wait_height([late], target, timeout=120), (
+            f"late joiner at {late.block_store.height()}, net at "
+            f"{nodes[0].block_store.height()}"
+        )
+    finally:
+        for node in nodes:
+            try:
+                if node.is_running():
+                    node.stop()
+            except Exception:
+                pass

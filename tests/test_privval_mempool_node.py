@@ -283,6 +283,7 @@ def test_node_init_start_produce_restart(tmp_path):
     cfg = default_config()
     cfg.base.home = str(tmp_path / "home")
     cfg.consensus = make_test_config().consensus
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
     out = init_files(cfg)
     genesis = load_genesis(cfg)
     assert genesis.chain_id.startswith("test-chain-")
@@ -320,6 +321,7 @@ def test_node_tx_flows_into_block(tmp_path):
     cfg = default_config()
     cfg.base.home = str(tmp_path / "home")
     cfg.consensus = make_test_config().consensus
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
     out = init_files(cfg)
     node = Node(cfg, load_genesis(cfg), out["pv"])
     node.start()
@@ -356,6 +358,7 @@ def test_node_no_empty_blocks_waits_for_txs(tmp_path):
     cfg = default_config()
     cfg.base.home = str(tmp_path / "home")
     cfg.consensus = make_test_config().consensus
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
     cfg.consensus.create_empty_blocks = False
     out = init_files(cfg)
     node = Node(cfg, load_genesis(cfg), out["pv"])
@@ -396,6 +399,7 @@ def test_node_with_socket_app_and_recheck(tmp_path):
         cfg = default_config()
         cfg.base.home = str(tmp_path / "home")
         cfg.consensus = make_test_config().consensus
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
         cfg.base.proxy_app = addr
         out = init_files(cfg)
         node = Node(cfg, load_genesis(cfg), out["pv"])
