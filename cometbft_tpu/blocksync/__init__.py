@@ -1,0 +1,5 @@
+"""Block-sync: fast catch-up by downloading committed blocks
+(reference: blocksync/)."""
+
+from .pool import BlockPool  # noqa: F401
+from .reactor import BlocksyncReactor  # noqa: F401

@@ -1,0 +1,181 @@
+"""Block download scheduler (reference: blocksync/pool.go:63-683).
+
+Work-stealing pool: one requester per in-flight height, each picking an
+available peer and re-picking (with the old peer banned for that height)
+on timeout or bad data. The reactor consumes blocks strictly in order via
+``peek_two_blocks`` → verify → ``pop_request``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+REQUEST_WINDOW = 20  # max heights in flight (pool.go maxPendingRequests≈)
+REQUEST_TIMEOUT = 15.0  # per-height peer response timeout
+MIN_RECV_RATE = 0  # rate eviction disabled by default (pool.go:133)
+
+
+class _Peer:
+    def __init__(self, peer_id: str, base: int, height: int):
+        self.id = peer_id
+        self.base = base
+        self.height = height
+        self.num_pending = 0
+        self.timeout_count = 0
+
+
+class _Requester:
+    def __init__(self, height: int):
+        self.height = height
+        self.peer_id: str | None = None
+        self.block = None
+        self.ext_commit = None
+        self.request_time = 0.0
+        self.banned: set[str] = set()
+
+
+class BlockPool:
+    def __init__(self, start_height: int, send_request, on_peer_error=None):
+        """``send_request(height, peer_id)`` dispatches a BlockRequest;
+        ``on_peer_error(peer_id, reason)`` reports misbehaving peers."""
+        self._mtx = threading.RLock()
+        self.height = start_height  # next height to apply
+        self.send_request = send_request
+        self.on_peer_error = on_peer_error or (lambda pid, r: None)
+        self.peers: dict[str, _Peer] = {}
+        self.requesters: dict[int, _Requester] = {}
+        self.max_peer_height = 0
+        self._running = True
+
+    # -- peers -------------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """StatusResponse from a peer (pool.go SetPeerRange)."""
+        with self._mtx:
+            p = self.peers.get(peer_id)
+            if p is None:
+                p = _Peer(peer_id, base, height)
+                self.peers[peer_id] = p
+            else:
+                p.base, p.height = base, height
+            self.max_peer_height = max(self.max_peer_height, height)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self.peers.pop(peer_id, None)
+            for r in self.requesters.values():
+                if r.peer_id == peer_id and r.block is None:
+                    r.peer_id = None  # re-dispatch
+            self.max_peer_height = max(
+                (p.height for p in self.peers.values()), default=0
+            )
+
+    def _pick_peer(self, height: int, banned: set[str]) -> _Peer | None:
+        candidates = [
+            p
+            for p in self.peers.values()
+            if p.base <= height <= p.height
+            and p.id not in banned
+            and p.num_pending < 10
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda p: p.num_pending)
+
+    # -- scheduling (call periodically from the reactor loop) --------------
+
+    def make_requests(self) -> None:
+        with self._mtx:
+            if not self._running:
+                return
+            for h in range(self.height, self.height + REQUEST_WINDOW):
+                if self.max_peer_height and h > self.max_peer_height:
+                    break
+                r = self.requesters.get(h)
+                if r is None:
+                    r = _Requester(h)
+                    self.requesters[h] = r
+                if r.block is not None:
+                    continue
+                now = time.monotonic()
+                if r.peer_id is not None:
+                    if now - r.request_time < REQUEST_TIMEOUT:
+                        continue
+                    # timeout: ban + re-pick
+                    r.banned.add(r.peer_id)
+                    peer = self.peers.get(r.peer_id)
+                    if peer is not None:
+                        peer.num_pending = max(0, peer.num_pending - 1)
+                        peer.timeout_count += 1
+                        if peer.timeout_count >= 3:
+                            self.on_peer_error(peer.id, "repeated timeouts")
+                    r.peer_id = None
+                peer = self._pick_peer(h, r.banned)
+                if peer is None:
+                    r.banned.clear()  # all candidates banned: retry all
+                    continue
+                r.peer_id = peer.id
+                r.request_time = now
+                peer.num_pending += 1
+                self.send_request(h, peer.id)
+
+    # -- block ingest ------------------------------------------------------
+
+    def add_block(self, peer_id: str, block, ext_commit=None) -> bool:
+        with self._mtx:
+            r = self.requesters.get(block.header.height)
+            if r is None or r.peer_id != peer_id:
+                # unsolicited — could be a late response; ignore
+                return False
+            if r.block is not None:
+                return False
+            r.block = block
+            r.ext_commit = ext_commit
+            peer = self.peers.get(peer_id)
+            if peer is not None:
+                peer.num_pending = max(0, peer.num_pending - 1)
+                peer.timeout_count = 0
+            return True
+
+    def redo_request(self, height: int) -> None:
+        """Block at ``height`` failed verification: ban the peer, refetch
+        (pool.go RedoRequest)."""
+        with self._mtx:
+            r = self.requesters.get(height)
+            if r is None:
+                return
+            if r.peer_id is not None:
+                r.banned.add(r.peer_id)
+                self.on_peer_error(r.peer_id, f"bad block {height}")
+                self.remove_peer(r.peer_id)
+            r.peer_id = None
+            r.block = None
+            r.ext_commit = None
+
+    # -- ordered consumption ----------------------------------------------
+
+    def peek_two_blocks(self):
+        with self._mtx:
+            r1 = self.requesters.get(self.height)
+            r2 = self.requesters.get(self.height + 1)
+            return (
+                (r1.block if r1 else None),
+                (r1.ext_commit if r1 else None),
+                (r2.block if r2 else None),
+            )
+
+    def pop_request(self) -> None:
+        with self._mtx:
+            self.requesters.pop(self.height, None)
+            self.height += 1
+
+    def is_caught_up(self) -> bool:
+        with self._mtx:
+            if not self.peers:
+                return False
+            return self.height >= self.max_peer_height
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._running = False

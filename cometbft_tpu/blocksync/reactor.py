@@ -1,0 +1,232 @@
+"""Blocksync reactor (reference: blocksync/reactor.go, channel 0x40).
+
+Serves stored blocks to catching-up peers and, while syncing, drives the
+pool: request blocks → verify the first of each pair via the second's
+LastCommit (VerifyCommitLight — the batched hot path, reactor.go:447) →
+ApplyBlock → switch to consensus when caught up (reactor.go:383-386).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..p2p.base_reactor import ChannelDescriptor, Reactor
+from ..types import serialization as ser
+from ..types.validation import VerificationError, verify_commit_light
+from .messages import (
+    BlockRequestMessage,
+    BlockResponseMessage,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+)
+from .pool import BlockPool
+
+BLOCKSYNC_CHANNEL = 0x40
+STATUS_INTERVAL = 5.0
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(
+        self,
+        state,  # sm.State at boot
+        block_exec,
+        block_store,
+        block_sync: bool,
+        consensus_reactor=None,  # for switch_to_consensus
+    ):
+        super().__init__("blocksync-reactor")
+        self.initial_state = state
+        self.state = state
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.block_sync = block_sync
+        self.consensus_reactor = consensus_reactor
+        self.pool = BlockPool(
+            block_store.height() + 1,
+            send_request=self._send_block_request,
+            on_peer_error=self._on_pool_peer_error,
+        )
+        self.synced = threading.Event()
+        self._n_synced = 0
+        if not block_sync:
+            self.synced.set()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=BLOCKSYNC_CHANNEL,
+                priority=5,
+                send_queue_capacity=1000,
+                recv_message_capacity=50 * 1024 * 1024,
+            )
+        ]
+
+    def on_start(self) -> None:
+        if self.block_sync:
+            threading.Thread(
+                target=self._pool_routine, name="blocksync-pool", daemon=True
+            ).start()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        peer.try_send(
+            BLOCKSYNC_CHANNEL,
+            ser.dumps(
+                StatusResponseMessage(
+                    height=self.block_store.height(),
+                    base=self.block_store.base(),
+                )
+            ),
+        )
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    # -- receive (reactor.go Receive) --------------------------------------
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        msg = ser.loads(msg_bytes)
+        if isinstance(msg, StatusRequestMessage):
+            peer.try_send(
+                BLOCKSYNC_CHANNEL,
+                ser.dumps(
+                    StatusResponseMessage(
+                        height=self.block_store.height(),
+                        base=self.block_store.base(),
+                    )
+                ),
+            )
+        elif isinstance(msg, StatusResponseMessage):
+            self.pool.set_peer_range(peer.id, msg.base, msg.height)
+        elif isinstance(msg, BlockRequestMessage):
+            block = self.block_store.load_block(msg.height)
+            if block is None:
+                peer.try_send(
+                    BLOCKSYNC_CHANNEL,
+                    ser.dumps(NoBlockResponseMessage(height=msg.height)),
+                )
+                return
+            ext = self.block_store.load_block_extended_commit(msg.height)
+            peer.try_send(
+                BLOCKSYNC_CHANNEL,
+                ser.dumps(BlockResponseMessage(block=block, ext_commit=ext)),
+            )
+        elif isinstance(msg, BlockResponseMessage):
+            self.pool.add_block(peer.id, msg.block, msg.ext_commit)
+        elif isinstance(msg, NoBlockResponseMessage):
+            pass  # the requester will time out and re-pick
+
+    # -- pool plumbing -----------------------------------------------------
+
+    def _send_block_request(self, height: int, peer_id: str) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.get_peer(peer_id)
+        if peer is not None:
+            peer.try_send(
+                BLOCKSYNC_CHANNEL, ser.dumps(BlockRequestMessage(height))
+            )
+
+    def _on_pool_peer_error(self, peer_id: str, reason) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.get_peer(peer_id)
+        if peer is not None:
+            self.switch.stop_and_remove_peer(peer, reason)
+
+    def _broadcast_status_request(self) -> None:
+        if self.switch is not None:
+            self.switch.try_broadcast(
+                BLOCKSYNC_CHANNEL, ser.dumps(StatusRequestMessage())
+            )
+
+    # -- the sync loop (reactor.go:272 poolRoutine) ------------------------
+
+    def _pool_routine(self) -> None:
+        last_status = 0.0
+        last_switch_check = 0.0
+        caught_up_since = None
+        while not self.quit_event().is_set():
+            now = time.monotonic()
+            if now - last_status > STATUS_INTERVAL:
+                self._broadcast_status_request()
+                last_status = now
+            self.pool.make_requests()
+
+            # Try to verify+apply the next block.
+            first, first_ext, second = self.pool.peek_two_blocks()
+            if first is not None and second is not None:
+                try:
+                    self._apply_first(first, first_ext, second)
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+                    raise  # local apply failure: fail-stop (reference panics)
+                continue
+
+            # Caught up? Need a stable signal before switching.
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+                last_switch_check = now
+                if self.pool.is_caught_up():
+                    if caught_up_since is None:
+                        caught_up_since = now
+                    elif now - caught_up_since > SWITCH_TO_CONSENSUS_INTERVAL:
+                        self._switch_to_consensus()
+                        return
+                else:
+                    caught_up_since = None
+            time.sleep(0.05)
+
+    def _apply_first(self, first, first_ext, second) -> None:
+        """reactor.go:447: first's validity is proven by second.LastCommit."""
+        from ..types import BlockID, PartSet
+
+        parts = PartSet.from_data(ser.dumps(first))
+        first_id = BlockID(first.hash(), parts.header)
+        try:
+            if second.last_commit is None:
+                raise VerificationError("second block missing last commit")
+            if second.last_commit.block_id != first_id:
+                raise VerificationError("second block commits a fork?")
+            verify_commit_light(
+                self.state.chain_id,
+                self.state.validators,
+                first_id,
+                first.header.height,
+                second.last_commit,
+            )  # ◄◄ HOT BATCH (types/validation.go via TPU verifier)
+        except (VerificationError, ValueError):
+            # Either block may be the forged one: redo BOTH and punish both
+            # serving peers (reactor.go:447-470).
+            self.pool.redo_request(first.header.height)
+            self.pool.redo_request(second.header.height)
+            return
+        seen_commit = second.last_commit
+        if self.block_store.height() < first.header.height:
+            if first_ext is not None and self.state.consensus_params.vote_extensions_enabled(
+                first.header.height
+            ):
+                self.block_store.save_block_with_extended_commit(
+                    first, parts, first_ext
+                )
+            else:
+                self.block_store.save_block(first, parts, seen_commit)
+        # ApplyBlock failure on a commit-verified block is a LOCAL fault —
+        # fail-stop like the reference's panic, never punish the peer.
+        self.state = self.block_exec.apply_block(self.state, first_id, first)
+        self._n_synced += 1
+        self.pool.pop_request()
+
+    def _switch_to_consensus(self) -> None:
+        """reactor.go:383-386 → consensus/reactor.go:109."""
+        self.pool.stop()
+        self.synced.set()
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(
+                self.state, skip_wal=self._n_synced > 0
+            )

@@ -222,9 +222,14 @@ class ConsensusReactor(Reactor):
             self.cs.stop()
 
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
-        """Blocksync finished → start the FSM (reactor.go:109)."""
-        self.cs.update_to_state(state)
+        """Blocksync finished → start the FSM (reactor.go:109).
+
+        ``wait_sync`` must drop BEFORE update_to_state broadcasts the new
+        height: once peers see it they catch-up-gossip votes exactly once,
+        and a still-syncing reactor would silently drop them."""
         self.wait_sync = False
+        self.cs.update_to_state(state)
+        self.cs.reconstruct_last_commit_if_needed(state)
         self.cs.do_wal_catchup = not skip_wal
         self.cs.start()
 

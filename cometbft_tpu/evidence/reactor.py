@@ -1,0 +1,61 @@
+"""Evidence gossip reactor (reference: evidence/reactor.go, channel 0x38).
+
+Clist-tailing broadcast like the mempool reactor; received evidence goes
+through the pool's full verification before being gossiped onward.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..p2p.base_reactor import ChannelDescriptor, Reactor
+from ..types import serialization as ser
+from ..types.evidence import EvidenceError
+from .pool import EvidencePool
+
+EVIDENCE_CHANNEL = 0x38
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool: EvidencePool):
+        super().__init__("evidence-reactor")
+        self.pool = pool
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=EVIDENCE_CHANNEL, priority=6, send_queue_capacity=100
+            )
+        ]
+
+    def add_peer(self, peer) -> None:
+        threading.Thread(
+            target=self._broadcast_routine,
+            args=(peer,),
+            name=f"evidence-bcast-{peer.id[:8]}",
+            daemon=True,
+        ).start()
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        try:
+            ev = ser.loads(msg_bytes)
+            self.pool.add_evidence(ev)
+        except (EvidenceError, ValueError, KeyError):
+            if self.switch is not None:
+                self.switch.stop_and_remove_peer(peer, "bad evidence")
+
+    def _broadcast_routine(self, peer) -> None:
+        el = None
+        while peer.is_running() and self.is_running():
+            if el is None:
+                el = self.pool.evidence_list.front_wait(timeout=0.2)
+                if el is None:
+                    continue
+            if not el.removed:
+                if not peer.send(EVIDENCE_CHANNEL, ser.dumps(el.value)):
+                    continue
+            nxt = el.next_wait(timeout=0.2)
+            if nxt is not None:
+                el = nxt
+            elif el.removed:
+                el = None

@@ -58,6 +58,10 @@ class Codec:
                 raise TypeError(f"unregistered dataclass {name}")
             d: dict[str, Any] = {"__t": name}
             for f in dataclasses.fields(v):
+                # Underscore fields are in-memory caches (e.g. Commit._hash)
+                # — serializing them breaks canonical byte equality.
+                if f.name.startswith("_"):
+                    continue
                 d[f.name] = self.encode(getattr(v, f.name))
             return d
         if isinstance(v, bytes):

@@ -14,11 +14,13 @@ import threading
 
 from .. import proxy
 from ..abci.kvstore import KVStoreApplication
+from ..blocksync import BlocksyncReactor
 from ..config import Config
 from ..consensus import ConsensusState
 from ..consensus.reactor import ConsensusReactor
 from ..consensus.replay import Handshaker
 from ..consensus.wal import WAL
+from ..evidence import EvidencePool, EvidenceReactor
 from ..libs import db as dbm
 from ..libs.service import BaseService
 from ..mempool import CListMempool
@@ -143,8 +145,11 @@ class Node(BaseService):
         if config.consensus.create_empty_blocks is False:
             self.mempool.enable_txs_available()
 
-        # 7. Evidence (real pool lands with the evidence milestone)
-        self.evidence_pool = NopEvidencePool()
+        # 7. Evidence pool (setup.go:254)
+        self.evidence_db = _make_db(config, "evidence")
+        self.evidence_pool = EvidencePool(
+            self.evidence_db, self.state_store, self.block_store
+        )
 
         # 8. Block executor + consensus (setup.go:254-292)
         self.block_exec = BlockExecutor(
@@ -163,7 +168,7 @@ class Node(BaseService):
             self.block_exec,
             self.block_store,
             tx_notifier=self.mempool,
-            evidence_pool=None,
+            evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
             wal=WAL(wal_path),
         )
@@ -176,7 +181,26 @@ class Node(BaseService):
         self.node_key = NodeKey.load_or_generate(
             config.base.resolve(config.base.node_key_file)
         )
-        self.consensus_reactor = ConsensusReactor(self.consensus)
+        # Blocksync only when it can help: enabled in config and we're not
+        # the sole validator (node.go onlyValidatorIsUs check).
+        only_us = (
+            priv_validator is not None
+            and len(state.validators) == 1
+            and state.validators.has_address(
+                bytes(priv_validator.get_pub_key().address())
+            )
+        )
+        run_blocksync = config.base.block_sync and not only_us
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus, wait_sync=run_blocksync
+        )
+        self.blocksync_reactor = BlocksyncReactor(
+            state,
+            self.block_exec,
+            self.block_store,
+            run_blocksync,
+            consensus_reactor=self.consensus_reactor,
+        )
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
         self.node_info = NodeInfo(
             node_id=self.node_key.node_id,
@@ -200,7 +224,10 @@ class Node(BaseService):
             max_inbound=config.p2p.max_num_inbound_peers,
             max_outbound=config.p2p.max_num_outbound_peers,
         )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.node_info.channels = self.switch.channel_ids()
 
@@ -252,7 +279,9 @@ class Node(BaseService):
             self.consensus.wal.close()
         except Exception:
             pass
-        for db in (self.app_db, self.block_db, self.state_db):
+        for db in (
+            self.app_db, self.block_db, self.state_db, self.evidence_db
+        ):
             try:
                 db.close()
             except Exception:

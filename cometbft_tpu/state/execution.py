@@ -93,12 +93,40 @@ def extended_commit_info(ec: ExtendedCommit, validators: ValidatorSet):
 
 
 def _abci_misbehavior(evidence_list, state: State) -> list[abci.Misbehavior]:
+    """types/evidence.go ABCI() — evidence → ABCI Misbehavior records."""
+    from ..types.evidence import (
+        DuplicateVoteEvidence,
+        LightClientAttackEvidence,
+    )
+
     out = []
     for ev in evidence_list or ():
-        try:
-            out.append(ev.abci(state))
-        except AttributeError:
-            pass
+        if isinstance(ev, DuplicateVoteEvidence):
+            out.append(
+                abci.Misbehavior(
+                    type=abci.MisbehaviorType.DUPLICATE_VOTE,
+                    validator=abci.Validator(
+                        address=ev.vote_a.validator_address,
+                        power=ev.validator_power,
+                    ),
+                    height=ev.height(),
+                    time_ns=ev.time_ns(),
+                    total_voting_power=ev.total_voting_power,
+                )
+            )
+        elif isinstance(ev, LightClientAttackEvidence):
+            for val in ev.byzantine_validators:
+                out.append(
+                    abci.Misbehavior(
+                        type=abci.MisbehaviorType.LIGHT_CLIENT_ATTACK,
+                        validator=abci.Validator(
+                            address=val.address, power=val.voting_power
+                        ),
+                        height=ev.height(),
+                        time_ns=ev.time_ns(),
+                        total_voting_power=ev.total_voting_power,
+                    )
+                )
     return out
 
 
@@ -249,6 +277,7 @@ class BlockExecutor:
 
         self.state_store.save(new_state)
 
+        self.evidence_pool.update(new_state, block.evidence)
         self._prune(new_state)
         self._fire_events(block, block_id, resp)
         if self.metrics is not None:

@@ -1,0 +1,86 @@
+"""Blocksync pool scheduling tests (reference analog: blocksync/pool_test.go)."""
+
+import time
+
+from cometbft_tpu.blocksync.pool import BlockPool, REQUEST_TIMEOUT
+
+
+class _Block:
+    def __init__(self, height):
+        class H:
+            pass
+
+        self.header = H()
+        self.header.height = height
+
+
+def test_pool_requests_and_ordered_consumption():
+    sent = []
+    pool = BlockPool(1, send_request=lambda h, p: sent.append((h, p)))
+    pool.set_peer_range("peerA", 1, 5)
+    pool.make_requests()
+    assert {h for h, _ in sent} == {1, 2, 3, 4, 5}
+    # out-of-order arrivals, ordered consumption
+    for h in (3, 1, 2):
+        assert pool.add_block("peerA", _Block(h))
+    first, _, second = pool.peek_two_blocks()
+    assert first.header.height == 1 and second.header.height == 2
+    pool.pop_request()
+    first, _, second = pool.peek_two_blocks()
+    assert first.header.height == 2 and second.header.height == 3
+    assert not pool.is_caught_up()  # still below peer height 5
+
+
+def test_pool_rejects_unsolicited_blocks():
+    pool = BlockPool(1, send_request=lambda h, p: None)
+    pool.set_peer_range("peerA", 1, 3)
+    pool.set_peer_range("peerB", 1, 3)
+    pool.make_requests()
+    wrong = "peerB" if pool.requesters[1].peer_id == "peerA" else "peerA"
+    assert not pool.add_block(wrong, _Block(1))
+    assert pool.add_block(pool.requesters[1].peer_id, _Block(1))
+
+
+def test_pool_timeout_repicks_other_peer(monkeypatch):
+    sent = []
+    pool = BlockPool(1, send_request=lambda h, p: sent.append((h, p)))
+    pool.set_peer_range("peerA", 1, 2)
+    pool.make_requests()
+    assigned = pool.requesters[1].peer_id
+    assert assigned == "peerA"
+    pool.set_peer_range("peerB", 1, 2)
+    # simulate timeout
+    pool.requesters[1].request_time -= REQUEST_TIMEOUT + 1
+    pool.make_requests()
+    assert pool.requesters[1].peer_id == "peerB"
+
+
+def test_pool_redo_request_bans_and_refetches():
+    errs = []
+    sent = []
+    pool = BlockPool(
+        1,
+        send_request=lambda h, p: sent.append((h, p)),
+        on_peer_error=lambda p, r: errs.append(p),
+    )
+    pool.set_peer_range("peerA", 1, 2)
+    pool.make_requests()
+    pool.add_block("peerA", _Block(1))
+    pool.redo_request(1)
+    assert errs == ["peerA"]
+    assert pool.requesters[1].block is None
+    # a new peer gets the refetch
+    pool.set_peer_range("peerB", 1, 2)
+    pool.make_requests()
+    assert pool.requesters[1].peer_id == "peerB"
+
+
+def test_pool_caught_up_and_peer_removal():
+    pool = BlockPool(4, send_request=lambda h, p: None)
+    assert not pool.is_caught_up()  # no peers yet
+    pool.set_peer_range("peerA", 1, 3)
+    assert pool.is_caught_up()  # we're already past peerA's tip
+    pool.set_peer_range("peerB", 1, 9)
+    assert not pool.is_caught_up()
+    pool.remove_peer("peerB")
+    assert pool.is_caught_up()
