@@ -14,7 +14,7 @@ import json
 
 from ..abci import types as abci
 from ..state.execution import (
-    _commit_info,
+    build_last_commit_info,
     validator_updates_to_validators,
 )
 from ..types import GenesisDoc
@@ -27,11 +27,17 @@ class HandshakeError(Exception):
 
 def exec_commit_block(proxy_app, block, state, store=None) -> bytes:
     """state/execution.go:679 ExecCommitBlock — replay one stored block
-    through FinalizeBlock+Commit, no validation, no events."""
+    through FinalizeBlock+Commit, no validation, no events.
+
+    DecidedLastCommit is built from the validator set at height-1 loaded
+    from the state store (buildLastCommitInfo), NOT the boot-time
+    state.last_validators — they diverge when the replayed window spans
+    validator-set changes.
+    """
     resp = proxy_app.finalize_block(
         abci.RequestFinalizeBlock(
             txs=list(block.data.txs),
-            decided_last_commit=_commit_info(block, state.last_validators),
+            decided_last_commit=build_last_commit_info(block, store, state),
             misbehavior=[],
             hash=block.hash(),
             height=block.header.height,

@@ -76,9 +76,51 @@ def commit_to_vote_set(chain_id: str, commit, validators) -> VoteSet:
                 signature=cs.signature,
             )
         )
-    oks = vs.add_votes_batch(votes)  # one batched verify (TPU path)
+    oks, errs = vs.add_votes_batch(votes)  # one batched verify (TPU path)
     if not all(oks):
-        raise ConsensusError("failed to reconstruct seen-commit votes")
+        cause = next((e for e in errs if e is not None), None)
+        raise ConsensusError(
+            f"failed to reconstruct seen-commit votes: {cause}"
+        )
+    return vs
+
+
+def extended_commit_to_vote_set(chain_id: str, ec, validators) -> VoteSet:
+    """Rebuild the precommit VoteSet — with vote extensions — from a stored
+    ExtendedCommit (types/block.go ToExtendedVoteSet / reference
+    votesFromExtendedCommit). Used after restart when extensions are
+    enabled so the next proposal's ExtendedCommitInfo isn't empty."""
+    vs = VoteSet(
+        chain_id, ec.height, ec.round, canonical.PRECOMMIT_TYPE,
+        validators, extensions_enabled=True,
+    )
+    from ..types.block import BLOCK_ID_FLAG_ABSENT
+
+    votes = []
+    for idx, es in enumerate(ec.extended_signatures):
+        cs = es.commit_sig
+        if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            continue
+        votes.append(
+            Vote(
+                msg_type=canonical.PRECOMMIT_TYPE,
+                height=ec.height,
+                round=ec.round,
+                block_id=cs.block_id(ec.block_id),
+                timestamp_ns=cs.timestamp_ns,
+                validator_address=cs.validator_address,
+                validator_index=idx,
+                signature=cs.signature,
+                extension=es.extension,
+                extension_signature=es.extension_signature,
+            )
+        )
+    oks, errs = vs.add_votes_batch(votes)
+    if not all(oks):
+        cause = next((e for e in errs if e is not None), None)
+        raise ConsensusError(
+            f"failed to reconstruct extended-commit votes: {cause}"
+        )
     return vs
 
 
@@ -382,11 +424,31 @@ class ConsensusState(BaseService):
         self._new_step()
 
     def reconstruct_last_commit_if_needed(self, state) -> None:
-        """After restart: rebuild rs.last_commit from the stored seen
-        commit (state.go reconstructLastCommit)."""
+        """After restart: rebuild rs.last_commit (state.go
+        reconstructLastCommit). When vote extensions were enabled at the
+        last height, reconstruct from the stored ExtendedCommit so the next
+        proposal's ExtendedCommitInfo carries the extensions (reference
+        votesFromExtendedCommit); otherwise from the plain seen commit."""
         if state.last_block_height == 0 or self.rs.last_commit is not None:
             return
-        seen = self.block_store.load_seen_commit() if self.block_store else None
+        if self.block_store is None:
+            return
+        if state.consensus_params.vote_extensions_enabled(
+            state.last_block_height
+        ):
+            ec = self.block_store.load_block_extended_commit(
+                state.last_block_height
+            )
+            if ec is None:
+                raise ConsensusError(
+                    "vote extensions enabled but no extended commit stored "
+                    f"for height {state.last_block_height}"
+                )
+            self.rs.last_commit = extended_commit_to_vote_set(
+                state.chain_id, ec, state.last_validators
+            )
+            return
+        seen = self.block_store.load_seen_commit()
         if seen is None or seen.height != state.last_block_height:
             return
         self.rs.last_commit = commit_to_vote_set(
@@ -596,33 +658,81 @@ class ConsensusState(BaseService):
         self._do_prevote(height, round_)
 
     def _do_prevote(self, height: int, round_: int) -> None:
-        """defaultDoPrevote:1313."""
+        """defaultDoPrevote (state.go:1313-1452, 0.39 semantics).
+
+        There is no unlocking: a validator locked on a block prevotes nil for
+        anything else unless the proposal carries a POL (Proposal.pol_round)
+        at or after its locked round — the algorithm's line-28 rule.  The old
+        prevote-the-lock shortcut had documented liveness defects.
+        """
         rs = self.rs
-        if rs.locked_block is not None:
-            self._sign_add_vote(
-                canonical.PREVOTE_TYPE,
-                rs.locked_block.hash(),
-                rs.locked_block_parts.header,
-            )
-            return
-        if rs.proposal_block is None:
+        if rs.proposal_block is None or rs.proposal is None:
             self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
             return
         try:
             self.block_exec.validate_block(self.state, rs.proposal_block)
-            accepted = self.block_exec.process_proposal(
-                rs.proposal_block, self.state
-            )
         except Exception:
-            accepted = False
-        if accepted:
+            # Invalid from consensus' perspective → prevote nil.
+            self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
+            return
+
+        def prevote_proposal() -> None:
             self._sign_add_vote(
                 canonical.PREVOTE_TYPE,
                 rs.proposal_block.hash(),
                 rs.proposal_block_parts.header,
             )
-        else:
+
+        if rs.proposal.pol_round == -1:
+            # Fresh proposal, never had a +2/3 majority (line 22-26).
+            if rs.locked_round == -1:
+                if (
+                    rs.valid_round != -1
+                    and rs.valid_block is not None
+                    and rs.proposal_block.hash() == rs.valid_block.hash()
+                ):
+                    # Matches our valid block: app-validity already attested
+                    # by a correct node; no ProcessProposal round trip.
+                    prevote_proposal()
+                    return
+                try:
+                    accepted = self.block_exec.process_proposal(
+                        rs.proposal_block, self.state
+                    )
+                except Exception:
+                    accepted = False
+                if accepted:
+                    prevote_proposal()
+                else:
+                    self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
+                return
+            if rs.proposal_block.hash() == rs.locked_block.hash():
+                prevote_proposal()
+                return
             self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
+            return
+
+        # Re-proposal carrying a POL round (line 28-32): prevote it iff a
+        # +2/3 prevote majority for this block exists at pol_round and our
+        # lock is not more recent (or matches the block). ProcessProposal is
+        # intentionally NOT called here — the +2/3 prevotes at pol_round mean
+        # at least one correct node already app-validated it
+        # (state.go:1413-1431's "we don't need to query the application").
+        pol_prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        maj23 = pol_prevotes.two_thirds_majority() if pol_prevotes else None
+        if (
+            maj23 is not None
+            and not maj23.is_nil()
+            and rs.proposal_block.hash() == maj23.hash
+            and 0 <= rs.proposal.pol_round < rs.round
+        ):
+            if rs.locked_round <= rs.proposal.pol_round:
+                prevote_proposal()
+                return
+            if rs.proposal_block.hash() == rs.locked_block.hash():
+                prevote_proposal()
+                return
+        self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
         rs = self.rs
@@ -667,10 +777,8 @@ class ConsensusState(BaseService):
             raise ConsensusError("POL round inconsistent with +2/3 prevotes")
 
         if maj23.is_nil():
-            # +2/3 prevoted nil → unlock and precommit nil.
-            rs.locked_round = -1
-            rs.locked_block = None
-            rs.locked_block_parts = None
+            # +2/3 prevoted nil → precommit nil.  The lock is NOT cleared:
+            # 0.39 removed all unlocking (state.go:1534-1539).
             self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"", None)
             return
 
@@ -700,11 +808,12 @@ class ConsensusState(BaseService):
             )
             return
 
-        # +2/3 prevoted a block we don't have → unlock, fetch it, precommit nil.
-        rs.locked_round = -1
-        rs.locked_block = None
-        rs.locked_block_parts = None
-        if rs.proposal_block is None or rs.proposal_block.hash() != maj23.hash:
+        # +2/3 prevoted a block we don't have → fetch it and precommit nil,
+        # keeping any existing lock (state.go:1580-1589).
+        if (
+            rs.proposal_block_parts is None
+            or rs.proposal_block_parts.header != maj23.part_set_header
+        ):
             rs.proposal_block = None
             rs.proposal_block_parts = PartSet(maj23.part_set_header)
         self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"", None)
@@ -877,16 +986,8 @@ class ConsensusState(BaseService):
         prevotes = rs.votes.prevotes(vote.round)
         maj23 = prevotes.two_thirds_majority()
         if maj23 is not None:
-            # Unlock on a later polka for a different block.
-            if (
-                rs.locked_block is not None
-                and rs.locked_round < vote.round <= rs.round
-                and rs.locked_block.hash() != maj23.hash
-            ):
-                rs.locked_round = -1
-                rs.locked_block = None
-                rs.locked_block_parts = None
-            # Track the latest valid block.
+            # Track the latest valid block.  No unlocking here — 0.39
+            # removed the unlock-on-later-polka rule (state.go:2260-2296).
             if (
                 not maj23.is_nil()
                 and rs.valid_round < vote.round == rs.round
@@ -899,7 +1000,12 @@ class ConsensusState(BaseService):
                     rs.valid_block = rs.proposal_block
                     rs.valid_block_parts = rs.proposal_block_parts
                 else:
+                    # We're getting the wrong block.
                     rs.proposal_block = None
+                if (
+                    rs.proposal_block_parts is None
+                    or rs.proposal_block_parts.header != maj23.part_set_header
+                ):
                     rs.proposal_block_parts = PartSet(maj23.part_set_header)
                 self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
 

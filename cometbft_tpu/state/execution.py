@@ -57,7 +57,7 @@ class NopEvidencePool:
 
 
 def _commit_info(block: Block, last_validators: ValidatorSet) -> abci.CommitInfo:
-    """ABCI view of the block's LastCommit (execution.go buildLastCommitInfo)."""
+    """ABCI view of the block's LastCommit against a given validator set."""
     votes = []
     if block.last_commit is not None and block.last_commit.size() > 0:
         for i, cs in enumerate(block.last_commit.signatures):
@@ -73,6 +73,45 @@ def _commit_info(block: Block, last_validators: ValidatorSet) -> abci.CommitInfo
     return abci.CommitInfo(
         round=block.last_commit.round if block.last_commit else 0, votes=votes
     )
+
+
+def build_last_commit_info(
+    block: Block, state_store, state: "State"
+) -> abci.CommitInfo:
+    """execution.go:405 buildLastCommitInfo — the voter powers the app sees
+    for block H must come from the validator set AT height H-1.
+
+    Live path (H == state.last_block_height + 1): state.last_validators IS
+    that set, no store I/O. Replay path (handshake replaying an older
+    window): load it from the state store — the boot-time in-memory set
+    diverges across validator-set changes. A missing store record fails
+    loudly rather than handing the app guessed voter powers (the reference
+    panics on a failed LoadValidators)."""
+    if block.header.height == state.initial_height:
+        return abci.CommitInfo(round=0, votes=[])
+    if (
+        block.header.height == state.last_block_height + 1
+        and state.last_validators is not None
+    ):
+        vals = state.last_validators
+    else:
+        vals = (
+            state_store.load_validators(block.header.height - 1)
+            if state_store is not None
+            else None
+        )
+        if vals is None:
+            raise RuntimeError(
+                f"no validator set stored for height "
+                f"{block.header.height - 1}"
+            )
+    commit_size = block.last_commit.size() if block.last_commit else 0
+    if commit_size != len(vals.validators):
+        raise RuntimeError(
+            f"commit size ({commit_size}) != validator set length "
+            f"({len(vals.validators)}) at height {block.header.height}"
+        )
+    return _commit_info(block, vals)
 
 
 def extended_commit_info(ec: ExtendedCommit, validators: ValidatorSet):
@@ -217,7 +256,9 @@ class BlockExecutor:
         resp = self.proxy_app.process_proposal(
             abci.RequestProcessProposal(
                 txs=list(block.data.txs),
-                proposed_last_commit=_commit_info(block, state.last_validators),
+                proposed_last_commit=build_last_commit_info(
+                    block, self.state_store, state
+                ),
                 misbehavior=_abci_misbehavior(block.evidence, state),
                 hash=block.hash(),
                 height=block.header.height,
@@ -249,7 +290,9 @@ class BlockExecutor:
         resp = self.proxy_app.finalize_block(
             abci.RequestFinalizeBlock(
                 txs=list(block.data.txs),
-                decided_last_commit=_commit_info(block, state.last_validators),
+                decided_last_commit=build_last_commit_info(
+                    block, self.state_store, state
+                ),
                 misbehavior=_abci_misbehavior(block.evidence, state),
                 hash=block.hash(),
                 height=block.header.height,

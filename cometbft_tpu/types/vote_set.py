@@ -113,7 +113,9 @@ class VoteSet:
         return self.maj23 is not None
 
     def has_two_thirds_any(self) -> bool:
-        return self.sum > self.val_set.total_voting_power() * 2 / 3
+        # Integer math: float division diverges from the reference's int64
+        # arithmetic once total power exceeds 2^53 (vote_set.go:340).
+        return 3 * self.sum > 2 * self.val_set.total_voting_power()
 
     def has_all(self) -> bool:
         return self.sum == self.val_set.total_voting_power()
@@ -137,20 +139,31 @@ class VoteSet:
         self._verify_vote_signature(vote, val.pub_key)
         return self._admit(vote, val)
 
-    def add_votes_batch(self, votes: list[Vote]) -> list[bool]:
+    def add_votes_batch(
+        self, votes: list[Vote]
+    ) -> tuple[list[bool], list[Exception | None]]:
         """Admit many votes with ONE device verification launch.
 
         TPU-native vote ingest: validates and pre-screens each vote, streams
         all (pubkey, sign-bytes, sig) triples (plus extension signatures
         when enabled) to the batch verifier, then admits the valid ones.
-        Per-vote errors don't abort the batch; the return mask marks newly
-        added votes.
+        Per-vote errors don't abort the batch; returns ``(added, errors)``
+        where ``added[i]`` marks newly admitted votes and ``errors[i]``
+        carries the per-vote failure (ConflictingVoteError for equivocation
+        — the caller's duplicate-vote-evidence input — or VoteError for a
+        bad signature / malformed vote) so the batched path surfaces the
+        same signals as single ``add_vote``.
         """
+        n = len(votes)
+        added = [False] * n
+        errors: list[Exception | None] = [None] * n
+
         screened: list[tuple[Vote, object]] = []
-        for vote in votes:
+        for i, vote in enumerate(votes):
             try:
                 self._check_vote(vote)
-            except (VoteError, VoteSetError):
+            except (VoteError, VoteSetError) as e:
+                errors[i] = e
                 screened.append((vote, None))
                 continue
             val = self.val_set.get_by_index(vote.validator_index)
@@ -175,21 +188,24 @@ class VoteSet:
                 )
                 lanes.append(i)  # second lane for the same vote
 
-        added = [False] * len(votes)
         if lanes:
             _, bits = verifier.verify()
             vote_ok: dict[int, bool] = {}
             for lane, ok in zip(lanes, bits):
                 vote_ok[lane] = vote_ok.get(lane, True) and bool(ok)
             for i, ok in vote_ok.items():
-                if not ok:
-                    continue
                 vote, val = screened[i]
+                if not ok:
+                    errors[i] = VoteError(
+                        f"invalid signature from validator "
+                        f"{vote.validator_address.hex()}"
+                    )
+                    continue
                 try:
                     added[i] = self._admit(vote, val)
-                except ConflictingVoteError:
-                    added[i] = False
-        return added
+                except ConflictingVoteError as e:
+                    errors[i] = e
+        return added, errors
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
         """Record a peer's claim of 2/3 for a block (vote_set.go:335-378):

@@ -83,6 +83,15 @@ class ChainDriver:
         self.state = state or make_genesis_state(genesis)
         self.last_commit: Commit | None = None
         self.last_block_id: BlockID | None = None
+        # Mirror node boot: persist genesis state so per-height validator
+        # records (vals:1, vals:2) exist for later handshake replay.
+        ss = getattr(executor, "state_store", None)
+        if (
+            ss is not None
+            and self.state.last_block_height == 0
+            and ss.load() is None
+        ):
+            ss.save(self.state)
 
     def next_block(self, txs: list[bytes]):
         height = (

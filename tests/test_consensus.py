@@ -202,3 +202,221 @@ def test_wal_crash_recovery_restart(tmp_path):
         assert parts2["state_store"].load().last_block_height >= start_height + 2
     finally:
         stop_node(cs2, parts2)
+
+
+# -- 0.39 locking semantics (no unlocking; POL-gated prevotes) -------------
+# Reference: consensus/state.go defaultDoPrevote:1313-1452, enterPrecommit
+# :1489-1590 — the pre-0.38 unlock rules are gone; a locked validator only
+# prevotes another block when the proposal carries a POL at or after its
+# locked round.
+
+
+def _locking_fixture():
+    """Unstarted 4-validator node (we are validator index of pv0) with two
+    distinct proposal-ready blocks A and B for height 1."""
+    from cometbft_tpu.types import serialization as ser
+    from cometbft_tpu.types.part_set import PartSet
+
+    genesis, pvs = make_genesis(4)
+    # our node must be SOME validator; use pvs[0]
+    cs, parts = make_consensus_node(genesis, pvs[0])
+    proposer = cs.state.validators.validators[0]
+    block_a = parts["executor"].create_proposal_block(
+        1, cs.state, None, proposer.address, time_ns=1_700_000_001_000_000_000
+    )
+    block_b = parts["executor"].create_proposal_block(
+        1, cs.state, None, proposer.address, time_ns=1_700_000_002_000_000_000
+    )
+    assert block_a.hash() != block_b.hash()
+    parts_a = PartSet.from_data(ser.dumps(block_a))
+    parts_b = PartSet.from_data(ser.dumps(block_b))
+    return cs, parts, pvs, (block_a, parts_a), (block_b, parts_b)
+
+
+def _prevote(chain_id, valset, pvs, idx, height, round_, block_id):
+    from cometbft_tpu.types.vote import Vote
+
+    val = valset.validators[idx]
+    v = Vote(
+        msg_type=canonical.PREVOTE_TYPE,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=1_700_000_000_000_000_000 + idx,
+        validator_address=val.address,
+        validator_index=idx,
+    )
+    pvs[idx].sign_vote(chain_id, v, sign_extension=False)
+    return v
+
+
+def _drain_own_votes(cs):
+    """Pop internally-queued own VoteMessages from the (unstarted) inbox."""
+    votes = []
+    while True:
+        try:
+            kind, mi = cs._queue.get_nowait()
+        except Exception:
+            break
+        if isinstance(mi.msg, VoteMessage):
+            votes.append(mi.msg.vote)
+    return votes
+
+
+class TestLockingSemantics:
+    def test_nil_polka_does_not_unlock(self):
+        cs, parts, pvs, (block_a, parts_a), _ = _locking_fixture()
+        try:
+            rs = cs.rs
+            rs.locked_round = 0
+            rs.locked_block = block_a
+            rs.locked_block_parts = parts_a
+            rs.round = 1
+            rs.step = RoundStep.PREVOTE
+            rs.votes.set_round(1)
+            nil = BlockID()
+            chain = cs.state.chain_id
+            for i in range(1, 4):  # 3/4 = +2/3 prevote nil at round 1
+                cs.rs.votes.add_vote(
+                    _prevote(chain, cs.state.validators, pvs, i, 1, 1, nil)
+                )
+            cs._enter_precommit(1, 1)
+            # lock kept, precommit nil
+            assert rs.locked_block is block_a
+            assert rs.locked_round == 0
+            own = _drain_own_votes(cs)
+            assert own and own[-1].msg_type == canonical.PRECOMMIT_TYPE
+            assert own[-1].block_id.is_nil()
+        finally:
+            stop_node(cs, parts)
+
+    def test_locked_prevotes_nil_on_fresh_proposal(self):
+        from cometbft_tpu.types.vote import Proposal
+
+        cs, parts, pvs, (block_a, parts_a), (block_b, parts_b) = (
+            _locking_fixture()
+        )
+        try:
+            rs = cs.rs
+            rs.locked_round = 0
+            rs.locked_block = block_a
+            rs.locked_block_parts = parts_a
+            rs.round = 1
+            rs.proposal = Proposal(
+                height=1,
+                round=1,
+                pol_round=-1,  # fresh proposal, no POL
+                block_id=BlockID(block_b.hash(), parts_b.header),
+                timestamp_ns=1_700_000_003_000_000_000,
+            )
+            rs.proposal_block = block_b
+            rs.proposal_block_parts = parts_b
+            cs._do_prevote(1, 1)
+            own = _drain_own_votes(cs)
+            assert own and own[-1].msg_type == canonical.PREVOTE_TYPE
+            assert own[-1].block_id.is_nil()  # not the lock, not the proposal
+            assert rs.locked_block is block_a
+        finally:
+            stop_node(cs, parts)
+
+    def test_pol_reproposal_overrides_lock(self):
+        """Liveness rule (line 28-29): locked_round <= Proposal.pol_round
+        with +2/3 prevotes at pol_round → prevote the re-proposal."""
+        from cometbft_tpu.types.vote import Proposal
+
+        cs, parts, pvs, (block_a, parts_a), (block_b, parts_b) = (
+            _locking_fixture()
+        )
+        try:
+            rs = cs.rs
+            rs.locked_round = 0
+            rs.locked_block = block_a
+            rs.locked_block_parts = parts_a
+            rs.round = 2
+            rs.votes.set_round(2)
+            bid_b = BlockID(block_b.hash(), parts_b.header)
+            chain = cs.state.chain_id
+            for i in range(1, 4):  # +2/3 prevoted B at round 1 (the POL)
+                cs.rs.votes.add_vote(
+                    _prevote(chain, cs.state.validators, pvs, i, 1, 1, bid_b)
+                )
+            rs.proposal = Proposal(
+                height=1,
+                round=2,
+                pol_round=1,  # >= locked_round
+                block_id=bid_b,
+                timestamp_ns=1_700_000_004_000_000_000,
+            )
+            rs.proposal_block = block_b
+            rs.proposal_block_parts = parts_b
+            cs._do_prevote(1, 2)
+            own = _drain_own_votes(cs)
+            assert own and own[-1].msg_type == canonical.PREVOTE_TYPE
+            assert own[-1].block_id == bid_b  # prevoted the re-proposal
+        finally:
+            stop_node(cs, parts)
+
+
+# -- extended-commit reconstruction after restart ---------------------------
+
+
+def test_reconstruct_last_commit_uses_extended_commit():
+    """With vote extensions enabled at the last height, restart must rebuild
+    rs.last_commit from the stored ExtendedCommit so extensions survive
+    (reference votesFromExtendedCommit)."""
+    import dataclasses
+
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet
+    from cometbft_tpu.types.params import ABCIParams
+
+    genesis, pvs = make_genesis(4)
+    cs, parts = make_consensus_node(genesis, pvs[0])
+    try:
+        chain = cs.state.chain_id
+        vals = cs.state.validators
+        from cometbft_tpu.types.block import PartSetHeader
+
+        bid = BlockID(b"\x11" * 32, PartSetHeader(total=1, hash=b"\x22" * 32))
+        vs = VoteSet(
+            chain, 1, 0, canonical.PRECOMMIT_TYPE, vals,
+            extensions_enabled=True,
+        )
+        for i in range(4):
+            v = Vote(
+                msg_type=canonical.PRECOMMIT_TYPE,
+                height=1,
+                round=0,
+                block_id=bid,
+                timestamp_ns=1_700_000_005_000_000_000 + i,
+                validator_address=vals.validators[i].address,
+                validator_index=i,
+                extension=b"ext-%d" % i,
+            )
+            pvs[i].sign_vote(chain, v, sign_extension=True)
+            vs.add_vote(v)
+        ec = vs.make_extended_commit(True)
+
+        # persist EC at height 1, then simulate restart state
+        from cometbft_tpu.types import serialization as ser
+
+        parts["block_store"].db.set(b"EC:" + b"%020d" % 1, ser.dumps(ec))
+        new_params = dataclasses.replace(
+            cs.state.consensus_params,
+            abci=ABCIParams(vote_extensions_enable_height=1),
+        )
+        state = cs.state
+        state.consensus_params = new_params
+        state.last_block_height = 1
+        state.last_validators = vals
+
+        cs.rs.last_commit = None
+        cs.reconstruct_last_commit_if_needed(state)
+        lc = cs.rs.last_commit
+        assert lc is not None and lc.extensions_enabled
+        ec2 = lc.make_extended_commit(True)
+        assert [es.extension for es in ec2.extended_signatures] == [
+            b"ext-0", b"ext-1", b"ext-2", b"ext-3"
+        ]
+    finally:
+        stop_node(cs, parts)

@@ -418,8 +418,10 @@ class TestVoteSet:
         votes = [_vote(vals, pvs, i, bid) for i in range(6)]
         votes[2].signature = bytes(64)  # invalid
         vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
-        added = vs.add_votes_batch(votes)
+        added, errors = vs.add_votes_batch(votes)
         assert added == [True, True, False, True, True, True]
+        assert errors[2] is not None  # bad signature surfaced, not swallowed
+        assert all(e is None for i, e in enumerate(errors) if i != 2)
         assert vs.two_thirds_majority() == bid
 
     def test_make_commit(self):
