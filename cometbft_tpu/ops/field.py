@@ -2,21 +2,27 @@
 
 Design (TPU-first, not a port):
 
-* A field element is ``(..., 20)`` int32 limbs, 13 bits each, little-endian
-  (value = sum(limb[i] << (13*i))). 13-bit limbs are chosen so that a full
-  schoolbook product column -- up to 20 partial products of 26 bits each --
-  fits a 32-bit signed accumulator (20 * 2^26 < 2^31). This keeps everything
-  in native int32 on the TPU VPU; no int64 emulation, no floats.
-* Representation is *lazy*: limbs are normally <= 8191 but may exceed 13 bits
-  slightly (bounded <= ~8400 after :func:`carry`); values are only canonical
-  (< p) after :func:`canonical`. All ops tolerate lazy inputs.
-* Multiplication is one batched outer product ``(..., 20, 20)`` plus a
-  "shear" pad/reshape that turns anti-diagonal summation into a plain
-  reduce -- a handful of fused XLA HLOs, no gathers, no data-dependent
-  control flow.
-* Reduction folds limb weight 2^260 -> 19 * 2^5 = 608 (since
-  2^255 = 19 mod p) and uses a few *parallel* carry passes instead of a
-  sequential ripple; bounds are re-established without branches.
+* A field element is ``(20, *batch)`` int32 limbs, 13 bits each,
+  little-endian along axis 0 (value = sum(limb[i] << (13*i))). The batch
+  dimensions TRAIL so the (large) signature axis is minor-most and fills
+  the TPU's 128-wide vector lanes; the 20-limb axis lives in sublanes.
+  (The previous limbs-minor layout padded 20 -> 128 lanes and wasted ~84%
+  of every vector register — measured ~2x end-to-end on a v5e.)
+* 13-bit limbs are chosen so a full schoolbook product column — up to 20
+  partial products of <= 2^27 each — plus the reduction fold stays inside
+  a 32-bit signed accumulator. Everything runs in native int32 on the TPU
+  VPU; no int64 emulation, no floats.
+* Representation is *lazy* with a single closed invariant, chosen so
+  every add/sub/neg/dbl2 needs only ONE carry pass and mul's column fold
+  needs THREE (interval-arithmetic proof in tests/test_field.py):
+  every op accepts operands with limbs <= 10015 and returns limbs
+  <= 10015, with all int32 intermediates in range (worst fold column
+  20 * 10015^2 + fold terms < 2^31). Values are only canonical (< p)
+  after :func:`canonical`.
+* Multiplication is one batched outer product ``(20, 20, *batch)`` plus a
+  "shear" pad/reshape over the two leading axes that turns anti-diagonal
+  summation into a plain axis-0 reduce — a handful of fused XLA HLOs, no
+  gathers, no data-dependent control flow.
 
 This is the arithmetic core under the batched ed25519 verifier
 (reference behavior: crypto/ed25519/ed25519.go + curve25519-voi batch
@@ -25,6 +31,8 @@ execution on the TPU VPU).
 """
 
 from __future__ import annotations
+
+from functools import lru_cache
 
 import numpy as np
 
@@ -49,83 +57,116 @@ _P_LIMBS = tuple((P >> (BITS * i)) & MASK for i in range(NLIMB))
 
 
 def to_limbs(x: int) -> np.ndarray:
-    """Python int -> limb vector (host helper)."""
+    """Python int -> (20,) limb vector (host helper)."""
     return np.array([(x >> (BITS * i)) & MASK for i in range(NLIMB)], np.int32)
 
 
 def from_limbs(limbs) -> int:
-    """Limb vector -> Python int (host helper; accepts lazy limbs)."""
+    """(20,) limb vector -> Python int (host helper; accepts lazy limbs)."""
     limbs = np.asarray(limbs)
     return sum(int(l) << (BITS * i) for i, l in enumerate(limbs))
 
 
-def const(x: int) -> jnp.ndarray:
-    """Constant field element as a (20,) device array."""
-    return jnp.array([(x >> (BITS * i)) & MASK for i in range(NLIMB)], jnp.int32)
+@lru_cache(maxsize=None)
+def _const_cached(x: int, batch_ndim: int) -> np.ndarray:
+    # numpy (not a device array): safe to reuse across jit traces. Frozen:
+    # the cache hands out the same object forever.
+    arr = np.array(
+        [(x >> (BITS * i)) & MASK for i in range(NLIMB)], np.int32
+    ).reshape((NLIMB,) + (1,) * batch_ndim)
+    arr.setflags(write=False)
+    return arr
 
 
-def carry(x: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
-    """Parallel carry propagation with mod-p folding.
+def const(x: int, batch_ndim: int = 0) -> np.ndarray:
+    """Constant field element shaped (20, 1 x batch_ndim) for broadcasting."""
+    return _const_cached(x, batch_ndim)
 
-    Accepts limbs up to ~2^27 and returns limbs <= 8191 + epsilon (< 8400),
-    value unchanged mod p. Each pass: split every limb into lo 13 bits plus
-    carry, shift carries up one limb, and fold the carry out of limb 19
-    (weight 2^260) back into limb 0 with factor 608.
+
+def bconst(x: int, ref: jnp.ndarray) -> np.ndarray:
+    """Constant shaped to broadcast against field element ``ref``."""
+    return _const_cached(x, ref.ndim - 1)
+
+
+def carry(x: jnp.ndarray, passes: int) -> jnp.ndarray:
+    """Parallel carry propagation with mod-p folding (axis 0 = limbs).
+
+    Each pass: split every limb into lo 13 bits plus carry, shift carries up
+    one limb, and fold the carry out of limb 19 (weight 2^260) back into
+    limb 0 with factor 608. Pass counts are fixed per call site from the
+    interval analysis in the module docstring.
     """
     for _ in range(passes):
         lo = x & MASK
         hi = x >> BITS
-        rolled = jnp.roll(hi, 1, axis=-1)
-        fold0 = rolled[..., :1] * FOLD
-        x = lo + jnp.concatenate([fold0, rolled[..., 1:]], axis=-1)
+        rolled = jnp.roll(hi, 1, axis=0)
+        fold0 = rolled[:1] * FOLD
+        x = lo + jnp.concatenate([fold0, rolled[1:]], axis=0)
     return x
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a + b, passes=2)
+    """Sum. One pass: inputs <= 10015 -> raw <= 20030, carries <= 2,
+    limb0 <= 8191 + 2*608 = 9407 <= 10015."""
+    return carry(a + b, passes=1)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    bias = jnp.array(_SUB_BIAS, jnp.int32)
-    return carry(a + bias - b, passes=2)
+    """Difference. One pass: raw <= 10015 + 16382 = 26397, carries <= 3,
+    limb0 <= 8191 + 3*608 = 10015."""
+    bias = jnp.asarray(
+        np.array(_SUB_BIAS, np.int32).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    )
+    return carry(a + bias - b, passes=1)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    bias = jnp.array(_SUB_BIAS, jnp.int32)
-    return carry(bias - a, passes=2)
+    bias = jnp.asarray(
+        np.array(_SUB_BIAS, np.int32).reshape((NLIMB,) + (1,) * (a.ndim - 1))
+    )
+    return carry(bias - a, passes=1)
+
+
+def dbl2(a: jnp.ndarray) -> jnp.ndarray:
+    """2*a, one carry pass (inputs <= 10015, output <= 9407)."""
+    return carry(a + a, passes=1)
 
 
 def _fold_cols(cols: jnp.ndarray) -> jnp.ndarray:
-    """Reduce 39 product columns (each < 2^31) to 20 lazy limbs.
+    """Reduce 39 product columns (each < ~2.02e9) to 20 lazy limbs.
 
     High columns are split into lo13/hi parts *before* multiplying by the
-    fold factor so every intermediate stays inside int32.
+    fold factor so every intermediate stays inside int32. Three carry
+    passes restore the <= 10015 invariant (bound proof in
+    tests/test_field.py::test_lazy_bound_discipline).
     """
-    lo_cols = cols[..., :NLIMB]
-    hi_cols = cols[..., NLIMB:]  # 19 columns, weight 2^(260 + 13*i)
+    lo_cols = cols[:NLIMB]
+    hi_cols = cols[NLIMB:]  # 19 columns, weight 2^(260 + 13*i)
     hi_lo = hi_cols & MASK
     hi_hi = hi_cols >> BITS
-    r = lo_cols
-    r = r + jnp.pad(hi_lo * FOLD, [(0, 0)] * (r.ndim - 1) + [(0, 1)])
-    r = r + jnp.pad(hi_hi * FOLD, [(0, 0)] * (r.ndim - 1) + [(1, 0)])
-    return carry(r, passes=4)
+    pad_tail = [(0, 1)] + [(0, 0)] * (cols.ndim - 1)
+    pad_head = [(1, 0)] + [(0, 0)] * (cols.ndim - 1)
+    r = lo_cols + jnp.pad(hi_lo * FOLD, pad_tail) + jnp.pad(hi_hi * FOLD, pad_head)
+    return carry(r, passes=3)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Batched field multiplication.
 
-    Schoolbook outer product, then the shear trick: pad each row i of the
-    (20, 20) product to width 40, flatten, drop the tail, and reshape to
-    (20, 39) -- element (i, j) lands in column i + j, so an axis sum yields
-    the 39 anti-diagonal columns with no gathers.
+    Schoolbook outer product over the two leading limb axes, then the shear
+    trick: pad rows to width 40, flatten the leading two axes, drop the
+    tail, reshape to (20, 39, *batch) — element (i, j) lands in column
+    i + j, so an axis-0 sum yields the 39 anti-diagonal columns with no
+    gathers. Inputs may be any lazy values (limbs <= 10015).
     """
-    prod = a[..., :, None] * b[..., None, :]  # (..., 20, 20), < 2^26 each
-    padded = jnp.pad(prod, [(0, 0)] * (prod.ndim - 2) + [(0, 0), (0, NLIMB)])
-    flat = padded.reshape(*prod.shape[:-2], NLIMB * 2 * NLIMB)
-    sheared = flat[..., : NLIMB * (2 * NLIMB - 1)].reshape(
-        *prod.shape[:-2], NLIMB, 2 * NLIMB - 1
+    prod = a[:, None] * b[None, :]  # (20, 20, *batch), each <= ~1.07e8
+    batch = prod.shape[2:]
+    padded = jnp.pad(prod, [(0, 0), (0, NLIMB)] + [(0, 0)] * len(batch))
+    flat = padded.reshape((NLIMB * 2 * NLIMB,) + batch)
+    sheared = flat[: NLIMB * (2 * NLIMB - 1)].reshape(
+        (NLIMB, 2 * NLIMB - 1) + batch
     )
-    cols = jnp.sum(sheared, axis=-2)  # (..., 39), each < 20 * 2^26 < 2^31
+    cols = jnp.sum(sheared, axis=0)  # (39, *batch)
     return _fold_cols(cols)
 
 
@@ -137,48 +178,51 @@ def canonical(x: jnp.ndarray) -> jnp.ndarray:
     """Fully reduce to the unique representative in [0, p).
 
     Sequential carries (exact), 2^255 -> 19 folding, then one conditional
-    subtract of p (branchless select). Input limbs may be lazy (<= ~2^27).
+    subtract of p (branchless select). Inputs must satisfy the lazy bound
+    (limbs <= 10015), for which two ripple rounds reach a fixpoint.
     """
-    for _ in range(3):
+    for _ in range(2):
         limbs = []
-        c = jnp.zeros_like(x[..., 0])
+        c = jnp.zeros_like(x[0])
         for i in range(NLIMB - 1):
-            v = x[..., i] + c
+            v = x[i] + c
             limbs.append(v & MASK)
             c = v >> BITS
-        v = x[..., NLIMB - 1] + c
+        v = x[NLIMB - 1] + c
         limbs.append(v & 0xFF)
         top = v >> 8  # weight 2^255 == 19
         limbs[0] = limbs[0] + top * 19
-        x = jnp.stack(limbs, axis=-1)
+        x = jnp.stack(limbs, axis=0)
     # x now in [0, 2^255); subtract p once if x >= p.
-    p_limbs = jnp.array(_P_LIMBS, jnp.int32)
-    borrow = jnp.zeros_like(x[..., 0])
+    p_limbs = _P_LIMBS
+    borrow = jnp.zeros_like(x[0])
     diff = []
     for i in range(NLIMB):
-        v = x[..., i] - p_limbs[i] + borrow
+        v = x[i] - p_limbs[i] + borrow
         diff.append(v & (MASK if i < NLIMB - 1 else 0xFF))
         v_shift = BITS if i < NLIMB - 1 else 8
         borrow = v >> v_shift  # arithmetic shift: 0 or -1
     ge_p = borrow == 0
-    y = jnp.stack(diff, axis=-1)
-    return jnp.where(ge_p[..., None], y, x)
+    y = jnp.stack(diff, axis=0)
+    return jnp.where(ge_p[None], y, x)
 
 
 def is_zero(x: jnp.ndarray) -> jnp.ndarray:
-    """True where x == 0 mod p. Shape (...,)."""
-    return jnp.all(canonical(x) == 0, axis=-1)
+    """True where x == 0 mod p. Shape (*batch,)."""
+    return jnp.all(canonical(x) == 0, axis=0)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(canonical(a) == canonical(b), axis=-1)
+    return jnp.all(canonical(a) == canonical(b), axis=0)
 
 
 def pow_const(base: jnp.ndarray, exponent: int) -> jnp.ndarray:
     """base ** exponent for a fixed public exponent.
 
     MSB-first square-and-multiply with a branchless select; the exponent is
-    compile-time constant so XLA sees a fixed-trip loop.
+    compile-time constant so XLA sees a fixed-trip loop. Prefer
+    :func:`pow_2_252_m3` for the decompression exponent — the addition
+    chain does ~265 muls where this does ~2 per bit.
     """
     import jax
 
@@ -189,7 +233,40 @@ def pow_const(base: jnp.ndarray, exponent: int) -> jnp.ndarray:
 
     def body(i, acc):
         acc = sq(acc)
-        return jnp.where(bits[i][..., None] == 1, mul(acc, base), acc)
+        sel = bits[i].reshape((1,) * acc.ndim)
+        return jnp.where(sel == 1, mul(acc, base), acc)
 
-    one = jnp.broadcast_to(const(1), base.shape)
+    one = jnp.broadcast_to(const(1, base.ndim - 1), base.shape)
     return jax.lax.fori_loop(0, nbits, body, one)
+
+
+def _sq_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    import jax
+
+    if n <= 4:
+        for _ in range(n):
+            x = sq(x)
+        return x
+    return jax.lax.fori_loop(0, n, lambda i, v: sq(v), x)
+
+
+def pow_2_252_m3(z: jnp.ndarray) -> jnp.ndarray:
+    """z ** (2^252 - 3) — the ed25519 decompression square-root exponent.
+
+    Classic curve25519 addition chain (~254 squarings + 11 multiplies),
+    ~2x cheaper than generic square-and-multiply over the same exponent.
+    """
+    z2 = sq(z)  # 2
+    z8 = _sq_n(z2, 2)  # 8
+    z9 = mul(z, z8)  # 9
+    z11 = mul(z2, z9)  # 11
+    z22 = sq(z11)  # 22
+    z_5_0 = mul(z9, z22)  # 2^5 - 2^0
+    z_10_0 = mul(_sq_n(z_5_0, 5), z_5_0)  # 2^10 - 2^0
+    z_20_0 = mul(_sq_n(z_10_0, 10), z_10_0)  # 2^20 - 2^0
+    z_40_0 = mul(_sq_n(z_20_0, 20), z_20_0)  # 2^40 - 2^0
+    z_50_0 = mul(_sq_n(z_40_0, 10), z_10_0)  # 2^50 - 2^0
+    z_100_0 = mul(_sq_n(z_50_0, 50), z_50_0)  # 2^100 - 2^0
+    z_200_0 = mul(_sq_n(z_100_0, 100), z_100_0)  # 2^200 - 2^0
+    z_250_0 = mul(_sq_n(z_200_0, 50), z_50_0)  # 2^250 - 2^0
+    return mul(_sq_n(z_250_0, 2), z)  # 2^252 - 3

@@ -53,23 +53,26 @@ def make_mesh(devices=None, commit_axis: int = 1) -> Mesh:
 
 @lru_cache(maxsize=None)
 def _sharded_verify(mesh: Mesh):
-    """jit of the verify kernel over a (C, V, ...) batch sharded on the mesh.
+    """jit of the verify kernel over a (..., C, V) batch sharded on the mesh.
 
-    Returns per-signature validity (C, V) sharded like the inputs plus the
-    per-commit verdict (C,) — the latter forces the one collective (a
-    commit-local all-reduce over the sig axis).
+    Batch dims TRAIL (see ops/field.py): y limbs are (20, C, V), parity
+    bits (C, V), scalar windows (64, C, V). Returns per-signature validity
+    (C, V) sharded like the inputs plus the per-commit verdict (C,) — the
+    latter forces the one collective (a commit-local all-reduce over the
+    sig axis).
     """
-    data = NamedSharding(mesh, P(AXIS_COMMIT, AXIS_SIG))
+    lead = NamedSharding(mesh, P(None, AXIS_COMMIT, AXIS_SIG))
+    flat = NamedSharding(mesh, P(AXIS_COMMIT, AXIS_SIG))
     verdict = NamedSharding(mesh, P(AXIS_COMMIT))
 
-    def step(y_a, sign_a, y_r, sign_r, s_bits, kneg_bits):
-        ok = curve.verify_kernel(y_a, sign_a, y_r, sign_r, s_bits, kneg_bits)
+    def step(y_a, sign_a, y_r, sign_r, s_nibs, kneg_nibs):
+        ok = curve.verify_kernel(y_a, sign_a, y_r, sign_r, s_nibs, kneg_nibs)
         return ok, jnp.all(ok, axis=-1)
 
     return jax.jit(
         step,
-        in_shardings=(data, data, data, data, data, data),
-        out_shardings=(data, verdict),
+        in_shardings=(lead, flat, lead, flat, lead, lead),
+        out_shardings=(flat, verdict),
     )
 
 
@@ -86,9 +89,9 @@ def verify_sharded(
 ):
     """Run the sharded verifier over host-packed arrays (see ops.verify).
 
-    ``arrays``/``host_ok`` come from ops.verify.pack_inputs with leading dim
-    n_commits * n_sigs (flattened); arrays are padded so both mesh axes
-    divide their dims, reshaped to (C, V, ...), and dispatched. Padding
+    ``arrays``/``host_ok`` come from ops.verify.pack_inputs with trailing
+    batch dim n_commits * n_sigs; arrays are padded so both mesh axes
+    divide their dims, reshaped to (..., C, V), and dispatched. Padding
     lanes are sliced off the result. ``host_ok`` must be ANDed in: a lane
     the host rejected (malformed length, non-canonical S) is zeroed in
     ``arrays`` and the all-zero encoding decompresses to a small-order
@@ -103,8 +106,8 @@ def verify_sharded(
 
     shaped = {}
     for k, v in arrays.items():
-        v = v.reshape(n_commits, n_sigs, *v.shape[1:])
-        pad = [(0, cp - n_commits), (0, vp - n_sigs)] + [(0, 0)] * (v.ndim - 2)
+        v = v.reshape(*v.shape[:-1], n_commits, n_sigs)
+        pad = [(0, 0)] * (v.ndim - 2) + [(0, cp - n_commits), (0, vp - n_sigs)]
         shaped[k] = np.pad(v, pad)
     # pjit with in_shardings requires positional args.
     ok, _ = _sharded_verify(mesh)(
@@ -112,8 +115,8 @@ def verify_sharded(
         shaped["sign_a"],
         shaped["y_r"],
         shaped["sign_r"],
-        shaped["s_bits"],
-        shaped["kneg_bits"],
+        shaped["s_nibs"],
+        shaped["kneg_nibs"],
     )
     device_ok = np.asarray(ok)[:n_commits, :n_sigs]
     return device_ok & np.asarray(host_ok, bool).reshape(n_commits, n_sigs)

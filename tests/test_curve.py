@@ -19,6 +19,11 @@ def from_dev_point(arr):
     return tuple(field.from_limbs(row) % ref.P for row in np.asarray(arr))
 
 
+def batch_points(pts):
+    """List of oracle points -> (4, 20, N) device batch."""
+    return np.stack([to_dev_point(p) for p in pts], axis=-1)
+
+
 def rand_point():
     k = rng.randrange(ref.L)
     return ref.scalar_mult(k, ref.BASE)
@@ -26,13 +31,13 @@ def rand_point():
 
 def test_point_add_double_vs_ref():
     pts = [rand_point() for _ in range(8)] + [ref.IDENTITY, ref.BASE]
-    a = np.stack([to_dev_point(p) for p in pts])
-    b = np.stack([to_dev_point(p) for p in reversed(pts)])
+    a = batch_points(pts)
+    b = batch_points(list(reversed(pts)))
     got_add = curve.point_add(a, b)
     got_dbl = curve.point_double(a)
     for i, (p, q) in enumerate(zip(pts, list(reversed(pts)))):
-        assert _proj_eq(ref.point_add(p, q), from_dev_point(got_add[i]))
-        assert _proj_eq(ref.point_double(p), from_dev_point(got_dbl[i]))
+        assert _proj_eq(ref.point_add(p, q), from_dev_point(got_add[..., i]))
+        assert _proj_eq(ref.point_double(p), from_dev_point(got_dbl[..., i]))
 
 
 def _proj_eq(p_ref, p_dev):
@@ -57,14 +62,14 @@ def test_decompress_vs_ref():
         y_limbs.append(field.to_limbs(v & ((1 << 255) - 1)))
         signs.append(v >> 255)
     pts, ok = curve.decompress(
-        np.stack(y_limbs), np.array(signs, np.int32)
+        np.stack(y_limbs, axis=-1), np.array(signs, np.int32)
     )
     ok = np.asarray(ok)
     for i, enc in enumerate(cases):
         expect = ref.decompress(enc)
         assert bool(ok[i]) == (expect is not None), f"case {i}"
         if expect is not None:
-            assert _proj_eq(expect, from_dev_point(pts[i])), f"case {i}"
+            assert _proj_eq(expect, from_dev_point(pts[..., i])), f"case {i}"
 
 
 def make_batch(n):

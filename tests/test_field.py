@@ -16,11 +16,12 @@ def rand_elems(n, bound=P):
 
 
 def limbs_of(values):
-    return np.stack([field.to_limbs(v) for v in values])
+    # batch axis TRAILS: (20, N)
+    return np.stack([field.to_limbs(v) for v in values], axis=-1)
 
 
 def back(arr):
-    return [field.from_limbs(row) % P for row in np.asarray(arr)]
+    return [field.from_limbs(col) % P for col in np.asarray(arr).T]
 
 
 def test_roundtrip():
@@ -67,7 +68,7 @@ def test_canonical_and_is_zero():
     la = limbs_of(vals)
     can = np.asarray(field.canonical(la))
     assert can.max() <= field.MASK
-    assert [field.from_limbs(r) for r in can] == [v % P for v in vals]
+    assert [field.from_limbs(c) for c in can.T] == [v % P for v in vals]
     zeros = np.asarray(field.is_zero(la))
     assert list(zeros) == [v % P == 0 for v in vals]
 
@@ -92,8 +93,71 @@ def test_pow_const():
 
 def test_extreme_lazy_limbs():
     """All-max lazy limbs (the worst mul input) stay correct and bounded."""
-    worst = np.full((4, field.NLIMB), 8799, np.int32)
+    worst = np.full((field.NLIMB, 4), 8799, np.int32)
     got = field.mul(worst, worst)
-    v = field.from_limbs(worst[0])
+    v = field.from_limbs(worst[:, 0])
     assert back(got) == [v * v % P] * 4
-    assert np.asarray(got).max() < 8800
+    assert np.asarray(got).max() <= 10015
+
+    # Loose-bound inputs (the worst add/sub outputs) must also be legal.
+    loose = np.full((field.NLIMB, 4), 10015, np.int32)
+    got = field.mul(loose, loose)
+    v = field.from_limbs(loose[:, 0])
+    assert back(got) == [v * v % P] * 4
+    assert np.asarray(got).max() <= 10015
+
+
+def test_lazy_bound_discipline():
+    """Interval proof of the lazy-limb invariant (ops/field.py docstring).
+
+    Invariant: every op accepts operands with limbs <= LOOSE = 10015 and
+    returns limbs <= LOOSE, with every int32 intermediate in range. This
+    closes the loop over arbitrary compositions of add/sub/neg/dbl2/mul.
+    """
+    LOOSE = 10015
+    INT32 = 2**31 - 1
+    B = field.BITS
+    F = field.FOLD
+    M = field.MASK
+
+    def one_pass(b0, bi):
+        # parallel carry: limb0 worst = lo + (limb19 carry)*FOLD,
+        # limbs>0 worst = lo + carry of the biggest neighbor.
+        return (
+            M + (bi >> B) * F,
+            M + (max(b0, bi) >> B),
+        )
+
+    # add: both inputs loose, one pass
+    b0, bi = one_pass(2 * LOOSE, 2 * LOOSE)
+    assert 2 * LOOSE <= INT32 and max(b0, bi) <= LOOSE
+    # sub/neg: loose input + bias (max limb 16382), one pass
+    raw = LOOSE + 16382
+    b0, bi = one_pass(raw, raw)
+    assert max(b0, bi) <= LOOSE
+    # mul: per-column product counts — column i of the folded 20 gets
+    # (i+1) products, plus hi_lo*FOLD (i <= 18), plus hi_hi*FOLD where
+    # hi_hi comes from column 19+i which has (20-i) products (i >= 1).
+    prod = LOOSE * LOOSE
+    worst_col = 0
+    for i in range(field.NLIMB):
+        col = (i + 1) * prod
+        if i <= 18:
+            col += M * F
+        if i >= 1:
+            col += (((20 - i) * prod) >> B) * F
+        assert col <= INT32, f"fold column {i} overflows"
+        worst_col = max(worst_col, col)
+    # three passes bring the folded columns under the loose bound
+    b0 = bi = worst_col
+    for _ in range(3):
+        b0, bi = one_pass(b0, bi)
+        assert max(b0, bi) <= INT32
+    assert max(b0, bi) <= LOOSE
+
+
+def test_pow_2_252_m3():
+    vals = rand_elems(6) + [0, 1, P - 1]
+    la = limbs_of(vals)
+    e = 2**252 - 3
+    assert back(field.pow_2_252_m3(la)) == [pow(v, e, P) for v in vals]
