@@ -34,6 +34,10 @@ class HeightVoteSet:
         self._round = 0
         self._round_vote_sets: dict[int, tuple[VoteSet, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}
+        # Shared across every VoteSet of this height: the consensus receive
+        # loop batch-preverifies drained vote signatures into this memo so
+        # per-vote admission skips the per-signature check (SURVEY §7(d)).
+        self.sig_memo: dict = {}
         self._add_round(0)
 
     def _add_round(self, round_: int) -> None:
@@ -42,11 +46,13 @@ class HeightVoteSet:
         prevotes = VoteSet(
             self.chain_id, self.height, round_,
             canonical.PREVOTE_TYPE, self.val_set,
+            sig_memo=self.sig_memo,
         )
         precommits = VoteSet(
             self.chain_id, self.height, round_,
             canonical.PRECOMMIT_TYPE, self.val_set,
             extensions_enabled=self.extensions_enabled,
+            sig_memo=self.sig_memo,
         )
         self._round_vote_sets[round_] = (prevotes, precommits)
 

@@ -19,6 +19,7 @@ import threading
 import time
 
 from ..config import ConsensusConfig
+from ..crypto import batch as crypto_batch
 from ..libs.events import EventSwitch
 from ..libs.service import BaseService
 from ..types import BlockID, PartSet, canonical
@@ -156,6 +157,7 @@ class ConsensusState(BaseService):
 
         # merged inbox: ("peer"|"internal"|"timeout", payload)
         self._queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._preverify_warned = False
         self.ticker = TimeoutTicker()
         self._n_started = 0
         self.replay_mode = False
@@ -268,33 +270,127 @@ class ConsensusState(BaseService):
     # the single-writer loop
     # ------------------------------------------------------------------
 
+    # Max items drained per micro-batch window. Bounds the per-launch batch
+    # and keeps timeouts responsive; 1024 covers a full prevote round of a
+    # 1000-validator set arriving at once.
+    _DRAIN_WINDOW = 1024
+
     def _receive_routine(self) -> None:
         while True:
-            kind, payload = self._queue.get()
-            if kind == "quit":
-                return
+            items = [self._queue.get()]
+            # Micro-batch window (SURVEY §7(d)): drain whatever is ALREADY
+            # queued — no waiting, so rounds never stall — and preverify all
+            # drained vote signatures in one batched launch. Items are then
+            # processed strictly in arrival order through the unchanged
+            # per-vote state machine, which hits the signature memo instead
+            # of verifying one-by-one.
             try:
-                if kind == "peer":
-                    self.wal.write(payload)
-                    with self._mtx:
-                        self._handle_msg(payload)
-                elif kind == "internal":
-                    self.wal.write_sync(payload)
-                    with self._mtx:
-                        self._handle_msg(payload)
-                elif kind == "timeout":
-                    self.wal.write(payload)
-                    with self._mtx:
-                        self._handle_timeout(payload)
-                elif kind == "txs_available":
-                    with self._mtx:
-                        self._handle_txs_available()
+                while len(items) < self._DRAIN_WINDOW:
+                    items.append(self._queue.get_nowait())
+            except queue.Empty:
+                pass
+            memo = None
+            try:
+                memo = self._preverify_queued_votes(items)
             except Exception:
-                if self.replay_mode:
-                    raise
-                import traceback
+                # Preverification is an optimization only — votes fall back
+                # to per-signature host verification — but a persistent
+                # failure here erases the batching win, so surface it once.
+                if not self._preverify_warned:
+                    self._preverify_warned = True
+                    import traceback
 
-                traceback.print_exc()
+                    traceback.print_exc()
+            try:
+                for kind, payload in items:
+                    if kind == "quit":
+                        return
+                    try:
+                        if kind == "peer":
+                            self.wal.write(payload)
+                            with self._mtx:
+                                self._handle_msg(payload)
+                        elif kind == "internal":
+                            self.wal.write_sync(payload)
+                            with self._mtx:
+                                self._handle_msg(payload)
+                        elif kind == "timeout":
+                            self.wal.write(payload)
+                            with self._mtx:
+                                self._handle_timeout(payload)
+                        elif kind == "txs_available":
+                            with self._mtx:
+                                self._handle_txs_available()
+                    except Exception:
+                        if self.replay_mode:
+                            raise
+                        import traceback
+
+                        traceback.print_exc()
+            finally:
+                if memo:
+                    # Memo entries are scoped to THIS drain window: votes
+                    # dropped before reaching signature verification (bad
+                    # rounds, failed pre-checks) must not let peer-
+                    # controlled entries accumulate for the height.
+                    memo.clear()
+
+    def _preverify_queued_votes(self, items) -> dict | None:
+        """One batched signature launch for all drained current-height votes.
+
+        Results land in the HeightVoteSet's signature memo keyed by the
+        exact (pubkey, sign bytes, signature) triple; admission later pops
+        them. Mirrors vote_set.go:216-231's per-vote verify with the
+        device-batched layout of SURVEY §7(d). Never changes consensus
+        state — a memo miss just falls back to the per-vote host verify.
+        """
+        votes: list[Vote] = []
+        for kind, payload in items:
+            if kind == "peer" and isinstance(payload.msg, VoteMessage):
+                votes.append(payload.msg.vote)
+        if len(votes) < 2:
+            return None
+        with self._mtx:
+            rs = self.rs
+            height = rs.height
+            val_set = rs.validators
+            memo = rs.votes.sig_memo
+            chain_id = self.state.chain_id
+        triples: list[tuple] = []
+        for vote in votes:
+            if vote.height != height:
+                continue
+            val = val_set.get_by_index(vote.validator_index)
+            if val is None:
+                continue
+            triples.append(
+                (val.pub_key, vote.sign_bytes(chain_id), vote.signature)
+            )
+            if (
+                rs.votes.extensions_enabled
+                and vote.msg_type == canonical.PRECOMMIT_TYPE
+                and not vote.block_id.is_nil()
+                and vote.extension_signature
+            ):
+                triples.append(
+                    (
+                        val.pub_key,
+                        vote.extension_sign_bytes(chain_id),
+                        vote.extension_signature,
+                    )
+                )
+        if len(triples) < 2:
+            return None
+        try:
+            verifier = crypto_batch.create_batch_verifier(triples[0][0])
+        except ValueError:
+            return None  # key type without a batch backend
+        for pub_key, sign_bytes, sig in triples:
+            verifier.add(pub_key, sign_bytes, sig)
+        _, bits = verifier.verify()
+        for (pub_key, sign_bytes, sig), ok in zip(triples, bits):
+            memo[(pub_key.bytes(), sign_bytes, sig)] = bool(ok)
+        return memo
 
     def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id

@@ -76,11 +76,18 @@ class VoteSet:
         signed_msg_type: int,
         val_set: ValidatorSet,
         extensions_enabled: bool = False,
+        sig_memo: dict | None = None,
     ):
         if height == 0:
             raise VoteSetError("cannot make VoteSet for height 0")
         if extensions_enabled and signed_msg_type != canonical.PRECOMMIT_TYPE:
             raise VoteSetError("extensions require precommit vote set")
+        # Optional shared memo of batch-preverified signatures:
+        # (pubkey bytes, sign bytes, signature) -> bool. Filled by the
+        # consensus receive loop's micro-batch launch so per-vote admission
+        # skips the signature check (SURVEY §7(d)); entries are popped on
+        # use to bound memory.
+        self.sig_memo = sig_memo
         self.chain_id = chain_id
         self.height = height
         self.round = round_
@@ -273,9 +280,48 @@ class VoteSet:
                 raise ConflictingVoteError(existing=existing, new=vote)
 
     def _verify_vote_signature(self, vote: Vote, pub_key) -> None:
+        if self.sig_memo is None:
+            # No memo: the reference per-vote path, untouched.
+            if self._needs_extension(vote):
+                vote.verify_vote_and_extension(self.chain_id, pub_key)
+            else:
+                vote.verify(self.chain_id, pub_key)
+            return
+        # The memo only certifies SIGNATURES; the address binding is not
+        # part of the sign bytes and must be enforced here exactly like
+        # vote.verify (types/vote.go:210-232) — a memo hit must never admit
+        # an address-spoofed relay of a validly signed vote.
+        if bytes(pub_key.address()) != vote.validator_address:
+            raise VoteError("invalid validator address")
+        ok = self.sig_memo.pop(
+            (pub_key.bytes(), vote.sign_bytes(self.chain_id), vote.signature),
+            None,
+        )
+        if ok is False:
+            raise VoteError(
+                f"invalid signature from validator "
+                f"{vote.validator_address.hex()}"
+            )
         if self._needs_extension(vote):
+            ext_ok = self.sig_memo.pop(
+                (
+                    pub_key.bytes(),
+                    vote.extension_sign_bytes(self.chain_id),
+                    vote.extension_signature,
+                ),
+                None,
+            )
+            if ext_ok is False:
+                raise VoteError(
+                    f"invalid extension signature from validator "
+                    f"{vote.validator_address.hex()}"
+                )
+            if ok and ext_ok:
+                return
             vote.verify_vote_and_extension(self.chain_id, pub_key)
         else:
+            if ok:
+                return
             vote.verify(self.chain_id, pub_key)
 
     def _admit(self, vote: Vote, val) -> bool:

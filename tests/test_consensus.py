@@ -420,3 +420,220 @@ def test_reconstruct_last_commit_uses_extended_commit():
         ]
     finally:
         stop_node(cs, parts)
+
+
+import helpers
+
+
+class TestBatchedVoteIngest:
+    """SURVEY §7(d): live vote floods verify in one batched launch.
+
+    The receive loop drains queued votes, preverifies signatures in a single
+    batch (device or fast host path by size), and admission pops the memo —
+    the pure-Python reference verifier must never run on the hot path.
+    """
+
+    def test_vote_flood_100_validators_batched(self, tmp_path):
+        import time as _time
+
+        from cometbft_tpu.crypto import ed25519_ref, fast25519
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.vote import Vote
+
+        genesis, pvs = helpers.make_genesis(100)
+        cs, parts = helpers.make_consensus_node(genesis, pvs[0])
+
+        # Count pure-Python oracle calls (must stay zero) and time spent in
+        # host signature verification.
+        ref_calls = 0
+        orig_ref_verify = ed25519_ref.verify
+
+        def counting_ref_verify(*a, **k):
+            nonlocal ref_calls
+            ref_calls += 1
+            return orig_ref_verify(*a, **k)
+
+        verify_time = 0.0
+        orig_many = fast25519.verify_many
+
+        def timed_many(*a, **k):
+            nonlocal verify_time
+            t0 = _time.thread_time()  # CPU time: immune to 1-core GIL noise
+            out = orig_many(*a, **k)
+            verify_time += _time.thread_time() - t0
+            return out
+
+        ed25519_ref.verify = counting_ref_verify
+        fast25519.verify_many = timed_many
+        try:
+            cs.start()
+            deadline = _time.time() + 10
+            while cs.rs.height != 1 and _time.time() < deadline:
+                _time.sleep(0.01)
+
+            block_id = BlockID(
+                hash=b"\x11" * 32,
+                part_set_header=PartSetHeader(total=1, hash=b"\x22" * 32),
+            )
+            vs = genesis.validator_set()
+            t0 = _time.perf_counter()
+            for idx in range(1, 100):  # node itself is validator 0
+                vote = Vote(
+                    msg_type=canonical.PREVOTE_TYPE,
+                    height=1,
+                    round=0,
+                    block_id=block_id,
+                    timestamp_ns=1_700_000_000_000_000_000 + idx,
+                    validator_address=vs.validators[idx].address,
+                    validator_index=idx,
+                )
+                pvs[idx].sign_vote(genesis.chain_id, vote, sign_extension=False)
+                cs.add_vote_from_peer(vote, f"peer{idx}")
+            while _time.time() < deadline:
+                with cs._mtx:
+                    if (
+                        cs.rs.height != 1
+                        or cs.rs.votes.prevotes(0).size() == 0
+                        or sum(
+                            1
+                            for i in range(100)
+                            if cs.rs.votes.prevotes(0).get_by_index(i)
+                        )
+                        >= 99
+                    ):
+                        break
+                _time.sleep(0.005)
+            ingest = _time.perf_counter() - t0
+        finally:
+            ed25519_ref.verify = orig_ref_verify
+            fast25519.verify_many = orig_many
+            helpers.stop_node(cs, parts)
+
+        assert ref_calls == 0, (
+            f"pure-Python verify ran {ref_calls}x on the hot path"
+        )
+        assert verify_time < 0.050, (
+            f"signature verification took {verify_time*1000:.1f} ms"
+        )
+        assert ingest < 2.0, f"99-vote ingest took {ingest:.2f}s"
+
+    def test_sig_memo_hit_and_poison(self):
+        """Memo True skips verification; memo False rejects; entries pop."""
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.vote import Vote, VoteError
+        from cometbft_tpu.types.vote_set import VoteSet
+
+        genesis, pvs = helpers.make_genesis(4)
+        vs = genesis.validator_set()
+        memo = {}
+        voteset = VoteSet(
+            genesis.chain_id, 1, 0, canonical.PREVOTE_TYPE, vs, sig_memo=memo
+        )
+        block_id = BlockID(
+            hash=b"\x01" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32),
+        )
+
+        def mk(idx):
+            v = Vote(
+                msg_type=canonical.PREVOTE_TYPE,
+                height=1,
+                round=0,
+                block_id=block_id,
+                timestamp_ns=1_700_000_000_000_000_001 + idx,
+                validator_address=vs.validators[idx].address,
+                validator_index=idx,
+            )
+            pvs[idx].sign_vote(genesis.chain_id, v, sign_extension=False)
+            return v
+
+        # valid vote, poisoned memo entry -> rejected without re-verify
+        v0 = mk(0)
+        key = (
+            vs.validators[0].pub_key.bytes(),
+            v0.sign_bytes(genesis.chain_id),
+            v0.signature,
+        )
+        memo[key] = False
+        with pytest.raises(VoteError):
+            voteset.add_vote(v0)
+        assert key not in memo  # popped
+
+        # memo True admits even a forged signature (proves the memo is used)
+        v1 = mk(1)
+        import dataclasses
+
+        forged = dataclasses.replace(v1, signature=b"\x99" * 64)
+        fkey = (
+            vs.validators[1].pub_key.bytes(),
+            forged.sign_bytes(genesis.chain_id),
+            forged.signature,
+        )
+        memo[fkey] = True
+        assert voteset.add_vote(forged)
+        assert fkey not in memo
+
+        # no memo entry: normal verification still works
+        assert voteset.add_vote(mk(2))
+
+    def test_memo_hit_never_bypasses_address_check(self):
+        """A poisoned memo must not admit an address-spoofed vote.
+
+        Vote sign bytes do NOT cover validator_address, so the memo can
+        only certify signatures; the address binding is enforced twice —
+        _check_vote's address/index match (vote_set.go:177-231) and the
+        vote.verify-parity check on the memo path — and a memo True entry
+        must not bypass either.
+        """
+        import dataclasses
+
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.vote import Vote, VoteError
+        from cometbft_tpu.types.vote_set import VoteSet, VoteSetError
+
+        genesis, pvs = helpers.make_genesis(4)
+        vs = genesis.validator_set()
+        memo = {}
+        voteset = VoteSet(
+            genesis.chain_id, 1, 0, canonical.PREVOTE_TYPE, vs, sig_memo=memo
+        )
+        block_id = BlockID(
+            hash=b"\x01" * 32,
+            part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32),
+        )
+        v = Vote(
+            msg_type=canonical.PREVOTE_TYPE,
+            height=1,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=1_700_000_000_000_000_009,
+            validator_address=vs.validators[1].address,
+            validator_index=1,
+        )
+        pvs[1].sign_vote(genesis.chain_id, v, sign_extension=False)
+        # address rewritten to validator 2, index left at 1: admission must
+        # reject on the address/index binding even with a memo-True entry
+        spoofed = dataclasses.replace(
+            v, validator_address=bytes(vs.validators[2].address)
+        )
+        memo[(
+            vs.validators[1].pub_key.bytes(),
+            spoofed.sign_bytes(genesis.chain_id),
+            spoofed.signature,
+        )] = True
+        with pytest.raises(VoteSetError, match="address"):
+            voteset.add_vote(spoofed)
+        # defense in depth: the memo-path verifier itself also enforces the
+        # vote.verify address binding (types/vote.go:210-232)
+        memo[(
+            vs.validators[1].pub_key.bytes(),
+            spoofed.sign_bytes(genesis.chain_id),
+            spoofed.signature,
+        )] = True
+        with pytest.raises(VoteError, match="address"):
+            voteset._verify_vote_signature(
+                spoofed, vs.validators[1].pub_key
+            )
