@@ -90,50 +90,6 @@ class HeightVoteSet:
                     )
             return vs.add_vote(vote)
 
-    def add_votes_batch(
-        self, votes: list[Vote], peer_id: str = ""
-    ) -> tuple[list[bool], list[Exception | None]]:
-        """Batched ingest: groups by (round, type) and feeds VoteSet's
-        batched verifier — the TPU path for vote floods. Unknown rounds
-        are bounded per peer exactly like ``add_vote``. Returns per-vote
-        (added, error) so equivocation (ConflictingVoteError) and bad
-        signatures surface to the caller just like single ``add_vote``."""
-        with self._mtx:
-            groups: dict[tuple[int, int], list[Vote]] = {}
-            results: dict[int, bool] = {}
-            errs: dict[int, Exception | None] = {}
-            for v in votes:
-                if not canonical.is_vote_type(v.msg_type):
-                    raise ValueError(f"not a vote type: {v.msg_type}")
-                groups.setdefault((v.round, v.msg_type), []).append(v)
-            for (round_, msg_type), group in groups.items():
-                vs = self._get_locked(round_, msg_type)
-                if vs is None:
-                    rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
-                    if len(rounds) >= MAX_CATCHUP_ROUNDS:
-                        # Don't raise mid-batch: other groups may already be
-                        # admitted, and their (added, error) results must
-                        # still reach the caller. Record the round-bound
-                        # violation as this group's per-vote error.
-                        err = GotVoteFromUnwantedRoundError(
-                            f"peer {peer_id} round {round_}"
-                        )
-                        for v in group:
-                            results[id(v)] = False
-                            errs[id(v)] = err
-                        continue
-                    self._add_round(round_)
-                    rounds.append(round_)
-                    vs = self._get_locked(round_, msg_type)
-                oks, group_errs = vs.add_votes_batch(group)
-                for v, ok, err in zip(group, oks, group_errs):
-                    results[id(v)] = ok
-                    errs[id(v)] = err
-            return (
-                [results[id(v)] for v in votes],
-                [errs[id(v)] for v in votes],
-            )
-
     # -- queries -----------------------------------------------------------
 
     def _get_locked(self, round_: int, msg_type: int) -> VoteSet | None:

@@ -157,7 +157,7 @@ class ConsensusState(BaseService):
 
         # merged inbox: ("peer"|"internal"|"timeout", payload)
         self._queue: queue.Queue = queue.Queue(maxsize=1000)
-        self._preverify_warned = False
+        self._preverify_warned_types: set[str] = set()
         self.ticker = TimeoutTicker()
         self._n_started = 0
         self.replay_mode = False
@@ -295,9 +295,14 @@ class ConsensusState(BaseService):
             except Exception:
                 # Preverification is an optimization only — votes fall back
                 # to per-signature host verification — but a persistent
-                # failure here erases the batching win, so surface it once.
-                if not self._preverify_warned:
-                    self._preverify_warned = True
+                # failure here erases the batching win, so surface it once
+                # per distinct failure type (a one-shot flag would let a
+                # transient relay hiccup permanently mask a later bug).
+                import sys as _sys
+
+                tname = type(_sys.exc_info()[1]).__name__
+                if tname not in self._preverify_warned_types:
+                    self._preverify_warned_types.add(tname)
                     import traceback
 
                     traceback.print_exc()

@@ -1,0 +1,53 @@
+"""Light client: trust-period verification over batched commit checks.
+
+Reference: /root/reference/light/ (client.go, verifier.go, detector.go,
+store/, provider/).
+"""
+
+from .client import Client, TrustOptions
+from .detector import detect_divergence
+from .errors import (
+    BadLightBlockError,
+    ConflictingHeadersError,
+    InvalidHeaderError,
+    LightBlockNotFoundError,
+    LightClientError,
+    NewValSetCantBeTrustedError,
+    NoWitnessesError,
+    OldHeaderExpiredError,
+    VerificationFailedError,
+)
+from .provider import Provider, StoreBackedProvider
+from .store import Store
+from .verifier import (
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "Client",
+    "TrustOptions",
+    "detect_divergence",
+    "Provider",
+    "StoreBackedProvider",
+    "Store",
+    "header_expired",
+    "validate_trust_level",
+    "verify",
+    "verify_adjacent",
+    "verify_backwards",
+    "verify_non_adjacent",
+    "BadLightBlockError",
+    "ConflictingHeadersError",
+    "InvalidHeaderError",
+    "LightBlockNotFoundError",
+    "LightClientError",
+    "NewValSetCantBeTrustedError",
+    "NoWitnessesError",
+    "OldHeaderExpiredError",
+    "VerificationFailedError",
+]

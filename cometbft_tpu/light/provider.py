@@ -1,0 +1,80 @@
+"""Light block providers (reference: light/provider/provider.go).
+
+A provider serves LightBlocks for a chain. The reference ships an
+RPC-backed provider (light/provider/http); here the first-class citizens
+are:
+
+* ``StoreBackedProvider`` — reads a full node's block/state stores
+  in-process (test fixtures, statesync's local path);
+* the RPC client provider lives with the RPC layer (rpc/) once a node
+  exposes HTTP, keeping this module transport-free.
+"""
+
+from __future__ import annotations
+
+from ..types.block import BLOCK_ID_FLAG_ABSENT
+from ..types.light_block import LightBlock, SignedHeader
+from .errors import BadLightBlockError, LightBlockNotFoundError
+
+
+class Provider:
+    """Provider interface (provider.go:9-32)."""
+
+    def chain_id(self) -> str:
+        raise NotImplementedError
+
+    def light_block(self, height: int) -> LightBlock:
+        """Return the light block at ``height`` (0 = latest). Raises
+        LightBlockNotFoundError when unavailable."""
+        raise NotImplementedError
+
+    def report_evidence(self, ev) -> None:  # pragma: no cover - optional
+        pass
+
+
+class StoreBackedProvider(Provider):
+    """Serve light blocks straight from a node's stores.
+
+    Mirrors what the reference's local RPC provider returns: the signed
+    header from the block store (header + its commit from height+1's
+    LastCommit, i.e. the stored seen-commit) and the validator set from the
+    state store.
+    """
+
+    def __init__(self, block_store, state_store, chain_id: str):
+        self._bs = block_store
+        self._ss = state_store
+        self._chain_id = chain_id
+        self._evidence: list = []
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self._bs.height()
+        block = self._bs.load_block(height)
+        # The canonical commit for height lands with block height+1; at the
+        # tip fall back to the seen commit (rpc/core/blocks.go Commit).
+        commit = self._bs.load_block_commit(height)
+        if commit is None and height == self._bs.height():
+            commit = self._bs.load_seen_commit()
+            if commit is not None and commit.height != height:
+                commit = None
+        if block is None or commit is None:
+            raise LightBlockNotFoundError(height)
+        vals = self._ss.load_validators(height)
+        if vals is None:
+            raise LightBlockNotFoundError(height)
+        lb = LightBlock(
+            signed_header=SignedHeader(header=block.header, commit=commit),
+            validator_set=vals,
+        )
+        try:
+            lb.validate_basic(self._chain_id)
+        except Exception as e:  # malformed data is a provider fault
+            raise BadLightBlockError(e) from e
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        self._evidence.append(ev)

@@ -1,0 +1,106 @@
+"""Persisted trusted light blocks (reference: light/store/db/db.go:328).
+
+Backed by the shared KV abstraction (libs/db): keys are
+``lb/<height:020d>`` so lexicographic iteration is height order; a size
+key tracks the pair count for O(1) Size().
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..libs import db as dbm
+from ..types import serialization as ser
+from ..types.light_block import LightBlock
+from .errors import LightBlockNotFoundError
+
+_PREFIX = b"lb/"
+_SIZE_KEY = b"lb_size"
+
+
+def _key(height: int) -> bytes:
+    return _PREFIX + b"%020d" % height
+
+
+class Store:
+    """Trusted light block store with the reference Store contract."""
+
+    def __init__(self, db: dbm.DB | None = None):
+        self._db = db if db is not None else dbm.MemDB()
+        self._mtx = threading.Lock()
+
+    # -- writes ------------------------------------------------------------
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        if lb.height <= 0:
+            raise ValueError("height must be positive")
+        with self._mtx:
+            existed = self._db.get(_key(lb.height)) is not None
+            self._db.set(_key(lb.height), ser.dumps(lb))
+            if not existed:
+                self._bump_size(+1)
+
+    def delete_light_block(self, height: int) -> None:
+        if height <= 0:
+            raise ValueError("height must be positive")
+        with self._mtx:
+            if self._db.get(_key(height)) is not None:
+                self._db.delete(_key(height))
+                self._bump_size(-1)
+
+    def prune(self, size: int) -> None:
+        """Delete oldest blocks until at most ``size`` remain
+        (light/store/db/db.go Prune)."""
+        with self._mtx:
+            excess = self._size() - size
+            if excess <= 0:
+                return
+            for k, _ in self._iter():
+                if excess == 0:
+                    break
+                self._db.delete(k)
+                self._bump_size(-1)
+                excess -= 1
+
+    # -- reads -------------------------------------------------------------
+
+    def light_block(self, height: int) -> LightBlock:
+        if height <= 0:
+            raise ValueError("height must be positive")
+        raw = self._db.get(_key(height))
+        if raw is None:
+            raise LightBlockNotFoundError(height)
+        return ser.loads(raw)
+
+    def last_light_block_height(self) -> int:
+        """-1 when empty (store.go:27-30)."""
+        for k, _ in self._db.reverse_iterator(_PREFIX, _PREFIX + b"\xff"):
+            return int(k[len(_PREFIX):])
+        return -1
+
+    def first_light_block_height(self) -> int:
+        for k, _ in self._iter():
+            return int(k[len(_PREFIX):])
+        return -1
+
+    def light_block_before(self, height: int) -> LightBlock:
+        """Latest stored block strictly below ``height``."""
+        for _, v in self._db.reverse_iterator(_PREFIX, _key(height)):
+            return ser.loads(v)
+        raise LightBlockNotFoundError(height)
+
+    def size(self) -> int:
+        with self._mtx:
+            return self._size()
+
+    # -- internals ---------------------------------------------------------
+
+    def _iter(self):
+        return self._db.iterator(_PREFIX, _PREFIX + b"\xff")
+
+    def _size(self) -> int:
+        raw = self._db.get(_SIZE_KEY)
+        return int(raw) if raw else 0
+
+    def _bump_size(self, delta: int) -> None:
+        self._db.set(_SIZE_KEY, b"%d" % (self._size() + delta))

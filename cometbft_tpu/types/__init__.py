@@ -43,3 +43,4 @@ from .validation import (  # noqa: F401
 from .validator_set import Validator, ValidatorSet  # noqa: F401
 from .vote import Proposal, Vote, VoteError  # noqa: F401
 from .vote_set import ConflictingVoteError, VoteSet  # noqa: F401
+from .light_block import LightBlock, SignedHeader  # noqa: E402,F401

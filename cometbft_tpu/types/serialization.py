@@ -33,6 +33,7 @@ from .params import (
     ValidatorParams,
     VersionParams,
 )
+from .light_block import LightBlock, SignedHeader
 from .part_set import Part
 from .validator_set import Validator, ValidatorSet
 from .vote import Proposal, Vote
@@ -64,6 +65,8 @@ codec.register(
     ConsensusParams,
     ev.DuplicateVoteEvidence,
     ev.LightClientAttackEvidence,
+    SignedHeader,
+    LightBlock,
 )
 
 codec.register_adapter(

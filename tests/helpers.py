@@ -274,3 +274,102 @@ def wait_for_height(parts_or_store, height: int, timeout: float = 30.0):
             return True
         _t.sleep(0.02)
     return False
+
+
+# -- light client chain fixture (reference analog: light/helpers_test.go
+# genLightBlocksWithKeys) --------------------------------------------------
+
+
+def make_light_chain(n_heights: int, n_vals: int = 4, rotate: int = 0,
+                     chain_id: str = CHAIN_ID, t0_ns: int | None = None,
+                     fork_at: int | None = None, fork_delta_ns: int = 0):
+    """Build a verifiable chain of LightBlocks with optional validator
+    rotation: at each height, ``rotate`` validators are replaced (new keys),
+    so non-adjacent trust overlap decays with distance — exercising the
+    bisection path. Keys are deterministic, so two calls produce identical
+    chains; ``fork_at``/``fork_delta_ns`` shift header times from that
+    height on, yielding a validly-signed FORK sharing the prefix (the
+    light-client-attack fixture). Returns dict[height, LightBlock].
+    """
+    from cometbft_tpu.types.block import Header, Version
+    from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    if t0_ns is None:
+        t0_ns = 1_700_000_000_000_000_000
+    seed_counter = [1000]
+
+    def new_pv():
+        seed_counter[0] += 1
+        return MockPV(
+            Ed25519PrivKey.from_seed(
+                seed_counter[0].to_bytes(2, "big") * 16
+            )
+        )
+
+    pvs = [new_pv() for _ in range(n_vals)]
+
+    def valset(pv_list):
+        return ValidatorSet(
+            [Validator(
+                address=bytes(pv.get_pub_key().address()),
+                pub_key=pv.get_pub_key(),
+                voting_power=10,
+            ) for pv in pv_list]
+        )
+
+    blocks: dict[int, LightBlock] = {}
+    pvs_at: dict[int, list] = {1: list(pvs)}
+    # Precompute validator sets: rotation applies from height 2 on.
+    for h in range(2, n_heights + 2):
+        prev = pvs_at[h - 1]
+        cur = list(prev)
+        for r in range(min(rotate, n_vals)):
+            cur[(h + r) % n_vals] = new_pv()
+        pvs_at[h] = cur
+
+    last_block_id = BlockID()
+    for h in range(1, n_heights + 1):
+        vs = valset(pvs_at[h])
+        next_vs = valset(pvs_at[h + 1])
+        time_ns = t0_ns + h * 1_000_000_000
+        if fork_at is not None and h >= fork_at:
+            time_ns += fork_delta_ns
+        header = Header(
+            version=Version(block=11, app=1),
+            chain_id=chain_id,
+            height=h,
+            time_ns=time_ns,
+            last_block_id=last_block_id,
+            last_commit_hash=b"\x01" * 32,
+            data_hash=b"\x02" * 32,
+            validators_hash=vs.hash(),
+            next_validators_hash=next_vs.hash(),
+            consensus_hash=b"\x03" * 32,
+            app_hash=b"\x04" * 32,
+            last_results_hash=b"\x05" * 32,
+            evidence_hash=b"\x06" * 32,
+            proposer_address=vs.validators[0].address,
+        )
+        from cometbft_tpu.types.block import PartSetHeader
+
+        block_id = BlockID(
+            hash=header.hash(),
+            part_set_header=PartSetHeader(total=1, hash=b"\x07" * 32),
+        )
+        ordered_pvs = _order_pvs(vs, pvs_at[h])
+        commit = sign_commit(
+            chain_id, vs, ordered_pvs, h, 0, block_id,
+            time_ns=time_ns,
+        )
+        blocks[h] = LightBlock(
+            signed_header=SignedHeader(header=header, commit=commit),
+            validator_set=vs,
+        )
+        last_block_id = block_id
+    return blocks
+
+
+def _order_pvs(vs, pv_list):
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pv_list}
+    return [by_addr[v.address] for v in vs.validators]
