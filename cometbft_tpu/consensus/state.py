@@ -292,17 +292,14 @@ class ConsensusState(BaseService):
             memo = None
             try:
                 memo = self._preverify_queued_votes(items)
-            except Exception:
+            except Exception as e:
                 # Preverification is an optimization only — votes fall back
                 # to per-signature host verification — but a persistent
                 # failure here erases the batching win, so surface it once
                 # per distinct failure type (a one-shot flag would let a
                 # transient relay hiccup permanently mask a later bug).
-                import sys as _sys
-
-                tname = type(_sys.exc_info()[1]).__name__
-                if tname not in self._preverify_warned_types:
-                    self._preverify_warned_types.add(tname)
+                if type(e).__name__ not in self._preverify_warned_types:
+                    self._preverify_warned_types.add(type(e).__name__)
                     import traceback
 
                     traceback.print_exc()

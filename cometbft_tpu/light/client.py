@@ -31,7 +31,6 @@ from .provider import Provider
 from .store import Store
 
 SECOND_NS = verifier.SECOND_NS
-HOUR_NS = 3600 * SECOND_NS
 
 # pivot = trusted + 9/10 * (target - trusted)  (client.go:46-52)
 _PIVOT_NUM = 9
@@ -272,6 +271,7 @@ class Client:
                 alt = self._block_from(w, sh.height)
             except Exception as e:
                 errors.append(e)
+                bad.append(i)
                 continue
             if alt.hash() != sh.hash():
                 raise ConflictingHeadersError(alt, i)

@@ -45,9 +45,15 @@ def detect_divergence(client, now_ns: int | None = None) -> list:
             continue
         if alt.hash() == target.hash():
             continue
-        ev = examine_conflicting_header_against_trace(
-            trace, alt, witness, now_ns, client
-        )
+        try:
+            ev = examine_conflicting_header_against_trace(
+                trace, alt, witness, now_ns, client
+            )
+        except LightClientError:
+            # witness can't even agree with the root of trust: faulty
+            # witness, drop it and keep scanning the others
+            bad_witnesses.append(i)
+            continue
         if ev is not None:
             evidence.append(ev)
             # report against the primary to every witness + the primary

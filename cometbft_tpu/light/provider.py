@@ -12,7 +12,6 @@ are:
 
 from __future__ import annotations
 
-from ..types.block import BLOCK_ID_FLAG_ABSENT
 from ..types.light_block import LightBlock, SignedHeader
 from .errors import BadLightBlockError, LightBlockNotFoundError
 

@@ -1,0 +1,66 @@
+"""RPC-backed light block provider (reference: light/provider/http).
+
+Fetches /commit + /validators from a full node's RPC and reconstructs the
+typed LightBlock. Paginates the validator set so 10k-validator chains
+(the BASELINE light-replay scale) work within the per_page cap.
+"""
+
+from __future__ import annotations
+
+from ..rpc import decoding as dec
+from ..rpc.client import HTTPClient, RPCError
+from ..types.light_block import LightBlock, SignedHeader
+from .errors import BadLightBlockError, LightBlockNotFoundError
+from .provider import Provider
+
+
+class RPCProvider(Provider):
+    def __init__(self, address: str, chain_id: str, timeout: float = 10.0):
+        self._client = HTTPClient(address, timeout=timeout)
+        self._chain_id = chain_id
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int) -> LightBlock:
+        params = {} if height == 0 else {"height": str(height)}
+        try:
+            commit_res = self._client.call("commit", **params)
+        except RPCError as e:
+            raise LightBlockNotFoundError(height) from e
+        sh_json = commit_res["signed_header"]
+        header = dec.dec_header(sh_json["header"])
+        commit = dec.dec_commit(sh_json["commit"])
+        vals = self._validators(header.height)
+        lb = LightBlock(
+            signed_header=SignedHeader(header=header, commit=commit),
+            validator_set=vals,
+        )
+        try:
+            lb.validate_basic(self._chain_id)
+        except Exception as e:
+            raise BadLightBlockError(e) from e
+        return lb
+
+    def _validators(self, height: int):
+        rows: list[dict] = []
+        page = 1
+        while True:
+            try:
+                res = self._client.call(
+                    "validators",
+                    height=str(height),
+                    page=str(page),
+                    per_page="100",
+                )
+            except RPCError as e:
+                raise LightBlockNotFoundError(height) from e
+            rows.extend(res["validators"])
+            if len(rows) >= int(res["total"]) or not res["validators"]:
+                break
+            page += 1
+        return dec.dec_validator_set(rows)
+
+    def report_evidence(self, ev) -> None:
+        # evidence submission lands with the broadcast_evidence route
+        pass

@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 
 from ..libs import db as dbm
+from ..libs.db import prefix_end
 from ..types import serialization as ser
 from ..types.light_block import LightBlock
 from .errors import LightBlockNotFoundError
@@ -74,7 +75,7 @@ class Store:
 
     def last_light_block_height(self) -> int:
         """-1 when empty (store.go:27-30)."""
-        for k, _ in self._db.reverse_iterator(_PREFIX, _PREFIX + b"\xff"):
+        for k, _ in self._db.reverse_iterator(_PREFIX, prefix_end(_PREFIX)):
             return int(k[len(_PREFIX):])
         return -1
 
@@ -96,7 +97,7 @@ class Store:
     # -- internals ---------------------------------------------------------
 
     def _iter(self):
-        return self._db.iterator(_PREFIX, _PREFIX + b"\xff")
+        return self._db.iterator(_PREFIX, prefix_end(_PREFIX))
 
     def _size(self) -> int:
         raw = self._db.get(_SIZE_KEY)

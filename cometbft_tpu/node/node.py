@@ -231,6 +231,34 @@ class Node(BaseService):
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.node_info.channels = self.switch.channel_ids()
 
+        # 10. RPC environment + server (node.go:536 startRPC)
+        from ..rpc import Environment, RPCServer
+
+        self.rpc_env = Environment(
+            block_store=self.block_store,
+            state_store=self.state_store,
+            consensus=self.consensus,
+            consensus_reactor=self.consensus_reactor,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            switch=self.switch,
+            proxy_app_query=self.proxy_app.query,
+            event_bus=self.event_bus,
+            genesis=genesis,
+            node_info=self.node_info,
+            priv_validator_pub_key=(
+                priv_validator.get_pub_key()
+                if priv_validator is not None
+                else None
+            ),
+            config=config,
+        )
+        self.rpc_server = (
+            RPCServer(self.rpc_env, config.rpc.laddr)
+            if config.rpc.laddr
+            else None
+        )
+
     def _on_app_error(self, err: Exception) -> None:
         # Fail-stop: the app is the source of truth (multi_app_conn.go:129).
         if self.is_running():
@@ -242,8 +270,10 @@ class Node(BaseService):
     # -- lifecycle (node.go:364 OnStart) -----------------------------------
 
     def on_start(self) -> None:
-        # boot order (node.go:364): transport listen → switch (starts
+        # boot order (node.go:364): RPC → transport listen → switch (starts
         # reactors, which start consensus) → dial persistent peers
+        if self.rpc_server is not None:
+            self.rpc_server.start()
         self.transport.listen(self.config.p2p.laddr)
         self.node_info.listen_addr = self.transport.listen_addr
         self.switch.start()
@@ -269,6 +299,11 @@ class Node(BaseService):
                 self.consensus.handle_txs_available()
 
     def on_stop(self) -> None:
+        if self.rpc_server is not None and self.rpc_server.is_running():
+            try:
+                self.rpc_server.stop()
+            except Exception:
+                pass
         for svc in (self.switch, self.event_bus, self.proxy_app):
             try:
                 if svc.is_running():

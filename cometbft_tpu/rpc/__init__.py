@@ -1,0 +1,19 @@
+"""RPC layer: JSON-RPC 2.0 over HTTP + WebSocket subscriptions.
+
+Reference: /root/reference/rpc/ (jsonrpc server, ~40 core routes, http and
+local clients).
+"""
+
+from .client import HTTPClient, LocalClient
+from .core.env import Environment
+from .core.routes import ROUTES, RPCError
+from .jsonrpc.server import RPCServer
+
+__all__ = [
+    "Environment",
+    "HTTPClient",
+    "LocalClient",
+    "ROUTES",
+    "RPCError",
+    "RPCServer",
+]

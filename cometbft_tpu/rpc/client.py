@@ -1,0 +1,75 @@
+"""RPC clients (reference: rpc/client/http/http.go, rpc/client/local).
+
+``HTTPClient``  — JSON-RPC 2.0 over HTTP POST (stdlib urllib; zero deps).
+``LocalClient`` — direct in-process dispatch against an Environment
+                  (rpc/client/local semantics: no network, same handlers).
+
+Both expose ``call(method, **params)`` plus pythonic helpers for the
+common routes; results are the JSON dicts the server returns.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import urllib.request
+
+from .core.routes import ROUTES, RPCError
+
+
+class HTTPClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        if base_url.startswith("tcp://"):
+            base_url = "http://" + base_url[len("tcp://"):]
+        if not base_url.startswith("http"):
+            base_url = "http://" + base_url
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._ids = itertools.count(1)
+
+    def call(self, method: str, **params):
+        payload = {
+            "jsonrpc": "2.0",
+            "id": next(self._ids),
+            "method": method,
+            "params": params,
+        }
+        req = urllib.request.Request(
+            self.base_url + "/",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = json.loads(resp.read())
+        if "error" in body:
+            err = body["error"]
+            raise RPCError(
+                err.get("message", "rpc error"),
+                code=err.get("code", -32603),
+                data=err.get("data", ""),
+            )
+        return body["result"]
+
+    def __getattr__(self, name: str):
+        if name in ROUTES:
+            return lambda **params: self.call(name, **params)
+        raise AttributeError(name)
+
+
+class LocalClient:
+    """In-process client over the same route handlers (rpc/client/local)."""
+
+    def __init__(self, env):
+        self.env = env
+
+    def call(self, method: str, **params):
+        fn = ROUTES.get(method)
+        if fn is None:
+            raise RPCError(f"method {method!r} not found", code=-32601)
+        return fn(self.env, **params)
+
+    def __getattr__(self, name: str):
+        if name in ROUTES:
+            return lambda **params: self.call(name, **params)
+        raise AttributeError(name)

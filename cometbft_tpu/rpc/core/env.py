@@ -1,0 +1,39 @@
+"""RPC Environment: handles to every service the routes read.
+
+Reference: rpc/core/env.go:199 — one struct threaded to all handlers
+instead of globals.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Environment:
+    # storage
+    block_store: object = None
+    state_store: object = None
+    # services
+    consensus: object = None  # ConsensusState
+    consensus_reactor: object = None
+    mempool: object = None
+    evidence_pool: object = None
+    switch: object = None  # p2p switch (peers, listeners)
+    proxy_app_query: object = None  # ABCI query connection
+    event_bus: object = None
+    tx_indexer: object = None
+    block_indexer: object = None
+    # static info
+    genesis: object = None
+    node_info: object = None
+    priv_validator_pub_key: object = None
+    config: object = None
+    # extra route tables merged in by the node (e.g. statesync)
+    extra: dict = field(default_factory=dict)
+
+    def latest_height(self) -> int:
+        return self.block_store.height() if self.block_store else 0
+
+    def chain_id(self) -> str:
+        return self.genesis.chain_id if self.genesis else ""

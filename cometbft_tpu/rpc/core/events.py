@@ -1,0 +1,66 @@
+"""Event payload encoding for RPC subscriptions.
+
+Maps event-bus dataclasses to the reference's tagged JSON envelope
+(types/events.go TMEventData registrations): {"type": "tendermint/event/X",
+"value": {...}}.
+"""
+
+from __future__ import annotations
+
+from ...types import event_bus as eb
+from .. import encoding as enc
+
+
+def encode_event_data(data) -> dict:
+    if isinstance(data, eb.EventDataNewBlock):
+        return {
+            "type": "tendermint/event/NewBlock",
+            "value": {
+                "block": enc.enc_block(data.block),
+                "block_id": enc.enc_block_id(data.block_id)
+                if getattr(data, "block_id", None)
+                else None,
+            },
+        }
+    if isinstance(data, eb.EventDataNewBlockHeader):
+        return {
+            "type": "tendermint/event/NewBlockHeader",
+            "value": {"header": enc.enc_header(data.header)},
+        }
+    if isinstance(data, eb.EventDataTx):
+        return {
+            "type": "tendermint/event/Tx",
+            "value": {
+                "TxResult": {
+                    "height": str(data.height),
+                    "index": data.index,
+                    "tx": enc.b64(data.tx),
+                    "result": enc.enc_exec_tx_result(data.result),
+                }
+            },
+        }
+    if isinstance(data, eb.EventDataRoundState):
+        return {
+            "type": "tendermint/event/RoundState",
+            "value": {
+                "height": str(data.height),
+                "round": data.round,
+                "step": str(data.step),
+            },
+        }
+    if isinstance(data, eb.EventDataVote):
+        v = data.vote
+        return {
+            "type": "tendermint/event/Vote",
+            "value": {
+                "Vote": {
+                    "type": v.msg_type,
+                    "height": str(v.height),
+                    "round": v.round,
+                    "validator_address": enc.hex_bytes(v.validator_address),
+                    "validator_index": v.validator_index,
+                }
+            },
+        }
+    # generic fallback: dataclass fields best-effort
+    return {"type": f"tendermint/event/{type(data).__name__}", "value": {}}

@@ -1,0 +1,644 @@
+"""RPC route handlers (reference: rpc/core/routes.go:12-56 + per-file
+implementations under rpc/core/).
+
+Every handler takes (env, **params) and returns a JSON-encodable dict.
+Param coercion (heights arrive as strings from JSON-RPC) happens here.
+Errors raise RPCError with reference-style messages.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ...abci import types as abci
+from ...mempool.clist_mempool import MempoolFullError, TxInCacheError
+from .. import encoding as enc
+
+
+class RPCError(Exception):
+    def __init__(self, message: str, code: int = -32603, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.data = data
+
+
+def _int(v, name: str, default=None) -> int | None:
+    if v is None or v == "":
+        if default is not None:
+            return default
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise RPCError(f"invalid {name}: {v!r}", code=-32602)
+
+
+def _height_or_latest(env, height) -> int:
+    h = _int(height, "height")
+    latest = env.latest_height()
+    if h is None or h == 0:
+        return latest
+    if h <= 0:
+        raise RPCError("height must be greater than 0")
+    if h > latest:
+        raise RPCError(
+            f"height {h} must be less than or equal to the current "
+            f"blockchain height {latest}"
+        )
+    return h
+
+
+def _tx_bytes(tx) -> bytes:
+    if isinstance(tx, (bytes, bytearray)):
+        return bytes(tx)
+    if isinstance(tx, str):
+        return enc.b64_decode(tx)
+    raise RPCError("tx must be base64 string", code=-32602)
+
+
+# ---------------------------------------------------------------------------
+# info routes (rpc/core/status.go, net.go, blocks.go, consensus.go)
+# ---------------------------------------------------------------------------
+
+
+def health(env) -> dict:
+    return {}
+
+
+def status(env) -> dict:
+    latest = env.latest_height()
+    meta = env.block_store.load_block_meta(latest) if latest else None
+    earliest = env.block_store.base() if hasattr(env.block_store, "base") else 1
+    emeta = env.block_store.load_block_meta(earliest) if latest else None
+    val_info = {}
+    if env.priv_validator_pub_key is not None:
+        pk = env.priv_validator_pub_key
+        power = 0
+        if env.state_store is not None:
+            st = env.state_store.load()
+            if st is not None:
+                idx, val = st.validators.get_by_address(bytes(pk.address()))
+                if idx >= 0:
+                    power = val.voting_power
+        val_info = {
+            "address": enc.hex_bytes(bytes(pk.address())),
+            "pub_key": {
+                "type": "tendermint/PubKeyEd25519",
+                "value": enc.b64(pk.bytes()),
+            },
+            "voting_power": str(power),
+        }
+    catching_up = False
+    if env.consensus_reactor is not None:
+        catching_up = bool(getattr(env.consensus_reactor, "wait_sync", False))
+    return {
+        "node_info": _node_info_json(env),
+        "sync_info": {
+            "latest_block_hash": enc.hex_bytes(
+                meta.block_id.hash if meta else b""
+            ),
+            "latest_app_hash": enc.hex_bytes(
+                meta.header.app_hash if meta else b""
+            ),
+            "latest_block_height": str(latest),
+            "latest_block_time": enc.rfc3339(meta.header.time_ns)
+            if meta
+            else enc.rfc3339(0),
+            "earliest_block_hash": enc.hex_bytes(
+                emeta.block_id.hash if emeta else b""
+            ),
+            "earliest_block_height": str(earliest if latest else 0),
+            "catching_up": catching_up,
+        },
+        "validator_info": val_info,
+    }
+
+
+def _node_info_json(env) -> dict:
+    ni = env.node_info
+    if ni is None:
+        return {}
+    return {
+        "id": ni.node_id,
+        "listen_addr": ni.listen_addr,
+        "network": ni.network,
+        "moniker": ni.moniker,
+        "channels": enc.hex_bytes(bytes(ni.channels or [])),
+    }
+
+
+def net_info(env) -> dict:
+    peers = env.switch.peers() if env.switch else []
+    return {
+        "listening": bool(env.switch and env.switch.is_running()),
+        "listeners": [env.node_info.listen_addr] if env.node_info else [],
+        "n_peers": str(len(peers)),
+        "peers": [
+            {
+                "node_info": {
+                    "id": p.node_id(),
+                    "moniker": getattr(p.node_info, "moniker", ""),
+                    "network": getattr(p.node_info, "network", ""),
+                },
+                "is_outbound": p.is_outbound,
+                "remote_ip": getattr(p, "remote_addr", ""),
+            }
+            for p in peers
+        ],
+    }
+
+
+def genesis(env) -> dict:
+    import json as _json
+
+    return {"genesis": _json.loads(env.genesis.to_json())}
+
+
+def block(env, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    blk = env.block_store.load_block(h)
+    meta = env.block_store.load_block_meta(h)
+    if blk is None or meta is None:
+        raise RPCError(f"block at height {h} not found")
+    return {
+        "block_id": enc.enc_block_id(meta.block_id),
+        "block": enc.enc_block(blk),
+    }
+
+
+def block_by_hash(env, hash=None) -> dict:  # noqa: A002
+    if not hash:
+        raise RPCError("hash is required", code=-32602)
+    raw = bytes.fromhex(hash) if isinstance(hash, str) else bytes(hash)
+    blk = env.block_store.load_block_by_hash(raw)
+    if blk is None:
+        raise RPCError(f"block with hash {hash} not found")
+    meta = env.block_store.load_block_meta(blk.header.height)
+    return {
+        "block_id": enc.enc_block_id(meta.block_id),
+        "block": enc.enc_block(blk),
+    }
+
+
+def header(env, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    meta = env.block_store.load_block_meta(h)
+    if meta is None:
+        raise RPCError(f"header at height {h} not found")
+    return {"header": enc.enc_header(meta.header)}
+
+
+def blockchain(env, min_height=None, max_height=None) -> dict:
+    """Block metas in [min, max], newest first, max 20
+    (rpc/core/blocks.go BlockchainInfo)."""
+    latest = env.latest_height()
+    maxh = min(_int(max_height, "max_height", latest) or latest, latest)
+    minh = max(_int(min_height, "min_height", 1) or 1, 1)
+    minh = max(minh, maxh - 20 + 1)
+    if minh > maxh:
+        raise RPCError(
+            f"min height {minh} can't be greater than max height {maxh}"
+        )
+    metas = []
+    for h in range(maxh, minh - 1, -1):
+        m = env.block_store.load_block_meta(h)
+        if m is not None:
+            metas.append(enc.enc_block_meta(m))
+    return {"last_height": str(latest), "block_metas": metas}
+
+
+def commit(env, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    meta = env.block_store.load_block_meta(h)
+    if meta is None:
+        raise RPCError(f"block at height {h} not found")
+    c = env.block_store.load_block_commit(h)
+    canonical = True
+    if c is None and h == env.latest_height():
+        c = env.block_store.load_seen_commit()
+        canonical = False
+    if c is None:
+        raise RPCError(f"commit for height {h} not found")
+    return {
+        "signed_header": {
+            "header": enc.enc_header(meta.header),
+            "commit": enc.enc_commit(c),
+        },
+        "canonical": canonical,
+    }
+
+
+def validators(env, height=None, page=None, per_page=None) -> dict:
+    h = _height_or_latest(env, height)
+    vals = env.state_store.load_validators(h)
+    if vals is None:
+        raise RPCError(f"validators at height {h} not found")
+    page_n = _int(page, "page", 1) or 1
+    per = min(_int(per_page, "per_page", 30) or 30, 100)
+    total = len(vals.validators)
+    start = (page_n - 1) * per
+    if start > total or page_n < 1:
+        raise RPCError(f"page should be within [1, {max(1,(total+per-1)//per)}] range")
+    subset = vals.validators[start : start + per]
+    return {
+        "block_height": str(h),
+        "validators": [enc.enc_validator(v) for v in subset],
+        "count": str(len(subset)),
+        "total": str(total),
+    }
+
+
+def consensus_params(env, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    st = env.state_store.load()
+    if st is None:
+        raise RPCError("no state")
+    p = st.consensus_params
+    return {
+        "block_height": str(h),
+        "consensus_params": {
+            "block": {
+                "max_bytes": str(p.block.max_bytes),
+                "max_gas": str(p.block.max_gas),
+            },
+            "evidence": {
+                "max_age_num_blocks": str(p.evidence.max_age_num_blocks),
+                "max_age_duration": str(p.evidence.max_age_duration_ns),
+                "max_bytes": str(p.evidence.max_bytes),
+            },
+            "validator": {"pub_key_types": list(p.validator.pub_key_types)},
+            "abci": {
+                "vote_extensions_enable_height": str(
+                    p.abci.vote_extensions_enable_height
+                ),
+            },
+        },
+    }
+
+
+def consensus_state(env) -> dict:
+    rs = env.consensus.get_round_state()
+    return {
+        "round_state": {
+            "height/round/step": f"{rs.height}/{rs.round}/{int(rs.step)}",
+            "start_time": enc.rfc3339(rs.start_time_ns),
+            "proposal_block_hash": enc.hex_bytes(
+                rs.proposal_block.hash() if rs.proposal_block else b""
+            ),
+            "locked_block_hash": enc.hex_bytes(
+                rs.locked_block.hash() if rs.locked_block else b""
+            ),
+            "valid_block_hash": enc.hex_bytes(
+                rs.valid_block.hash() if rs.valid_block else b""
+            ),
+        }
+    }
+
+
+def dump_consensus_state(env) -> dict:
+    rs = env.consensus.get_round_state()
+    votes = []
+    if rs.votes is not None:
+        for r in range(rs.round + 1):
+            pv = rs.votes.prevotes(r)
+            pc = rs.votes.precommits(r)
+            votes.append(
+                {
+                    "round": r,
+                    "prevotes_bit_array": str(pv.bit_array()) if pv else "",
+                    "precommits_bit_array": str(pc.bit_array()) if pc else "",
+                }
+            )
+    out = consensus_state(env)
+    out["round_state"]["height_vote_set"] = votes
+    peers = env.switch.peers() if env.switch else []
+    out["peers"] = [{"node_address": p.node_id()} for p in peers]
+    return out
+
+
+def unconfirmed_txs(env, limit=None) -> dict:
+    lim = min(_int(limit, "limit", 30) or 30, 100)
+    txs = env.mempool.reap_max_txs(lim)
+    return {
+        "n_txs": str(len(txs)),
+        "total": str(env.mempool.size()),
+        "total_bytes": str(env.mempool.size_bytes()),
+        "txs": [enc.b64(tx) for tx in txs],
+    }
+
+
+def num_unconfirmed_txs(env) -> dict:
+    return {
+        "n_txs": str(env.mempool.size()),
+        "total": str(env.mempool.size()),
+        "total_bytes": str(env.mempool.size_bytes()),
+        "txs": None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# ABCI passthrough (rpc/core/abci.go)
+# ---------------------------------------------------------------------------
+
+
+def abci_info(env) -> dict:
+    res = env.proxy_app_query.info(abci.RequestInfo())
+    return {
+        "response": {
+            "data": res.data,
+            "version": res.version,
+            "app_version": str(res.app_version),
+            "last_block_height": str(res.last_block_height),
+            "last_block_app_hash": enc.b64(res.last_block_app_hash),
+        }
+    }
+
+
+def abci_query(env, path="", data="", height=None, prove=False) -> dict:
+    raw = bytes.fromhex(data) if isinstance(data, str) else bytes(data or b"")
+    res = env.proxy_app_query.query(
+        abci.RequestQuery(
+            data=raw,
+            path=path,
+            height=_int(height, "height", 0) or 0,
+            prove=bool(prove),
+        )
+    )
+    return {
+        "response": {
+            "code": res.code,
+            "log": res.log,
+            "info": res.info,
+            "index": str(res.index),
+            "key": enc.b64(res.key),
+            "value": enc.b64(res.value),
+            "height": str(res.height),
+            "codespace": res.codespace,
+        }
+    }
+
+
+# ---------------------------------------------------------------------------
+# tx ingress (rpc/core/mempool.go)
+# ---------------------------------------------------------------------------
+
+
+def _check_tx_sync(env, tx: bytes):
+    """CheckTx and wait for the result (BroadcastTxSync semantics)."""
+    import threading
+
+    done = threading.Event()
+    box = {}
+
+    def cb(res):
+        box["res"] = res
+        done.set()
+
+    try:
+        env.mempool.check_tx(tx, cb=cb)
+    except TxInCacheError:
+        raise RPCError("tx already exists in cache")
+    except MempoolFullError as e:
+        raise RPCError(str(e))
+    if not done.wait(timeout=10):
+        raise RPCError("timed out waiting for tx to be included in mempool")
+    return box["res"]
+
+
+def broadcast_tx_async(env, tx=None) -> dict:
+    raw = _tx_bytes(tx)
+    try:
+        env.mempool.check_tx(raw)
+    except TxInCacheError:
+        raise RPCError("tx already exists in cache")
+    except MempoolFullError as e:
+        raise RPCError(str(e))
+    from ...crypto import tmhash
+
+    return {"code": 0, "data": "", "log": "", "hash": enc.hex_bytes(tmhash.sum(raw))}
+
+
+def broadcast_tx_sync(env, tx=None) -> dict:
+    raw = _tx_bytes(tx)
+    res = _check_tx_sync(env, raw)
+    from ...crypto import tmhash
+
+    return {
+        "code": res.code,
+        "data": enc.b64(res.data),
+        "log": res.log,
+        "codespace": res.codespace,
+        "hash": enc.hex_bytes(tmhash.sum(raw)),
+    }
+
+
+def broadcast_tx_commit(env, tx=None) -> dict:
+    """CheckTx, then wait for the tx to land in a committed block
+    (rpc/core/mempool.go:104 BroadcastTxCommit) via an event-bus
+    subscription."""
+    import queue as _q
+
+    from ...crypto import tmhash
+    from ...libs import pubsub
+    from ...types.event_bus import EVENT_TYPE_KEY
+
+    raw = _tx_bytes(tx)
+    tx_hash = tmhash.sum(raw)
+    if env.event_bus is None:
+        raise RPCError("event bus unavailable")
+    subscriber = f"broadcast_tx_commit:{tx_hash.hex()}"
+    query = pubsub.Query.parse(
+        f"{EVENT_TYPE_KEY} = 'Tx' AND tx.hash = '{tx_hash.hex().upper()}'"
+    )
+    sub = env.event_bus.subscribe(subscriber, query, capacity=1)
+    try:
+        check_res = _check_tx_sync(env, raw)
+        result = {
+            "check_tx": {
+                "code": check_res.code,
+                "data": enc.b64(check_res.data),
+                "log": check_res.log,
+            },
+            "hash": enc.hex_bytes(tx_hash),
+        }
+        if check_res.code != abci.OK:
+            result["tx_result"] = {"code": check_res.code}
+            result["height"] = "0"
+            return result
+        try:
+            msg = sub.out.get(timeout=30.0)
+        except _q.Empty:
+            raise RPCError("timed out waiting for tx to be included in a block")
+        data = msg.data  # EventDataTx
+        result["tx_result"] = enc.enc_exec_tx_result(data.result)
+        result["height"] = str(data.height)
+        return result
+    finally:
+        try:
+            env.event_bus.unsubscribe_all(subscriber)
+        except Exception:
+            pass
+
+
+def check_tx(env, tx=None) -> dict:
+    """Run CheckTx against the app WITHOUT adding to the mempool
+    (rpc/core/mempool.go CheckTx)."""
+    raw = _tx_bytes(tx)
+    res = env.proxy_app_query.check_tx(abci.RequestCheckTx(tx=raw))
+    return {
+        "code": res.code,
+        "data": enc.b64(res.data),
+        "log": res.log,
+        "gas_wanted": str(res.gas_wanted),
+        "gas_used": str(res.gas_used),
+    }
+
+
+# ---------------------------------------------------------------------------
+# block results / tx lookup (need stores + indexer)
+# ---------------------------------------------------------------------------
+
+
+def block_results(env, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    resp = env.state_store.load_finalize_block_response(h)
+    if resp is None:
+        raise RPCError(f"results for height {h} not available")
+    return {
+        "height": str(h),
+        "txs_results": [
+            enc.enc_exec_tx_result(r) for r in (resp.tx_results or [])
+        ],
+        "finalize_block_events": enc.enc_events(resp.events),
+        "validator_updates": [
+            {
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": enc.b64(vu.pub_key.bytes()),
+                },
+                "power": str(vu.power),
+            }
+            for vu in (resp.validator_updates or [])
+        ],
+        "app_hash": enc.hex_bytes(resp.app_hash),
+    }
+
+
+def tx(env, hash=None, prove=False) -> dict:  # noqa: A002
+    if env.tx_indexer is None:
+        raise RPCError("transaction indexing is disabled")
+    if not hash:
+        raise RPCError("hash is required", code=-32602)
+    raw = bytes.fromhex(hash) if isinstance(hash, str) else bytes(hash)
+    res = env.tx_indexer.get(raw)
+    if res is None:
+        raise RPCError(f"tx ({hash}) not found")
+    return _enc_tx_result(res, prove, env)
+
+
+def _enc_tx_result(res, prove, env) -> dict:
+    out = {
+        "hash": enc.hex_bytes(res.tx_hash),
+        "height": str(res.height),
+        "index": res.index,
+        "tx_result": enc.enc_exec_tx_result(res.result),
+        "tx": enc.b64(res.tx),
+    }
+    if prove:
+        blk = env.block_store.load_block(res.height)
+        if blk is not None:
+            from ...crypto import merkle
+
+            txs = list(blk.data.txs)
+            _, proofs = merkle.proofs_from_byte_slices(txs)
+            pr = proofs[res.index]
+            out["proof"] = {
+                "root_hash": enc.hex_bytes(pr.root_hash),
+                "data": enc.b64(res.tx),
+                "proof": {
+                    "total": str(pr.total),
+                    "index": str(pr.index),
+                    "leaf_hash": enc.b64(pr.leaf_hash),
+                    "aunts": [enc.b64(a) for a in pr.aunts],
+                },
+            }
+    return out
+
+
+def tx_search(env, query=None, prove=False, page=None, per_page=None,
+              order_by=None) -> dict:
+    if env.tx_indexer is None:
+        raise RPCError("transaction indexing is disabled")
+    if not query:
+        raise RPCError("query is required", code=-32602)
+    results = env.tx_indexer.search(query)
+    if (order_by or "asc") == "desc":
+        results = list(reversed(results))
+    page_n = _int(page, "page", 1) or 1
+    per = min(_int(per_page, "per_page", 30) or 30, 100)
+    start = (page_n - 1) * per
+    subset = results[start : start + per]
+    return {
+        "txs": [_enc_tx_result(r, prove, env) for r in subset],
+        "total_count": str(len(results)),
+    }
+
+
+def block_search(env, query=None, page=None, per_page=None, order_by=None) -> dict:
+    if env.block_indexer is None:
+        raise RPCError("block indexing is disabled")
+    if not query:
+        raise RPCError("query is required", code=-32602)
+    heights = env.block_indexer.search(query)
+    if (order_by or "asc") == "desc":
+        heights = list(reversed(heights))
+    page_n = _int(page, "page", 1) or 1
+    per = min(_int(per_page, "per_page", 30) or 30, 100)
+    subset = heights[(page_n - 1) * per : (page_n - 1) * per + per]
+    blocks = []
+    for h in subset:
+        m = env.block_store.load_block_meta(h)
+        b = env.block_store.load_block(h)
+        if m and b:
+            blocks.append(
+                {"block_id": enc.enc_block_id(m.block_id), "block": enc.enc_block(b)}
+            )
+    return {"blocks": blocks, "total_count": str(len(heights))}
+
+
+def broadcast_evidence(env, evidence=None) -> dict:
+    raise RPCError("evidence broadcast over RPC not supported yet")
+
+
+# ---------------------------------------------------------------------------
+# route table (rpc/core/routes.go:12-56)
+# ---------------------------------------------------------------------------
+
+ROUTES = {
+    "health": health,
+    "status": status,
+    "net_info": net_info,
+    "genesis": genesis,
+    "blockchain": blockchain,
+    "block": block,
+    "block_by_hash": block_by_hash,
+    "block_results": block_results,
+    "header": header,
+    "commit": commit,
+    "validators": validators,
+    "consensus_state": consensus_state,
+    "dump_consensus_state": dump_consensus_state,
+    "consensus_params": consensus_params,
+    "unconfirmed_txs": unconfirmed_txs,
+    "num_unconfirmed_txs": num_unconfirmed_txs,
+    "abci_info": abci_info,
+    "abci_query": abci_query,
+    "broadcast_tx_async": broadcast_tx_async,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_commit": broadcast_tx_commit,
+    "check_tx": check_tx,
+    "tx": tx,
+    "tx_search": tx_search,
+    "block_search": block_search,
+    "broadcast_evidence": broadcast_evidence,
+}

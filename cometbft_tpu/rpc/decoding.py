@@ -1,0 +1,110 @@
+"""Decode RPC JSON back into data-model types (inverse of encoding.py).
+
+Used by RPC clients that need typed results — most importantly the light
+client's RPC provider (reference: rpc/client http + light/provider/http),
+which must reconstruct byte-exact headers/commits so hashes and signature
+checks reproduce.
+"""
+
+from __future__ import annotations
+
+import base64
+from datetime import datetime, timezone
+
+from ..crypto.keys import Ed25519PubKey
+from ..types.block import (
+    BLOCK_ID_FLAG_ABSENT,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+    Version,
+)
+from ..types.validator_set import Validator, ValidatorSet
+
+
+def from_hex(s: str) -> bytes:
+    return bytes.fromhex(s) if s else b""
+
+
+def from_b64(s) -> bytes:
+    return base64.b64decode(s) if s else b""
+
+
+def parse_rfc3339(s: str) -> int:
+    """RFC3339 with nanosecond fraction -> ns since epoch."""
+    if not s:
+        return 0
+    base, _, frac_z = s.partition(".")
+    dt = datetime.strptime(base, "%Y-%m-%dT%H:%M:%S").replace(
+        tzinfo=timezone.utc
+    )
+    ns = int(dt.timestamp()) * 1_000_000_000
+    if frac_z:
+        frac = frac_z.rstrip("Z")
+        ns += int(frac.ljust(9, "0")[:9])
+    return ns
+
+
+def dec_block_id(d: dict) -> BlockID:
+    parts = d.get("parts") or {}
+    return BlockID(
+        hash=from_hex(d.get("hash", "")),
+        part_set_header=PartSetHeader(
+            total=int(parts.get("total", 0)),
+            hash=from_hex(parts.get("hash", "")),
+        ),
+    )
+
+
+def dec_header(d: dict) -> Header:
+    v = d.get("version") or {}
+    return Header(
+        version=Version(block=int(v.get("block", 0)), app=int(v.get("app", 0))),
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time_ns=parse_rfc3339(d["time"]),
+        last_block_id=dec_block_id(d.get("last_block_id") or {}),
+        last_commit_hash=from_hex(d.get("last_commit_hash", "")),
+        data_hash=from_hex(d.get("data_hash", "")),
+        validators_hash=from_hex(d.get("validators_hash", "")),
+        next_validators_hash=from_hex(d.get("next_validators_hash", "")),
+        consensus_hash=from_hex(d.get("consensus_hash", "")),
+        app_hash=from_hex(d.get("app_hash", "")),
+        last_results_hash=from_hex(d.get("last_results_hash", "")),
+        evidence_hash=from_hex(d.get("evidence_hash", "")),
+        proposer_address=from_hex(d.get("proposer_address", "")),
+    )
+
+
+def dec_commit_sig(d: dict) -> CommitSig:
+    return CommitSig(
+        block_id_flag=int(d.get("block_id_flag", BLOCK_ID_FLAG_ABSENT)),
+        validator_address=from_hex(d.get("validator_address", "")),
+        timestamp_ns=parse_rfc3339(d.get("timestamp", "")),
+        signature=from_b64(d.get("signature")),
+    )
+
+
+def dec_commit(d: dict) -> Commit:
+    return Commit(
+        height=int(d["height"]),
+        round=int(d["round"]),
+        block_id=dec_block_id(d["block_id"]),
+        signatures=[dec_commit_sig(s) for s in d.get("signatures", [])],
+    )
+
+
+def dec_validator(d: dict) -> Validator:
+    pk = d.get("pub_key") or {}
+    return Validator(
+        address=from_hex(d["address"]),
+        pub_key=Ed25519PubKey(from_b64(pk.get("value"))),
+        voting_power=int(d["voting_power"]),
+        proposer_priority=int(d.get("proposer_priority", 0)),
+    )
+
+
+def dec_validator_set(vals: list[dict]) -> ValidatorSet:
+    return ValidatorSet([dec_validator(v) for v in vals])

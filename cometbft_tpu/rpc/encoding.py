@@ -1,0 +1,135 @@
+"""RPC JSON encoding of the data model.
+
+Follows the reference's JSON conventions (rpc/core responses via
+cometbft/libs/json): integers that can exceed 2^53 are strings, hashes and
+addresses are upper-hex, raw byte blobs (txs, app data, signatures,
+pubkeys) are base64, times are RFC3339 with nanoseconds.
+"""
+
+from __future__ import annotations
+
+import base64
+from datetime import datetime, timezone
+
+
+def hex_bytes(b: bytes | None) -> str:
+    return (b or b"").hex().upper()
+
+
+def b64(b: bytes | None) -> str:
+    return base64.b64encode(b or b"").decode()
+
+
+def b64_decode(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def rfc3339(ns: int) -> str:
+    dt = datetime.fromtimestamp(ns / 1e9, tz=timezone.utc)
+    frac = ns % 1_000_000_000
+    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{frac:09d}Z"
+
+
+def enc_block_id(bid) -> dict:
+    return {
+        "hash": hex_bytes(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": hex_bytes(bid.part_set_header.hash),
+        },
+    }
+
+
+def enc_header(h) -> dict:
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": rfc3339(h.time_ns),
+        "last_block_id": enc_block_id(h.last_block_id),
+        "last_commit_hash": hex_bytes(h.last_commit_hash),
+        "data_hash": hex_bytes(h.data_hash),
+        "validators_hash": hex_bytes(h.validators_hash),
+        "next_validators_hash": hex_bytes(h.next_validators_hash),
+        "consensus_hash": hex_bytes(h.consensus_hash),
+        "app_hash": hex_bytes(h.app_hash),
+        "last_results_hash": hex_bytes(h.last_results_hash),
+        "evidence_hash": hex_bytes(h.evidence_hash),
+        "proposer_address": hex_bytes(h.proposer_address),
+    }
+
+
+def enc_commit_sig(cs) -> dict:
+    return {
+        "block_id_flag": cs.block_id_flag,
+        "validator_address": hex_bytes(cs.validator_address),
+        "timestamp": rfc3339(cs.timestamp_ns) if cs.timestamp_ns else "",
+        "signature": b64(cs.signature) if cs.signature else None,
+    }
+
+
+def enc_commit(c) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": enc_block_id(c.block_id),
+        "signatures": [enc_commit_sig(s) for s in c.signatures],
+    }
+
+
+def enc_block(b) -> dict:
+    return {
+        "header": enc_header(b.header),
+        "data": {"txs": [b64(tx) for tx in b.data.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": enc_commit(b.last_commit) if b.last_commit else None,
+    }
+
+
+def enc_block_meta(m) -> dict:
+    return {
+        "block_id": enc_block_id(m.block_id),
+        "block_size": str(m.block_size),
+        "header": enc_header(m.header),
+        "num_txs": str(m.num_txs),
+    }
+
+
+def enc_validator(v) -> dict:
+    return {
+        "address": hex_bytes(v.address),
+        "pub_key": {
+            "type": "tendermint/PubKeyEd25519",
+            "value": b64(v.pub_key.bytes()),
+        },
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+def enc_events(events) -> list:
+    out = []
+    for ev in events or []:
+        out.append(
+            {
+                "type": ev.type,
+                "attributes": [
+                    {"key": a.key, "value": a.value, "index": a.index}
+                    for a in ev.attributes
+                ],
+            }
+        )
+    return out
+
+
+def enc_exec_tx_result(r) -> dict:
+    return {
+        "code": r.code,
+        "data": b64(r.data) if r.data else None,
+        "log": r.log,
+        "info": getattr(r, "info", ""),
+        "gas_wanted": str(getattr(r, "gas_wanted", 0)),
+        "gas_used": str(getattr(r, "gas_used", 0)),
+        "events": enc_events(getattr(r, "events", [])),
+        "codespace": getattr(r, "codespace", ""),
+    }

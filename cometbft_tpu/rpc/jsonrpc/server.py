@@ -1,0 +1,389 @@
+"""JSON-RPC 2.0 server over HTTP + WebSocket subscriptions.
+
+Reference: rpc/jsonrpc/server/{http_server,http_json_handler,
+http_uri_handler,ws_handler}.go. Endpoints:
+
+* ``POST /``           — JSON-RPC 2.0 (single or batch)
+* ``GET /<route>?a=b`` — URI routes, same handlers
+* ``GET /``            — route listing (the reference's help page)
+* ``GET /websocket``   — RFC 6455 upgrade; subscribe/unsubscribe stream
+                         event-bus matches as JSON-RPC notifications
+
+Implementation is stdlib-only (ThreadingHTTPServer + a compact RFC 6455
+frame layer) — the runtime around the TPU compute path stays
+dependency-free.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socketserver
+import struct
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ...libs import pubsub
+from ...libs.service import BaseService
+from ..core.routes import ROUTES, RPCError
+
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+MAX_BODY = 1 << 20  # 1MB request cap (http_server.go maxBodyBytes)
+
+
+def _rpc_response(id_, result=None, error=None) -> dict:
+    out = {"jsonrpc": "2.0", "id": id_}
+    if error is not None:
+        out["error"] = error
+    else:
+        out["result"] = result
+    return out
+
+
+def _rpc_error(code: int, message: str, data: str = "") -> dict:
+    err = {"code": code, "message": message}
+    if data:
+        err["data"] = data
+    return err
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "cometbft-tpu-rpc"
+
+    # injected by RPCServer
+    env = None
+    routes = ROUTES
+
+    def log_message(self, fmt, *args):  # quiet by default
+        logger = getattr(self.server, "logger", None)
+        if logger is not None:
+            logger.debug("rpc: " + fmt % args)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _call(self, method: str, params: dict):
+        fn = self.routes.get(method)
+        if fn is None:
+            raise RPCError(f"method {method!r} not found", code=-32601)
+        try:
+            return fn(self.env, **(params or {}))
+        except RPCError:
+            raise
+        except TypeError as e:
+            raise RPCError(str(e), code=-32602)
+        except Exception as e:
+            raise RPCError(str(e) or repr(e))
+
+    def _send_json(self, payload, status: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- HTTP verbs --------------------------------------------------------
+
+    def do_POST(self) -> None:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > MAX_BODY:
+            self._send_json(
+                _rpc_response(None, error=_rpc_error(-32600, "body too large")),
+                status=413,
+            )
+            return
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except json.JSONDecodeError as e:
+            self._send_json(
+                _rpc_response(None, error=_rpc_error(-32700, f"parse error: {e}"))
+            )
+            return
+        if isinstance(req, list):
+            self._send_json([self._handle_one(r) for r in req])
+        else:
+            self._send_json(self._handle_one(req))
+
+    def _handle_one(self, req: dict) -> dict:
+        id_ = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        if not isinstance(params, dict):
+            return _rpc_response(
+                id_, error=_rpc_error(-32602, "params must be an object")
+            )
+        try:
+            return _rpc_response(id_, result=self._call(method, params))
+        except RPCError as e:
+            return _rpc_response(
+                id_, error=_rpc_error(e.code, str(e), e.data)
+            )
+
+    def do_GET(self) -> None:
+        parsed = urllib.parse.urlsplit(self.path)
+        route = parsed.path.strip("/")
+        if route == "websocket":
+            self._do_websocket()
+            return
+        if route == "":
+            self._send_json({"routes": sorted(self.routes)})
+            return
+        params = {
+            k: v[0] if len(v) == 1 else v
+            for k, v in urllib.parse.parse_qs(parsed.query).items()
+        }
+        # URI params arrive quoted (height=1, hash="AB12", tx=0x... styles)
+        for k, v in list(params.items()):
+            if isinstance(v, str) and len(v) >= 2 and v[0] == v[-1] == '"':
+                params[k] = v[1:-1]
+        try:
+            self._send_json(
+                _rpc_response(-1, result=self._call(route, params))
+            )
+        except RPCError as e:
+            self._send_json(
+                _rpc_response(-1, error=_rpc_error(e.code, str(e), e.data)),
+                status=500 if e.code == -32603 else 400,
+            )
+
+    # -- WebSocket (ws_handler.go) ----------------------------------------
+
+    def _do_websocket(self) -> None:
+        key = self.headers.get("Sec-WebSocket-Key")
+        if self.headers.get("Upgrade", "").lower() != "websocket" or not key:
+            self._send_json(
+                _rpc_response(None, error=_rpc_error(-32600, "not a websocket"))
+            , status=400)
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        self.close_connection = True
+        conn = _WSConn(self.connection, self.env)
+        try:
+            conn.serve()
+        finally:
+            conn.cleanup()
+
+
+class _WSConn:
+    """One WebSocket session: JSON-RPC over frames + event forwarding."""
+
+    def __init__(self, sock, env):
+        self.sock = sock
+        self.env = env
+        self.id = f"ws-{id(self):x}"
+        self._write_mtx = threading.Lock()
+        self._subs: dict[str, tuple[object, object]] = {}  # query -> (q, sub)
+        self._alive = True
+
+    # frame io ------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf += chunk
+        return buf
+
+    def _read_frame(self) -> tuple[int, bytes]:
+        h = self._read_exact(2)
+        opcode = h[0] & 0x0F
+        masked = h[1] & 0x80
+        ln = h[1] & 0x7F
+        if ln == 126:
+            ln = struct.unpack(">H", self._read_exact(2))[0]
+        elif ln == 127:
+            ln = struct.unpack(">Q", self._read_exact(8))[0]
+        if ln > MAX_BODY:
+            raise ConnectionError("ws frame too large")
+        mask = self._read_exact(4) if masked else b""
+        payload = self._read_exact(ln)
+        if masked:
+            payload = bytes(
+                b ^ mask[i % 4] for i, b in enumerate(payload)
+            )
+        return opcode, payload
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        with self._write_mtx:
+            head = bytes([0x80 | opcode])
+            ln = len(payload)
+            if ln < 126:
+                head += bytes([ln])
+            elif ln < (1 << 16):
+                head += bytes([126]) + struct.pack(">H", ln)
+            else:
+                head += bytes([127]) + struct.pack(">Q", ln)
+            self.sock.sendall(head + payload)
+
+    def send_json(self, payload: dict) -> None:
+        try:
+            self._send_frame(0x1, json.dumps(payload).encode())
+        except OSError:
+            self._alive = False
+
+    # session -------------------------------------------------------------
+
+    def serve(self) -> None:
+        while self._alive:
+            try:
+                opcode, payload = self._read_frame()
+            except (ConnectionError, OSError):
+                return
+            if opcode == 0x8:  # close
+                try:
+                    self._send_frame(0x8, b"")
+                except OSError:
+                    pass
+                return
+            if opcode == 0x9:  # ping
+                self._send_frame(0xA, payload)
+                continue
+            if opcode not in (0x1, 0x2):
+                continue
+            try:
+                req = json.loads(payload)
+            except json.JSONDecodeError:
+                self.send_json(
+                    _rpc_response(None, error=_rpc_error(-32700, "parse error"))
+                )
+                continue
+            self._handle(req)
+
+    def _handle(self, req: dict) -> None:
+        id_ = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        try:
+            if method == "subscribe":
+                self._subscribe(id_, params.get("query", ""))
+            elif method == "unsubscribe":
+                self._unsubscribe(id_, params.get("query", ""))
+            elif method == "unsubscribe_all":
+                self._unsub_all()
+                self.send_json(_rpc_response(id_, result={}))
+            else:
+                fn = ROUTES.get(method)
+                if fn is None:
+                    raise RPCError(f"method {method!r} not found", code=-32601)
+                self.send_json(
+                    _rpc_response(id_, result=fn(self.env, **params))
+                )
+        except RPCError as e:
+            self.send_json(_rpc_response(id_, error=_rpc_error(e.code, str(e))))
+        except Exception as e:
+            self.send_json(_rpc_response(id_, error=_rpc_error(-32603, str(e))))
+
+    def _subscribe(self, id_, query_str: str) -> None:
+        if not query_str:
+            raise RPCError("query is required", code=-32602)
+        if self.env.event_bus is None:
+            raise RPCError("event bus unavailable")
+        q = pubsub.Query.parse(query_str)
+        sub = self.env.event_bus.subscribe(self.id, q, capacity=100)
+        self._subs[query_str] = (q, sub)
+        threading.Thread(
+            target=self._forward, args=(query_str, sub, id_), daemon=True
+        ).start()
+        self.send_json(_rpc_response(id_, result={}))
+
+    def _forward(self, query_str: str, sub, id_) -> None:
+        from ..core.events import encode_event_data
+
+        while self._alive and not sub.canceled.is_set():
+            try:
+                msg = sub.out.get(timeout=0.5)
+            except Exception:
+                continue
+            self.send_json(
+                _rpc_response(
+                    id_,
+                    result={
+                        "query": query_str,
+                        "data": encode_event_data(msg.data),
+                        "events": msg.events,
+                    },
+                )
+            )
+
+    def _unsubscribe(self, id_, query_str: str) -> None:
+        pair = self._subs.pop(query_str, None)
+        if pair is None:
+            raise RPCError(f"not subscribed to {query_str!r}")
+        q, _sub = pair
+        self.env.event_bus.unsubscribe(self.id, q)
+        self.send_json(_rpc_response(id_, result={}))
+
+    def _unsub_all(self) -> None:
+        if self._subs:
+            try:
+                self.env.event_bus.unsubscribe_all(self.id)
+            except Exception:
+                pass
+            self._subs.clear()
+
+    def cleanup(self) -> None:
+        self._alive = False
+        self._unsub_all()
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    request_queue_size = 64
+
+
+class RPCServer(BaseService):
+    """HTTP JSON-RPC server bound to config.rpc.laddr."""
+
+    def __init__(self, env, laddr: str, logger=None):
+        super().__init__("rpc-server")
+        self.env = env
+        self.laddr = laddr
+        self.logger = logger
+        self._httpd: _Server | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def bound_addr(self) -> str:
+        if self._httpd is None:
+            return ""
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def on_start(self) -> None:
+        host, port = _parse_laddr(self.laddr)
+        handler = type("BoundHandler", (_Handler,), {"env": self.env})
+        self._httpd = _Server((host, port), handler)
+        self._httpd.logger = self.logger
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rpc-http", daemon=True
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+def _parse_laddr(laddr: str) -> tuple[str, int]:
+    addr = laddr
+    for prefix in ("tcp://", "http://"):
+        if addr.startswith(prefix):
+            addr = addr[len(prefix):]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)

@@ -19,6 +19,7 @@ def _net_config(home: str) -> "Config":
     cfg = default_config()
     cfg.base.home = home
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
     # Single-core-friendly timeouts: pure-python single-verify is ~10ms,
     # so sub-50ms rounds starve under 4 in-process nodes.
     cfg.consensus = dataclasses.replace(

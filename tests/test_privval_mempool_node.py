@@ -284,6 +284,7 @@ def test_node_init_start_produce_restart(tmp_path):
     cfg.base.home = str(tmp_path / "home")
     cfg.consensus = make_test_config().consensus
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
     out = init_files(cfg)
     genesis = load_genesis(cfg)
     assert genesis.chain_id.startswith("test-chain-")
@@ -322,6 +323,7 @@ def test_node_tx_flows_into_block(tmp_path):
     cfg.base.home = str(tmp_path / "home")
     cfg.consensus = make_test_config().consensus
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
     out = init_files(cfg)
     node = Node(cfg, load_genesis(cfg), out["pv"])
     node.start()
@@ -359,6 +361,7 @@ def test_node_no_empty_blocks_waits_for_txs(tmp_path):
     cfg.base.home = str(tmp_path / "home")
     cfg.consensus = make_test_config().consensus
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
     cfg.consensus.create_empty_blocks = False
     out = init_files(cfg)
     node = Node(cfg, load_genesis(cfg), out["pv"])
@@ -400,6 +403,7 @@ def test_node_with_socket_app_and_recheck(tmp_path):
         cfg.base.home = str(tmp_path / "home")
         cfg.consensus = make_test_config().consensus
         cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
         cfg.base.proxy_app = addr
         out = init_files(cfg)
         node = Node(cfg, load_genesis(cfg), out["pv"])

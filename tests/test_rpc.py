@@ -1,0 +1,295 @@
+"""RPC layer tests: HTTP JSON-RPC + URI routes + WebSocket subscriptions
+against a live single-validator node (reference analog: rpc/core tests +
+rpc/jsonrpc/server tests)."""
+
+import base64
+import dataclasses
+import hashlib
+import json
+import socket
+import struct
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.config import default_config
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.rpc import HTTPClient, RPCError
+
+from helpers import make_genesis
+
+_MS = 1_000_000
+
+
+def _cfg(home: str):
+    cfg = default_config()
+    cfg.base.home = home
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=400 * _MS,
+        timeout_prevote_ns=200 * _MS,
+        timeout_precommit_ns=200 * _MS,
+        timeout_commit_ns=150 * _MS,
+        skip_timeout_commit=False,
+        create_empty_blocks=True,
+    )
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    home = tmp_path_factory.mktemp("rpcnode")
+    cfg = _cfg(str(home))
+    init_files(cfg)
+    genesis, pvs = make_genesis(1)
+    n = Node(cfg, genesis, pvs[0])
+    n.start()
+    deadline = time.monotonic() + 20
+    while n.block_store.height() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert n.block_store.height() >= 2, "node failed to make blocks"
+    yield n
+    n.stop()
+
+
+@pytest.fixture(scope="module")
+def client(node):
+    return HTTPClient(node.rpc_server.bound_addr)
+
+
+class TestInfoRoutes:
+    def test_health(self, client):
+        assert client.health() == {}
+
+    def test_status(self, client, node):
+        st = client.status()
+        assert st["node_info"]["network"] == node.genesis.chain_id
+        assert int(st["sync_info"]["latest_block_height"]) >= 2
+        assert not st["sync_info"]["catching_up"]
+        assert st["validator_info"]["voting_power"] == "10"
+
+    def test_block_and_commit(self, client):
+        b = client.block(height="2")
+        assert b["block"]["header"]["height"] == "2"
+        assert b["block_id"]["hash"]
+        c = client.commit(height="2")
+        assert c["signed_header"]["header"]["height"] == "2"
+        assert c["signed_header"]["commit"]["signatures"]
+        # hash chain: commit 2's block id matches block 2's id
+        assert c["signed_header"]["commit"]["block_id"]["hash"] == (
+            b["block_id"]["hash"]
+        )
+
+    def test_block_by_hash(self, client):
+        b = client.block(height="2")
+        got = client.block_by_hash(hash=b["block_id"]["hash"])
+        assert got["block"]["header"]["height"] == "2"
+
+    def test_header_and_blockchain(self, client):
+        h = client.header(height="1")
+        assert h["header"]["height"] == "1"
+        bc = client.blockchain(min_height="1", max_height="2")
+        assert [m["header"]["height"] for m in bc["block_metas"]] == ["2", "1"]
+
+    def test_validators(self, client):
+        v = client.validators(height="1")
+        assert v["total"] == "1" and len(v["validators"]) == 1
+        assert v["validators"][0]["voting_power"] == "10"
+
+    def test_genesis(self, client, node):
+        g = client.genesis()
+        assert g["genesis"]["chain_id"] == node.genesis.chain_id
+
+    def test_consensus_routes(self, client):
+        cs = client.consensus_state()
+        assert "height/round/step" in cs["round_state"]
+        dump = client.dump_consensus_state()
+        assert "round_state" in dump
+        params = client.consensus_params()
+        assert int(params["consensus_params"]["block"]["max_bytes"]) > 0
+
+    def test_net_info(self, client):
+        ni = client.net_info()
+        assert ni["n_peers"] == "0"
+
+    def test_abci_info_and_query(self, client):
+        info = client.abci_info()
+        assert int(info["response"]["last_block_height"]) >= 1
+
+    def test_unknown_method(self, client):
+        with pytest.raises(RPCError):
+            client.call("definitely_not_a_route")
+
+    def test_invalid_height(self, client):
+        with pytest.raises(RPCError):
+            client.block(height="999999")
+
+
+class TestTxRoutes:
+    def test_broadcast_tx_commit_roundtrip(self, client):
+        tx = b"rpckey=rpcvalue"
+        res = client.broadcast_tx_commit(tx=base64.b64encode(tx).decode())
+        assert res["check_tx"]["code"] == 0
+        assert res["tx_result"]["code"] == 0
+        assert int(res["height"]) > 0
+        # the app now serves the key via abci_query
+        q = client.abci_query(path="", data=b"rpckey".hex())
+        assert base64.b64decode(q["response"]["value"]) == b"rpcvalue"
+
+    def test_broadcast_tx_sync_and_unconfirmed(self, client):
+        tx = b"synckey=syncvalue"
+        res = client.broadcast_tx_sync(tx=base64.b64encode(tx).decode())
+        assert res["code"] == 0 and res["hash"]
+        # duplicate is rejected by the cache
+        with pytest.raises(RPCError):
+            client.broadcast_tx_sync(tx=base64.b64encode(tx).decode())
+        n = client.num_unconfirmed_txs()
+        assert int(n["total"]) >= 0  # may already have been reaped
+
+    def test_check_tx(self, client):
+        res = client.check_tx(tx=base64.b64encode(b"k=v").decode())
+        assert res["code"] == 0
+
+
+class TestURIRoutes:
+    def test_get_status_and_block(self, node):
+        base = f"http://{node.rpc_server.bound_addr}"
+        with urllib.request.urlopen(base + "/status", timeout=5) as r:
+            st = json.loads(r.read())
+        assert int(st["result"]["sync_info"]["latest_block_height"]) >= 1
+        with urllib.request.urlopen(base + "/block?height=1", timeout=5) as r:
+            b = json.loads(r.read())
+        assert b["result"]["block"]["header"]["height"] == "1"
+        with urllib.request.urlopen(base + "/", timeout=5) as r:
+            idx = json.loads(r.read())
+        assert "status" in idx["routes"]
+
+
+class _WSClient:
+    """Minimal RFC 6455 client for tests."""
+
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)), timeout=10)
+        key = base64.b64encode(b"0123456789abcdef").decode()
+        req = (
+            f"GET /websocket HTTP/1.1\r\nHost: {addr}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self.sock.sendall(req.encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            buf += self.sock.recv(4096)
+        assert b"101" in buf.split(b"\r\n", 1)[0]
+
+    def send_json(self, payload):
+        data = json.dumps(payload).encode()
+        mask = b"\x11\x22\x33\x44"
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(data))
+        ln = len(data)
+        if ln < 126:
+            head = bytes([0x81, 0x80 | ln])
+        else:
+            head = bytes([0x81, 0x80 | 126]) + struct.pack(">H", ln)
+        self.sock.sendall(head + mask + masked)
+
+    def recv_json(self):
+        def read(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = self.sock.recv(n - len(buf))
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            return buf
+
+        h = read(2)
+        ln = h[1] & 0x7F
+        if ln == 126:
+            ln = struct.unpack(">H", read(2))[0]
+        elif ln == 127:
+            ln = struct.unpack(">Q", read(8))[0]
+        return json.loads(read(ln))
+
+    def close(self):
+        self.sock.close()
+
+
+class TestWebSocket:
+    def test_subscribe_new_block(self, node):
+        ws = _WSClient(node.rpc_server.bound_addr)
+        try:
+            ws.send_json(
+                {
+                    "jsonrpc": "2.0",
+                    "id": 1,
+                    "method": "subscribe",
+                    "params": {"query": "tm.event = 'NewBlock'"},
+                }
+            )
+            ack = ws.recv_json()
+            assert ack["id"] == 1 and ack["result"] == {}
+            ev = ws.recv_json()
+            data = ev["result"]["data"]
+            assert data["type"] == "tendermint/event/NewBlock"
+            assert int(data["value"]["block"]["header"]["height"]) > 0
+            # rpc methods also work over the socket
+            ws.send_json({"jsonrpc": "2.0", "id": 2, "method": "health",
+                          "params": {}})
+            # drain until we see the health response (block events interleave)
+            for _ in range(50):
+                msg = ws.recv_json()
+                if msg.get("id") == 2:
+                    assert msg["result"] == {}
+                    break
+            else:
+                pytest.fail("health response not received")
+            ws.send_json(
+                {
+                    "jsonrpc": "2.0",
+                    "id": 3,
+                    "method": "unsubscribe",
+                    "params": {"query": "tm.event = 'NewBlock'"},
+                }
+            )
+            for _ in range(50):
+                msg = ws.recv_json()
+                if msg.get("id") == 3:
+                    assert msg["result"] == {}
+                    break
+            else:
+                pytest.fail("unsubscribe ack not received")
+        finally:
+            ws.close()
+
+
+class TestLightOverRPC:
+    def test_light_client_via_rpc_provider(self, node):
+        """End-to-end: light client bisects against a live node's RPC."""
+        from cometbft_tpu import light
+        from cometbft_tpu.light.rpc_provider import RPCProvider
+
+        addr = node.rpc_server.bound_addr
+        chain_id = node.genesis.chain_id
+        provider = RPCProvider(addr, chain_id)
+        root = provider.light_block(1)
+        assert root.height == 1
+        client = light.Client(
+            chain_id=chain_id,
+            trust_options=light.TrustOptions(
+                period_ns=3_600_000_000_000, height=1, hash=root.hash()
+            ),
+            primary=provider,
+            witnesses=[RPCProvider(addr, chain_id)],
+        )
+        target = node.block_store.height() - 1
+        assert target >= 2
+        lb = client.verify_light_block_at_height(target)
+        assert lb.height == target
+        from cometbft_tpu.light import detector
+
+        assert detector.detect_divergence(client) == []
