@@ -231,6 +231,27 @@ class Node(BaseService):
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.node_info.channels = self.switch.channel_ids()
 
+        # 9b. Indexers (setup.go:141 createAndStartIndexerService)
+        from ..state.indexer import (
+            IndexerService,
+            KVBlockIndexer,
+            KVTxIndexer,
+        )
+
+        if config.tx_index.indexer == "kv":
+            self.indexer_db = _make_db(config, "tx_index")
+            self.tx_indexer = KVTxIndexer(self.indexer_db)
+            self.block_indexer = KVBlockIndexer(self.indexer_db)
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus
+            )
+            self.indexer_service.start()
+        else:
+            self.indexer_db = None
+            self.tx_indexer = None
+            self.block_indexer = None
+            self.indexer_service = None
+
         # 10. RPC environment + server (node.go:536 startRPC)
         from ..rpc import Environment, RPCServer
 
@@ -246,6 +267,8 @@ class Node(BaseService):
             event_bus=self.event_bus,
             genesis=genesis,
             node_info=self.node_info,
+            tx_indexer=self.tx_indexer,
+            block_indexer=self.block_indexer,
             priv_validator_pub_key=(
                 priv_validator.get_pub_key()
                 if priv_validator is not None
@@ -299,6 +322,11 @@ class Node(BaseService):
                 self.consensus.handle_txs_available()
 
     def on_stop(self) -> None:
+        if self.indexer_service is not None:
+            try:
+                self.indexer_service.stop()
+            except Exception:
+                pass
         if self.rpc_server is not None and self.rpc_server.is_running():
             try:
                 self.rpc_server.stop()
@@ -315,8 +343,11 @@ class Node(BaseService):
         except Exception:
             pass
         for db in (
-            self.app_db, self.block_db, self.state_db, self.evidence_db
+            self.app_db, self.block_db, self.state_db, self.evidence_db,
+            self.indexer_db,
         ):
+            if db is None:
+                continue
             try:
                 db.close()
             except Exception:

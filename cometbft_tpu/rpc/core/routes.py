@@ -536,7 +536,21 @@ def tx(env, hash=None, prove=False) -> dict:  # noqa: A002
     return _enc_tx_result(res, prove, env)
 
 
-def _enc_tx_result(res, prove, env) -> dict:
+def _page_window(page, per_page, total) -> tuple[int, int]:
+    page_n = _int(page, "page", 1) or 1
+    per = _int(per_page, "per_page", 30) or 30
+    if per < 1:
+        raise RPCError("per_page must be at least 1", code=-32602)
+    per = min(per, 100)
+    pages = max(1, (total + per - 1) // per)
+    if page_n < 1 or page_n > pages:
+        raise RPCError(
+            f"page should be within [1, {pages}] range", code=-32602
+        )
+    return (page_n - 1) * per, per
+
+
+def _enc_tx_result(res, prove, env, proof_cache=None) -> dict:
     out = {
         "hash": enc.hex_bytes(res.tx_hash),
         "height": str(res.height),
@@ -545,15 +559,21 @@ def _enc_tx_result(res, prove, env) -> dict:
         "tx": enc.b64(res.tx),
     }
     if prove:
-        blk = env.block_store.load_block(res.height)
-        if blk is not None:
+        cached = (proof_cache or {}).get(res.height)
+        if cached is None:
+            blk = env.block_store.load_block(res.height)
+            if blk is None:
+                return out
             from ...crypto import merkle
 
-            txs = list(blk.data.txs)
-            _, proofs = merkle.proofs_from_byte_slices(txs)
+            cached = merkle.proofs_from_byte_slices(list(blk.data.txs))
+            if proof_cache is not None:
+                proof_cache[res.height] = cached
+        root, proofs = cached
+        if True:
             pr = proofs[res.index]
             out["proof"] = {
-                "root_hash": enc.hex_bytes(pr.root_hash),
+                "root_hash": enc.hex_bytes(root),
                 "data": enc.b64(res.tx),
                 "proof": {
                     "total": str(pr.total),
@@ -574,12 +594,13 @@ def tx_search(env, query=None, prove=False, page=None, per_page=None,
     results = env.tx_indexer.search(query)
     if (order_by or "asc") == "desc":
         results = list(reversed(results))
-    page_n = _int(page, "page", 1) or 1
-    per = min(_int(per_page, "per_page", 30) or 30, 100)
-    start = (page_n - 1) * per
+    start, per = _page_window(page, per_page, len(results))
     subset = results[start : start + per]
+    proof_cache: dict = {}
     return {
-        "txs": [_enc_tx_result(r, prove, env) for r in subset],
+        "txs": [
+            _enc_tx_result(r, prove, env, proof_cache) for r in subset
+        ],
         "total_count": str(len(results)),
     }
 
@@ -592,9 +613,8 @@ def block_search(env, query=None, page=None, per_page=None, order_by=None) -> di
     heights = env.block_indexer.search(query)
     if (order_by or "asc") == "desc":
         heights = list(reversed(heights))
-    page_n = _int(page, "page", 1) or 1
-    per = min(_int(per_page, "per_page", 30) or 30, 100)
-    subset = heights[(page_n - 1) * per : (page_n - 1) * per + per]
+    start, per = _page_window(page, per_page, len(heights))
+    subset = heights[start : start + per]
     blocks = []
     for h in subset:
         m = env.block_store.load_block_meta(h)

@@ -135,10 +135,13 @@ class _Handler(BaseHTTPRequestHandler):
             k: v[0] if len(v) == 1 else v
             for k, v in urllib.parse.parse_qs(parsed.query).items()
         }
-        # URI params arrive quoted (height=1, hash="AB12", tx=0x... styles)
+        # URI params arrive quoted (height=1, hash="AB12", tx=0x... styles);
+        # booleans arrive as text and must not stay truthy strings
         for k, v in list(params.items()):
             if isinstance(v, str) and len(v) >= 2 and v[0] == v[-1] == '"':
-                params[k] = v[1:-1]
+                params[k] = v = v[1:-1]
+            if isinstance(v, str) and v.lower() in ("true", "false"):
+                params[k] = v.lower() == "true"
         try:
             self._send_json(
                 _rpc_response(-1, result=self._call(route, params))

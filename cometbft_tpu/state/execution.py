@@ -402,14 +402,15 @@ class BlockExecutor:
         self.event_bus.publish_new_block_header(
             EventDataNewBlockHeader(header=block.header)
         )
-        if resp.events:
-            self.event_bus.publish_new_block_events(
-                EventDataNewBlockEvents(
-                    height=block.header.height,
-                    events=list(resp.events),
-                    num_txs=len(block.data.txs),
-                )
+        # Unconditional (execution.go fireEvents): block.height must be
+        # searchable even when the app emitted no block-level events.
+        self.event_bus.publish_new_block_events(
+            EventDataNewBlockEvents(
+                height=block.header.height,
+                events=list(resp.events or []),
+                num_txs=len(block.data.txs),
             )
+        )
         for i, tx in enumerate(block.data.txs):
             self.event_bus.publish_tx(
                 EventDataTx(

@@ -1,0 +1,296 @@
+"""Tx + block event indexers over the KV store.
+
+Reference: state/txindex/kv/kv.go (tx indexer),
+state/indexer/block/kv/kv.go (block indexer), and
+state/txindex/indexer_service.go (the EventBus consumer that feeds both).
+
+Key scheme (height zero-padded so lexicographic = numeric order):
+
+  tx/h/<tx_hash>                                  -> serialized TxRecord
+  tx/e/<composite_key>/<value>/<height>/<index>   -> tx_hash
+  blk/e/<composite_key>/<value>/<height>          -> b""
+
+Searches use the SAME query language as the pubsub layer
+(libs/pubsub.Query) — ``tx.height = 5 AND transfer.amount > 100`` — by
+scanning the event keyspace per condition and intersecting result sets.
+Scan-based matching trades raw speed for zero bespoke query machinery;
+the hot path of this framework is signature verification, not index
+lookups, and range conditions still prune by key prefix when the
+condition is an equality.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field as dc_field
+
+from ..crypto import tmhash
+from ..libs import db as dbm
+from ..libs.db import prefix_end
+from ..libs.pubsub import Query
+from ..types import serialization as ser
+from ..types.event_bus import (
+    BLOCK_HEIGHT_KEY,
+    TX_HASH_KEY,
+    TX_HEIGHT_KEY,
+    flatten_abci_events,
+)
+
+_TX_HASH_PREFIX = b"tx/h/"
+_TX_EVENT_PREFIX = b"tx/e/"
+_BLK_EVENT_PREFIX = b"blk/e/"
+
+
+@dataclass
+class TxRecord:
+    """Indexed transaction result (abci.TxResult analog)."""
+
+    height: int
+    index: int
+    tx: bytes
+    result: object  # ExecTxResult
+    tx_hash: bytes = b""
+
+
+ser.codec.register(TxRecord)
+
+
+def _ek(prefix: bytes, key: str, value: str, height: int, index: int = -1) -> bytes:
+    out = prefix + key.encode() + b"/" + value.encode() + b"/%020d" % height
+    if index >= 0:
+        out += b"/%010d" % index
+    return out
+
+
+class KVTxIndexer:
+    """Event-key tx index (state/txindex/kv/kv.go:721)."""
+
+    def __init__(self, db: dbm.DB | None = None):
+        self.db = db if db is not None else dbm.MemDB()
+        self._mtx = threading.Lock()
+
+    def index(self, rec: TxRecord, events) -> None:
+        """Index one tx: by hash plus every (event key, value) pair."""
+        rec.tx_hash = rec.tx_hash or tmhash.sum(rec.tx)
+        with self._mtx:
+            batch = self.db.new_batch()
+            batch.set(_TX_HASH_PREFIX + rec.tx_hash, ser.dumps(rec))
+            flat = flatten_abci_events(
+                events,
+                {
+                    TX_HEIGHT_KEY: [str(rec.height)],
+                    TX_HASH_KEY: [rec.tx_hash.hex().upper()],
+                },
+            )
+            for key, values in flat.items():
+                if "/" in key:  # app-controlled key would corrupt the layout
+                    continue
+                for value in values:
+                    if "/" in value:
+                        continue
+                    batch.set(
+                        _ek(_TX_EVENT_PREFIX, key, value, rec.height, rec.index),
+                        rec.tx_hash,
+                    )
+            batch.write()
+
+    def get(self, tx_hash: bytes) -> TxRecord | None:
+        raw = self.db.get(_TX_HASH_PREFIX + bytes(tx_hash))
+        return ser.loads(raw) if raw else None
+
+    def search(self, query: str | Query) -> list[TxRecord]:
+        """All indexed txs matching every condition, height/index order."""
+        q = Query.parse(query) if isinstance(query, str) else query
+        hashes = _match_conditions(
+            self.db, q, _TX_EVENT_PREFIX, want_value=True
+        )
+        if hashes is None:  # unconstrained query: full scan by hash space
+            hashes = []
+            for _, v in self.db.iterator(
+                _TX_EVENT_PREFIX, prefix_end(_TX_EVENT_PREFIX)
+            ):
+                if v not in hashes:
+                    hashes.append(v)
+        out = []
+        seen = set()
+        for h in hashes:
+            if h in seen:
+                continue
+            seen.add(h)
+            rec = self.get(h)
+            if rec is not None:
+                out.append(rec)
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+
+class KVBlockIndexer:
+    """Block event index (state/indexer/block/kv/kv.go:609)."""
+
+    def __init__(self, db: dbm.DB | None = None):
+        self.db = db if db is not None else dbm.MemDB()
+        self._mtx = threading.Lock()
+
+    def index(self, height: int, events) -> None:
+        with self._mtx:
+            batch = self.db.new_batch()
+            flat = flatten_abci_events(
+                events, {BLOCK_HEIGHT_KEY: [str(height)]}
+            )
+            for key, values in flat.items():
+                if "/" in key:
+                    continue
+                for value in values:
+                    if "/" in value:
+                        continue
+                    batch.set(
+                        _ek(_BLK_EVENT_PREFIX, key, value, height), b""
+                    )
+            batch.write()
+
+    def search(self, query: str | Query) -> list[int]:
+        """Heights whose block events match every condition, ascending."""
+        q = Query.parse(query) if isinstance(query, str) else query
+        heights = _match_conditions(
+            self.db, q, _BLK_EVENT_PREFIX, want_value=False
+        )
+        if heights is None:
+            heights = []
+            for k, _ in self.db.iterator(
+                _BLK_EVENT_PREFIX, prefix_end(_BLK_EVENT_PREFIX)
+            ):
+                h = int(k.rsplit(b"/", 1)[-1])
+                if h not in heights:
+                    heights.append(h)
+        return sorted(set(heights))
+
+
+def _match_conditions(db, q: Query, prefix: bytes, want_value: bool):
+    """Intersect per-condition matches. Returns None when the query has no
+    usable conditions (caller falls back to a full scan)."""
+    result = None
+    for cond in q.conditions:
+        matches = _match_one(db, cond, prefix, want_value)
+        if result is None:
+            result = matches
+        else:
+            keep = set(matches)
+            result = [m for m in result if m in keep]
+        if not result:
+            return []
+    return result
+
+
+def _match_one(db, cond, prefix: bytes, want_value: bool):
+    """One condition scan. Equality prunes by exact key prefix; range ops
+    scan the composite key's whole value space and compare."""
+    base = prefix + cond.key.encode() + b"/"
+    out = []
+    if cond.op == "=":
+        # Prefix-prune on the canonical rendering. The indexer writes
+        # integers as str(int) (heights, indexes), so "tx.height = 5"
+        # resolves with one exact-prefix scan instead of walking every tx
+        # ever indexed. Non-canonical numeric renderings ("5.0", "05")
+        # fall back to the full comparator scan below.
+        value = cond.value
+        if cond.is_number and float(value) == int(float(value)):
+            value = int(float(value))
+        scan_from = base + str(value).encode() + b"/"
+        for k, v in db.iterator(scan_from, prefix_end(scan_from)):
+            out.append(v if want_value else int(_height_of(k, want_value)))
+        if out or not cond.is_number:
+            return out
+        out = []
+    # range / CONTAINS / EXISTS: scan all values under the key
+    for k, v in db.iterator(base, prefix_end(base)):
+        rest = k[len(base):]
+        value = rest.rsplit(b"/", 2 if want_value else 1)[0].decode()
+        if cond.matches_values([value]):
+            out.append(v if want_value else int(_height_of(k, want_value)))
+    return out
+
+
+def _height_of(key: bytes, has_index: bool) -> bytes:
+    parts = key.rsplit(b"/", 2 if has_index else 1)
+    return parts[1] if has_index else parts[-1]
+
+
+class IndexerService:
+    """EventBus consumer feeding both indexers
+    (state/txindex/indexer_service.go)."""
+
+    def __init__(self, tx_indexer, block_indexer, event_bus):
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._warned_types: set[str] = set()
+
+    def start(self) -> None:
+        from ..libs import pubsub
+        from ..types.event_bus import (
+            EVENT_NEW_BLOCK_EVENTS,
+            EVENT_TX,
+            EVENT_TYPE_KEY,
+        )
+
+        tx_q = pubsub.Query.parse(f"{EVENT_TYPE_KEY} = '{EVENT_TX}'")
+        blk_q = pubsub.Query.parse(
+            f"{EVENT_TYPE_KEY} = '{EVENT_NEW_BLOCK_EVENTS}'"
+        )
+        # Unbounded (capacity=0 -> Queue(0)): a bounded queue would trip the
+        # pubsub slow-subscriber policy on a publish burst (a >N-tx block)
+        # and silently cancel indexing forever — the reference uses
+        # SubscribeUnbuffered for exactly this consumer.
+        tx_sub = self.event_bus.subscribe("indexer-tx", tx_q, capacity=0)
+        blk_sub = self.event_bus.subscribe("indexer-blk", blk_q, capacity=0)
+        for sub, fn in ((tx_sub, self._on_tx), (blk_sub, self._on_block)):
+            t = threading.Thread(
+                target=self._consume, args=(sub, fn), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _consume(self, sub, fn) -> None:
+        import queue as _q
+
+        while not self._stop.is_set() and not sub.canceled.is_set():
+            try:
+                msg = sub.out.get(timeout=0.2)
+            except _q.Empty:
+                continue
+            try:
+                fn(msg.data)
+            except Exception as e:
+                # indexing must never kill the node, but silent data loss
+                # is undiagnosable: surface once per failure type
+                if type(e).__name__ not in self._warned_types:
+                    self._warned_types.add(type(e).__name__)
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _on_tx(self, data) -> None:  # EventDataTx
+        self.tx_indexer.index(
+            TxRecord(
+                height=data.height,
+                index=data.index,
+                tx=data.tx,
+                result=data.result,
+            ),
+            getattr(data.result, "events", None),
+        )
+
+    def _on_block(self, data) -> None:  # EventDataNewBlockEvents
+        self.block_indexer.index(data.height, data.events)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for sub_name in ("indexer-tx", "indexer-blk"):
+            try:
+                self.event_bus.unsubscribe_all(sub_name)
+            except Exception:
+                pass
+        for t in self._threads:
+            t.join(timeout=1)

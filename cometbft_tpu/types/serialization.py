@@ -69,6 +69,10 @@ codec.register(
     LightBlock,
 )
 
+from ..abci.types import Event, EventAttribute, ExecTxResult  # noqa: E402
+
+codec.register(Event, EventAttribute, ExecTxResult)
+
 codec.register_adapter(
     keys.Ed25519PubKey,
     "ed25519.pub",

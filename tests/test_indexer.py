@@ -1,0 +1,85 @@
+"""KV tx/block indexer unit tests (reference analog:
+state/txindex/kv/kv_test.go, state/indexer/block/kv/kv_test.go)."""
+
+from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+from cometbft_tpu.crypto import tmhash
+from cometbft_tpu.state.indexer import KVBlockIndexer, KVTxIndexer, TxRecord
+
+
+def _rec(height, index, tx):
+    return TxRecord(
+        height=height, index=index, tx=tx, result=ExecTxResult(code=0)
+    )
+
+
+def _ev(type_, **attrs):
+    return Event(
+        type=type_,
+        attributes=[
+            EventAttribute(key=k, value=v, index=True)
+            for k, v in attrs.items()
+        ],
+    )
+
+
+class TestTxIndexer:
+    def test_get_by_hash(self):
+        idx = KVTxIndexer()
+        idx.index(_rec(1, 0, b"tx-a"), [])
+        got = idx.get(tmhash.sum(b"tx-a"))
+        assert got is not None and got.tx == b"tx-a" and got.height == 1
+        assert idx.get(tmhash.sum(b"missing")) is None
+
+    def test_search_by_event_attrs(self):
+        idx = KVTxIndexer()
+        idx.index(
+            _rec(1, 0, b"t1"), [_ev("transfer", sender="alice", amount="100")]
+        )
+        idx.index(
+            _rec(2, 0, b"t2"), [_ev("transfer", sender="bob", amount="250")]
+        )
+        idx.index(
+            _rec(2, 1, b"t3"), [_ev("transfer", sender="alice", amount="7")]
+        )
+        alice = idx.search("transfer.sender = 'alice'")
+        assert [r.tx for r in alice] == [b"t1", b"t3"]
+        # AND intersects conditions
+        rich_alice = idx.search(
+            "transfer.sender = 'alice' AND transfer.amount > 50"
+        )
+        assert [r.tx for r in rich_alice] == [b"t1"]
+        # numeric range over heights
+        h2 = idx.search("tx.height = 2")
+        assert sorted(r.tx for r in h2) == [b"t2", b"t3"]
+        assert idx.search("transfer.sender = 'carol'") == []
+
+    def test_search_orders_by_height_then_index(self):
+        idx = KVTxIndexer()
+        idx.index(_rec(5, 1, b"late"), [_ev("k", v="x")])
+        idx.index(_rec(5, 0, b"early"), [_ev("k", v="x")])
+        idx.index(_rec(2, 0, b"first"), [_ev("k", v="x")])
+        assert [r.tx for r in idx.search("k.v = 'x'")] == [
+            b"first", b"early", b"late",
+        ]
+
+    def test_contains_and_exists(self):
+        idx = KVTxIndexer()
+        idx.index(_rec(1, 0, b"m1"), [_ev("wasm", action="mint_token")])
+        idx.index(_rec(1, 1, b"m2"), [_ev("wasm", action="burn")])
+        got = idx.search("wasm.action CONTAINS 'mint'")
+        assert [r.tx for r in got] == [b"m1"]
+        both = idx.search("wasm.action EXISTS")
+        assert len(both) == 2
+
+
+class TestBlockIndexer:
+    def test_height_and_event_search(self):
+        idx = KVBlockIndexer()
+        idx.index(1, [])
+        idx.index(2, [_ev("reward", validator="v1")])
+        idx.index(3, [_ev("reward", validator="v2")])
+        assert idx.search("block.height >= 2") == [2, 3]
+        assert idx.search("reward.validator = 'v1'") == [2]
+        assert idx.search(
+            "block.height <= 3 AND reward.validator = 'v2'"
+        ) == [3]

@@ -293,3 +293,39 @@ class TestLightOverRPC:
         from cometbft_tpu.light import detector
 
         assert detector.detect_divergence(client) == []
+
+
+class TestIndexerRoutes:
+    def test_tx_and_search(self, client, node):
+        tx = b"idxkey=idxvalue"
+        res = client.broadcast_tx_commit(tx=base64.b64encode(tx).decode())
+        tx_hash = res["hash"]
+        height = res["height"]
+        # indexing is async off the event bus: allow it a moment
+        deadline = time.monotonic() + 5
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = client.tx(hash=tx_hash)
+                break
+            except RPCError:
+                time.sleep(0.05)
+        assert got is not None, "tx never indexed"
+        assert got["hash"] == tx_hash
+        assert got["height"] == height
+        assert base64.b64decode(got["tx"]) == tx
+
+        # search by hash and by height through the pubsub query language
+        by_hash = client.tx_search(query=f"tx.hash = '{tx_hash}'")
+        assert by_hash["total_count"] == "1"
+        by_height = client.tx_search(query=f"tx.height = {height}")
+        assert any(r["hash"] == tx_hash for r in by_height["txs"])
+
+        # proof round-trips against the block's data hash
+        proved = client.tx(hash=tx_hash, prove=True)
+        assert proved["proof"]["root_hash"]
+
+    def test_block_search(self, client):
+        res = client.block_search(query="block.height >= 1")
+        assert int(res["total_count"]) >= 1
+        assert res["blocks"][0]["block"]["header"]["height"]
