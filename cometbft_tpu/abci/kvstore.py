@@ -25,12 +25,18 @@ VALIDATOR_TX_PREFIX = b"val:"
 
 
 class KVStoreApplication(BaseApplication):
-    def __init__(self, db: dbm.DB | None = None):
+    def __init__(self, db: dbm.DB | None = None, snapshot_interval: int = 5):
         self.db = db if db is not None else dbm.MemDB()
         self._mtx = threading.Lock()
         self._staged: dict[bytes, bytes] = {}
         self._val_updates: list[abci.ValidatorUpdate] = []
         self._validators: dict[str, int] = {}  # pubkey hex -> power
+        # Point-in-time snapshots taken at commit every snapshot_interval
+        # heights (reference: test/e2e/app snapshots). A LIVE dump would
+        # race block production: the chunk served later must match the
+        # app hash advertised for that height exactly.
+        self.snapshot_interval = snapshot_interval
+        self._snapshots: dict[int, tuple[bytes, bytes]] = {}  # h -> (hash, blob)
         raw = self.db.get(_STATE_KEY)
         if raw:
             st = json.loads(raw)
@@ -198,48 +204,73 @@ class KVStoreApplication(BaseApplication):
             self._stage_state(batch)
             batch.write()
             self._staged = {}
+            if (
+                self.snapshot_interval > 0
+                and self.height % self.snapshot_interval == 0
+            ):
+                self._snapshots[self.height] = (
+                    self.app_hash,
+                    self._dump_state_blob(),
+                )
+                for h in sorted(self._snapshots)[:-2]:
+                    del self._snapshots[h]  # keep the 2 most recent
             retain = self.height - 500 if self.height > 500 else 0
             return abci.ResponseCommit(retain_height=max(retain, 0))
 
     # -- Snapshots (whole state in one chunk) ------------------------------
 
+    def _dump_state_blob(self) -> bytes:
+        kvs = {
+            k[len(_KV_PREFIX) :].hex(): v.hex()
+            for k, v in self.db.iterator(
+                _KV_PREFIX, dbm.prefix_end(_KV_PREFIX)
+            )
+        }
+        return json.dumps(
+            {
+                "height": self.height,
+                "size": self.size,
+                "validators": self._validators,
+                "kvs": kvs,
+            }
+        ).encode()
+
     def list_snapshots(self, req):
         with self._mtx:
-            if self.height == 0:
-                return abci.ResponseListSnapshots()
             return abci.ResponseListSnapshots(
                 snapshots=[
                     abci.Snapshot(
-                        height=self.height,
-                        format=1,
-                        chunks=1,
-                        hash=self.app_hash,
+                        height=h, format=1, chunks=1, hash=hash_
                     )
+                    for h, (hash_, _) in sorted(self._snapshots.items())
                 ]
             )
 
     def load_snapshot_chunk(self, req):
         with self._mtx:
-            kvs = {
-                k[len(_KV_PREFIX) :].hex(): v.hex()
-                for k, v in self.db.iterator(
-                    _KV_PREFIX, dbm.prefix_end(_KV_PREFIX)
-                )
-            }
-            blob = json.dumps(
-                {
-                    "height": self.height,
-                    "size": self.size,
-                    "validators": self._validators,
-                    "kvs": kvs,
-                }
-            ).encode()
-            return abci.ResponseLoadSnapshotChunk(chunk=blob)
+            snap = self._snapshots.get(req.height)
+            if snap is None:
+                return abci.ResponseLoadSnapshotChunk(chunk=b"")
+            return abci.ResponseLoadSnapshotChunk(chunk=snap[1])
 
     def offer_snapshot(self, req):
-        if req.snapshot.format != 1 or req.snapshot.chunks != 1:
+        if req.snapshot.format != 1:
             return abci.ResponseOfferSnapshot(
                 result=abci.OfferSnapshotResult.REJECT_FORMAT
+            )
+        # Wrong chunk count is THIS snapshot's defect, not the format's: a
+        # bogus advertisement must not poison every valid format-1 snapshot
+        # via the pool's reject_format.
+        if req.snapshot.chunks != 1:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OfferSnapshotResult.REJECT
+            )
+        # This app's snapshot hash IS its app hash: verify against the
+        # light-client-trusted value the engine passes us (the app-side
+        # check the ABCI contract prescribes).
+        if req.app_hash and req.snapshot.hash != req.app_hash:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OfferSnapshotResult.REJECT
             )
         self._restore_target = req.snapshot
         return abci.ResponseOfferSnapshot(

@@ -70,6 +70,24 @@ class BlocksyncReactor(Reactor):
                 target=self._pool_routine, name="blocksync-pool", daemon=True
             ).start()
 
+    def switch_to_block_sync(self, state) -> None:
+        """Statesync finished: start block-syncing FROM the restored state
+        (reactor.go SwitchToBlockSync). Rebuilds the pool at the restored
+        height — the one chosen at construction assumed genesis."""
+        self.state = state
+        self.block_sync = True
+        self.synced.clear()
+        self.pool = BlockPool(
+            state.last_block_height + 1,
+            send_request=self._send_block_request,
+            on_peer_error=self._on_pool_peer_error,
+        )
+        # re-announce status so peers learn we now need blocks
+        self._broadcast_status_request()
+        threading.Thread(
+            target=self._pool_routine, name="blocksync-pool", daemon=True
+        ).start()
+
     # -- peer lifecycle ----------------------------------------------------
 
     def add_peer(self, peer) -> None:

@@ -190,17 +190,27 @@ class Node(BaseService):
                 bytes(priv_validator.get_pub_key().address())
             )
         )
+        # Statesync only makes sense for an empty node (node.go:377).
+        self.statesync_enabled = (
+            config.statesync.enable and state.last_block_height == 0
+        )
         run_blocksync = config.base.block_sync and not only_us
         self.consensus_reactor = ConsensusReactor(
-            self.consensus, wait_sync=run_blocksync
+            self.consensus, wait_sync=run_blocksync or self.statesync_enabled
         )
         self.blocksync_reactor = BlocksyncReactor(
             state,
             self.block_exec,
             self.block_store,
-            run_blocksync,
+            # during statesync, blocksync stays parked until the snapshot
+            # restore hands it a state (switch_to_block_sync)
+            run_blocksync and not self.statesync_enabled,
             consensus_reactor=self.consensus_reactor,
         )
+        if self.statesync_enabled:
+            # parked-for-statesync is NOT synced: the constructor pre-sets
+            # the event for plain non-blocksync nodes only
+            self.blocksync_reactor.synced.clear()
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
         self.node_info = NodeInfo(
             node_id=self.node_key.node_id,
@@ -225,10 +235,29 @@ class Node(BaseService):
             max_outbound=config.p2p.max_num_outbound_peers,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+        # 9c. Statesync reactor: every node serves snapshots; a syncing
+        # node also runs the Syncer (setup.go:476 startStateSync)
+        from ..statesync import StatesyncReactor, Syncer
+
+        self.statesync_reactor = StatesyncReactor(self.proxy_app.snapshot)
+        self.syncer = None
+        if self.statesync_enabled:
+            sp = self._make_state_provider()
+            self.syncer = Syncer(
+                self.proxy_app.snapshot,
+                self.proxy_app.query,
+                sp,
+                self.statesync_reactor.request_chunk,
+                chunk_timeout=config.statesync.chunk_request_timeout_ns / 1e9,
+                discovery_time=config.statesync.discovery_time_ns / 1e9,
+            )
+            self.statesync_reactor.syncer = self.syncer
+
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
         self.node_info.channels = self.switch.channel_ids()
 
         # 9b. Indexers (setup.go:141 createAndStartIndexerService)
@@ -282,6 +311,68 @@ class Node(BaseService):
             else None
         )
 
+    def _make_state_provider(self):
+        """Light-client state provider from config.state_sync
+        (stateprovider.go:29: needs witnesses, so >=2 RPC servers)."""
+        from ..light import TrustOptions
+        from ..light.rpc_provider import RPCProvider
+        from ..statesync import StateProvider
+
+        ss = self.config.statesync
+        if not ss.rpc_servers:
+            raise ValueError("statesync requires state_sync.rpc_servers")
+        providers = [
+            RPCProvider(addr, self.genesis.chain_id)
+            for addr in ss.rpc_servers
+        ]
+        return StateProvider(
+            self.genesis.chain_id,
+            self.genesis,
+            providers,
+            TrustOptions(
+                period_ns=ss.trust_period_ns,
+                height=ss.trust_height,
+                hash=bytes.fromhex(ss.trust_hash),
+            ),
+            initial_height=self.genesis.initial_height,
+        )
+
+    def _statesync_routine(self) -> None:
+        """Background restore; on success bootstrap stores and hand off to
+        blocksync (node.go startStateSync + statesync completion path)."""
+        try:
+            state, commit = self.syncer.sync_any(deadline=120.0)
+        except Exception:
+            # Any failure path (SyncError, light-client errors, RPC down)
+            # must not leave the node parked forever...
+            import traceback
+
+            traceback.print_exc()
+            if self.syncer.applied_any:
+                # ...but once ANY chunk was applied the app is no longer at
+                # genesis: block-syncing from height 1 would replay against
+                # mutated app state and fork on the first app hash.
+                # Fail-stop like the reference (syncer.go verifyApp panic).
+                import sys
+
+                print(
+                    "statesync failed after chunks were applied; "
+                    "the data dir needs a reset — stopping node",
+                    file=sys.stderr,
+                )
+                try:
+                    self.stop()
+                except Exception:
+                    pass
+                return
+            # nothing applied: safe to block-sync the chain from genesis
+            self.blocksync_reactor.switch_to_block_sync(self.state)
+            return
+        self.state_store.bootstrap(state)
+        self.block_store.save_seen_commit(commit)
+        self.state = state
+        self.blocksync_reactor.switch_to_block_sync(state)
+
     def _on_app_error(self, err: Exception) -> None:
         # Fail-stop: the app is the source of truth (multi_app_conn.go:129).
         if self.is_running():
@@ -308,6 +399,10 @@ class Node(BaseService):
         if persistent:
             self.switch.set_persistent_peers(persistent)
             self.switch.dial_peers_async(persistent)
+        if self.statesync_enabled:
+            threading.Thread(
+                target=self._statesync_routine, name="statesync", daemon=True
+            ).start()
         if self.mempool.txs_available() is not None:
             self._txs_available_thread = threading.Thread(
                 target=self._forward_txs_available, daemon=True

@@ -570,18 +570,17 @@ def _enc_tx_result(res, prove, env, proof_cache=None) -> dict:
             if proof_cache is not None:
                 proof_cache[res.height] = cached
         root, proofs = cached
-        if True:
-            pr = proofs[res.index]
-            out["proof"] = {
-                "root_hash": enc.hex_bytes(root),
-                "data": enc.b64(res.tx),
-                "proof": {
-                    "total": str(pr.total),
-                    "index": str(pr.index),
-                    "leaf_hash": enc.b64(pr.leaf_hash),
-                    "aunts": [enc.b64(a) for a in pr.aunts],
-                },
-            }
+        pr = proofs[res.index]
+        out["proof"] = {
+            "root_hash": enc.hex_bytes(root),
+            "data": enc.b64(res.tx),
+            "proof": {
+                "total": str(pr.total),
+                "index": str(pr.index),
+                "leaf_hash": enc.b64(pr.leaf_hash),
+                "aunts": [enc.b64(a) for a in pr.aunts],
+            },
+        }
     return out
 
 

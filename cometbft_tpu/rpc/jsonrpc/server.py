@@ -136,11 +136,12 @@ class _Handler(BaseHTTPRequestHandler):
             for k, v in urllib.parse.parse_qs(parsed.query).items()
         }
         # URI params arrive quoted (height=1, hash="AB12", tx=0x... styles);
-        # booleans arrive as text and must not stay truthy strings
+        # bare booleans arrive as text and must not stay truthy strings —
+        # but QUOTED values are explicitly strings ("true" stays "true")
         for k, v in list(params.items()):
             if isinstance(v, str) and len(v) >= 2 and v[0] == v[-1] == '"':
-                params[k] = v = v[1:-1]
-            if isinstance(v, str) and v.lower() in ("true", "false"):
+                params[k] = v[1:-1]
+            elif isinstance(v, str) and v.lower() in ("true", "false"):
                 params[k] = v.lower() == "true"
         try:
             self._send_json(

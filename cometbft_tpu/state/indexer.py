@@ -106,10 +106,12 @@ class KVTxIndexer:
         )
         if hashes is None:  # unconstrained query: full scan by hash space
             hashes = []
+            scanned = set()
             for _, v in self.db.iterator(
                 _TX_EVENT_PREFIX, prefix_end(_TX_EVENT_PREFIX)
             ):
-                if v not in hashes:
+                if v not in scanned:
+                    scanned.add(v)
                     hashes.append(v)
         out = []
         seen = set()

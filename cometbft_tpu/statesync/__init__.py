@@ -1,0 +1,35 @@
+"""Statesync: snapshot bootstrap of fresh nodes over channels 0x60/0x61.
+
+Reference: /root/reference/statesync/ (syncer, reactor, chunks, snapshots,
+stateprovider).
+"""
+
+from .chunks import ChunkQueue
+from .messages import CHUNK_CHANNEL, SNAPSHOT_CHANNEL
+from .reactor import StatesyncReactor
+from .snapshots import Snapshot, SnapshotPool
+from .stateprovider import StateProvider
+from .syncer import (
+    AbortError,
+    AppHashMismatchError,
+    RejectFormatError,
+    RejectSnapshotError,
+    SyncError,
+    Syncer,
+)
+
+__all__ = [
+    "AbortError",
+    "AppHashMismatchError",
+    "ChunkQueue",
+    "CHUNK_CHANNEL",
+    "RejectFormatError",
+    "RejectSnapshotError",
+    "SNAPSHOT_CHANNEL",
+    "Snapshot",
+    "SnapshotPool",
+    "StateProvider",
+    "StatesyncReactor",
+    "SyncError",
+    "Syncer",
+]

@@ -1,0 +1,75 @@
+"""Chunk queue for one snapshot restore (statesync/chunks.go).
+
+Chunks arrive out of order from multiple peers; the applier consumes them
+strictly in index order. Bounded in memory (chunks are app-defined blobs;
+the reference spools to a temp dir — here the queue holds at most
+``chunks`` entries of one snapshot, the kvstore-scale case, and can be
+swapped for file spooling transparently behind put/next)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class ChunkQueue:
+    def __init__(self, n_chunks: int):
+        self.n_chunks = n_chunks
+        self._mtx = threading.Condition()
+        self._chunks: dict[int, tuple[bytes, str]] = {}  # index -> (blob, peer)
+        self._next = 0
+        self._closed = False
+        self._returned: set[int] = set()
+
+    def put(self, index: int, chunk: bytes, peer_id: str) -> bool:
+        """Store a fetched chunk; True if newly added."""
+        with self._mtx:
+            if self._closed or index >= self.n_chunks or index < self._next:
+                return False
+            if index in self._chunks:
+                return False
+            self._chunks[index] = (chunk, peer_id)
+            self._mtx.notify_all()
+            return True
+
+    def next(self, timeout: float | None = None):
+        """Blocking in-order consume: (index, chunk, peer_id) or None on
+        close/timeout."""
+        with self._mtx:
+            if not self._mtx.wait_for(
+                lambda: self._closed or self._next in self._chunks,
+                timeout=timeout,
+            ):
+                return None
+            if self._closed:
+                return None
+            idx = self._next
+            chunk, peer = self._chunks.pop(idx)
+            self._next += 1
+            return idx, chunk, peer
+
+    def retry(self, index: int) -> None:
+        """Re-request from ``index`` on (refetch semantics of
+        ApplySnapshotChunkResult.RETRY / refetch_chunks)."""
+        with self._mtx:
+            self._next = min(self._next, index)
+            for i in list(self._chunks):
+                if i >= index:
+                    del self._chunks[i]
+
+    def pending(self) -> list[int]:
+        """Indexes not yet stored nor consumed (fetch targets)."""
+        with self._mtx:
+            return [
+                i
+                for i in range(self._next, self.n_chunks)
+                if i not in self._chunks
+            ]
+
+    def done(self) -> bool:
+        with self._mtx:
+            return self._next >= self.n_chunks
+
+    def close(self) -> None:
+        with self._mtx:
+            self._closed = True
+            self._mtx.notify_all()

@@ -1,0 +1,49 @@
+"""Statesync wire messages, channels 0x60/0x61 (statesync/reactor.go:21-23,
+proto/tendermint/statesync)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..types import serialization as ser
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+
+@dataclass(slots=True)
+class SnapshotsRequestMessage:
+    pass
+
+
+@dataclass(slots=True)
+class SnapshotsResponseMessage:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+
+@dataclass(slots=True)
+class ChunkRequestMessage:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+
+
+@dataclass(slots=True)
+class ChunkResponseMessage:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+    chunk: bytes = b""
+    missing: bool = False
+
+
+ser.codec.register(
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+)

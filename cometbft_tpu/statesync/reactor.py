@@ -1,0 +1,144 @@
+"""Statesync p2p reactor: snapshot/chunk channels 0x60/0x61.
+
+Reference: statesync/reactor.go. Two roles:
+
+* server — every node answers SnapshotsRequest from the app's
+  ListSnapshots and ChunkRequest from LoadSnapshotChunk (capped sizes);
+* client — a statesyncing node broadcasts SnapshotsRequest on peer add
+  and forwards responses into its Syncer.
+"""
+
+from __future__ import annotations
+
+from ..abci import types as abci
+from ..p2p.base_reactor import ChannelDescriptor, Reactor
+from ..types import serialization as ser
+from .messages import (
+    CHUNK_CHANNEL,
+    SNAPSHOT_CHANNEL,
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+)
+from .snapshots import Snapshot
+
+_MAX_SNAPSHOTS_ADVERTISED = 10  # reactor.go recentSnapshots
+
+
+class StatesyncReactor(Reactor):
+    def __init__(self, proxy_snapshot, syncer=None):
+        super().__init__("statesync-reactor")
+        self.proxy_snapshot = proxy_snapshot
+        self.syncer = syncer  # None on nodes that aren't statesyncing
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=SNAPSHOT_CHANNEL,
+                priority=5,
+                send_queue_capacity=10,
+                recv_message_capacity=4 << 20,
+            ),
+            ChannelDescriptor(
+                id=CHUNK_CHANNEL,
+                priority=3,
+                send_queue_capacity=4,
+                recv_message_capacity=16 << 20,
+            ),
+        ]
+
+    def add_peer(self, peer) -> None:
+        if self.syncer is not None:
+            peer.try_send(
+                SNAPSHOT_CHANNEL, ser.dumps(SnapshotsRequestMessage())
+            )
+
+    def remove_peer(self, peer, reason) -> None:
+        if self.syncer is not None:
+            self.syncer.remove_peer(peer.id)
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        msg = ser.loads(msg_bytes)
+        if ch_id == SNAPSHOT_CHANNEL:
+            self._receive_snapshot(peer, msg)
+        elif ch_id == CHUNK_CHANNEL:
+            self._receive_chunk(peer, msg)
+
+    # -- snapshot channel ----------------------------------------------------
+
+    def _receive_snapshot(self, peer, msg) -> None:
+        if isinstance(msg, SnapshotsRequestMessage):
+            res = self.proxy_snapshot.list_snapshots(
+                abci.RequestListSnapshots()
+            )
+            for s in (res.snapshots or [])[:_MAX_SNAPSHOTS_ADVERTISED]:
+                peer.try_send(
+                    SNAPSHOT_CHANNEL,
+                    ser.dumps(
+                        SnapshotsResponseMessage(
+                            height=s.height,
+                            format=s.format,
+                            chunks=s.chunks,
+                            hash=s.hash,
+                            metadata=s.metadata,
+                        )
+                    ),
+                )
+        elif isinstance(msg, SnapshotsResponseMessage):
+            if self.syncer is not None:
+                self.syncer.add_snapshot(
+                    Snapshot(
+                        height=msg.height,
+                        format=msg.format,
+                        chunks=msg.chunks,
+                        hash=msg.hash,
+                        metadata=msg.metadata,
+                    ),
+                    peer.id,
+                )
+
+    # -- chunk channel ---------------------------------------------------------
+
+    def _receive_chunk(self, peer, msg) -> None:
+        if isinstance(msg, ChunkRequestMessage):
+            res = self.proxy_snapshot.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=msg.height, format=msg.format, chunk=msg.index
+                )
+            )
+            peer.try_send(
+                CHUNK_CHANNEL,
+                ser.dumps(
+                    ChunkResponseMessage(
+                        height=msg.height,
+                        format=msg.format,
+                        index=msg.index,
+                        chunk=res.chunk or b"",
+                        missing=not res.chunk,
+                    )
+                ),
+            )
+        elif isinstance(msg, ChunkResponseMessage):
+            if self.syncer is not None and not msg.missing:
+                self.syncer.add_chunk(
+                    msg.height, msg.format, msg.index, msg.chunk, peer.id
+                )
+
+    # -- outgoing chunk requests (used by the Syncer) -------------------------
+
+    def request_chunk(self, peer_id: str, snapshot, index: int) -> None:
+        if self.switch is None:
+            return
+        peer = self.switch.get_peer(peer_id)
+        if peer is not None:
+            peer.try_send(
+                CHUNK_CHANNEL,
+                ser.dumps(
+                    ChunkRequestMessage(
+                        height=snapshot.height,
+                        format=snapshot.format,
+                        index=index,
+                    )
+                ),
+            )

@@ -1,0 +1,77 @@
+"""Snapshot pool: deduped peer-advertised snapshots ranked for offering.
+
+Reference: statesync/snapshots.go — snapshots keyed by
+(height, format, chunks, hash); tracks which peers can serve each so
+chunk fetches spread across providers and peer failures prune cleanly.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+    def key(self) -> tuple:
+        return (self.height, self.format, self.chunks, self.hash)
+
+
+class SnapshotPool:
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._snapshots: dict[tuple, Snapshot] = {}
+        self._peers: dict[tuple, set[str]] = {}
+        self._rejected: set[tuple] = set()
+
+    def add(self, snapshot: Snapshot, peer_id: str) -> bool:
+        """Returns True if the snapshot is new."""
+        with self._mtx:
+            key = snapshot.key()
+            if key in self._rejected:
+                return False
+            new = key not in self._snapshots
+            self._snapshots[key] = snapshot
+            self._peers.setdefault(key, set()).add(peer_id)
+            return new
+
+    def best(self) -> Snapshot | None:
+        """Highest height first, then newest format (snapshots.go Best)."""
+        with self._mtx:
+            if not self._snapshots:
+                return None
+            return max(
+                self._snapshots.values(), key=lambda s: (s.height, s.format)
+            )
+
+    def peers_of(self, snapshot: Snapshot) -> list[str]:
+        with self._mtx:
+            return sorted(self._peers.get(snapshot.key(), ()))
+
+    def reject(self, snapshot: Snapshot) -> None:
+        with self._mtx:
+            key = snapshot.key()
+            self._rejected.add(key)
+            self._snapshots.pop(key, None)
+            self._peers.pop(key, None)
+
+    def reject_format(self, fmt: int) -> None:
+        with self._mtx:
+            for key in [k for k, s in self._snapshots.items() if s.format == fmt]:
+                self._rejected.add(key)
+                self._snapshots.pop(key)
+                self._peers.pop(key, None)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            for key in list(self._peers):
+                self._peers[key].discard(peer_id)
+                if not self._peers[key]:
+                    del self._peers[key]
+                    self._snapshots.pop(key, None)

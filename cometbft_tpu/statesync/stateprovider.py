@@ -1,0 +1,79 @@
+"""Trusted state provider for statesync (statesync/stateprovider.go:29-56).
+
+Builds the ``sm.State`` a node needs after restoring an app snapshot at
+height H — validators at H/H+1, consensus params, app hash — plus the
+commit FOR H, all verified through the light client (so a statesyncing
+node trusts nothing but its configured trust root).
+"""
+
+from __future__ import annotations
+
+from ..light import Client as LightClient
+from ..light import TrustOptions
+from ..state.state import State
+from ..types.params import ConsensusParams
+
+
+class StateProvider:
+    """Light-client-backed provider (LightClientStateProvider)."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        genesis,
+        providers: list,
+        trust_options: TrustOptions,
+        initial_height: int = 1,
+    ):
+        if not providers:
+            raise ValueError("statesync needs at least one light provider")
+        self.chain_id = chain_id
+        self.genesis = genesis
+        self.initial_height = initial_height
+        self.client = LightClient(
+            chain_id=chain_id,
+            trust_options=trust_options,
+            primary=providers[0],
+            witnesses=list(providers[1:]),
+        )
+
+    def app_hash(self, height: int) -> bytes:
+        """App hash AFTER height = header(height+1).app_hash
+        (stateprovider.go AppHash)."""
+        lb = self.client.verify_light_block_at_height(height + 1)
+        return lb.signed_header.header.app_hash
+
+    def commit(self, height: int):
+        """Verified commit for ``height`` (stateprovider.go Commit)."""
+        lb = self.client.verify_light_block_at_height(height)
+        return lb.signed_header.commit
+
+    def state(self, height: int) -> State:
+        """Trusted sm.State for resuming AFTER ``height``
+        (stateprovider.go State): needs light blocks at H, H+1, H+2 —
+        header H+1 proves app_hash(H), vals(H+2) gives next_validators of
+        the resumed state."""
+        cur = self.client.verify_light_block_at_height(height)
+        nxt = self.client.verify_light_block_at_height(height + 1)
+        nxt2 = self.client.verify_light_block_at_height(height + 2)
+        params = (
+            self.genesis.consensus_params
+            if self.genesis is not None
+            else ConsensusParams()
+        )
+        return State(
+            chain_id=self.chain_id,
+            initial_height=self.initial_height,
+            last_block_height=cur.height,
+            # the signed header's own commit carries cur's BlockID
+            last_block_id=cur.signed_header.commit.block_id,
+            last_block_time_ns=cur.time_ns,
+            validators=nxt.validator_set,
+            next_validators=nxt2.validator_set,
+            last_validators=cur.validator_set,
+            last_height_validators_changed=nxt.height,
+            consensus_params=params,
+            last_height_consensus_params_changed=self.initial_height,
+            app_hash=nxt.signed_header.header.app_hash,
+            last_results_hash=nxt.signed_header.header.last_results_hash,
+        )

@@ -1,0 +1,240 @@
+"""Statesync syncer: snapshot discovery → offer → chunk restore → verify.
+
+Reference: statesync/syncer.go:145-516. Flow per snapshot (best first):
+
+  OfferSnapshot(app) → parallel chunk fetch from serving peers →
+  ApplySnapshotChunk in order (RETRY/REJECT semantics) → verify the
+  restored app hash against the light-client-verified header → hand back
+  (state, commit) for the node to bootstrap stores and fall into
+  blocksync/consensus.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..abci import types as abci
+from .chunks import ChunkQueue
+from .snapshots import Snapshot, SnapshotPool
+
+
+class SyncError(Exception):
+    pass
+
+
+class RejectSnapshotError(SyncError):
+    """App rejected this snapshot; try another (syncer.go errRejectSnapshot)."""
+
+
+class RejectFormatError(SyncError):
+    """App rejected the format; skip all snapshots of it."""
+
+
+class RetryError(SyncError):
+    pass
+
+
+class AppHashMismatchError(SyncError):
+    """Restored app hash != trusted header's — the fatal outcome."""
+
+
+class AbortError(SyncError):
+    """App demanded the sync stop (syncer.go errAbort): terminal."""
+
+
+class Syncer:
+    def __init__(
+        self,
+        proxy_snapshot,  # ABCI snapshot connection
+        proxy_query,  # ABCI query connection (Info for verify)
+        state_provider,
+        request_chunk,  # f(peer_id, snapshot, index) -> None (reactor send)
+        chunk_timeout: float = 10.0,
+        discovery_time: float = 5.0,
+    ):
+        self.proxy_snapshot = proxy_snapshot
+        self.proxy_query = proxy_query
+        self.state_provider = state_provider
+        self.request_chunk = request_chunk
+        self.chunk_timeout = chunk_timeout
+        self.discovery_time = discovery_time
+        self.pool = SnapshotPool()
+        self._chunk_queue: ChunkQueue | None = None
+        self._current: Snapshot | None = None
+        self._mtx = threading.Lock()
+        # Once ANY chunk has been applied the app's state is no longer
+        # genesis: callers must not fall back to blocksync-from-genesis
+        # (the reference fail-stops post-restore errors for this reason).
+        self.applied_any = False
+
+    # -- inputs from the reactor -------------------------------------------
+
+    def add_snapshot(self, snapshot: Snapshot, peer_id: str) -> bool:
+        return self.pool.add(snapshot, peer_id)
+
+    def add_chunk(self, height, fmt, index, chunk: bytes, peer_id: str) -> bool:
+        with self._mtx:
+            cur, q = self._current, self._chunk_queue
+        if cur is None or q is None:
+            return False
+        if height != cur.height or fmt != cur.format:
+            return False
+        return q.put(index, chunk, peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.pool.remove_peer(peer_id)
+
+    # -- main entry (syncer.go:145 SyncAny) ---------------------------------
+
+    def sync_any(self, deadline: float | None = None):
+        """Try snapshots until one restores; returns (state, commit).
+
+        Raises SyncError when no snapshot could be restored before the
+        deadline (the node then falls back to blocksync from genesis).
+        """
+        end = None if deadline is None else time.monotonic() + deadline
+        waited = 0.0
+        while True:
+            snapshot = self.pool.best()
+            if snapshot is None:
+                if end is not None and time.monotonic() > end:
+                    raise SyncError("no viable snapshots discovered")
+                time.sleep(0.2)
+                waited += 0.2
+                if end is None and waited >= self.discovery_time:
+                    raise SyncError("no snapshots discovered")
+                continue
+            try:
+                return self._sync_one(snapshot)
+            except RejectFormatError:
+                self.pool.reject_format(snapshot.format)
+            except (AppHashMismatchError, AbortError):
+                raise  # terminal: never offer the app anything else
+            except (RejectSnapshotError, RetryError, SyncError):
+                self.pool.reject(snapshot)
+
+    def _sync_one(self, snapshot: Snapshot):
+        """syncer.go:236 Sync: offer → fetch+apply → verify."""
+        # The trusted app hash for this height must exist BEFORE restoring.
+        # Snapshot.hash is an OPAQUE app identifier (abci spec) — comparing
+        # it to the chain app hash is the APP's job via
+        # RequestOfferSnapshot.app_hash, not ours.
+        trusted_app_hash = self.state_provider.app_hash(snapshot.height)
+
+        res = self.proxy_snapshot.offer_snapshot(
+            abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    chunks=snapshot.chunks,
+                    hash=snapshot.hash,
+                    metadata=snapshot.metadata,
+                ),
+                app_hash=trusted_app_hash,
+            )
+        )
+        r = abci.OfferSnapshotResult
+        if res.result == r.ABORT:
+            raise AbortError("app aborted statesync")
+        if res.result == r.REJECT_FORMAT:
+            raise RejectFormatError()
+        if res.result in (r.REJECT, r.REJECT_SENDER, r.UNKNOWN):
+            raise RejectSnapshotError(f"offer result {res.result}")
+
+        with self._mtx:
+            self._current = snapshot
+            self._chunk_queue = ChunkQueue(snapshot.chunks)
+        try:
+            self._fetch_and_apply(snapshot)
+        finally:
+            with self._mtx:
+                q = self._chunk_queue
+                self._current = None
+                self._chunk_queue = None
+            if q is not None:
+                q.close()
+
+        # verify restored app against the trusted header (syncer.go:485)
+        info = self.proxy_query.info(abci.RequestInfo())
+        if info.last_block_app_hash != trusted_app_hash:
+            raise AppHashMismatchError(
+                f"restored app hash {info.last_block_app_hash.hex()} != "
+                f"trusted {trusted_app_hash.hex()}"
+            )
+        if info.last_block_height != snapshot.height:
+            raise AppHashMismatchError(
+                f"restored app height {info.last_block_height} != "
+                f"snapshot height {snapshot.height}"
+            )
+        state = self.state_provider.state(snapshot.height)
+        commit = self.state_provider.commit(snapshot.height)
+        state.app_version = info.app_version
+        return state, commit
+
+    # -- chunk plumbing -----------------------------------------------------
+
+    def _fetch_and_apply(self, snapshot: Snapshot) -> None:
+        q = self._chunk_queue
+        stop = threading.Event()
+        fetcher = threading.Thread(
+            target=self._fetch_loop, args=(snapshot, q, stop), daemon=True
+        )
+        fetcher.start()
+        try:
+            applied = 0
+            deadline = time.monotonic() + self.chunk_timeout * max(
+                1, snapshot.chunks
+            )
+            while applied < snapshot.chunks:
+                item = q.next(timeout=1.0)
+                if item is None:
+                    if time.monotonic() > deadline:
+                        raise RetryError("timed out fetching chunks")
+                    continue
+                index, chunk, peer = item
+                res = self.proxy_snapshot.apply_snapshot_chunk(
+                    abci.RequestApplySnapshotChunk(
+                        index=index, chunk=chunk, sender=peer
+                    )
+                )
+                r = abci.ApplySnapshotChunkResult
+                if res.result == r.ACCEPT:
+                    applied += 1
+                    self.applied_any = True
+                    continue
+                if res.result == r.ABORT:
+                    raise AbortError("app aborted during chunk apply")
+                if res.result == r.RETRY:
+                    q.retry(index)
+                    applied = min(applied, index)
+                    continue
+                if res.result == r.RETRY_SNAPSHOT:
+                    raise RetryError("app requested snapshot retry")
+                raise RejectSnapshotError(f"chunk apply result {res.result}")
+        finally:
+            stop.set()
+            fetcher.join(timeout=2)
+
+    def _fetch_loop(self, snapshot: Snapshot, q: ChunkQueue, stop) -> None:
+        """Round-robin pending chunk requests over serving peers
+        (syncer.go:415 fetchChunks, collapsed to one requester thread —
+        chunk application is serial anyway and peers stream responses)."""
+        requested: dict[int, float] = {}
+        while not stop.is_set() and not q.done():
+            peers = self.pool.peers_of(snapshot)
+            if not peers:
+                time.sleep(0.2)
+                continue
+            now = time.monotonic()
+            for n, index in enumerate(q.pending()):
+                last = requested.get(index, 0.0)
+                if now - last < self.chunk_timeout:
+                    continue
+                peer = peers[(index + int(now)) % len(peers)]
+                try:
+                    self.request_chunk(peer, snapshot, index)
+                    requested[index] = now
+                except Exception:
+                    pass
+            time.sleep(0.1)

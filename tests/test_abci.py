@@ -88,7 +88,7 @@ def test_kvstore_persistence_and_handshake_info(tmp_path):
 
 
 def test_kvstore_snapshot_roundtrip():
-    src = KVStoreApplication()
+    src = KVStoreApplication(snapshot_interval=1)
     _finalize(src, 1, [b"x=1", b"y=2"])
     src.commit()
     snaps = src.list_snapshots(abci.RequestListSnapshots()).snapshots
@@ -241,7 +241,7 @@ def test_socket_server_restart_same_unix_addr(tmp_path):
 
 
 def test_kvstore_snapshot_includes_high_byte_keys():
-    src = KVStoreApplication()
+    src = KVStoreApplication(snapshot_interval=1)
     _finalize(src, 1, [b"\xff\x01=edge"])
     src.commit()
     chunk = src.load_snapshot_chunk(

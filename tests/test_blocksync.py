@@ -1,5 +1,6 @@
 """Blocksync pool scheduling tests (reference analog: blocksync/pool_test.go)."""
 
+import pytest
 import time
 
 from cometbft_tpu.blocksync.pool import BlockPool, REQUEST_TIMEOUT
@@ -84,3 +85,103 @@ def test_pool_caught_up_and_peer_removal():
     assert not pool.is_caught_up()
     pool.remove_peer("peerB")
     assert pool.is_caught_up()
+
+
+@pytest.mark.slow
+def test_blocksync_end_to_end_catchup(tmp_path):
+    """A fresh node catches up 20+ blocks THROUGH THE BLOCKSYNC REACTOR
+    (reference: blocksync/reactor.go:272-530 poolRoutine -> verify via
+    second commit -> ApplyBlock -> SwitchToConsensus), then follows
+    consensus. The reactor's _n_synced counter proves blocksync did the
+    catch-up rather than consensus gossip."""
+    import dataclasses
+    import time
+
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.node import Node, init_files
+    from helpers import make_genesis
+
+    _MS = 1_000_000
+
+    def cfg_for(home):
+        cfg = default_config()
+        cfg.base.home = home
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=500 * _MS,
+            timeout_prevote_ns=250 * _MS,
+            timeout_precommit_ns=250 * _MS,
+            timeout_commit_ns=80 * _MS,
+            skip_timeout_commit=False,
+            create_empty_blocks=True,
+        )
+        return cfg
+
+    genesis, pvs = make_genesis(1)
+    cfg_a = cfg_for(str(tmp_path / "a"))
+    init_files(cfg_a)
+    node_a = Node(cfg_a, genesis, pvs[0])
+    node_b = None
+    try:
+        node_a.start()
+        deadline = time.monotonic() + 60
+        while (
+            node_a.block_store.height() < 25
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert node_a.block_store.height() >= 25, "producer too slow"
+
+        cfg_b = cfg_for(str(tmp_path / "b"))
+        cfg_b.base.block_sync = True
+        init_files(cfg_b)
+        node_b = Node(cfg_b, genesis, None)  # non-validator follower
+        assert node_b.blocksync_reactor.block_sync, "blocksync must be on"
+        seed = (
+            f"{node_a.node_key.node_id}@"
+            f"{node_a.transport.listen_addr[len('tcp://'):]}"
+        )
+        node_b.config.p2p.persistent_peers = seed
+        node_b.start()
+
+        # 1. blocksync catches up and switches to consensus
+        deadline = time.monotonic() + 90
+        while (
+            not node_b.blocksync_reactor.synced.is_set()
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert node_b.blocksync_reactor.synced.is_set(), (
+            f"never switched to consensus (synced "
+            f"{node_b.blocksync_reactor._n_synced} blocks, B at height "
+            f"{node_b.block_store.height()}, A at "
+            f"{node_a.block_store.height()})"
+        )
+        assert node_b.blocksync_reactor._n_synced >= 20, (
+            "catch-up did not go through blocksync"
+        )
+
+        # 2. after the switch, B follows consensus to NEW heights
+        switch_height = node_b.block_store.height()
+        deadline = time.monotonic() + 30
+        while (
+            node_b.block_store.height() < switch_height + 3
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert node_b.block_store.height() >= switch_height + 3, (
+            "did not follow consensus after blocksync switch"
+        )
+
+        # 3. both stores agree on a shared height
+        h = min(node_a.block_store.height(), node_b.block_store.height()) - 1
+        assert (
+            node_a.block_store.load_block_meta(h).block_id
+            == node_b.block_store.load_block_meta(h).block_id
+        )
+    finally:
+        if node_b is not None:
+            node_b.stop()
+        node_a.stop()
