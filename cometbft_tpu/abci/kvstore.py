@@ -37,6 +37,7 @@ class KVStoreApplication(BaseApplication):
         # app hash advertised for that height exactly.
         self.snapshot_interval = snapshot_interval
         self._snapshots: dict[int, tuple[bytes, bytes]] = {}  # h -> (hash, blob)
+        self._restore_target = None  # accepted OfferSnapshot, if any
         raw = self.db.get(_STATE_KEY)
         if raw:
             st = json.loads(raw)
@@ -278,7 +279,35 @@ class KVStoreApplication(BaseApplication):
         )
 
     def apply_snapshot_chunk(self, req):
-        st = json.loads(req.chunk)
+        # Chunks come from untrusted peers: validate EVERYTHING before any
+        # mutation — a half-applied parse failure would leave the app
+        # inconsistent and poison a later blocksync-from-genesis.
+        try:
+            st = json.loads(req.chunk)
+            height = int(st["height"])
+            size = int(st["size"])
+            validators = dict(st["validators"])
+            kvs = {
+                bytes.fromhex(k): bytes.fromhex(v)
+                for k, v in st["kvs"].items()
+            }
+        except (ValueError, KeyError, TypeError):
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.ApplySnapshotChunkResult.REJECT_SNAPSHOT
+            )
+        if (
+            self._restore_target is not None
+            and height != self._restore_target.height
+        ):
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.ApplySnapshotChunkResult.REJECT_SNAPSHOT
+            )
+        st = {
+            "height": height,
+            "size": size,
+            "validators": validators,
+            "kvs": {k.hex(): v.hex() for k, v in kvs.items()},
+        }
         with self._mtx:
             batch = self.db.new_batch()
             for k_hex, v_hex in st["kvs"].items():

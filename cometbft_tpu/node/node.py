@@ -258,6 +258,22 @@ class Node(BaseService):
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+
+        # 9d. PEX + address book (setup.go:427,454)
+        from ..p2p.pex import AddrBook, PexReactor
+
+        self.addr_book = AddrBook(
+            config.base.resolve("config/addrbook.json")
+        )
+        self.addr_book.add_our_address(self.node_key.node_id)
+        self.pex_reactor = None
+        if config.p2p.pex:
+            self.pex_reactor = PexReactor(
+                self.addr_book,
+                seed_mode=config.p2p.seed_mode,
+                max_outbound=config.p2p.max_num_outbound_peers,
+            )
+            self.switch.add_reactor("PEX", self.pex_reactor)
         self.node_info.channels = self.switch.channel_ids()
 
         # 9b. Indexers (setup.go:141 createAndStartIndexerService)
@@ -399,6 +415,14 @@ class Node(BaseService):
         if persistent:
             self.switch.set_persistent_peers(persistent)
             self.switch.dial_peers_async(persistent)
+        # seeds prime the address book; PEX's ensure-peers loop dials them
+        seeds = [
+            a.strip()
+            for a in self.config.p2p.seeds.split(",")
+            if a.strip()
+        ]
+        for seed in seeds:
+            self.addr_book.add_address(seed, src="seed-config")
         if self.statesync_enabled:
             threading.Thread(
                 target=self._statesync_routine, name="statesync", daemon=True

@@ -1,0 +1,16 @@
+"""Peer exchange: bucketed address book + discovery reactor (channel 0x00).
+
+Reference: /root/reference/p2p/pex/.
+"""
+
+from .addrbook import AddrBook, KnownAddress
+from .reactor import PEX_CHANNEL, PexAddrsMessage, PexReactor, PexRequestMessage
+
+__all__ = [
+    "AddrBook",
+    "KnownAddress",
+    "PEX_CHANNEL",
+    "PexAddrsMessage",
+    "PexReactor",
+    "PexRequestMessage",
+]

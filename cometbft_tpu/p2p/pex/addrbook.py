@@ -1,0 +1,254 @@
+"""Bucketed peer address book (reference: p2p/pex/addrbook.go:947).
+
+Two bucket classes, like the reference:
+
+* NEW buckets — addresses heard about (from PEX or config) but never
+  successfully connected to. Bucketed by hash(src_id, addr_group) so one
+  gossiping peer can't own the whole table.
+* OLD buckets — addresses we HAVE connected to (mark_good promotes).
+  Bucketed by hash(addr_group).
+
+Eviction drops the oldest address of a full bucket (the reference evicts
+by lowest chance score; last_attempt ordering approximates it without the
+clock arithmetic). The book persists to a JSON file on every mutation
+batch and reloads on boot (addrbook.go saveToFile/loadFromFile).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+BUCKET_SIZE = 64
+# getSelection caps (pex_reactor / addrbook.go GetSelection)
+SELECTION_PERCENT = 23
+MAX_SELECTION = 250
+
+
+@dataclass
+class KnownAddress:
+    """addrbook.go knownAddress."""
+
+    addr: str  # "id@host:port"
+    src: str  # peer id that told us
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"  # "new" | "old"
+
+    @property
+    def node_id(self) -> str:
+        return self.addr.partition("@")[0]
+
+    @property
+    def host(self) -> str:
+        return self.addr.partition("@")[2].rpartition(":")[0]
+
+    def is_old(self) -> bool:
+        return self.bucket_type == "old"
+
+    def is_bad(self, now: float) -> bool:
+        """addrbook.go isBad: too many failed attempts, never succeeded."""
+        return self.attempts >= 3 and self.last_success == 0
+
+
+def _group(addr: str) -> str:
+    """Routability group: /16 for IPv4-ish hosts (addrbook.go groupKey)."""
+    host = addr.partition("@")[2].rpartition(":")[0]
+    parts = host.split(".")
+    if len(parts) == 4:
+        return ".".join(parts[:2])
+    return host
+
+
+class AddrBook:
+    def __init__(self, file_path: str | None = None, key: bytes | None = None):
+        self.file_path = file_path
+        self._key = key if key is not None else os.urandom(8)
+        self._mtx = threading.Lock()
+        self._addrs: dict[str, KnownAddress] = {}  # node_id -> ka
+        self._new: list[set[str]] = [set() for _ in range(NEW_BUCKET_COUNT)]
+        self._old: list[set[str]] = [set() for _ in range(OLD_BUCKET_COUNT)]
+        self._our_ids: set[str] = set()
+        self._rng = random.Random()
+        if file_path and os.path.exists(file_path):
+            self._load()
+
+    # -- identity ----------------------------------------------------------
+
+    def add_our_address(self, node_id: str) -> None:
+        with self._mtx:
+            self._our_ids.add(node_id)
+            self._remove_locked(node_id)
+
+    # -- hashing -----------------------------------------------------------
+
+    def _bucket_new(self, ka: KnownAddress) -> int:
+        h = hashlib.sha256(
+            self._key + ka.src.encode() + _group(ka.addr).encode()
+        ).digest()
+        return int.from_bytes(h[:4], "big") % NEW_BUCKET_COUNT
+
+    def _bucket_old(self, ka: KnownAddress) -> int:
+        h = hashlib.sha256(self._key + _group(ka.addr).encode()).digest()
+        return int.from_bytes(h[:4], "big") % OLD_BUCKET_COUNT
+
+    # -- mutations ---------------------------------------------------------
+
+    def add_address(self, addr: str, src: str) -> bool:
+        """addrbook.go AddAddress: new addresses land in a NEW bucket."""
+        node_id = addr.partition("@")[0]
+        if not node_id or "@" not in addr:
+            return False
+        with self._mtx:
+            if node_id in self._our_ids:
+                return False
+            ka = self._addrs.get(node_id)
+            if ka is not None:
+                if ka.is_old():
+                    return False  # already proven; keep the old entry
+                # refresh source/address for a known-new entry
+                ka.addr = addr
+                return False
+            ka = KnownAddress(addr=addr, src=src)
+            self._addrs[node_id] = ka
+            bucket = self._new[self._bucket_new(ka)]
+            if len(bucket) >= BUCKET_SIZE:
+                self._evict_locked(bucket)
+            bucket.add(node_id)
+            self._save_locked()
+            return True
+
+    def mark_attempt(self, addr: str) -> None:
+        with self._mtx:
+            ka = self._addrs.get(addr.partition("@")[0])
+            if ka is not None:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+
+    def mark_good(self, addr: str) -> None:
+        """Successful handshake: promote to an OLD bucket
+        (addrbook.go MarkGood/moveToOld)."""
+        node_id = addr.partition("@")[0]
+        with self._mtx:
+            ka = self._addrs.get(node_id)
+            if ka is None:
+                ka = KnownAddress(addr=addr, src=node_id)
+                self._addrs[node_id] = ka
+            ka.attempts = 0
+            ka.last_success = time.time()
+            if not ka.is_old():
+                self._new[self._bucket_new(ka)].discard(node_id)
+                ka.bucket_type = "old"
+                bucket = self._old[self._bucket_old(ka)]
+                if len(bucket) >= BUCKET_SIZE:
+                    self._evict_locked(bucket)
+                bucket.add(node_id)
+            self._save_locked()
+
+    def mark_bad(self, addr: str) -> None:
+        with self._mtx:
+            self._remove_locked(addr.partition("@")[0])
+            self._save_locked()
+
+    def _remove_locked(self, node_id: str) -> None:
+        ka = self._addrs.pop(node_id, None)
+        if ka is None:
+            return
+        for bucket in self._new + self._old:
+            bucket.discard(node_id)
+
+    def _evict_locked(self, bucket: set[str]) -> None:
+        """Drop the stalest entry of a full bucket."""
+        victim = max(
+            bucket,
+            key=lambda nid: self._addrs[nid].last_attempt
+            if nid in self._addrs
+            else 0.0,
+        )
+        bucket.discard(victim)
+        self._addrs.pop(victim, None)
+
+    # -- queries -----------------------------------------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._addrs)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def has(self, node_id: str) -> bool:
+        with self._mtx:
+            return node_id in self._addrs
+
+    def pick_address(self, new_bias_pct: int = 30) -> KnownAddress | None:
+        """Random pick biased between new/old (addrbook.go PickAddress)."""
+        with self._mtx:
+            now = time.time()
+            news = [
+                ka
+                for ka in self._addrs.values()
+                if not ka.is_old() and not ka.is_bad(now)
+            ]
+            olds = [
+                ka
+                for ka in self._addrs.values()
+                if ka.is_old() and not ka.is_bad(now)
+            ]
+            if not news and not olds:
+                return None
+            use_new = news and (
+                not olds or self._rng.randrange(100) < new_bias_pct
+            )
+            pool = news if use_new else olds
+            return self._rng.choice(pool)
+
+    def get_selection(self) -> list[str]:
+        """Random ~23% (max 250) of addresses for a PEX response
+        (addrbook.go GetSelection)."""
+        with self._mtx:
+            addrs = [ka.addr for ka in self._addrs.values()]
+        n = min(max(len(addrs) * SELECTION_PERCENT // 100, 1), MAX_SELECTION)
+        self._rng.shuffle(addrs)
+        return addrs[:n]
+
+    # -- persistence -------------------------------------------------------
+
+    def _save_locked(self) -> None:
+        if not self.file_path:
+            return
+        payload = {
+            "key": self._key.hex(),
+            "addrs": [asdict(ka) for ka in self._addrs.values()],
+        }
+        tmp = self.file_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.file_path)
+
+    def save(self) -> None:
+        with self._mtx:
+            self._save_locked()
+
+    def _load(self) -> None:
+        try:
+            with open(self.file_path) as f:
+                payload = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return
+        self._key = bytes.fromhex(payload.get("key", self._key.hex()))
+        for row in payload.get("addrs", []):
+            ka = KnownAddress(**row)
+            self._addrs[ka.node_id] = ka
+            if ka.is_old():
+                self._old[self._bucket_old(ka)].add(ka.node_id)
+            else:
+                self._new[self._bucket_new(ka)].add(ka.node_id)

@@ -1,0 +1,197 @@
+"""PEX reactor: peer discovery over channel 0x00.
+
+Reference: p2p/pex/pex_reactor.go:764. Responsibilities:
+
+* answer PexRequest with a random selection from the address book
+  (throttled per peer);
+* feed received PexAddrs into the book;
+* ensure-peers loop: when outbound slots are free, dial addresses picked
+  from the book (new/old biased by connectedness);
+* seed mode: accept, serve addresses, then hang up (crawler-lite).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ...types import serialization as ser
+from ..base_reactor import ChannelDescriptor, Reactor
+from .addrbook import AddrBook
+
+PEX_CHANNEL = 0x00
+
+_ENSURE_INTERVAL = 1.0  # pex_reactor.go ensurePeersPeriod (30s; test-scaled)
+_REQUEST_INTERVAL = 2.0  # min seconds between requests per peer
+_MAX_ADDRS_PER_MSG = 250
+
+
+@dataclass(slots=True)
+class PexRequestMessage:
+    pass
+
+
+@dataclass(slots=True)
+class PexAddrsMessage:
+    addrs: list[str] = field(default_factory=list)
+
+
+ser.codec.register(PexRequestMessage, PexAddrsMessage)
+
+
+class PexReactor(Reactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        seed_mode: bool = False,
+        ensure_interval: float = _ENSURE_INTERVAL,
+        max_outbound: int = 10,
+    ):
+        super().__init__("pex-reactor")
+        self.book = book
+        self.seed_mode = seed_mode
+        self.ensure_interval = ensure_interval
+        self.max_outbound = max_outbound
+        self._last_request: dict[str, float] = {}
+        self._requested: set[str] = set()  # peers we asked (expect a reply)
+        self._dialing: set[str] = set()
+        self._mtx = threading.Lock()
+        self._stop = threading.Event()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=PEX_CHANNEL,
+                priority=1,
+                send_queue_capacity=10,
+                recv_message_capacity=64 * 1024,
+            )
+        ]
+
+    def on_start(self) -> None:
+        threading.Thread(
+            target=self._ensure_peers_routine, name="pex-ensure", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        self._stop.set()
+        self.book.save()
+
+    # -- peer lifecycle ----------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        if peer.outbound:
+            # outbound connect proved the address (pex_reactor.go AddPeer)
+            if peer.socket_addr:
+                self.book.mark_good(peer.socket_addr)
+            self._request_addrs(peer)
+        elif self.seed_mode:
+            # seeds serve a selection immediately, then disconnect
+            peer.try_send(
+                PEX_CHANNEL,
+                ser.dumps(
+                    PexAddrsMessage(
+                        addrs=self.book.get_selection()[:_MAX_ADDRS_PER_MSG]
+                    )
+                ),
+            )
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._mtx:
+            self._last_request.pop(peer.id, None)
+            self._requested.discard(peer.id)
+
+    # -- receive -----------------------------------------------------------
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        msg = ser.loads(msg_bytes)
+        if isinstance(msg, PexRequestMessage):
+            now = time.monotonic()
+            with self._mtx:
+                last = self._last_request.get(peer.id, 0.0)
+                if now - last < _REQUEST_INTERVAL:
+                    return  # throttle spammy askers (receiveRequest)
+                self._last_request[peer.id] = now
+            peer.try_send(
+                PEX_CHANNEL,
+                ser.dumps(
+                    PexAddrsMessage(
+                        addrs=self.book.get_selection()[:_MAX_ADDRS_PER_MSG]
+                    )
+                ),
+            )
+            if self.seed_mode:
+                # seed: job done, free the slot (pex_reactor.go:174)
+                threading.Timer(
+                    0.5, self._disconnect_peer, args=(peer,)
+                ).start()
+        elif isinstance(msg, PexAddrsMessage):
+            with self._mtx:
+                solicited = peer.id in self._requested
+                self._requested.discard(peer.id)
+            if not solicited:
+                return  # unsolicited addrs: ignore (ReceiveAddrs guard)
+            for addr in msg.addrs[:_MAX_ADDRS_PER_MSG]:
+                self.book.add_address(addr, src=peer.id)
+
+    def _disconnect_peer(self, peer) -> None:
+        if self.switch is not None:
+            self.switch.stop_and_remove_peer(peer, "seed: served addrs")
+
+    def _request_addrs(self, peer) -> None:
+        with self._mtx:
+            self._requested.add(peer.id)
+        peer.try_send(PEX_CHANNEL, ser.dumps(PexRequestMessage()))
+
+    # -- ensure-peers loop (pex_reactor.go:426 ensurePeers) ----------------
+
+    def _ensure_peers_routine(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._ensure_peers()
+            except Exception:
+                pass
+            self._stop.wait(self.ensure_interval)
+
+    def _ensure_peers(self) -> None:
+        if self.switch is None or self.seed_mode:
+            return
+        outbound, _inbound = self.switch.num_peers()
+        need = self.max_outbound - outbound
+        if need <= 0:
+            return
+        connected = {p.id for p in self.switch.peers()}
+        for _ in range(need * 2):
+            ka = self.book.pick_address()
+            if ka is None:
+                break
+            with self._mtx:
+                if ka.node_id in self._dialing:
+                    continue
+            if ka.node_id in connected:
+                continue
+            with self._mtx:
+                self._dialing.add(ka.node_id)
+            self.book.mark_attempt(ka.addr)
+            threading.Thread(
+                target=self._dial, args=(ka,), daemon=True
+            ).start()
+            need -= 1
+            if need <= 0:
+                break
+        # still starving and nobody to dial: ask a connected peer for more
+        if need > 0:
+            peers = self.switch.peers()
+            if peers:
+                self._request_addrs(peers[int(time.time()) % len(peers)])
+
+    def _dial(self, ka) -> None:
+        try:
+            # non-persistent dial: single attempt, no backoff loop
+            self.switch._dial_with_backoff(ka.addr)
+        except Exception:
+            pass
+        finally:
+            with self._mtx:
+                self._dialing.discard(ka.node_id)

@@ -136,12 +136,12 @@ def net_info(env) -> dict:
         "peers": [
             {
                 "node_info": {
-                    "id": p.node_id(),
+                    "id": p.id,
                     "moniker": getattr(p.node_info, "moniker", ""),
                     "network": getattr(p.node_info, "network", ""),
                 },
-                "is_outbound": p.is_outbound,
-                "remote_ip": getattr(p, "remote_addr", ""),
+                "is_outbound": p.outbound,
+                "remote_ip": getattr(p, "socket_addr", ""),
             }
             for p in peers
         ],
@@ -312,7 +312,7 @@ def dump_consensus_state(env) -> dict:
     out = consensus_state(env)
     out["round_state"]["height_vote_set"] = votes
     peers = env.switch.peers() if env.switch else []
-    out["peers"] = [{"node_address": p.node_id()} for p in peers]
+    out["peers"] = [{"node_address": p.id} for p in peers]
     return out
 
 

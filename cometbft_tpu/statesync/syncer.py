@@ -15,6 +15,7 @@ import threading
 import time
 
 from ..abci import types as abci
+from ..light.errors import LightClientError
 from .chunks import ChunkQueue
 from .snapshots import Snapshot, SnapshotPool
 
@@ -33,6 +34,10 @@ class RejectFormatError(SyncError):
 
 class RetryError(SyncError):
     pass
+
+
+class RetrySnapshotError(SyncError):
+    """App asked to re-offer the SAME snapshot (errRetrySnapshot)."""
 
 
 class AppHashMismatchError(SyncError):
@@ -67,6 +72,7 @@ class Syncer:
         # genesis: callers must not fall back to blocksync-from-genesis
         # (the reference fail-stops post-restore errors for this reason).
         self.applied_any = False
+        self._requested: dict[int, float] = {}  # chunk index -> last request
 
     # -- inputs from the reactor -------------------------------------------
 
@@ -95,6 +101,7 @@ class Syncer:
         """
         end = None if deadline is None else time.monotonic() + deadline
         waited = 0.0
+        retries: dict[tuple, int] = {}
         while True:
             snapshot = self.pool.best()
             if snapshot is None:
@@ -111,6 +118,12 @@ class Syncer:
                 self.pool.reject_format(snapshot.format)
             except (AppHashMismatchError, AbortError):
                 raise  # terminal: never offer the app anything else
+            except RetrySnapshotError:
+                # app wants the SAME snapshot again; cap the retries so a
+                # permanently failing app can't loop forever
+                retries[snapshot.key()] = retries.get(snapshot.key(), 0) + 1
+                if retries[snapshot.key()] >= 3:
+                    self.pool.reject(snapshot)
             except (RejectSnapshotError, RetryError, SyncError):
                 self.pool.reject(snapshot)
 
@@ -120,7 +133,9 @@ class Syncer:
         # Snapshot.hash is an OPAQUE app identifier (abci spec) — comparing
         # it to the chain app hash is the APP's job via
         # RequestOfferSnapshot.app_hash, not ours.
-        trusted_app_hash = self.state_provider.app_hash(snapshot.height)
+        trusted_app_hash = self._provider_call(
+            lambda: self.state_provider.app_hash(snapshot.height)
+        )
 
         res = self.proxy_snapshot.offer_snapshot(
             abci.RequestOfferSnapshot(
@@ -167,10 +182,33 @@ class Syncer:
                 f"restored app height {info.last_block_height} != "
                 f"snapshot height {snapshot.height}"
             )
-        state = self.state_provider.state(snapshot.height)
-        commit = self.state_provider.commit(snapshot.height)
+        # The chain tip may be exactly at the snapshot height: state()
+        # needs light blocks H+1/H+2, which can lag the restore by a block
+        # or two — retry instead of treating a young tip as fatal.
+        state = self._provider_call(
+            lambda: self.state_provider.state(snapshot.height)
+        )
+        commit = self._provider_call(
+            lambda: self.state_provider.commit(snapshot.height)
+        )
         state.app_version = info.app_version
         return state, commit
+
+    def _provider_call(self, fn, attempts: int = 20, delay: float = 0.5):
+        """Light-provider fetches retry through transient misses (young
+        chain tip, RPC hiccup); persistent failure surfaces as a SyncError
+        so sync_any's control flow — not the caller's thread — handles it."""
+        last: Exception | None = None
+        for _ in range(attempts):
+            try:
+                return fn()
+            except LightClientError as e:
+                last = e
+                time.sleep(delay)
+            except Exception as e:  # provider/transport faults
+                last = e
+                time.sleep(delay)
+        raise SyncError(f"state provider unavailable: {last}")
 
     # -- chunk plumbing -----------------------------------------------------
 
@@ -207,10 +245,15 @@ class Syncer:
                     raise AbortError("app aborted during chunk apply")
                 if res.result == r.RETRY:
                     q.retry(index)
+                    # make the fetcher re-request immediately: its
+                    # per-index throttle would otherwise eat the deadline
+                    for i in list(self._requested):
+                        if i >= index:
+                            del self._requested[i]
                     applied = min(applied, index)
                     continue
                 if res.result == r.RETRY_SNAPSHOT:
-                    raise RetryError("app requested snapshot retry")
+                    raise RetrySnapshotError()
                 raise RejectSnapshotError(f"chunk apply result {res.result}")
         finally:
             stop.set()
@@ -220,7 +263,8 @@ class Syncer:
         """Round-robin pending chunk requests over serving peers
         (syncer.go:415 fetchChunks, collapsed to one requester thread —
         chunk application is serial anyway and peers stream responses)."""
-        requested: dict[int, float] = {}
+        self._requested.clear()
+        requested = self._requested
         while not stop.is_set() and not q.done():
             peers = self.pool.peers_of(snapshot)
             if not peers:

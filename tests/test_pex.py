@@ -1,0 +1,153 @@
+"""PEX tests: address book semantics + seed-driven discovery over real TCP
+(reference analog: p2p/pex/{addrbook,pex_reactor}_test.go)."""
+
+import dataclasses
+import time
+
+import pytest
+
+from cometbft_tpu.p2p.pex import AddrBook
+
+from helpers import make_genesis
+
+_MS = 1_000_000
+
+
+def _addr(i, port=26656):
+    return f"{'ab%02x' % i * 10}@10.{i % 250}.0.1:{port}"
+
+
+class TestAddrBook:
+    def test_add_pick_and_selection(self, tmp_path):
+        book = AddrBook(str(tmp_path / "book.json"))
+        for i in range(20):
+            assert book.add_address(_addr(i), src="peer-src")
+        assert book.size() == 20
+        assert not book.add_address(_addr(3), src="other")  # dup
+        ka = book.pick_address()
+        assert ka is not None and book.has(ka.node_id)
+        sel = book.get_selection()
+        assert 1 <= len(sel) <= 20
+
+    def test_mark_good_promotes_and_survives_reload(self, tmp_path):
+        path = str(tmp_path / "book.json")
+        book = AddrBook(path)
+        a = _addr(1)
+        book.add_address(a, src="s")
+        book.mark_good(a)
+        assert book._addrs[a.partition("@")[0]].is_old()
+        # reload from disk
+        book2 = AddrBook(path)
+        assert book2.size() == 1
+        assert book2._addrs[a.partition("@")[0]].is_old()
+
+    def test_mark_bad_removes(self, tmp_path):
+        book = AddrBook(str(tmp_path / "book.json"))
+        a = _addr(2)
+        book.add_address(a, src="s")
+        book.mark_bad(a)
+        assert book.size() == 0
+
+    def test_bad_addresses_not_picked(self, tmp_path):
+        book = AddrBook(str(tmp_path / "book.json"))
+        a = _addr(3)
+        book.add_address(a, src="s")
+        for _ in range(3):
+            book.mark_attempt(a)
+        assert book.pick_address() is None  # 3 failed attempts, no success
+
+    def test_own_address_rejected(self, tmp_path):
+        book = AddrBook(str(tmp_path / "book.json"))
+        me = _addr(9)
+        book.add_our_address(me.partition("@")[0])
+        assert not book.add_address(me, src="s")
+
+    def test_bucket_eviction_bounds_size(self, tmp_path):
+        from cometbft_tpu.p2p.pex import addrbook as ab
+
+        book = AddrBook(str(tmp_path / "book.json"))
+        # same source + same /16 group -> same new bucket: force eviction
+        for i in range(ab.BUCKET_SIZE + 10):
+            addr = f"{'cd%02x' % i * 10}@10.7.0.{i % 250}:26656"
+            book.add_address(addr, src="one-src")
+        bucket_sizes = [len(b) for b in book._new if b]
+        assert all(sz <= ab.BUCKET_SIZE for sz in bucket_sizes)
+
+
+@pytest.mark.slow
+def test_pex_discovery_via_seed(tmp_path):
+    """Node C knows ONLY the seed; it must discover and dial node A through
+    PEX (pex_reactor.go:426 ensurePeers + addrbook selection)."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.node import Node, init_files
+
+    def cfg_for(home, n_vals_cfg=True):
+        cfg = default_config()
+        cfg.base.home = home
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=600 * _MS,
+            timeout_prevote_ns=300 * _MS,
+            timeout_precommit_ns=300 * _MS,
+            timeout_commit_ns=200 * _MS,
+            skip_timeout_commit=False,
+        )
+        return cfg
+
+    genesis, pvs = make_genesis(1)
+    nodes = []
+    try:
+        # seed node S and full node A; A dials S so S's book learns A
+        cfg_s = cfg_for(str(tmp_path / "seed"))
+        init_files(cfg_s)
+        seed_node = Node(cfg_s, genesis, None)
+        nodes.append(seed_node)
+        seed_node.start()
+        seed_addr = (
+            f"{seed_node.node_key.node_id}@"
+            f"{seed_node.transport.listen_addr[len('tcp://'):]}"
+        )
+
+        cfg_a = cfg_for(str(tmp_path / "a"))
+        init_files(cfg_a)
+        node_a = Node(cfg_a, genesis, pvs[0])
+        nodes.append(node_a)
+        node_a.config.p2p.persistent_peers = seed_addr
+        node_a.start()
+        # the seed learns A's listen address once A dials it: inject A's
+        # dialable address into the seed's book the way a production seed
+        # learns it from the node's self-advertisement
+        a_addr = (
+            f"{node_a.node_key.node_id}@"
+            f"{node_a.transport.listen_addr[len('tcp://'):]}"
+        )
+        seed_node.addr_book.add_address(a_addr, src="inbound")
+
+        # C: knows ONLY the seed
+        cfg_c = cfg_for(str(tmp_path / "c"))
+        init_files(cfg_c)
+        node_c = Node(cfg_c, genesis, None)
+        nodes.append(node_c)
+        node_c.config.p2p.seeds = seed_addr
+        node_c.start()
+
+        deadline = time.monotonic() + 30
+        discovered = False
+        while time.monotonic() < deadline:
+            if node_c.addr_book.has(node_a.node_key.node_id):
+                discovered = True
+                if node_c.switch.get_peer(node_a.node_key.node_id):
+                    break
+            time.sleep(0.2)
+        assert discovered, "C never learned A's address via PEX"
+        assert node_c.switch.get_peer(node_a.node_key.node_id) is not None, (
+            "C discovered A but never dialed it"
+        )
+    finally:
+        for n in reversed(nodes):
+            try:
+                n.stop()
+            except Exception:
+                pass
