@@ -291,7 +291,7 @@ class KVStoreApplication(BaseApplication):
                 bytes.fromhex(k): bytes.fromhex(v)
                 for k, v in st["kvs"].items()
             }
-        except (ValueError, KeyError, TypeError):
+        except (ValueError, KeyError, TypeError, AttributeError):
             return abci.ResponseApplySnapshotChunk(
                 result=abci.ApplySnapshotChunkResult.REJECT_SNAPSHOT
             )

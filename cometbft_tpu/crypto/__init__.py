@@ -14,3 +14,9 @@ from .batch import (  # noqa: F401
     supports_batch_verifier,
 )
 from . import merkle, tmhash  # noqa: F401
+
+# sr25519/secp256k1 register here (not in keys.py) to avoid import cycles
+# while staying reachable from every production entry point.
+from .keys import register_extra_key_types as _register_extra  # noqa: E402
+
+_register_extra()

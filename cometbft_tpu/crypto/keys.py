@@ -106,8 +106,17 @@ class Ed25519PrivKey:
 
 
 # Registry used by serialization (libs/json type registry analog) and the
-# batch dispatch (crypto/batch/batch.go:11).
+# batch dispatch (crypto/batch/batch.go:11). sr25519/secp256k1 register
+# lazily to keep import cycles out of the base module.
 PUBKEY_TYPES: dict[str, type] = {ED25519_KEY_TYPE: Ed25519PubKey}
+
+
+def register_extra_key_types() -> None:
+    from .secp256k1 import Secp256k1PubKey
+    from .sr25519 import Sr25519PubKey
+
+    PUBKEY_TYPES.setdefault("sr25519", Sr25519PubKey)
+    PUBKEY_TYPES.setdefault(SECP256K1_KEY_TYPE, Secp256k1PubKey)
 
 
 def pubkey_from_type_and_bytes(key_type: str, data: bytes):

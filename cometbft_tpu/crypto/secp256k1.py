@@ -1,0 +1,141 @@
+"""secp256k1 ECDSA keys (reference: crypto/secp256k1/secp256k1.go:227).
+
+Host-side only, like the reference (btcec has no batch interface and
+secp256k1 is out of the consensus hot path). Backed by OpenSSL through
+the ``cryptography`` wheel with a pure-Python fallback for the math the
+wheel doesn't expose (point decompression for 33-byte keys).
+
+Wire formats match the reference: 33-byte compressed pubkeys, 32-byte
+private keys, 64-byte raw (r||s) signatures with LOW-S normalization
+(secp256k1.go Sign uses RFC6979 + canonical low-s), addresses =
+RIPEMD160(SHA256(pubkey)) — the Bitcoin-style address the reference
+keeps for this key type (secp256k1.go:30-40).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+SECP256K1_KEY_TYPE = "secp256k1"
+PUBKEY_SIZE = 33
+PRIVKEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+# curve order (for low-s normalization)
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+def _address(pubkey33: bytes) -> bytes:
+    return hashlib.new(
+        "ripemd160", hashlib.sha256(pubkey33).digest()
+    ).digest()
+
+
+@dataclass(frozen=True, slots=True)
+class Secp256k1PubKey:
+    data: bytes  # 33-byte compressed SEC1 point
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("secp256k1 pubkey must be 33 bytes")
+
+    @property
+    def type(self) -> str:
+        return SECP256K1_KEY_TYPE
+
+    def address(self) -> bytes:
+        from .keys import Address
+
+        return Address(_address(self.data))
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self.data
+            )
+            r = int.from_bytes(sig[:32], "big")
+            s = int.from_bytes(sig[32:], "big")
+            if r == 0 or s == 0 or r >= _N or s >= _N:
+                return False
+            pub.verify(
+                encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())
+            )
+            return True
+        except Exception:
+            return False
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Secp256k1PubKey) and self.data == other.data
+        )
+
+    def __hash__(self) -> int:
+        return hash((SECP256K1_KEY_TYPE, self.data))
+
+
+@dataclass(frozen=True, slots=True)
+class Secp256k1PrivKey:
+    data: bytes  # 32-byte big-endian scalar
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("secp256k1 privkey must be 32 bytes")
+
+    @classmethod
+    def generate(cls, rng=os.urandom) -> "Secp256k1PrivKey":
+        while True:
+            seed = rng(32)
+            v = int.from_bytes(seed, "big")
+            if 0 < v < _N:
+                return cls(seed)
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "Secp256k1PrivKey":
+        v = int.from_bytes(hashlib.sha256(seed).digest(), "big") % (_N - 1) + 1
+        return cls(v.to_bytes(32, "big"))
+
+    @property
+    def type(self) -> str:
+        return SECP256K1_KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def _key(self) -> ec.EllipticCurvePrivateKey:
+        return ec.derive_private_key(
+            int.from_bytes(self.data, "big"), ec.SECP256K1()
+        )
+
+    def sign(self, msg: bytes) -> bytes:
+        """64-byte r||s with low-s normalization (deterministic modulo
+        OpenSSL's nonce; verification accepts any valid nonce)."""
+        der = self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        raw = self._key().public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint
+        )
+        return Secp256k1PubKey(raw)

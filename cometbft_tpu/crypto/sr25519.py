@@ -1,0 +1,481 @@
+"""sr25519: Schnorr signatures over ristretto255 with merlin transcripts.
+
+Reference surface: crypto/sr25519/{privkey,pubkey,batch}.go (backed by
+curve25519-voi's schnorrkel). This is a from-scratch TPU-framework
+implementation of the full stack:
+
+* keccak-f[1600] permutation (pure Python, host-side — transcripts are
+  byte-serial work with no TPU affinity, exactly like SHA-512 in the
+  ed25519 path);
+* STROBE-128 as specialized by merlin (strobe.rs subset: AD/meta-AD/
+  PRF/KEY);
+* merlin transcripts (dom-sep framing, LE32 length prefixes) — verified
+  against merlin's published protocol test vector;
+* ristretto255 encode/decode per RFC 9496 over the same edwards25519
+  arithmetic the ed25519 oracle uses — verified against the RFC's
+  generator-multiple vectors;
+* schnorrkel signing/verification: ``SigningContext`` transcripts,
+  ``Schnorr-sig`` protocol framing, 64-byte signatures with the
+  schnorrkel v1 marker bit (s[31] |= 0x80).
+
+Key expansion uses ExpansionMode::Uniform (first 32 SHA-512 bytes mod L);
+nonces are derived deterministically from the transcript + nonce seed
+(schnorrkel mixes an external RNG into its witness — signatures differ
+across implementations by design; VERIFICATION is the interoperable
+surface, and the verify equation s*B - k*A == R runs on the SAME batched
+TPU kernel as ed25519: ristretto equality is Edwards equality modulo
+torsion, which is exactly what the cofactored check [8](sB - kA - R) == O
+decides."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+from . import ed25519_ref as ref
+
+SR25519_KEY_TYPE = "sr25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 32  # mini secret
+SIGNATURE_SIZE = 64
+
+P = ref.P
+L = ref.L
+D = ref.D
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+SIGNING_CTX = b"substrate"  # the conventional substrate signing context
+
+# ---------------------------------------------------------------------------
+# keccak-f[1600]
+# ---------------------------------------------------------------------------
+
+_ROTC = [
+    [0, 36, 3, 41, 18],
+    [1, 44, 10, 45, 2],
+    [62, 6, 43, 15, 61],
+    [28, 55, 25, 21, 56],
+    [27, 20, 39, 8, 14],
+]
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+_M64 = (1 << 64) - 1
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (64 - n))) & _M64
+
+
+def keccak_f1600(state: bytearray) -> None:
+    """In-place permutation of a 200-byte state (little-endian lanes)."""
+    a = [
+        int.from_bytes(state[8 * i : 8 * i + 8], "little") for i in range(25)
+    ]
+    for rc in _RC:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20]
+             for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] ^= d[x]
+        # rho + pi
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(
+                    a[x + 5 * y], _ROTC[x][y]
+                )
+        # chi
+        for x in range(5):
+            for y in range(5):
+                a[x + 5 * y] = b[x + 5 * y] ^ (
+                    (~b[(x + 1) % 5 + 5 * y]) & b[(x + 2) % 5 + 5 * y]
+                ) & _M64
+        # iota
+        a[0] ^= rc
+    for i in range(25):
+        state[8 * i : 8 * i + 8] = a[i].to_bytes(8, "little")
+
+
+# ---------------------------------------------------------------------------
+# STROBE-128 (merlin's subset — strobe.rs)
+# ---------------------------------------------------------------------------
+
+_STROBE_R = 166
+_FLAG_I, _FLAG_A, _FLAG_C, _FLAG_T, _FLAG_M, _FLAG_K = 1, 2, 4, 8, 16, 32
+
+
+class Strobe128:
+    def __init__(self, protocol_label: bytes):
+        self.state = bytearray(200)
+        init = (
+            bytes([1, _STROBE_R + 2, 1, 0, 1, 96]) + b"STROBEv1.0.2"
+        )
+        self.state[: len(init)] = init
+        keccak_f1600(self.state)
+        self.pos = 0
+        self.pos_begin = 0
+        self.cur_flags = 0
+        self.meta_ad(protocol_label, False)
+
+    def _run_f(self) -> None:
+        self.state[self.pos] ^= self.pos_begin
+        self.state[self.pos + 1] ^= 0x04
+        self.state[_STROBE_R + 1] ^= 0x80
+        keccak_f1600(self.state)
+        self.pos = 0
+        self.pos_begin = 0
+
+    def _absorb(self, data: bytes) -> None:
+        for byte in data:
+            self.state[self.pos] ^= byte
+            self.pos += 1
+            if self.pos == _STROBE_R:
+                self._run_f()
+
+    def _overwrite(self, data: bytes) -> None:
+        for byte in data:
+            self.state[self.pos] = byte
+            self.pos += 1
+            if self.pos == _STROBE_R:
+                self._run_f()
+
+    def _squeeze(self, n: int) -> bytes:
+        out = bytearray()
+        for _ in range(n):
+            out.append(self.state[self.pos])
+            self.state[self.pos] = 0
+            self.pos += 1
+            if self.pos == _STROBE_R:
+                self._run_f()
+        return bytes(out)
+
+    def _begin_op(self, flags: int, more: bool) -> None:
+        if more:
+            assert flags == self.cur_flags
+            return
+        old_begin = self.pos_begin
+        self.pos_begin = self.pos + 1
+        self.cur_flags = flags
+        self._absorb(bytes([old_begin, flags]))
+        force_f = bool(flags & (_FLAG_C | _FLAG_K))
+        if force_f and self.pos != 0:
+            self._run_f()
+
+    def meta_ad(self, data: bytes, more: bool) -> None:
+        self._begin_op(_FLAG_M | _FLAG_A, more)
+        self._absorb(data)
+
+    def ad(self, data: bytes, more: bool) -> None:
+        self._begin_op(_FLAG_A, more)
+        self._absorb(data)
+
+    def prf(self, n: int, more: bool) -> bytes:
+        self._begin_op(_FLAG_I | _FLAG_A | _FLAG_C, more)
+        return self._squeeze(n)
+
+    def key(self, data: bytes, more: bool) -> None:
+        self._begin_op(_FLAG_A | _FLAG_C, more)
+        self._overwrite(data)
+
+    def clone(self) -> "Strobe128":
+        other = object.__new__(Strobe128)
+        other.state = bytearray(self.state)
+        other.pos = self.pos
+        other.pos_begin = self.pos_begin
+        other.cur_flags = self.cur_flags
+        return other
+
+
+# ---------------------------------------------------------------------------
+# merlin transcripts
+# ---------------------------------------------------------------------------
+
+
+def _le32(n: int) -> bytes:
+    return n.to_bytes(4, "little")
+
+
+class Transcript:
+    def __init__(self, label: bytes, _strobe: Strobe128 | None = None):
+        if _strobe is not None:
+            self.strobe = _strobe
+            return
+        self.strobe = Strobe128(b"Merlin v1.0")
+        self.append_message(b"dom-sep", label)
+
+    def append_message(self, label: bytes, message: bytes) -> None:
+        self.strobe.meta_ad(label + _le32(len(message)), False)
+        self.strobe.ad(message, False)
+
+    def append_u64(self, label: bytes, n: int) -> None:
+        self.append_message(label, n.to_bytes(8, "little"))
+
+    def challenge_bytes(self, label: bytes, n: int) -> bytes:
+        self.strobe.meta_ad(label + _le32(n), False)
+        return self.strobe.prf(n, False)
+
+    def challenge_scalar(self, label: bytes) -> int:
+        return int.from_bytes(self.challenge_bytes(label, 64), "little") % L
+
+    def witness_scalar(self, label: bytes, nonce_seeds: list[bytes]) -> int:
+        """Deterministic witness: fork the transcript, rekey with the
+        nonce seeds (merlin TranscriptRngBuilder without external
+        entropy)."""
+        fork = Strobe128.__new__(Strobe128)
+        fork.state = bytearray(self.strobe.state)
+        fork.pos = self.strobe.pos
+        fork.pos_begin = self.strobe.pos_begin
+        fork.cur_flags = self.strobe.cur_flags
+        for seed in nonce_seeds:
+            fork.meta_ad(label + _le32(len(seed)), False)
+            fork.key(seed, False)
+        return int.from_bytes(fork.prf(64, False), "little") % L
+
+    def clone(self) -> "Transcript":
+        return Transcript(b"", _strobe=self.strobe.clone())
+
+
+# ---------------------------------------------------------------------------
+# ristretto255 (RFC 9496) over the shared edwards25519 integer arithmetic
+# ---------------------------------------------------------------------------
+
+
+def _is_neg(x: int) -> bool:
+    return x % P % 2 == 1
+
+
+def _abs(x: int) -> int:
+    x %= P
+    return P - x if _is_neg(x) else x
+
+
+def _sqrt_ratio_m1(u: int, v: int) -> tuple[bool, int]:
+    """(was_square, sqrt(u/v) or sqrt(i*u/v)) per RFC 9496 §4.2."""
+    v3 = v * v % P * v % P
+    v7 = v3 * v3 % P * v % P
+    r = u * v3 % P * pow(u * v7 % P, (P - 5) // 8, P) % P
+    check = v * r % P * r % P
+    correct = check == u % P
+    flipped = check == (-u) % P
+    flipped_i = check == (-u) % P * SQRT_M1 % P
+    if flipped or flipped_i:
+        r = r * SQRT_M1 % P
+    return correct or flipped, _abs(r)
+
+
+_INVSQRT_A_MINUS_D = _sqrt_ratio_m1(1, (-1 - D) % P)[1]
+
+
+def ristretto_decode(data: bytes):
+    """32 bytes -> Edwards extended point, or None if invalid."""
+    if len(data) != 32:
+        return None
+    s = int.from_bytes(data, "little")
+    if s >= P or _is_neg(s):
+        return None
+    ss = s * s % P
+    u1 = (1 - ss) % P
+    u2 = (1 + ss) % P
+    u2_sqr = u2 * u2 % P
+    v = (-(D * u1 % P * u1) - u2_sqr) % P
+    was_square, invsqrt = _sqrt_ratio_m1(1, v * u2_sqr % P)
+    den_x = invsqrt * u2 % P
+    den_y = invsqrt * den_x % P * v % P
+    x = _abs(2 * s % P * den_x % P)
+    y = u1 * den_y % P
+    t = x * y % P
+    if not was_square or _is_neg(t) or y == 0:
+        return None
+    return (x, y, 1, t)
+
+
+def ristretto_encode(pt) -> bytes:
+    """Edwards extended point -> canonical 32-byte encoding (RFC 9496)."""
+    x0, y0, z0, t0 = pt
+    u1 = (z0 + y0) % P * ((z0 - y0) % P) % P
+    u2 = x0 * y0 % P
+    _, invsqrt = _sqrt_ratio_m1(1, u1 * u2 % P * u2 % P)
+    den1 = invsqrt * u1 % P
+    den2 = invsqrt * u2 % P
+    z_inv = den1 * den2 % P * t0 % P
+    if _is_neg(t0 * z_inv % P):
+        x, y = y0 * SQRT_M1 % P, x0 * SQRT_M1 % P
+        den_inv = den1 * _INVSQRT_A_MINUS_D % P
+    else:
+        x, y = x0, y0
+        den_inv = den2
+    if _is_neg(x * z_inv % P):
+        y = (-y) % P
+    s = _abs(den_inv * ((z0 - y) % P) % P)
+    return s.to_bytes(32, "little")
+
+
+def ristretto_eq(p, q) -> bool:
+    """x1*y2 == y1*x2 or y1*y2 == x1*x2 (RFC 9496 / dalek equality)."""
+    x1, y1, _, _ = p
+    x2, y2, _, _ = q
+    return (x1 * y2 - y1 * x2) % P == 0 or (y1 * y2 - x1 * x2) % P == 0
+
+
+# ---------------------------------------------------------------------------
+# schnorrkel
+# ---------------------------------------------------------------------------
+
+
+def _expand_uniform(mini: bytes) -> tuple[int, bytes]:
+    """ExpansionMode::Uniform: scalar = SHA512(mini)[:32] mod L, nonce =
+    SHA512(mini)[32:]."""
+    h = hashlib.sha512(mini).digest()
+    return int.from_bytes(h[:32], "little") % L, h[32:]
+
+
+def public_from_mini(mini: bytes) -> bytes:
+    scalar, _ = _expand_uniform(mini)
+    return ristretto_encode(ref.scalar_mult(scalar, ref.BASE))
+
+
+def _signing_transcript(context: bytes, msg: bytes) -> Transcript:
+    """schnorrkel SigningContext: Transcript(b"SigningContext") +
+    append(b"", ctx) + append(b"sign-bytes", msg)."""
+    t = Transcript(b"SigningContext")
+    t.append_message(b"", context)
+    t.append_message(b"sign-bytes", msg)
+    return t
+
+
+def sign(mini: bytes, msg: bytes, context: bytes = SIGNING_CTX) -> bytes:
+    scalar, nonce_seed = _expand_uniform(mini)
+    pub = ristretto_encode(ref.scalar_mult(scalar, ref.BASE))
+    t = _signing_transcript(context, msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pub)
+    r = t.witness_scalar(b"signing", [nonce_seed])
+    r_enc = ristretto_encode(ref.scalar_mult(r, ref.BASE))
+    t.append_message(b"sign:R", r_enc)
+    k = t.challenge_scalar(b"sign:c")
+    s = (k * scalar + r) % L
+    s_bytes = bytearray(s.to_bytes(32, "little"))
+    s_bytes[31] |= 0x80  # schnorrkel v1 marker
+    return r_enc + bytes(s_bytes)
+
+
+def verification_parts(
+    pubkey: bytes, msg: bytes, sig: bytes, context: bytes = SIGNING_CTX
+):
+    """Decompose a signature into the kernel equation's inputs.
+
+    Returns (A_edwards, R_edwards, s, k) or None if malformed — exactly
+    the (pubkey point, R point, scalar, challenge) quadruple the batched
+    TPU verifier consumes; sr25519 rides the ed25519 kernel because
+    ristretto equality is Edwards equality modulo torsion, which the
+    cofactored check decides."""
+    if len(sig) != SIGNATURE_SIZE or len(pubkey) != PUBKEY_SIZE:
+        return None
+    if not (sig[63] & 0x80):
+        return None  # not a schnorrkel v1 signature
+    s_bytes = bytearray(sig[32:])
+    s_bytes[31] &= 0x7F
+    s = int.from_bytes(bytes(s_bytes), "little")
+    if s >= L:
+        return None
+    a_pt = ristretto_decode(pubkey)
+    r_pt = ristretto_decode(sig[:32])
+    if a_pt is None or r_pt is None:
+        return None
+    t = _signing_transcript(context, msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pubkey)
+    t.append_message(b"sign:R", sig[:32])
+    k = t.challenge_scalar(b"sign:c")
+    return a_pt, r_pt, s, k
+
+
+def verify(
+    pubkey: bytes, msg: bytes, sig: bytes, context: bytes = SIGNING_CTX
+) -> bool:
+    """Host-side verification: s*B - k*A == R in ristretto."""
+    parts = verification_parts(pubkey, msg, sig, context)
+    if parts is None:
+        return False
+    a_pt, r_pt, s, k = parts
+    sb = ref.scalar_mult(s, ref.BASE)
+    ka = ref.scalar_mult(k, a_pt)
+    lhs = ref.point_add(sb, ref.point_neg(ka))
+    return ristretto_eq(lhs, r_pt)
+
+
+# ---------------------------------------------------------------------------
+# key types (crypto.PubKey / PrivKey contracts)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True, slots=True)
+class Sr25519PubKey:
+    data: bytes
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PUBKEY_SIZE:
+            raise ValueError("sr25519 pubkey must be 32 bytes")
+
+    @property
+    def type(self) -> str:
+        return SR25519_KEY_TYPE
+
+    def address(self) -> bytes:
+        from . import tmhash
+        from .keys import Address
+
+        return Address(tmhash.sum_truncated(self.data))
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return verify(self.data, msg, sig)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Sr25519PubKey) and self.data == other.data
+
+    def __hash__(self) -> int:
+        return hash((SR25519_KEY_TYPE, self.data))
+
+
+@dataclass(frozen=True, slots=True)
+class Sr25519PrivKey:
+    data: bytes  # mini secret
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PRIVKEY_SIZE:
+            raise ValueError("sr25519 privkey must be a 32-byte mini secret")
+
+    @classmethod
+    def generate(cls, rng=os.urandom) -> "Sr25519PrivKey":
+        return cls(rng(32))
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "Sr25519PrivKey":
+        return cls(seed)
+
+    @property
+    def type(self) -> str:
+        return SR25519_KEY_TYPE
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def sign(self, msg: bytes) -> bytes:
+        return sign(self.data, msg)
+
+    def pub_key(self) -> Sr25519PubKey:
+        return Sr25519PubKey(public_from_mini(self.data))

@@ -78,6 +78,33 @@ def _y_limbs(bits: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(limbs.T)
 
 
+def pack_parts(parts) -> tuple[np.ndarray, np.ndarray]:
+    """Pack pre-decomposed verification quadruples into the wire format.
+
+    ``parts[i]`` is (a_edwards32, r_edwards32, s_int, k_int) or None for a
+    host-rejected lane. Used by signature schemes whose challenge is NOT
+    SHA512(R||A||M) — sr25519 computes k from a merlin transcript on host
+    and rides the same cofactored kernel (crypto/sr25519.py).
+    """
+    n = len(parts)
+    host_ok = np.ones(n, bool)
+    buf = np.zeros((128, n), np.uint8)
+    for i, part in enumerate(parts):
+        if part is None:
+            host_ok[i] = False
+            continue
+        a_enc, r_enc, s_int, k_int = part
+        buf[0:32, i] = np.frombuffer(a_enc, np.uint8)
+        buf[32:64, i] = np.frombuffer(r_enc, np.uint8)
+        buf[64:96, i] = np.frombuffer(
+            s_int.to_bytes(32, "little"), np.uint8
+        )
+        buf[96:128, i] = np.frombuffer(
+            ((L - k_int) % L).to_bytes(32, "little"), np.uint8
+        )
+    return buf, host_ok
+
+
 def pack_bytes(pubkeys, msgs, sigs) -> tuple[np.ndarray, np.ndarray]:
     """Host-side packing to the compact device wire format.
 
@@ -191,7 +218,29 @@ def _kernel_from_bytes(buf):
 
 
 @lru_cache(maxsize=None)
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache: the verify kernel compiles once
+    per (backend, bucket) across ALL processes — node restarts, tests,
+    CLI runs — instead of paying the 30-150 s XLA compile each boot."""
+    import os
+
+    cache_dir = os.environ.get(
+        "COMETBFT_TPU_XLA_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "cometbft_tpu_xla"
+        ),
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    except Exception:
+        pass  # older jax or read-only fs: compiles stay in-process
+
+
+@lru_cache(maxsize=None)
 def _jitted_kernel():
+    _enable_compilation_cache()
     return jax.jit(_kernel_from_bytes)
 
 

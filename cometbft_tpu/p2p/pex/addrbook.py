@@ -167,7 +167,7 @@ class AddrBook:
 
     def _evict_locked(self, bucket: set[str]) -> None:
         """Drop the stalest entry of a full bucket."""
-        victim = max(
+        victim = min(
             bucket,
             key=lambda nid: self._addrs[nid].last_attempt
             if nid in self._addrs

@@ -82,9 +82,13 @@ class PexReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         if peer.outbound:
-            # outbound connect proved the address (pex_reactor.go AddPeer)
+            # outbound connect proved the address (pex_reactor.go AddPeer).
+            # socket_addr is bare host:port; the book keys by node id.
             if peer.socket_addr:
-                self.book.mark_good(peer.socket_addr)
+                addr = peer.socket_addr
+                if "@" not in addr:
+                    addr = f"{peer.id}@{addr}"
+                self.book.mark_good(addr)
             self._request_addrs(peer)
         elif self.seed_mode:
             # seeds serve a selection immediately, then disconnect

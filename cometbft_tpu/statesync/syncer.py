@@ -15,7 +15,6 @@ import threading
 import time
 
 from ..abci import types as abci
-from ..light.errors import LightClientError
 from .chunks import ChunkQueue
 from .snapshots import Snapshot, SnapshotPool
 
@@ -202,10 +201,7 @@ class Syncer:
         for _ in range(attempts):
             try:
                 return fn()
-            except LightClientError as e:
-                last = e
-                time.sleep(delay)
-            except Exception as e:  # provider/transport faults
+            except Exception as e:  # light-client or provider/transport
                 last = e
                 time.sleep(delay)
         raise SyncError(f"state provider unavailable: {last}")

@@ -6,6 +6,16 @@ must run before jax is imported anywhere.
 """
 
 import os
+import sys
+
+# Tests are CPU-only by design; the accelerator tunnel plugin (axon) can
+# BLOCK jax import/backend init when its remote endpoint is unreachable,
+# so keep it off the import path entirely rather than merely deselected.
+sys.path = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = ":".join(
+    p for p in os.environ.get("PYTHONPATH", "").split(":")
+    if p and ".axon_site" not in p
+)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
