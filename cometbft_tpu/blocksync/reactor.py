@@ -242,6 +242,12 @@ class BlocksyncReactor(Reactor):
 
     def _switch_to_consensus(self) -> None:
         """reactor.go:383-386 → consensus/reactor.go:109."""
+        if self.logger is not None:
+            self.logger.info(
+                "switching to consensus",
+                height=self.block_store.height(),
+                blocks_synced=self._n_synced,
+            )
         self.pool.stop()
         self.synced.set()
         if self.consensus_reactor is not None:

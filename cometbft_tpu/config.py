@@ -27,6 +27,7 @@ class BaseConfig:
     node_key_file: str = "config/node_key.json"
     block_sync: bool = True
     state_sync: bool = False
+    log_level: str = "info"  # debug | info | error | none
 
     def resolve(self, path: str) -> str:
         p = os.path.expanduser(path)

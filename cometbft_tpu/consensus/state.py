@@ -167,6 +167,9 @@ class ConsensusState(BaseService):
         self.update_to_state(state)
         self.reconstruct_last_commit_if_needed(state)
 
+    def add_block_committed_hook(self, fn) -> None:
+        self._on_block_committed.append(fn)
+
     # ------------------------------------------------------------------
     # public surface
     # ------------------------------------------------------------------

@@ -63,16 +63,21 @@ class Ed25519BatchVerifier(BatchVerifier):
         return len(self._pubkeys)
 
     def verify(self) -> tuple[bool, list[bool]]:
+        import time as _time
+
+        t0 = _time.perf_counter()
         if len(self._pubkeys) < HOST_BATCH_THRESHOLD:
             from . import fast25519
 
             bitmap = fast25519.verify_many(
                 self._pubkeys, self._msgs, self._sigs
             )
+            _observe("ed25519-host", t0, len(bitmap))
             return all(bitmap), bitmap
         from ..ops import verify as ov
 
         ok_all, bitmap = ov.verify_batch(self._pubkeys, self._msgs, self._sigs)
+        _observe("ed25519-tpu", t0, len(self._pubkeys))
         return ok_all, list(np.asarray(bitmap, bool))
 
 
@@ -108,15 +113,19 @@ class Sr25519BatchVerifier(BatchVerifier):
         return len(self._pubkeys)
 
     def verify(self) -> tuple[bool, list[bool]]:
+        import time as _time
+
         from . import ed25519_ref as ref
         from . import sr25519 as sr
 
+        t0 = _time.perf_counter()
         n = len(self._pubkeys)
         if n < self.HOST_THRESHOLD:
             bitmap = [
                 sr.verify(p, m, s)
                 for p, m, s in zip(self._pubkeys, self._msgs, self._sigs)
             ]
+            _observe("sr25519-host", t0, n)
             return all(bitmap), bitmap
         from ..ops import verify as ov
 
@@ -133,6 +142,7 @@ class Sr25519BatchVerifier(BatchVerifier):
         buf, host_ok = ov.pack_parts(parts)
         device_ok = ov.verify_bytes_async(buf, n)()
         valid = device_ok & host_ok
+        _observe("sr25519-tpu", t0, n)
         return bool(valid.all()), list(np.asarray(valid, bool))
 
 
@@ -140,6 +150,20 @@ _BATCH_BACKENDS: dict[str, type] = {
     keys.ED25519_KEY_TYPE: Ed25519BatchVerifier,
     "sr25519": Sr25519BatchVerifier,
 }
+
+
+def _observe(backend: str, t0: float, n: int) -> None:
+    """Record batch-verify latency/volume when a node's metrics are live."""
+    import time as _time
+
+    from ..libs import metrics as libmetrics
+
+    m = libmetrics.DEFAULT_NODE_METRICS
+    if m is not None:
+        m.verify_batch_seconds.labels(backend).observe(
+            _time.perf_counter() - t0
+        )
+        m.verify_batch_sigs.labels(backend).inc(n)
 
 
 def supports_batch_verifier(pub_key) -> bool:

@@ -69,7 +69,9 @@ class Secp256k1PubKey:
             )
             r = int.from_bytes(sig[:32], "big")
             s = int.from_bytes(sig[32:], "big")
-            if r == 0 or s == 0 or r >= _N or s >= _N:
+            # low-S only: the reference rejects malleable high-S forms
+            # (secp256k1.go Signature serialization is canonical)
+            if r == 0 or s == 0 or r >= _N or s > _N // 2:
                 return False
             pub.verify(
                 encode_dss_signature(r, s), msg, ec.ECDSA(hashes.SHA256())

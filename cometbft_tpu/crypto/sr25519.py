@@ -232,11 +232,7 @@ class Transcript:
         """Deterministic witness: fork the transcript, rekey with the
         nonce seeds (merlin TranscriptRngBuilder without external
         entropy)."""
-        fork = Strobe128.__new__(Strobe128)
-        fork.state = bytearray(self.strobe.state)
-        fork.pos = self.strobe.pos
-        fork.pos_begin = self.strobe.pos_begin
-        fork.cur_flags = self.strobe.cur_flags
+        fork = self.strobe.clone()
         for seed in nonce_seeds:
             fork.meta_ad(label + _le32(len(seed)), False)
             fork.key(seed, False)

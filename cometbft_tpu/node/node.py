@@ -100,6 +100,17 @@ class Node(BaseService):
         self.config = config
         self.genesis = genesis
 
+        # 0. Observability floor: leveled structured logging + metrics
+        # (reference: libs/log + per-package prometheus metrics).
+        from ..libs import log as liblog
+        from ..libs import metrics as libmetrics
+
+        self.logger = liblog.Logger(
+            level=liblog.parse_level(config.base.log_level)
+        ).with_fields(chain=genesis.chain_id[:16])
+        self.metrics = libmetrics.NodeMetrics()
+        libmetrics.DEFAULT_NODE_METRICS = self.metrics
+
         # 1. DBs (setup.go initDBs:107)
         self.app_db = _make_db(config, "app")
         self.block_db = _make_db(config, "blockstore")
@@ -174,8 +185,11 @@ class Node(BaseService):
         )
         if priv_validator is not None:
             self.consensus.set_priv_validator(priv_validator)
+        self.consensus.logger = self.logger.with_module("consensus")
         self.state = state
         self._txs_available_thread: threading.Thread | None = None
+        self._last_commit_time = 0.0
+        self.consensus.add_block_committed_hook(self._on_block_committed)
 
         # 9. P2P: transport + switch + reactors (setup.go:325,394)
         self.node_key = NodeKey.load_or_generate(
@@ -321,11 +335,50 @@ class Node(BaseService):
             ),
             config=config,
         )
+        self.rpc_env.extra["metrics"] = self.metrics
+        self.rpc_env.extra["refresh_metrics"] = self._refresh_metrics
         self.rpc_server = (
-            RPCServer(self.rpc_env, config.rpc.laddr)
+            RPCServer(
+                self.rpc_env,
+                config.rpc.laddr,
+                logger=self.logger.with_module("rpc"),
+            )
             if config.rpc.laddr
             else None
         )
+        self.switch.logger = self.logger.with_module("p2p")
+        self.blocksync_reactor.logger = self.logger.with_module("blocksync")
+        self.statesync_reactor.logger = self.logger.with_module("statesync")
+
+    def _on_block_committed(self, height: int) -> None:
+        """Metrics + the per-commit log line (consensus/metrics.go)."""
+        import time as _time
+
+        meta = self.block_store.load_block_meta(height)
+        now = _time.monotonic()
+        self.metrics.height.set(height)
+        if self._last_commit_time:
+            self.metrics.block_interval.observe(now - self._last_commit_time)
+        self._last_commit_time = now
+        if meta is not None:
+            self.metrics.block_txs.set(meta.num_txs)
+            self.logger.with_module("consensus").info(
+                "finalized block",
+                height=height,
+                num_txs=meta.num_txs,
+                app_hash=meta.header.app_hash,
+            )
+
+    def _refresh_metrics(self) -> None:
+        """Pull-time gauges (collector pattern): cheap reads at scrape —
+        nothing here may touch the consensus commit path or disk."""
+        out, inb = self.switch.num_peers()
+        self.metrics.peers.set(out + inb)
+        self.metrics.mempool_size.set(self.mempool.size())
+        with self.consensus._mtx:
+            vals = self.consensus.rs.validators
+        if vals is not None:
+            self.metrics.validators.set(len(vals))
 
     def _make_state_provider(self):
         """Light-client state provider from config.state_sync
@@ -356,6 +409,8 @@ class Node(BaseService):
     def _statesync_routine(self) -> None:
         """Background restore; on success bootstrap stores and hand off to
         blocksync (node.go startStateSync + statesync completion path)."""
+        slog = self.logger.with_module("statesync")
+        slog.info("discovering snapshots")
         try:
             state, commit = self.syncer.sync_any(deadline=120.0)
         except Exception:
@@ -382,11 +437,16 @@ class Node(BaseService):
                     pass
                 return
             # nothing applied: safe to block-sync the chain from genesis
+            slog.error("statesync failed; falling back to blocksync")
             self.blocksync_reactor.switch_to_block_sync(self.state)
             return
         self.state_store.bootstrap(state)
         self.block_store.save_seen_commit(commit)
         self.state = state
+        slog.info(
+            "snapshot restored", height=state.last_block_height,
+            app_hash=state.app_hash,
+        )
         self.blocksync_reactor.switch_to_block_sync(state)
 
     def _on_app_error(self, err: Exception) -> None:
@@ -404,7 +464,13 @@ class Node(BaseService):
         # reactors, which start consensus) → dial persistent peers
         if self.rpc_server is not None:
             self.rpc_server.start()
+            self.logger.with_module("rpc").info(
+                "RPC server listening", addr=self.rpc_server.bound_addr
+            )
         self.transport.listen(self.config.p2p.laddr)
+        self.logger.with_module("p2p").info(
+            "p2p transport listening", addr=self.transport.listen_addr
+        )
         self.node_info.listen_addr = self.transport.listen_addr
         self.switch.start()
         persistent = [
@@ -441,6 +507,10 @@ class Node(BaseService):
                 self.consensus.handle_txs_available()
 
     def on_stop(self) -> None:
+        from ..libs import metrics as libmetrics
+
+        if libmetrics.DEFAULT_NODE_METRICS is self.metrics:
+            libmetrics.DEFAULT_NODE_METRICS = None
         if self.indexer_service is not None:
             try:
                 self.indexer_service.stop()

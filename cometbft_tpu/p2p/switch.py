@@ -194,6 +194,13 @@ class Switch(BaseService):
             with self._peers_mtx:
                 self._peers.pop(peer.id, None)
             raise
+        if self.logger is not None:
+            self.logger.info(
+                "peer connected",
+                peer=peer.id[:10],
+                outbound=peer.outbound,
+                addr=peer.socket_addr,
+            )
         return peer
 
     def stop_and_remove_peer(self, peer: Peer, reason) -> None:
@@ -205,6 +212,10 @@ class Switch(BaseService):
                 peer.stop()
         except Exception:
             pass
+        if self.logger is not None:
+            self.logger.info(
+                "peer disconnected", peer=peer.id[:10], reason=str(reason)
+            )
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)

@@ -131,6 +131,9 @@ class _Handler(BaseHTTPRequestHandler):
         if route == "":
             self._send_json({"routes": sorted(self.routes)})
             return
+        if route == "metrics":
+            self._do_metrics()
+            return
         params = {
             k: v[0] if len(v) == 1 else v
             for k, v in urllib.parse.parse_qs(parsed.query).items()
@@ -152,6 +155,28 @@ class _Handler(BaseHTTPRequestHandler):
                 _rpc_response(-1, error=_rpc_error(e.code, str(e), e.data)),
                 status=500 if e.code == -32603 else 400,
             )
+
+    def _do_metrics(self) -> None:
+        """Prometheus text exposition (node/node.go:630 analog)."""
+        metrics = self.env.extra.get("metrics")
+        if metrics is None:
+            self._send_json(
+                _rpc_response(-1, error=_rpc_error(-32601, "metrics disabled")),
+                status=404,
+            )
+            return
+        refresh = self.env.extra.get("refresh_metrics")
+        if refresh is not None:
+            try:
+                refresh()
+            except Exception:
+                pass
+        body = metrics.registry.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     # -- WebSocket (ws_handler.go) ----------------------------------------
 

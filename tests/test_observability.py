@@ -1,0 +1,160 @@
+"""Logging + metrics tests (reference analog: libs/log tests,
+metrics exposition via the prometheus endpoint)."""
+
+import io
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.libs.metrics import NodeMetrics, Registry
+
+
+class TestLogger:
+    def _logger(self, level=liblog.DEBUG):
+        sink = io.StringIO()
+        return liblog.Logger(sink=sink, level=level), sink
+
+    def test_format_and_fields(self):
+        logger, sink = self._logger()
+        logger.with_module("consensus").info(
+            "finalized block", height=5, app_hash=b"\xab\xcd"
+        )
+        line = sink.getvalue()
+        assert line.startswith("I[")
+        assert "finalized block" in line
+        assert "module=consensus" in line
+        assert "height=5" in line
+        assert "app_hash=ABCD" in line
+
+    def test_level_filtering(self):
+        logger, sink = self._logger(level=liblog.INFO)
+        logger.debug("hidden")
+        logger.info("shown")
+        logger.error("also shown")
+        out = sink.getvalue()
+        assert "hidden" not in out
+        assert "shown" in out and "also shown" in out
+
+    def test_per_module_levels(self):
+        logger, sink = self._logger(level=liblog.DEBUG)
+        logger.set_module_level("p2p", liblog.ERROR)
+        logger.with_module("p2p").info("chatty")
+        logger.with_module("p2p").error("p2p boom")
+        logger.with_module("consensus").info("important")
+        out = sink.getvalue()
+        assert "chatty" not in out
+        assert "p2p boom" in out and "important" in out
+
+    def test_bound_fields_compose(self):
+        logger, sink = self._logger()
+        child = logger.with_fields(a=1).with_fields(b=2)
+        child.info("msg")
+        assert "a=1" in sink.getvalue() and "b=2" in sink.getvalue()
+
+    def test_parse_level(self):
+        assert liblog.parse_level("debug") == liblog.DEBUG
+        assert liblog.parse_level("ERROR") == liblog.ERROR
+        with pytest.raises(ValueError):
+            liblog.parse_level("verbose")
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_render(self):
+        r = Registry(namespace="t")
+        c = r.counter("reqs_total", "requests")
+        g = r.gauge("height")
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        c.inc()
+        c.inc(2)
+        g.set(42)
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = r.render()
+        assert "# TYPE t_reqs_total counter" in text
+        assert "t_reqs_total 3.0" in text
+        assert "t_height 42.0" in text
+        assert 't_lat_seconds_bucket{le="0.1"} 1' in text
+        assert 't_lat_seconds_bucket{le="1.0"} 2' in text
+        assert 't_lat_seconds_bucket{le="+Inf"} 3' in text
+        assert "t_lat_seconds_count 3" in text
+
+    def test_labels(self):
+        r = Registry(namespace="t")
+        c = r.counter("verified_total", label_names=("backend",))
+        c.labels("tpu").inc(5)
+        c.labels("host").inc(1)
+        text = r.render()
+        assert 't_verified_total{backend="tpu"} 5.0' in text
+        assert 't_verified_total{backend="host"} 1.0' in text
+
+    def test_node_metrics_shape(self):
+        m = NodeMetrics()
+        m.height.set(7)
+        m.verify_batch_sigs.labels("ed25519-host").inc(100)
+        text = m.registry.render()
+        assert "cometbft_tpu_consensus_height 7.0" in text
+        assert 'backend="ed25519-host"' in text
+
+
+class TestNodeObservability:
+    def test_metrics_endpoint_and_commit_logs(self, tmp_path):
+        """A live node serves /metrics with real values and logs commits."""
+        import dataclasses
+        import time
+
+        from cometbft_tpu.config import default_config
+        from cometbft_tpu.node import Node, init_files
+        from helpers import make_genesis
+
+        _MS = 1_000_000
+        cfg = default_config()
+        cfg.base.home = str(tmp_path)
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=400 * _MS,
+            timeout_prevote_ns=200 * _MS,
+            timeout_precommit_ns=200 * _MS,
+            timeout_commit_ns=100 * _MS,
+            skip_timeout_commit=False,
+        )
+        init_files(cfg)
+        genesis, pvs = make_genesis(1)
+        node = Node(cfg, genesis, pvs[0])
+        sink = io.StringIO()
+        node.logger = liblog.Logger(sink=sink, level=liblog.INFO).with_fields(
+            chain=genesis.chain_id
+        )
+        # re-bind module loggers made before the override
+        node.consensus.logger = node.logger.with_module("consensus")
+        node.consensus._on_block_committed = []
+        node.consensus.add_block_committed_hook(node._on_block_committed)
+        try:
+            node.start()
+            deadline = time.monotonic() + 20
+            while (
+                node.block_store.height() < 3
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert node.block_store.height() >= 3
+            with urllib.request.urlopen(
+                f"http://{node.rpc_server.bound_addr}/metrics", timeout=5
+            ) as r:
+                assert "text/plain" in r.headers["Content-Type"]
+                text = r.read().decode()
+            height_line = [
+                ln
+                for ln in text.splitlines()
+                if ln.startswith("cometbft_tpu_consensus_height ")
+            ][0]
+            assert float(height_line.split()[-1]) >= 3
+            assert "cometbft_tpu_consensus_block_interval_seconds_count" in text
+            logs = sink.getvalue()
+            assert "finalized block" in logs
+            assert "module=consensus" in logs
+        finally:
+            node.stop()
