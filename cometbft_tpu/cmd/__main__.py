@@ -1,7 +1,8 @@
 """``python -m cometbft_tpu.cmd`` — the node CLI (reference:
 cmd/cometbft/main.go:14-52 + commands/).
 
-Commands: init, start, unsafe-reset-all, show-validator, version.
+Commands: init, start, unsafe-reset-all, show-validator, show-node-id,
+gen-validator, testnet, rollback, inspect, version.
 """
 
 from __future__ import annotations
@@ -16,16 +17,29 @@ import time
 
 
 def _config(args):
+    """Defaults <- config.toml (if present) <- CLI flags, then validated
+    (commands/root.go + viper layering)."""
     from ..config import default_config
+    from ..config_file import load_toml, validate_basic
 
     cfg = default_config()
     cfg.base.home = args.home
+    toml_path = cfg.base.resolve("config/config.toml")
+    if os.path.exists(toml_path):
+        home = cfg.base.home
+        cfg = load_toml(toml_path, base=cfg)
+        cfg.base.home = home  # the file must not relocate the tree
     if getattr(args, "proxy_app", None):
         cfg.base.proxy_app = args.proxy_app
     if getattr(args, "p2p_laddr", None):
         cfg.p2p.laddr = args.p2p_laddr
     if getattr(args, "persistent_peers", None):
         cfg.p2p.persistent_peers = args.persistent_peers
+    if getattr(args, "rpc_laddr", None):
+        cfg.rpc.laddr = args.rpc_laddr
+    if getattr(args, "log_level", None):
+        cfg.base.log_level = args.log_level
+    validate_basic(cfg)
     return cfg
 
 
@@ -98,6 +112,156 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_gen_validator(args) -> int:
+    """commands/gen_validator.go: print a fresh validator key (no files)."""
+    from ..crypto.keys import Ed25519PrivKey
+
+    pv = Ed25519PrivKey.generate()
+    print(
+        json.dumps(
+            {
+                "address": bytes(pv.pub_key().address()).hex().upper(),
+                "pub_key": {"type": pv.pub_key().type,
+                            "value": pv.pub_key().bytes().hex()},
+                "priv_key": {"type": pv.type, "value": pv.bytes().hex()},
+            }
+        )
+    )
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go: write N node home dirs sharing one genesis."""
+    from dataclasses import replace
+
+    from ..config import default_config
+    from ..config_file import save_toml
+    from ..crypto.keys import Ed25519PrivKey
+    from ..node import init_files
+    from ..p2p import NodeKey
+    from ..types import GenesisDoc, GenesisValidator
+
+    n_vals = args.validators
+    out_dir = os.path.expanduser(args.output_dir)
+    pvs = [Ed25519PrivKey.generate() for _ in range(n_vals)]
+    doc = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        validators=[
+            GenesisValidator(pub_key=pv.pub_key(), power=10) for pv in pvs
+        ],
+    )
+    doc.validate_and_complete()
+    node_ids = []
+    for i in range(n_vals):
+        home = os.path.join(out_dir, f"node{i}")
+        cfg = default_config()
+        cfg.base.home = home
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.starting_port + 2 * i + 1}"
+        init_files(cfg)
+        # overwrite the generated single-validator genesis with the shared one
+        with open(cfg.base.resolve(cfg.base.genesis_file), "w") as f:
+            f.write(doc.to_json())
+        from ..privval import FilePV
+
+        pv_file = FilePV.generate_from_key(
+            pvs[i],
+            cfg.base.resolve(cfg.base.priv_validator_key_file),
+            cfg.base.resolve(cfg.base.priv_validator_state_file),
+        )
+        pv_file.save()
+        nk = NodeKey.load_or_generate(
+            cfg.base.resolve(cfg.base.node_key_file)
+        )
+        node_ids.append(
+            f"{nk.node_id}@127.0.0.1:{args.starting_port + 2 * i}"
+        )
+        save_toml(cfg, cfg.base.resolve("config/config.toml"))
+    # wire everyone to everyone via persistent peers
+    for i in range(n_vals):
+        home = os.path.join(out_dir, f"node{i}")
+        cfg = default_config()
+        cfg.base.home = home
+        from ..config_file import load_toml
+
+        cfg = load_toml(
+            cfg.base.resolve("config/config.toml"), base=cfg
+        )
+        cfg.base.home = home
+        cfg.p2p.persistent_peers = ",".join(
+            a for j, a in enumerate(node_ids) if j != i
+        )
+        save_toml(cfg, cfg.base.resolve("config/config.toml"))
+    print(f"wrote {n_vals} node homes under {out_dir}")
+    print("peers:", ",".join(node_ids))
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """commands/rollback.go: remove the last block, roll state back one
+    height (recovery from an app-hash fork after an app bug)."""
+    from ..libs import db as dbm
+    from ..state import Store as StateStore
+    from ..state.rollback import rollback_state
+    from ..store import BlockStore
+
+    cfg = _config(args)
+    state_db = dbm.FileDB(cfg.base.resolve("data/state.db"))
+    block_db = dbm.FileDB(cfg.base.resolve("data/blockstore.db"))
+    try:
+        state_store = StateStore(state_db)
+        block_store = BlockStore(block_db)
+        height, app_hash = rollback_state(
+            state_store, block_store, remove_block=args.hard
+        )
+        print(
+            f"rolled back state to height {height} "
+            f"(app_hash {app_hash.hex().upper()})"
+        )
+        return 0
+    finally:
+        state_db.close()
+        block_db.close()
+
+
+def cmd_inspect(args) -> int:
+    """inspect/inspect.go:32: read-only RPC over a STOPPED node's data
+    dir — crash forensics without a consensus engine."""
+    from ..libs import db as dbm
+    from ..rpc import Environment, RPCServer
+    from ..state import Store as StateStore
+    from ..state.indexer import KVBlockIndexer, KVTxIndexer
+    from ..store import BlockStore
+    from ..types import GenesisDoc
+
+    cfg = _config(args)
+    with open(cfg.base.resolve(cfg.base.genesis_file)) as f:
+        genesis = GenesisDoc.from_json(f.read())
+    state_db = dbm.FileDB(cfg.base.resolve("data/state.db"))
+    block_db = dbm.FileDB(cfg.base.resolve("data/blockstore.db"))
+    idx_db = dbm.FileDB(cfg.base.resolve("data/tx_index.db"))
+    env = Environment(
+        block_store=BlockStore(block_db),
+        state_store=StateStore(state_db),
+        tx_indexer=KVTxIndexer(idx_db),
+        block_indexer=KVBlockIndexer(idx_db),
+        genesis=genesis,
+        config=cfg,
+    )
+    server = RPCServer(env, args.rpc_laddr or cfg.rpc.laddr)
+    server.start()
+    print(f"inspect RPC serving {cfg.base.home} at {server.bound_addr}")
+    print("read-only routes: status/block/commit/validators/tx_search/...")
+    stop = {"flag": False}
+    signal.signal(signal.SIGINT, lambda *_: stop.update(flag=True))
+    signal.signal(signal.SIGTERM, lambda *_: stop.update(flag=True))
+    while not stop["flag"]:
+        time.sleep(0.2)
+    server.stop()
+    return 0
+
+
 def cmd_start(args) -> int:
     from ..node import default_new_node
 
@@ -161,6 +325,23 @@ def main(argv=None) -> int:
         help="comma-separated id@host:port",
     )
     sub.add_parser("show-node-id")
+    sub.add_parser("gen-validator")
+    tp = sub.add_parser("testnet")
+    tp.add_argument("--v", dest="validators", type=int, default=4)
+    tp.add_argument("--o", dest="output_dir", default="./mytestnet")
+    tp.add_argument("--chain-id", dest="chain_id", default=None)
+    tp.add_argument(
+        "--starting-port", dest="starting_port", type=int, default=26656
+    )
+    rb = sub.add_parser("rollback")
+    rb.add_argument(
+        "--hard", action="store_true",
+        help="also remove the block itself, not only the state",
+    )
+    ip = sub.add_parser("inspect")
+    ip.add_argument("--rpc-laddr", dest="rpc_laddr", default=None)
+    sp.add_argument("--rpc-laddr", dest="rpc_laddr", default=None)
+    sp.add_argument("--log-level", dest="log_level", default=None)
 
     args = p.parse_args(argv)
     return {
@@ -168,6 +349,10 @@ def main(argv=None) -> int:
         "init": cmd_init,
         "show-validator": cmd_show_validator,
         "show-node-id": cmd_show_node_id,
+        "gen-validator": cmd_gen_validator,
+        "testnet": cmd_testnet,
+        "rollback": cmd_rollback,
+        "inspect": cmd_inspect,
         "unsafe-reset-all": cmd_unsafe_reset_all,
         "start": cmd_start,
     }[args.command](args)

@@ -46,6 +46,14 @@ def init_files(config: Config) -> dict:
     pv_state_file = config.base.resolve(config.base.priv_validator_state_file)
     pv = FilePV.load_or_generate(pv_key_file, pv_state_file)
 
+    # durable config (config/toml.go WriteConfigFile): written once so
+    # operators edit a file, not code
+    from ..config_file import save_toml
+
+    toml_path = config.base.resolve("config/config.toml")
+    if not os.path.exists(toml_path):
+        save_toml(config, toml_path)
+
     genesis_file = config.base.resolve(config.base.genesis_file)
     created_genesis = False
     if not os.path.exists(genesis_file):

@@ -203,15 +203,23 @@ class FilePV(PrivValidator):
 
     @classmethod
     def generate(cls, key_file: str, state_file: str) -> "FilePV":
-        priv = Ed25519PrivKey.generate()
+        pv = cls.generate_from_key(
+            Ed25519PrivKey.generate(), key_file, state_file
+        )
+        pv.save()
+        return pv
+
+    @classmethod
+    def generate_from_key(
+        cls, priv, key_file: str, state_file: str
+    ) -> "FilePV":
+        """Wrap an existing key (testnet generator, commands/testnet.go)."""
         key = _FilePVKey(
             address=bytes(priv.pub_key().address()),
             priv_key=priv,
             file_path=key_file,
         )
-        pv = cls(key, LastSignState(file_path=state_file))
-        pv.save()
-        return pv
+        return cls(key, LastSignState(file_path=state_file))
 
     @classmethod
     def load(cls, key_file: str, state_file: str) -> "FilePV":

@@ -149,6 +149,37 @@ class BlockStore:
 
     # -- prune -------------------------------------------------------------
 
+    def delete_block(self, height: int) -> None:
+        """Remove the block at ``height`` — only the TIP may be removed
+        (rollback --hard).
+
+        The NEW tip's canonical commit (``C:<height-1>``) must survive —
+        it arrived inside the deleted block as its LastCommit and becomes
+        the new seen commit, so a restarted node can still reconstruct
+        rs.last_commit and propose."""
+        with self._mtx:
+            if height != self._height:
+                raise ValueError(
+                    f"can only delete the tip block ({self._height}), "
+                    f"got {height}"
+                )
+            meta = self.load_block_meta(height)
+            block = self.load_block(height)
+            batch = self.db.new_batch()
+            if meta is not None:
+                for i in range(meta.block_id.part_set_header.total):
+                    batch.delete(_h(b"P:", height) + b":%06d" % i)
+                batch.delete(b"BH:" + meta.block_id.hash)
+            batch.delete(_h(b"BM:", height))
+            batch.delete(_h(b"EC:", height))
+            if block is not None and block.last_commit is not None:
+                batch.set(b"SC", ser.dumps(block.last_commit))
+            self._height = height - 1
+            if self._base > self._height:
+                self._base = self._height
+            self._save_state(batch)
+            batch.write()
+
     def prune_blocks(self, retain_height: int) -> int:
         """Delete blocks below ``retain_height``; returns number pruned
         (store/store.go:293). Keeps the commit chain above the new base."""

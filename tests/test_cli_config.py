@@ -1,0 +1,177 @@
+"""Config TOML + CLI command tests (reference analog: config/toml_test.go,
+cmd/cometbft/commands/*_test.go)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cometbft_tpu.cmd.__main__ import main as cli_main
+from cometbft_tpu.config import default_config
+from cometbft_tpu.config_file import (
+    load_toml,
+    render_toml,
+    save_toml,
+    validate_basic,
+)
+
+
+class TestConfigFile:
+    def test_round_trip_all_sections(self, tmp_path):
+        cfg = default_config()
+        cfg.base.moniker = "tester"
+        cfg.p2p.seeds = "aa@1.2.3.4:26656"
+        cfg.statesync.rpc_servers = ["http://x:26657", "http://y:26657"]
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus, timeout_commit_ns=777
+        )
+        path = str(tmp_path / "config.toml")
+        save_toml(cfg, path)
+        cfg2 = load_toml(path)
+        assert cfg2.base.moniker == "tester"
+        assert cfg2.p2p.seeds == "aa@1.2.3.4:26656"
+        assert cfg2.statesync.rpc_servers == [
+            "http://x:26657", "http://y:26657",
+        ]
+        assert cfg2.consensus.timeout_commit_ns == 777
+        validate_basic(cfg2)
+
+    def test_unknown_key_rejected(self, tmp_path):
+        path = str(tmp_path / "config.toml")
+        with open(path, "w") as f:
+            f.write("[consensus]\ntimeout_propse_ns = 5\n")  # typo'd key
+        with pytest.raises(ValueError, match="unknown config key"):
+            load_toml(path)
+
+    def test_validation_catches_bad_values(self):
+        cfg = default_config()
+        cfg.base.log_level = "verbose"
+        with pytest.raises(ValueError, match="log_level"):
+            validate_basic(cfg)
+        cfg = default_config()
+        cfg.statesync.enable = True  # no rpc servers / trust root
+        with pytest.raises(ValueError, match="rpc_servers"):
+            validate_basic(cfg)
+        cfg = default_config()
+        cfg.mempool = dataclasses.replace(cfg.mempool, size=0)
+        with pytest.raises(ValueError, match="mempool.size"):
+            validate_basic(cfg)
+
+    def test_render_is_valid_toml_with_comments(self):
+        import tomllib
+
+        text = render_toml(default_config())
+        assert text.startswith("#")
+        tomllib.loads(text)
+
+
+class TestCLI:
+    def test_init_writes_config_toml(self, tmp_path, capsys):
+        home = str(tmp_path / "home")
+        assert cli_main(["--home", home, "init"]) == 0
+        assert os.path.exists(os.path.join(home, "config/config.toml"))
+        cfg = load_toml(os.path.join(home, "config/config.toml"))
+        validate_basic(cfg)
+
+    def test_start_respects_config_toml(self, tmp_path, capsys):
+        """Edit the config file; `start` must pick the change up."""
+        home = str(tmp_path / "home")
+        cli_main(["--home", home, "init"])
+        path = os.path.join(home, "config/config.toml")
+        cfg = load_toml(path)
+        cfg.base.moniker = "from-file"
+        save_toml(cfg, path)
+        from cometbft_tpu.cmd.__main__ import _config
+
+        class A:
+            home_ = home
+
+        args = type("A", (), {"home": home})()
+        got = _config(args)
+        assert got.base.moniker == "from-file"
+
+    def test_gen_validator(self, capsys):
+        assert cli_main(["gen-validator"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(bytes.fromhex(out["address"])) == 20
+        assert out["pub_key"]["type"] == "ed25519"
+
+    def test_testnet_generator(self, tmp_path, capsys):
+        out_dir = str(tmp_path / "net")
+        assert cli_main(
+            ["testnet", "--v", "3", "--o", out_dir,
+             "--starting-port", "27000", "--chain-id", "tn-1"]
+        ) == 0
+        genesis_docs = []
+        for i in range(3):
+            home = os.path.join(out_dir, f"node{i}")
+            assert os.path.exists(
+                os.path.join(home, "config/priv_validator_key.json")
+            )
+            cfg = load_toml(os.path.join(home, "config/config.toml"))
+            assert cfg.p2p.laddr.endswith(str(27000 + 2 * i))
+            # everyone peers with everyone else
+            assert cfg.p2p.persistent_peers.count("@") == 2
+            with open(os.path.join(home, "config/genesis.json")) as f:
+                genesis_docs.append(f.read())
+        assert genesis_docs[0] == genesis_docs[1] == genesis_docs[2]
+        assert json.loads(genesis_docs[0])["chain_id"] == "tn-1"
+        assert len(json.loads(genesis_docs[0])["validators"]) == 3
+
+
+@pytest.mark.slow
+class TestRollback:
+    def test_rollback_then_recommit(self, tmp_path, capsys):
+        """Run a node, roll back one height, restart: it must re-apply and
+        keep committing from the rolled-back height."""
+        from cometbft_tpu.node import Node, init_files
+        from helpers import make_genesis
+
+        _MS = 1_000_000
+        cfg = default_config()
+        cfg.base.home = str(tmp_path)
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=400 * _MS,
+            timeout_prevote_ns=200 * _MS,
+            timeout_precommit_ns=200 * _MS,
+            timeout_commit_ns=100 * _MS,
+            skip_timeout_commit=False,
+        )
+        init_files(cfg)
+        genesis, pvs = make_genesis(1)
+        node = Node(cfg, genesis, pvs[0])
+        node.start()
+        deadline = time.monotonic() + 30
+        while node.block_store.height() < 5 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        h = node.block_store.height()
+        assert h >= 5
+        node.stop()
+
+        # SOFT rollback (state only): the block stays so the handshake
+        # re-syncs state from the stored block + responses; --hard also
+        # removes the block, which additionally requires the APP to roll
+        # back (commands/rollback.go documents the same contract).
+        assert cli_main(["--home", str(tmp_path), "rollback"]) == 0
+        out = capsys.readouterr().out
+        assert "rolled back state to height" in out
+
+        # restart: handshake replays the tip, node resumes and grows
+        node2 = Node(cfg, genesis, pvs[0])
+        assert node2.state.last_block_height >= h - 1
+        node2.start()
+        deadline = time.monotonic() + 30
+        while (
+            node2.block_store.height() < h + 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert node2.block_store.height() >= h + 2
+        node2.stop()
