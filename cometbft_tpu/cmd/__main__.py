@@ -16,30 +16,40 @@ import sys
 import time
 
 
-def _config(args):
+def _config(args, strict: bool = True):
     """Defaults <- config.toml (if present) <- CLI flags, then validated
-    (commands/root.go + viper layering)."""
+    (commands/root.go + viper layering).
+
+    Recovery commands pass strict=False: the tools an operator reaches
+    for when a node is broken must not be blocked by the very config
+    file that broke it — problems downgrade to a warning.
+    """
     from ..config import default_config
     from ..config_file import load_toml, validate_basic
 
     cfg = default_config()
     cfg.base.home = args.home
     toml_path = cfg.base.resolve("config/config.toml")
-    if os.path.exists(toml_path):
-        home = cfg.base.home
-        cfg = load_toml(toml_path, base=cfg)
-        cfg.base.home = home  # the file must not relocate the tree
-    if getattr(args, "proxy_app", None):
-        cfg.base.proxy_app = args.proxy_app
-    if getattr(args, "p2p_laddr", None):
-        cfg.p2p.laddr = args.p2p_laddr
-    if getattr(args, "persistent_peers", None):
-        cfg.p2p.persistent_peers = args.persistent_peers
-    if getattr(args, "rpc_laddr", None):
-        cfg.rpc.laddr = args.rpc_laddr
-    if getattr(args, "log_level", None):
-        cfg.base.log_level = args.log_level
-    validate_basic(cfg)
+    try:
+        if os.path.exists(toml_path):
+            home = cfg.base.home
+            cfg = load_toml(toml_path, base=cfg)
+            cfg.base.home = home  # the file must not relocate the tree
+        if getattr(args, "proxy_app", None):
+            cfg.base.proxy_app = args.proxy_app
+        if getattr(args, "p2p_laddr", None):
+            cfg.p2p.laddr = args.p2p_laddr
+        if getattr(args, "persistent_peers", None):
+            cfg.p2p.persistent_peers = args.persistent_peers
+        if getattr(args, "rpc_laddr", None):
+            cfg.rpc.laddr = args.rpc_laddr
+        if getattr(args, "log_level", None):
+            cfg.base.log_level = args.log_level
+        validate_basic(cfg)
+    except ValueError as e:
+        if strict:
+            raise SystemExit(f"config error: {e}")
+        print(f"warning: ignoring config problem: {e}", file=sys.stderr)
     return cfg
 
 
@@ -99,7 +109,7 @@ def cmd_unsafe_reset_all(args) -> int:
     """commands/reset.go — wipe data, keep keys, reset sign state."""
     from ..privval import FilePV, LastSignState
 
-    cfg = _config(args)
+    cfg = _config(args, strict=False)
     data_dir = cfg.base.resolve("data")
     if os.path.isdir(data_dir):
         shutil.rmtree(data_dir)
@@ -132,8 +142,6 @@ def cmd_gen_validator(args) -> int:
 
 def cmd_testnet(args) -> int:
     """commands/testnet.go: write N node home dirs sharing one genesis."""
-    from dataclasses import replace
-
     from ..config import default_config
     from ..config_file import save_toml
     from ..crypto.keys import Ed25519PrivKey
@@ -152,6 +160,7 @@ def cmd_testnet(args) -> int:
     )
     doc.validate_and_complete()
     node_ids = []
+    cfgs = []
     for i in range(n_vals):
         home = os.path.join(out_dir, f"node{i}")
         cfg = default_config()
@@ -177,18 +186,9 @@ def cmd_testnet(args) -> int:
         node_ids.append(
             f"{nk.node_id}@127.0.0.1:{args.starting_port + 2 * i}"
         )
-        save_toml(cfg, cfg.base.resolve("config/config.toml"))
-    # wire everyone to everyone via persistent peers
-    for i in range(n_vals):
-        home = os.path.join(out_dir, f"node{i}")
-        cfg = default_config()
-        cfg.base.home = home
-        from ..config_file import load_toml
-
-        cfg = load_toml(
-            cfg.base.resolve("config/config.toml"), base=cfg
-        )
-        cfg.base.home = home
+        cfgs.append(cfg)
+    # wire everyone to everyone, then write each config ONCE
+    for i, cfg in enumerate(cfgs):
         cfg.p2p.persistent_peers = ",".join(
             a for j, a in enumerate(node_ids) if j != i
         )
@@ -206,7 +206,7 @@ def cmd_rollback(args) -> int:
     from ..state.rollback import rollback_state
     from ..store import BlockStore
 
-    cfg = _config(args)
+    cfg = _config(args, strict=False)
     state_db = dbm.FileDB(cfg.base.resolve("data/state.db"))
     block_db = dbm.FileDB(cfg.base.resolve("data/blockstore.db"))
     try:

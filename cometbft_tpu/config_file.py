@@ -36,7 +36,15 @@ def _render_value(v) -> str:
     if isinstance(v, (int, float)):
         return str(v)
     if isinstance(v, str):
-        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+        out = v.replace("\\", "\\\\").replace('"', '\\"')
+        out = out.replace("\n", "\\n").replace("\r", "\\r").replace(
+            "\t", "\\t"
+        )
+        if any(ord(c) < 0x20 for c in out):
+            raise ValueError(
+                f"control characters not representable in config: {v!r}"
+            )
+        return '"' + out + '"'
     if isinstance(v, (list, tuple)):
         return "[" + ", ".join(_render_value(x) for x in v) + "]"
     raise TypeError(f"unrenderable config value {v!r}")
@@ -82,7 +90,11 @@ def load_toml(path: str, base: Config | None = None) -> Config:
         field_names = {f.name for f in dataclasses.fields(sub)}
         for key, value in payload.items():
             if isinstance(value, dict):
-                continue  # another section at top level
+                if not section:
+                    continue  # sibling [section] table at top level
+                raise ValueError(
+                    f"unexpected nested table [{section}.{key}]"
+                )
             if key not in field_names:
                 raise ValueError(
                     f"unknown config key "

@@ -982,6 +982,9 @@ class ConsensusState(BaseService):
         block.validate_basic()
         self.block_exec.validate_block(self.state, block)
 
+        from ..libs.fail import fail_point
+
+        fail_point("cs-before-save-block")
         if self.block_store.height() < block.header.height:
             seen_commit = precommits.make_commit()
             if self.state.consensus_params.vote_extensions_enabled(height):
@@ -991,12 +994,15 @@ class ConsensusState(BaseService):
             else:
                 self.block_store.save_block(block, parts, seen_commit)
 
+        fail_point("cs-after-save-block")
         # EndHeight AFTER the block is saved, BEFORE ApplyBlock: a crash
         # in between recovers via the ABCI handshake replay, not the WAL
         # (state.go:1753-1820 fail points).
         self.wal.write_end_height(height)
+        fail_point("cs-after-end-height")
 
         new_state = self.block_exec.apply_block(self.state, block_id, block)
+        fail_point("cs-after-apply-block")
 
         for hook in self._on_block_committed:
             hook(height)
@@ -1207,12 +1213,30 @@ class ConsensusState(BaseService):
         height = self.rs.height
         msgs = self.wal.search_for_end_height(height - 1)
         if msgs is None:
-            # The WAL is seeded with EndHeight(0) at creation, so a missing
-            # marker means corruption — refusing to sign blindly is the
-            # whole point of the WAL (replay.go:94 returns an error here).
+            # A crash between save_block(h) and write_end_height(h) leaves
+            # the WAL one marker BEHIND the store; the handshake already
+            # replayed the block into the app, so everything after the
+            # last marker concerns committed heights and is safely stale
+            # (the state.go:1753-1820 crash matrix, cs-after-save-block
+            # case). Only a WAL with no markers at all — it is seeded
+            # with EndHeight(0) at creation — signals real corruption:
+            # refusing to sign blindly is the whole point of the WAL
+            # (replay.go:94). ONE scan finds the newest stale marker.
+            from .wal import EndHeightMessage
+
+            has_stale_marker = False
+            for msg in self.wal.iter_messages():
+                if (
+                    isinstance(msg, EndHeightMessage)
+                    and msg.height <= height - 1
+                ):
+                    has_stale_marker = True
+            if has_stale_marker:
+                msgs = []  # tail is pre-handshake noise, nothing to replay
+        if msgs is None:
             raise ConsensusError(
-                f"WAL has no #ENDHEIGHT marker for height {height - 1}; "
-                "refusing to start (possible WAL corruption)"
+                f"WAL has no #ENDHEIGHT marker at or below height "
+                f"{height - 1}; refusing to start (possible WAL corruption)"
             )
         self.replay_mode = True
         live_wal, self.wal = self.wal, NopWAL()

@@ -1,0 +1,34 @@
+"""Env-gated crash points for fault-injection testing.
+
+Reference: libs/fail/fail.go:28 + the FAIL_TEST_INDEX callsites at
+state/execution.go:247-297 and consensus/state.go:1753-1820. Crash tests
+spawn a real node process with ``COMETBFT_TPU_FAIL=<point-name>``; when
+execution reaches that named point the process dies HARD (os._exit — no
+cleanup, no flushes beyond what the code already fsynced), and the test
+restarts the node asserting WAL/handshake recovery.
+
+Points are free when the env var is unset: one dict lookup.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ENV_VAR = "COMETBFT_TPU_FAIL"
+
+_target = os.environ.get(ENV_VAR, "")
+
+
+def fail_point(name: str) -> None:
+    """Die hard if this named point is the injection target."""
+    if _target and name == _target:
+        sys.stderr.write(f"FAIL POINT HIT: {name} — crashing\n")
+        sys.stderr.flush()
+        os._exit(99)
+
+
+def set_target(name: str) -> None:
+    """Test helper: arm a point in-process (subprocess tests use the env)."""
+    global _target
+    _target = name

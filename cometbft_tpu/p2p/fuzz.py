@@ -1,0 +1,60 @@
+"""FuzzedConnection: config-driven fault injection on a connection.
+
+Reference: p2p/fuzz.go:14-86. Wraps any read/write/close connection (the
+SecretConnection in practice) and probabilistically delays or drops
+writes and reads — the lossy-link tier of the test strategy (SURVEY §4):
+reactors must survive arbitrary message loss because consensus timeouts,
+blocksync re-requests and mempool rebroadcast all assume it.
+
+Modes (fuzz.go FuzzModeDrop/FuzzModeDelay):
+  * drop  — with probability ``prob_drop_rw`` a write is swallowed whole
+            (the peer never sees it) or a read returns empty;
+  * delay — with probability ``prob_sleep`` the op sleeps ``sleep_s``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+class FuzzedConnection:
+    def __init__(
+        self,
+        conn,
+        prob_drop_rw: float = 0.0,
+        prob_sleep: float = 0.0,
+        sleep_s: float = 0.05,
+        seed: int | None = None,
+    ):
+        self._conn = conn
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_sleep = prob_sleep
+        self.sleep_s = sleep_s
+        self._rng = random.Random(seed)
+        self.dropped_writes = 0
+        self.dropped_reads = 0
+
+    def _fuzz(self) -> bool:
+        """True -> drop this op."""
+        if self.prob_sleep and self._rng.random() < self.prob_sleep:
+            time.sleep(self.sleep_s)
+        return bool(
+            self.prob_drop_rw and self._rng.random() < self.prob_drop_rw
+        )
+
+    def write(self, data: bytes) -> int:
+        if self._fuzz():
+            self.dropped_writes += 1
+            return len(data)  # swallowed: caller believes it was sent
+        return self._conn.write(data)
+
+    def read(self, n: int) -> bytes:
+        data = self._conn.read(n)
+        if self._fuzz():
+            self.dropped_reads += 1
+            return b""
+        return data
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)

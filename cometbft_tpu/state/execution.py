@@ -305,10 +305,14 @@ class BlockExecutor:
             raise RuntimeError(
                 "FinalizeBlock returned wrong number of tx results"
             )
+        from ..libs.fail import fail_point
+
+        fail_point("exec-after-finalize")
 
         self.state_store.save_finalize_block_response(
             block.header.height, resp
         )
+        fail_point("exec-after-save-responses")
 
         new_state = self._update_state(state, block_id, block, resp)
 

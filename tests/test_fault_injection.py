@@ -1,0 +1,366 @@
+"""Fault-injection tier (reference analog: libs/fail + consensus
+replay_test.go WAL corruption cases + e2e runner/perturb.go).
+
+The crash tests run a REAL single-validator node as a subprocess with
+COMETBFT_TPU_FAIL=<point> armed; the process dies hard (os._exit) at the
+named point mid-commit; the test restarts it and asserts recovery: the
+node reaches a higher height than it crashed at, and the double-sign
+protection file never regresses.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CRASH_POINTS = [
+    "cs-before-save-block",
+    "cs-after-save-block",
+    "cs-after-end-height",
+    "exec-after-finalize",
+    "exec-after-save-responses",
+    "cs-after-apply-block",
+]
+
+
+def _env(extra=None):
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if ".axon_site" not in v or k != "PYTHONPATH"
+    }
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _run_node(home, timeout, extra_env=None):
+    """Run `start` until exit or timeout; returns (rc, stdout)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu.cmd", "--home", home, "start"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env=_env(extra_env),
+        text=True,
+        cwd=REPO,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, _ = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+        return proc.returncode, out
+
+
+def _last_height(out: str) -> int:
+    hs = [
+        int(line.split("height=")[1].split()[0])
+        for line in out.splitlines()
+        if "committed height=" in line
+    ]
+    return max(hs) if hs else 0
+
+
+def _init_home(home):
+    subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu.cmd", "--home", home, "init"],
+        check=True,
+        env=_env(),
+        capture_output=True,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crash_point_recovery(tmp_path, point):
+    """Crash at every stage of the commit pipeline; the restarted node
+    must replay (WAL or handshake) and keep committing with no
+    double-sign regression (replay_test.go crash matrix)."""
+    home = str(tmp_path)
+    _init_home(home)
+
+    rc, out = _run_node(home, timeout=60, extra_env={"COMETBFT_TPU_FAIL": point})
+    assert rc == 99, f"node did not hit {point}: rc={rc}\n{out[-2000:]}"
+    assert f"FAIL POINT HIT: {point}" in out
+    crashed_at = _last_height(out)
+
+    sign_state_before = json.load(
+        open(os.path.join(home, "data/priv_validator_state.json"))
+    )
+
+    rc2, out2 = _run_node(home, timeout=25)  # no fail env: runs until TERM
+    recovered = _last_height(out2)
+    assert recovered > crashed_at, (
+        f"no progress after crash at {point}: {crashed_at} -> {recovered}"
+        f"\n{out2[-2000:]}"
+    )
+
+    sign_state_after = json.load(
+        open(os.path.join(home, "data/priv_validator_state.json"))
+    )
+    assert sign_state_after["height"] >= sign_state_before["height"], (
+        "double-sign protection state went backwards"
+    )
+
+
+class TestWALCorruption:
+    def _write_wal(self, tmp_path, n=8):
+        from cometbft_tpu.consensus.wal import WAL, MsgInfo
+        from cometbft_tpu.consensus.messages import VoteMessage
+        from cometbft_tpu.types.block import BlockID
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types import canonical
+
+        path = str(tmp_path / "wal" / "wal")
+        wal = WAL(path)
+        for i in range(n):
+            wal.write(
+                MsgInfo(
+                    VoteMessage(
+                        Vote(
+                            msg_type=canonical.PREVOTE_TYPE,
+                            height=1,
+                            round=i,
+                            block_id=BlockID(),
+                            timestamp_ns=i,
+                            validator_address=b"\x01" * 20,
+                            validator_index=0,
+                            signature=b"\x02" * 64,
+                        )
+                    ),
+                    "peer",
+                )
+            )
+        wal.flush_and_sync()
+        wal.close()
+        return path
+
+    def _read_all(self, path):
+        from cometbft_tpu.consensus.wal import WAL
+
+        wal = WAL(path)
+        try:
+            return list(wal.iter_messages())
+        finally:
+            wal.close()
+
+    def test_truncated_tail_recovers_prefix(self, tmp_path):
+        """A crash mid-write leaves a torn final frame: every record
+        before it must still replay (wal.go corruption handling)."""
+        path = self._write_wal(tmp_path)
+        full = self._read_all(path)
+        assert len(full) == 9  # 8 votes + the initial EndHeight(0) marker
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 17)  # tear the last frame
+        got = self._read_all(path)
+        assert len(got) == 8
+
+    def test_corrupted_record_stops_at_crc(self, tmp_path):
+        """A flipped byte mid-file fails the CRC: replay keeps the good
+        prefix and refuses the garbage suffix."""
+        path = self._write_wal(tmp_path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        got = self._read_all(path)
+        assert 0 < len(got) < 9
+
+    def test_garbage_prefix_yields_nothing(self, tmp_path):
+        path = str(tmp_path / "wal" / "wal")
+        os.makedirs(os.path.dirname(path))
+        with open(path, "wb") as f:
+            f.write(os.urandom(256))
+        assert self._read_all(path) == []
+
+
+class TestFuzzedConnection:
+    class _Pipe:
+        def __init__(self):
+            self.written = []
+
+        def write(self, data):
+            self.written.append(bytes(data))
+            return len(data)
+
+        def read(self, n):
+            return b"x" * n
+
+        def close(self):
+            pass
+
+    def test_drop_mode_swallows_writes(self):
+        from cometbft_tpu.p2p.fuzz import FuzzedConnection
+
+        pipe = self._Pipe()
+        conn = FuzzedConnection(pipe, prob_drop_rw=0.5, seed=7)
+        for _ in range(200):
+            conn.write(b"m")
+        assert 0 < len(pipe.written) < 200
+        assert conn.dropped_writes == 200 - len(pipe.written)
+
+    def test_delay_mode_sleeps(self):
+        from cometbft_tpu.p2p.fuzz import FuzzedConnection
+
+        pipe = self._Pipe()
+        conn = FuzzedConnection(
+            pipe, prob_sleep=1.0, sleep_s=0.01, seed=1
+        )
+        t0 = time.monotonic()
+        for _ in range(5):
+            conn.write(b"m")
+        assert time.monotonic() - t0 >= 0.05
+        assert len(pipe.written) == 5  # delay mode never drops
+
+    def test_consensus_survives_lossy_links(self, tmp_path):
+        """4 validators over real TCP where every connection randomly
+        drops ~2% of frames. A single dropped frame desyncs the AEAD
+        nonce stream and KILLS that connection — so this drives the
+        reconnect-and-catch-up machinery hard (perturb.go's disconnect
+        analog); persistent full-mesh peers must re-establish and
+        consensus must keep committing."""
+        import dataclasses
+
+        from cometbft_tpu import p2p
+        from cometbft_tpu.config import default_config
+        from cometbft_tpu.node import Node, init_files
+        from cometbft_tpu.p2p.fuzz import FuzzedConnection
+        from cometbft_tpu.p2p import transport as p2p_transport
+        from helpers import make_genesis
+
+        _MS = 1_000_000
+
+        # wrap every upgraded secret connection in a lossy fuzzer
+        orig_upgrade = p2p_transport.MultiplexTransport._upgrade
+
+        def lossy_upgrade(self, *a, **k):
+            up = orig_upgrade(self, *a, **k)
+            up.secret_conn = FuzzedConnection(
+                up.secret_conn, prob_drop_rw=0.02, seed=None
+            )
+            return up
+
+        p2p_transport.MultiplexTransport._upgrade = lossy_upgrade
+        nodes = []
+        try:
+            genesis, pvs = make_genesis(4)
+            addrs = []
+            for i, pv in enumerate(pvs):
+                cfg = default_config()
+                cfg.base.home = str(tmp_path / f"n{i}")
+                cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                cfg.rpc.laddr = ""
+                cfg.consensus = dataclasses.replace(
+                    cfg.consensus,
+                    timeout_propose_ns=900 * _MS,
+                    timeout_prevote_ns=500 * _MS,
+                    timeout_precommit_ns=500 * _MS,
+                    timeout_commit_ns=300 * _MS,
+                    skip_timeout_commit=False,
+                    peer_gossip_sleep_duration_ns=30 * _MS,
+                )
+                init_files(cfg)
+                node = Node(cfg, genesis, pv)
+                nodes.append(node)
+                node.start()
+                addrs.append(
+                    f"{node.node_key.node_id}@"
+                    f"{node.transport.listen_addr[len('tcp://'):]}"
+                )
+            # persistent FULL MESH: dead fuzzed connections must come back
+            for i, node in enumerate(nodes):
+                peers = [a for j, a in enumerate(addrs) if j != i]
+                node.config.p2p.persistent_peers = ",".join(peers)
+                node.switch.set_persistent_peers(peers)
+                node.switch.dial_peers_async(peers)
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if min(n.block_store.height() for n in nodes) >= 3:
+                    break
+                time.sleep(0.2)
+            assert min(n.block_store.height() for n in nodes) >= 3, (
+                f"lossy net stalled at heights "
+                f"{[n.block_store.height() for n in nodes]}"
+            )
+        finally:
+            p2p_transport.MultiplexTransport._upgrade = orig_upgrade
+            for n in reversed(nodes):
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+
+@pytest.mark.slow
+def test_kill_and_restart_under_load(tmp_path):
+    """perturb.go 'kill' under tx load: SIGKILL a committing node mid-run,
+    restart, and require full recovery plus continued progress with the
+    pre-kill transactions still queryable."""
+    home = str(tmp_path)
+    _init_home(home)
+    env = _env()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu.cmd", "--home", home, "start"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env=env,
+        text=True,
+        cwd=REPO,
+    )
+    try:
+        # wait for the RPC to accept a tx, then keep a little load going
+        import base64
+        import urllib.request
+
+        deadline = time.monotonic() + 30
+        tx = base64.b64encode(b"survivor=yes").decode()
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    "http://127.0.0.1:26657/",
+                    data=json.dumps(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": 1,
+                            "method": "broadcast_tx_commit",
+                            "params": {"tx": tx},
+                        }
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    res = json.load(r)
+                if res["result"]["tx_result"]["code"] == 0:
+                    ok = True
+                    break
+            except Exception:
+                time.sleep(0.5)
+        assert ok, "tx never committed before the kill"
+        proc.kill()  # SIGKILL: no cleanup, no flushes
+        proc.communicate(timeout=10)
+    except BaseException:
+        proc.kill()
+        raise
+
+    rc, out = _run_node(home, timeout=25)
+    assert _last_height(out) > 0, f"no progress after SIGKILL\n{out[-2000:]}"
+    # pre-kill state survived
+    assert "node started" in out
