@@ -1,23 +1,45 @@
-"""North-star benchmark: batched ed25519 verification throughput on chip.
+"""North-star benchmark suite: the five BASELINE.md configs.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout (the headline metric, same shape the
+driver parses); the full per-config table goes to stderr as extra JSON
+lines so the numbers are recorded without confusing the parser.
 
-Config: the BASELINE.json "light client replay @ 10k validators" shape —
-a 4096-signature batch (largest bucket below the 10k commit, representative
-of per-launch work). Baseline is single-signature CPU verification via
-OpenSSL ed25519 (the `cryptography` wheel), the same role curve25519-voi
-plays for the reference engine (crypto/ed25519/bench_test.go:31-68).
+Headline: ed25519 batch-verify throughput on the 4096-signature flat
+batch (BASELINE config 5's size; unchanged metric name since round 1 so
+rounds stay comparable), measured as steady-state host->device round
+trips including packing — what a consensus round actually pays.
+
+Baseline honesty: the reference's hot path is curve25519-voi *batch*
+verification (crypto/ed25519/ed25519.go:196-228), not single verifies.
+No Go toolchain exists in this image, so the baseline is measured
+OpenSSL single-verify throughput on one core times 2.0 — a documented,
+deliberately generous stand-in for voi's batch speedup over its single
+verify (random-linear-combination batching roughly halves per-sig cost
+at these batch sizes). vs_baseline therefore UNDERSTATES the advantage
+vs OpenSSL and approximates it vs voi-batch.
+
+Configs (BASELINE.md "North-star target", crypto/ed25519/bench_test.go:31-68):
+  1. 64-sig batch            (CPU-parity bucket)
+  2. 150-validator commit    (types.Commit verify, Cosmos-Hub-sized)
+  3. 1000-validator round    (VoteSet prevote+precommit batched ingest)
+  4. 10k-validator light replay (verify_commit_light — the north star)
+  5. 4096 mixed ed25519+sr25519 (blocksync catch-up shape)
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
 
-def _make_batch(n: int, seed: int = 3):
+def _eprint(obj) -> None:
+    print(json.dumps(obj), file=sys.stderr, flush=True)
+
+
+def _make_ed_batch(n: int, seed: int = 3):
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -26,13 +48,13 @@ def _make_batch(n: int, seed: int = 3):
     rng = np.random.default_rng(seed)
     raw = serialization.Encoding.Raw
     pub_fmt = serialization.PublicFormat.Raw
-    keys = [Ed25519PrivateKey.generate() for _ in range(64)]
+    keys = [Ed25519PrivateKey.generate() for _ in range(min(n, 64))]
     pubs = [k.public_key().public_bytes(raw, pub_fmt) for k in keys]
     pubkeys, msgs, sigs = [], [], []
     for i in range(n):
         k = keys[i % len(keys)]
-        # Distinct message per lane, like commit vote sign-bytes (timestamps
-        # differ per validator — types/block.go:871-883 in the reference).
+        # Distinct message per lane, like commit vote sign-bytes
+        # (timestamps differ per validator — types/block.go:871-883).
         msg = rng.bytes(112)
         pubkeys.append(pubs[i % len(keys)])
         msgs.append(msg)
@@ -40,48 +62,276 @@ def _make_batch(n: int, seed: int = 3):
     return pubkeys, msgs, sigs
 
 
-def _cpu_baseline(pubkeys, msgs, sigs, n_sample: int = 512) -> float:
+def _cpu_single_baseline(n_sample: int = 512) -> float:
     """OpenSSL single-verify throughput (sigs/sec), one core."""
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PublicKey,
     )
 
-    loaded = [Ed25519PublicKey.from_public_bytes(p) for p in pubkeys[:n_sample]]
+    pubkeys, msgs, sigs = _make_ed_batch(n_sample)
+    loaded = [Ed25519PublicKey.from_public_bytes(p) for p in pubkeys]
     t0 = time.perf_counter()
-    for pk, m, s in zip(loaded, msgs[:n_sample], sigs[:n_sample]):
+    for pk, m, s in zip(loaded, msgs, sigs):
         pk.verify(s, m)
-    dt = time.perf_counter() - t0
-    return n_sample / dt
+    return n_sample / (time.perf_counter() - t0)
+
+
+# voi batch-verify speedup proxy over its own single verify (see module
+# docstring); applied to the OpenSSL single-verify measurement.
+VOI_BATCH_FACTOR = 2.0
+
+
+def _steady(fn, reps: int = 3) -> float:
+    fn()  # warm-up: compile + caches
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def bench_flat_batch(n: int):
+    """Configs 1 (n=64) and the 4096 headline: flat verify_batch."""
+    from cometbft_tpu.ops import verify as ov
+
+    pubkeys, msgs, sigs = _make_ed_batch(n)
+    ok, bitmap = ov.verify_batch(pubkeys, msgs, sigs)
+    assert ok and bitmap.all(), "benchmark batch failed verification"
+    dt = _steady(lambda: ov.verify_batch(pubkeys, msgs, sigs))
+    return n / dt, dt
+
+
+def _make_valset_and_pvs(n_vals: int):
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    pvs = [
+        MockPV(Ed25519PrivKey.from_seed(i.to_bytes(32, "big")))
+        for i in range(1, n_vals + 1)
+    ]
+    vals = ValidatorSet(
+        [Validator(pv.get_pub_key(), voting_power=10) for pv in pvs]
+    )
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+    ordered = [by_addr[bytes(v.address)] for v in vals.validators]
+    return vals, ordered
+
+
+def _sign_commit(chain_id, vals, pvs, height, block_id):
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import Commit
+    from cometbft_tpu.types.vote import Vote
+
+    base_ns = 1_700_000_000_000_000_000
+    sigs = []
+    for idx, (val, pv) in enumerate(zip(vals.validators, pvs)):
+        vote = Vote(
+            msg_type=canonical.PRECOMMIT_TYPE,
+            height=height,
+            round=0,
+            block_id=block_id,
+            timestamp_ns=base_ns + idx,
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        pv.sign_vote(chain_id, vote, sign_extension=False)
+        sigs.append(vote.commit_sig())
+    return Commit(height=height, round=0, block_id=block_id, signatures=sigs)
+
+
+def _block_id():
+    from cometbft_tpu.types.block import BlockID, PartSetHeader
+
+    return BlockID(
+        hash=bytes(range(32)),
+        part_set_header=PartSetHeader(total=1, hash=bytes(32)),
+    )
+
+
+def bench_commit_verify(n_vals: int, light: bool):
+    """Configs 2 (150 validators, full verify) and 4 (10k, light replay).
+
+    Measures types.verify_commit / verify_commit_light end to end —
+    sign-bytes construction, batch packing, device verify — the exact
+    work the reference's Commit.VerifySignatures does
+    (types/validation.go:26,60,153-257).
+    """
+    from cometbft_tpu.types import validation
+
+    chain_id = "bench-chain"
+    vals, pvs = _make_valset_and_pvs(n_vals)
+    bid = _block_id()
+    commit = _sign_commit(chain_id, vals, pvs, 7, bid)
+    fn = validation.verify_commit_light if light else validation.verify_commit
+    dt = _steady(lambda: fn(chain_id, vals, bid, 7, commit))
+    return n_vals / dt, dt
+
+
+def bench_vote_round(n_vals: int):
+    """Config 3: a prevote+precommit round through VoteSet batched ingest
+    (types/vote_set.py add_votes_batch — the consensus hot path,
+    types/vote_set.go:216-231 / consensus/state.go:2086)."""
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.vote import Vote
+    from cometbft_tpu.types.vote_set import VoteSet
+
+    chain_id = "bench-chain"
+    vals, pvs = _make_valset_and_pvs(n_vals)
+    bid = _block_id()
+    base_ns = 1_700_000_000_000_000_000
+
+    def make_votes(msg_type):
+        votes = []
+        for idx, (val, pv) in enumerate(zip(vals.validators, pvs)):
+            v = Vote(
+                msg_type=msg_type,
+                height=3,
+                round=0,
+                block_id=bid,
+                timestamp_ns=base_ns + idx,
+                validator_address=val.address,
+                validator_index=idx,
+            )
+            pv.sign_vote(chain_id, v, sign_extension=False)
+            votes.append(v)
+        return votes
+
+    prevotes = make_votes(canonical.PREVOTE_TYPE)
+    precommits = make_votes(canonical.PRECOMMIT_TYPE)
+
+    def run_round():
+        pv_set = VoteSet(
+            chain_id, 3, 0, canonical.PREVOTE_TYPE, vals
+        )
+        pc_set = VoteSet(
+            chain_id, 3, 0, canonical.PRECOMMIT_TYPE, vals
+        )
+        added, _ = pv_set.add_votes_batch(prevotes)
+        assert all(added)
+        added, _ = pc_set.add_votes_batch(precommits)
+        assert all(added)
+        assert pv_set.two_thirds_majority() is not None
+        assert pc_set.two_thirds_majority() is not None
+
+    dt = _steady(run_round)
+    return 2 * n_vals / dt, dt
+
+
+def bench_mixed(n: int):
+    """Config 5: half ed25519, half sr25519 through the crypto.batch
+    dispatch (crypto/batch/batch.go:11; sr25519 rides the same cofactored
+    TPU kernel — crypto/sr25519/batch.go:14-46)."""
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.crypto.keys import Ed25519PubKey
+    from cometbft_tpu.crypto.sr25519 import Sr25519PrivKey, Sr25519PubKey
+
+    half = n // 2
+    ed_pub, ed_msg, ed_sig = _make_ed_batch(half, seed=11)
+
+    # sr25519 signing is pure Python (~ms/sig): sign a small unique set
+    # and tile it. Verification cost per lane is unaffected by repeats.
+    uniq = 64
+    sr_keys = [
+        Sr25519PrivKey(i.to_bytes(32, "little")) for i in range(1, uniq + 1)
+    ]
+    sr_pub, sr_msg, sr_sig = [], [], []
+    for i in range(half):
+        k = sr_keys[i % uniq]
+        msg = b"sr-lane-%d" % (i % uniq)
+        sr_pub.append(k.pub_key())
+        sr_msg.append(msg)
+        sr_sig.append(k.sign(msg) if i < uniq else sr_sig[i % uniq])
+
+    def run():
+        verifiers = {
+            "ed25519": crypto_batch.create_batch_verifier(
+                Ed25519PubKey(ed_pub[0])
+            ),
+            "sr25519": crypto_batch.create_batch_verifier(sr_pub[0]),
+        }
+        for p, m, s in zip(ed_pub, ed_msg, ed_sig):
+            verifiers["ed25519"].add(Ed25519PubKey(p), m, s)
+        for p, m, s in zip(sr_pub, sr_msg, sr_sig):
+            verifiers["sr25519"].add(p, m, s)
+        for name, v in verifiers.items():
+            ok, bitmap = v.verify()
+            assert ok, f"{name} mixed batch failed"
+
+    dt = _steady(run)
+    return n / dt, dt
 
 
 def main() -> None:
-    from cometbft_tpu.ops import verify as ov
+    single = _cpu_single_baseline()
+    batch_baseline = single * VOI_BATCH_FACTOR
+    _eprint(
+        {
+            "config": "cpu_baseline",
+            "openssl_single_sigs_per_sec": round(single, 1),
+            "voi_batch_proxy_sigs_per_sec": round(batch_baseline, 1),
+            "note": "proxy = single x 2.0 (voi batch speedup stand-in)",
+        }
+    )
 
-    n = 4096
-    pubkeys, msgs, sigs = _make_batch(n)
+    tput, dt = bench_flat_batch(64)
+    _eprint(
+        {
+            "config": "1_batch64",
+            "sigs_per_sec": round(tput, 1),
+            "latency_ms": round(dt * 1e3, 2),
+            "vs_batch_baseline": round(tput / batch_baseline, 2),
+        }
+    )
 
-    baseline = _cpu_baseline(pubkeys, msgs, sigs)
+    tput, dt = bench_commit_verify(150, light=False)
+    _eprint(
+        {
+            "config": "2_commit150_verify",
+            "sigs_per_sec": round(tput, 1),
+            "commit_latency_ms": round(dt * 1e3, 2),
+            "vs_batch_baseline": round(tput / batch_baseline, 2),
+        }
+    )
 
-    # Warm-up: compile + first execution.
-    ok_all, bitmap = ov.verify_batch(pubkeys, msgs, sigs)
-    assert ok_all and bitmap.all(), "benchmark batch failed verification"
+    tput, dt = bench_vote_round(1000)
+    _eprint(
+        {
+            "config": "3_round1000_votes",
+            "votes_per_sec": round(tput, 1),
+            "round_latency_ms": round(dt * 1e3, 2),
+            "vs_batch_baseline": round(tput / batch_baseline, 2),
+        }
+    )
 
-    # Timed: steady-state round trips (host pack + device verify + readback),
-    # i.e. what a consensus round actually pays.
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        ok_all, _ = ov.verify_batch(pubkeys, msgs, sigs)
-    dt = (time.perf_counter() - t0) / reps
-    throughput = n / dt
+    tput, dt = bench_commit_verify(10_000, light=True)
+    _eprint(
+        {
+            "config": "4_light10k_commit_verify",
+            "sigs_per_sec": round(tput, 1),
+            "commit_latency_ms": round(dt * 1e3, 2),
+            "vs_batch_baseline": round(tput / batch_baseline, 2),
+        }
+    )
 
+    tput, dt = bench_mixed(4096)
+    _eprint(
+        {
+            "config": "5_mixed4096_ed_sr",
+            "sigs_per_sec": round(tput, 1),
+            "latency_ms": round(dt * 1e3, 2),
+            "vs_batch_baseline": round(tput / batch_baseline, 2),
+        }
+    )
+
+    # Headline: 4096-lane flat ed25519 batch (round-1-comparable metric).
+    tput, dt = bench_flat_batch(4096)
     print(
         json.dumps(
             {
                 "metric": "ed25519_batch_verify_throughput",
-                "value": round(throughput, 1),
+                "value": round(tput, 1),
                 "unit": "sigs/sec",
-                "vs_baseline": round(throughput / baseline, 2),
+                "vs_baseline": round(tput / batch_baseline, 2),
             }
         )
     )

@@ -85,7 +85,9 @@ class Ed25519PrivKey:
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "Ed25519PrivKey":
-        return cls(seed + ref.pubkey_from_seed(seed))
+        from . import fast25519
+
+        return cls(seed + fast25519.pubkey_from_seed(seed))
 
     @property
     def type(self) -> str:
@@ -99,7 +101,9 @@ class Ed25519PrivKey:
         return self.data
 
     def sign(self, msg: bytes) -> bytes:
-        return ref.sign(self.seed, msg)
+        from . import fast25519
+
+        return fast25519.sign_one(self.seed, msg)
 
     def pub_key(self) -> Ed25519PubKey:
         return Ed25519PubKey(self.data[32:])

@@ -217,6 +217,12 @@ def _kernel_from_bytes(buf):
     return curve.verify_kernel(**unpack_on_device(buf))
 
 
+def _kernel_from_bytes_pallas(buf):
+    from . import pallas_verify
+
+    return pallas_verify.verify_kernel(**unpack_on_device(buf))
+
+
 @lru_cache(maxsize=None)
 def _enable_compilation_cache() -> None:
     """Persistent XLA compilation cache: the verify kernel compiles once
@@ -239,9 +245,83 @@ def _enable_compilation_cache() -> None:
 
 
 @lru_cache(maxsize=None)
-def _jitted_kernel():
+def _jitted_kernel(which: str = "xla"):
     _enable_compilation_cache()
-    return jax.jit(_kernel_from_bytes)
+    fn = _kernel_from_bytes_pallas if which == "pallas" else _kernel_from_bytes
+    return jax.jit(fn)
+
+
+# Kernel selection: "auto" routes single-chip batches through the Pallas
+# kernel on TPU backends (VMEM-resident ladder, ~2x the XLA lowering) and
+# the XLA kernel elsewhere (CPU tests, virtual-device meshes — Pallas
+# interpret mode is far slower than the XLA program there). Overridable
+# for benchmarking via COMETBFT_TPU_KERNEL=pallas|xla.
+_KERNEL_MODE = None
+_PALLAS_BROKEN = False
+
+
+def _pallas_wanted() -> bool:
+    global _KERNEL_MODE
+    if _KERNEL_MODE is None:
+        import os
+
+        _KERNEL_MODE = os.environ.get("COMETBFT_TPU_KERNEL", "auto")
+    if _KERNEL_MODE == "pallas":
+        return True
+    if _KERNEL_MODE == "xla":
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# Buckets below this stay on the XLA kernel even when Pallas is wanted:
+# small-lane Mosaic layouts compile pathologically slowly and the launch
+# is latency-bound there anyway (the host path owns batches < 768).
+_PALLAS_MIN_LANES = 512
+
+
+def _note_pallas_broken(e: Exception) -> None:
+    global _PALLAS_BROKEN
+    _PALLAS_BROKEN = True
+    from ..libs import log as _log
+
+    _log.default_logger().with_module("ops.verify").error(
+        "pallas verify kernel failed; falling back to XLA kernel",
+        err=repr(e)[:200],
+    )
+
+
+def _run_kernel(buf):
+    """Dispatch one bucket launch, falling back to XLA if Mosaic balks.
+
+    Returns (device_array, used_pallas). jit dispatch is asynchronous, so
+    a Mosaic *runtime* fault only surfaces when the result materializes —
+    callers resolve through :func:`_materialize`, which retries the
+    launch on the XLA kernel in that case.
+    """
+    if (
+        buf.shape[1] >= _PALLAS_MIN_LANES
+        and _pallas_wanted()
+        and not _PALLAS_BROKEN
+    ):
+        try:
+            return _jitted_kernel("pallas")(buf), True
+        except Exception as e:  # synchronous trace/compile failure
+            _note_pallas_broken(e)
+    return _jitted_kernel("xla")(buf), False
+
+
+def _materialize(out, used_pallas: bool, buf):
+    """np.asarray(out) with device-side pallas faults rerouted to XLA."""
+    try:
+        return np.asarray(out)
+    except Exception as e:
+        if not used_pallas:
+            raise
+        _note_pallas_broken(e)
+        return np.asarray(_jitted_kernel("xla")(buf))
 
 
 # Measured sweet spot on a v5e: per-signature device time grows superlinearly
@@ -267,15 +347,16 @@ def verify_bytes_async(buf: np.ndarray, n: int):
             piece = buf[:, lo:hi]
             if hi - lo < _CHUNK:
                 piece = np.pad(piece, [(0, 0), (0, _CHUNK - (hi - lo))])
-            outs.append((_jitted_kernel()(piece), hi - lo))
+            out, used_pallas = _run_kernel(piece)
+            outs.append((out, used_pallas, piece, hi - lo))
         return lambda: np.concatenate(
-            [np.asarray(o)[:m] for o, m in outs]
+            [_materialize(o, up, p)[:m] for o, up, p, m in outs]
         )
     size = bucket_size(n)
     if size != n:
         buf = np.pad(buf, [(0, 0), (0, size - n)])
-    out = _jitted_kernel()(buf)
-    return lambda: np.asarray(out)[:n]
+    out, used_pallas = _run_kernel(buf)
+    return lambda: _materialize(out, used_pallas, buf)[:n]
 
 
 def verify_batch(pubkeys, msgs, sigs) -> tuple[bool, np.ndarray]:

@@ -48,6 +48,20 @@ class TestKeys:
         msg = b"cross-check"
         assert ours.sign(msg) == theirs.sign(msg)
 
+    def test_fast_sign_matches_pure_oracle(self):
+        # sign_one/pubkey_from_seed route through OpenSSL; ed25519 is
+        # deterministic so the bytes must equal the pure-Python oracle's.
+        from cometbft_tpu.crypto import ed25519_ref as ref
+        from cometbft_tpu.crypto import fast25519
+
+        for i in range(3):
+            seed = bytes([i + 9]) * 32
+            msg = b"oracle-pin-%d" % i
+            assert fast25519.pubkey_from_seed(seed) == ref.pubkey_from_seed(
+                seed
+            )
+            assert fast25519.sign_one(seed, msg) == ref.sign(seed, msg)
+
 
 class TestMerkle:
     def test_empty_tree(self):
