@@ -1,0 +1,231 @@
+"""ABCI conformance driver + console (reference: abci/cmd/abci-cli,
+abci/tests/server/client.go:114).
+
+``run_conformance(client)`` drives any started ABCI client (socket, gRPC,
+or local) through the protocol-level request/response assertions the
+reference's ``abci-cli test`` performs against example apps: echo/info
+round trips, InitChain, the PrepareProposal -> ProcessProposal ->
+FinalizeBlock -> Commit block flow with app-hash stability, CheckTx
+accept/reject, Query after commit, and snapshot listing. Failures raise
+``ConformanceError`` naming the failed check.
+
+``console(client)`` is the interactive REPL (`abci-cli console`).
+"""
+
+from __future__ import annotations
+
+from . import types as abci
+
+
+class ConformanceError(AssertionError):
+    pass
+
+
+def _check(cond: bool, name: str, detail: str = "") -> None:
+    if not cond:
+        raise ConformanceError(f"{name}: {detail}" if detail else name)
+
+
+def run_conformance(client, chain_id: str = "abci-conformance") -> list[str]:
+    """Drive the protocol conformance suite; returns passed check names.
+
+    The app behind ``client`` must be kvstore-semantic (key=value txs) —
+    the same assumption abci-cli's tests make about the example apps.
+    """
+    passed: list[str] = []
+
+    def ok(name: str) -> None:
+        passed.append(name)
+
+    # echo round trip (client.go TestEcho)
+    msg = "conformance-echo"
+    _check(client.echo(msg) == msg, "echo", "payload not echoed back")
+    ok("echo")
+    client.flush()
+    ok("flush")
+
+    # info before init (client.go InfoSync)
+    info = client.info(abci.RequestInfo(version="conformance"))
+    _check(info is not None, "info", "nil response")
+    first_height = info.last_block_height
+    ok("info")
+
+    # init chain on a fresh app only (a replayed app keeps its state)
+    if first_height == 0:
+        client.init_chain(
+            abci.RequestInitChain(chain_id=chain_id, initial_height=1)
+        )
+        ok("init_chain")
+
+    # check_tx accept + reject (client.go TestCheckTx-style)
+    good = b"conf-key=conf-val"
+    res = client.check_tx(abci.RequestCheckTx(tx=good))
+    _check(res.code == 0, "check_tx_ok", f"code={res.code}")
+    ok("check_tx_ok")
+    res_bad = client.check_tx(abci.RequestCheckTx(tx=b"="))
+    _check(res_bad.code != 0, "check_tx_reject", "empty kv accepted")
+    ok("check_tx_reject")
+
+    # block flow: prepare -> process -> finalize -> commit
+    height = max(first_height, 0) + 1
+    prep = client.prepare_proposal(
+        abci.RequestPrepareProposal(
+            max_tx_bytes=1 << 20,
+            txs=[good],
+            local_last_commit=abci.ExtendedCommitInfo(round=0),
+            misbehavior=[],
+            height=height,
+            time_ns=0,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+    txs = list(prep.txs)
+    _check(good in txs, "prepare_proposal", "tx dropped")
+    ok("prepare_proposal")
+
+    proc = client.process_proposal(
+        abci.RequestProcessProposal(
+            txs=txs,
+            proposed_last_commit=abci.CommitInfo(round=0),
+            misbehavior=[],
+            hash=b"",
+            height=height,
+            time_ns=0,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+    _check(proc.is_accepted, "process_proposal", f"status={proc.status}")
+    ok("process_proposal")
+
+    fin = client.finalize_block(
+        abci.RequestFinalizeBlock(
+            txs=txs,
+            decided_last_commit=abci.CommitInfo(round=0),
+            misbehavior=[],
+            hash=b"",
+            height=height,
+            time_ns=0,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+    _check(len(fin.tx_results) == len(txs), "finalize_block", "result count")
+    _check(
+        all(r.code == 0 for r in fin.tx_results),
+        "finalize_block_codes",
+        "tx failed",
+    )
+    app_hash = fin.app_hash
+    ok("finalize_block")
+
+    client.commit(abci.RequestCommit())
+    ok("commit")
+
+    # deterministic app hash: replaying the same block on a fresh height
+    # must NOT change state retroactively — info reflects the commit
+    info2 = client.info(abci.RequestInfo(version="conformance"))
+    _check(
+        info2.last_block_height == height,
+        "info_height_advanced",
+        f"{info2.last_block_height} != {height}",
+    )
+    _check(
+        info2.last_block_app_hash == app_hash,
+        "info_app_hash",
+        "hash mismatch after commit",
+    )
+    ok("info_after_commit")
+
+    # query returns the committed value (client.go TestKV semantics)
+    q = client.query(abci.RequestQuery(data=b"conf-key", path="/key"))
+    _check(q.value == b"conf-val", "query_committed", f"value={q.value!r}")
+    ok("query_committed")
+
+    # snapshots surface (may be empty below the snapshot interval)
+    snaps = client.list_snapshots(abci.RequestListSnapshots())
+    _check(snaps is not None, "list_snapshots", "nil response")
+    ok("list_snapshots")
+
+    return passed
+
+
+# ------------------------------------------------------------------ console
+
+
+_CONSOLE_HELP = """\
+commands (abci-cli console surface):
+  echo <text>            info
+  check_tx <key=value>   deliver <key=value>   (finalize+commit one block)
+  query <key>            commit
+  help                   quit
+"""
+
+
+def console(client, inp=None, out=None) -> None:
+    """Interactive ABCI console (abci-cli.go console command)."""
+    import sys
+
+    inp = inp if inp is not None else sys.stdin
+    out = out if out is not None else sys.stdout
+    height = client.info(abci.RequestInfo()).last_block_height
+
+    def w(s: str) -> None:
+        out.write(s + "\n")
+        out.flush()
+
+    w(_CONSOLE_HELP)
+    for line in inp:
+        parts = line.strip().split(None, 1)
+        if not parts:
+            continue
+        cmd, arg = parts[0], (parts[1] if len(parts) > 1 else "")
+        try:
+            if cmd == "quit":
+                return
+            elif cmd == "help":
+                w(_CONSOLE_HELP)
+            elif cmd == "echo":
+                w(f"-> {client.echo(arg)}")
+            elif cmd == "info":
+                r = client.info(abci.RequestInfo())
+                w(
+                    f"-> height={r.last_block_height} "
+                    f"app_hash={r.last_block_app_hash.hex()}"
+                )
+            elif cmd == "check_tx":
+                r = client.check_tx(abci.RequestCheckTx(tx=arg.encode()))
+                w(f"-> code={r.code} log={r.log}")
+            elif cmd == "deliver":
+                height += 1
+                fin = client.finalize_block(
+                    abci.RequestFinalizeBlock(
+                        txs=[arg.encode()],
+                        decided_last_commit=abci.CommitInfo(round=0),
+                        misbehavior=[],
+                        hash=b"",
+                        height=height,
+                        time_ns=0,
+                        next_validators_hash=b"",
+                        proposer_address=b"",
+                    )
+                )
+                client.commit(abci.RequestCommit())
+                w(
+                    f"-> height={height} "
+                    f"codes={[r.code for r in fin.tx_results]} "
+                    f"app_hash={fin.app_hash.hex()}"
+                )
+            elif cmd == "query":
+                r = client.query(
+                    abci.RequestQuery(data=arg.encode(), path="/key")
+                )
+                w(f"-> code={r.code} value={r.value!r}")
+            elif cmd == "commit":
+                client.commit(abci.RequestCommit())
+                w("-> committed")
+            else:
+                w(f"unknown command {cmd!r} (try help)")
+        except Exception as e:
+            w(f"error: {e!r}")

@@ -1,0 +1,226 @@
+"""gRPC ABCI transport (reference: abci/client/grpc_client.go:247,
+abci/server/grpc_server.go:76).
+
+A real gRPC (HTTP/2) service carrying the same 16 unary methods as the
+socket transport. The payload serializer is the framework's canonical
+self-describing JSON (abci/codec.py) registered through gRPC's generic
+method handlers — the one codec family used at every process boundary.
+Interop with a Go ABCI app would swap the (de)serializers for protobuf
+encoding of proto/tendermint/abci; like the socket codec, that's a
+boundary-module-only change.
+
+Unlike the socket protocol there is no FIFO pipelining contract: gRPC
+gives each call its own stream, so CheckTxAsync maps to a channel future
+(the reference's grpc client does the same with per-call goroutines).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+
+import grpc
+
+from ..libs.service import BaseService
+from . import codec
+from .application import Application
+from .client import Client, ReqRes
+
+_SERVICE = "cometbft.abci.ABCI"
+
+# method name -> (request attr on Application). Echo/Flush are transport
+# no-ops kept for protocol parity (abci/types/application.go).
+_METHODS = (
+    "echo",
+    "flush",
+    "info",
+    "query",
+    "check_tx",
+    "init_chain",
+    "prepare_proposal",
+    "process_proposal",
+    "finalize_block",
+    "extend_vote",
+    "verify_vote_extension",
+    "commit",
+    "list_snapshots",
+    "offer_snapshot",
+    "load_snapshot_chunk",
+    "apply_snapshot_chunk",
+)
+
+
+def _serialize(msg) -> bytes:
+    return json.dumps(codec._to_jsonable(msg), separators=(",", ":")).encode()
+
+
+def _deserialize(data: bytes):
+    return codec._from_jsonable(json.loads(data))
+
+
+class GrpcServer(BaseService):
+    """Serves one Application over gRPC (abci/server/grpc_server.go)."""
+
+    def __init__(self, addr: str, app: Application, max_workers: int = 10):
+        super().__init__("abci-grpc-server")
+        for scheme in ("grpc://", "tcp://"):
+            if addr.startswith(scheme):
+                addr = addr[len(scheme) :]
+        self.addr = addr
+        self.app = app
+        self._max_workers = max_workers
+        self._server = None
+
+    def _handle(self, method: str):
+        app = self.app
+
+        def unary(request, context):
+            if method == "echo":
+                return request  # echo carries its payload back (a str)
+            if method == "flush":
+                return ""  # acknowledgement only
+            return getattr(app, method)(request)
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary,
+            request_deserializer=_deserialize,
+            response_serializer=_serialize,
+        )
+
+    def on_start(self) -> None:
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="abci-grpc",
+            )
+        )
+        handlers = {m: self._handle(m) for m in _METHODS}
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        bound = self._server.add_insecure_port(self.addr)
+        if bound == 0:
+            raise OSError(f"cannot bind gRPC ABCI server at {self.addr}")
+        self.bound_port = bound
+        self._server.start()
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0).wait(2.0)
+
+
+class GrpcClient(Client):
+    """ABCI client over gRPC (abci/client/grpc_client.go).
+
+    Sync methods issue blocking unary calls; ``check_tx_async`` uses the
+    channel's future API and completes the ReqRes from a callback thread
+    (the reference launches a goroutine per async call, :247).
+    """
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        super().__init__("abci-grpc-client")
+        # accept grpc:// and tcp:// prefixes — gRPC targets are bare
+        # host:port (the CLI's default --addr carries a tcp:// scheme)
+        for scheme in ("grpc://", "tcp://"):
+            if addr.startswith(scheme):
+                addr = addr[len(scheme) :]
+        self.addr = addr
+        self.timeout = timeout
+        self._channel = None
+        self._calls = {}
+
+    def on_start(self) -> None:
+        self._channel = grpc.insecure_channel(self.addr)
+        grpc.channel_ready_future(self._channel).result(timeout=self.timeout)
+        for m in _METHODS:
+            self._calls[m] = self._channel.unary_unary(
+                f"/{_SERVICE}/{m}",
+                request_serializer=_serialize,
+                response_deserializer=_deserialize,
+            )
+
+    def on_stop(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+
+    def _call(self, method: str, req):
+        try:
+            return self._calls[method](req, timeout=self.timeout)
+        except grpc.RpcError as e:
+            err = ConnectionError(f"ABCI gRPC {method}: {e.code().name}")
+            self._err = self._err or err
+            if self._on_error is not None:
+                self._on_error(err)
+            raise err from e
+
+    # -- sync surface ------------------------------------------------------
+
+    def echo(self, msg: str) -> str:
+        return self._call("echo", msg)
+
+    def flush(self) -> None:
+        self._call("flush", "")
+
+    def info(self, req):
+        return self._call("info", req)
+
+    def query(self, req):
+        return self._call("query", req)
+
+    def check_tx(self, req):
+        return self._call("check_tx", req)
+
+    def init_chain(self, req):
+        return self._call("init_chain", req)
+
+    def prepare_proposal(self, req):
+        return self._call("prepare_proposal", req)
+
+    def process_proposal(self, req):
+        return self._call("process_proposal", req)
+
+    def finalize_block(self, req):
+        return self._call("finalize_block", req)
+
+    def extend_vote(self, req):
+        return self._call("extend_vote", req)
+
+    def verify_vote_extension(self, req):
+        return self._call("verify_vote_extension", req)
+
+    def commit(self, req):
+        return self._call("commit", req)
+
+    def list_snapshots(self, req):
+        return self._call("list_snapshots", req)
+
+    def offer_snapshot(self, req):
+        return self._call("offer_snapshot", req)
+
+    def load_snapshot_chunk(self, req):
+        return self._call("load_snapshot_chunk", req)
+
+    def apply_snapshot_chunk(self, req):
+        return self._call("apply_snapshot_chunk", req)
+
+    # -- async surface -----------------------------------------------------
+
+    def check_tx_async(self, req) -> ReqRes:
+        rr = ReqRes("check_tx", req)
+        fut = self._calls["check_tx"].future(req, timeout=self.timeout)
+
+        def done(f):
+            try:
+                resp = f.result()
+            except grpc.RpcError as e:
+                rr._complete_error(
+                    ConnectionError(f"ABCI gRPC check_tx: {e.code().name}")
+                )
+                return
+            rr._complete(resp)
+            if self._global_cb is not None:
+                self._global_cb(rr.request, resp)
+
+        fut.add_done_callback(done)
+        return rr

@@ -297,6 +297,55 @@ def cmd_start(args) -> int:
     return 0
 
 
+def _abci_client(args):
+    """socket | grpc | local client for the abci-* commands
+    (abci/cmd/abci-cli.go's --abci flag)."""
+    if args.transport == "grpc":
+        from ..abci.grpc import GrpcClient
+
+        client = GrpcClient(args.addr)
+    elif args.transport == "local":
+        from ..abci.client import LocalClient
+        from ..abci.kvstore import KVStoreApplication
+
+        client = LocalClient(KVStoreApplication())
+    else:
+        from ..abci.socket_client import SocketClient
+
+        client = SocketClient(args.addr)
+    client.start()
+    return client
+
+
+def cmd_abci_test(args) -> int:
+    """abci-cli test: protocol conformance against a running app."""
+    from ..abci.conformance import ConformanceError, run_conformance
+
+    client = _abci_client(args)
+    try:
+        passed = run_conformance(client)
+    except ConformanceError as e:
+        print(f"FAIL {e}")
+        return 1
+    finally:
+        client.stop()
+    for name in passed:
+        print(f"ok {name}")
+    print(f"passed {len(passed)} checks")
+    return 0
+
+
+def cmd_abci_console(args) -> int:
+    from ..abci.conformance import console
+
+    client = _abci_client(args)
+    try:
+        console(client)
+    finally:
+        client.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="cometbft-tpu")
     p.add_argument(
@@ -340,6 +389,14 @@ def main(argv=None) -> int:
     )
     ip = sub.add_parser("inspect")
     ip.add_argument("--rpc-laddr", dest="rpc_laddr", default=None)
+    for name in ("abci-test", "abci-console"):
+        ab = sub.add_parser(name)
+        ab.add_argument("--addr", default="tcp://127.0.0.1:26658")
+        ab.add_argument(
+            "--transport",
+            choices=["socket", "grpc", "local"],
+            default="socket",
+        )
     sp.add_argument("--rpc-laddr", dest="rpc_laddr", default=None)
     sp.add_argument("--log-level", dest="log_level", default=None)
 
@@ -355,6 +412,8 @@ def main(argv=None) -> int:
         "inspect": cmd_inspect,
         "unsafe-reset-all": cmd_unsafe_reset_all,
         "start": cmd_start,
+        "abci-test": cmd_abci_test,
+        "abci-console": cmd_abci_console,
     }[args.command](args)
 
 

@@ -24,6 +24,10 @@ class BaseConfig:
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
+    # When set (tcp://host:port or unix:///path), the node LISTENS here
+    # for a remote signer instead of using the file PV
+    # (config.go PrivValidatorListenAddr; privval/signer_*.go).
+    priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     block_sync: bool = True
     state_sync: bool = False

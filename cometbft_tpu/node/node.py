@@ -519,6 +519,15 @@ class Node(BaseService):
 
         if libmetrics.DEFAULT_NODE_METRICS is self.metrics:
             libmetrics.DEFAULT_NODE_METRICS = None
+        # Remote-signer endpoint (default_new_node attaches it): release
+        # the listening socket + ping thread or a same-process restart on
+        # the same laddr fails with EADDRINUSE.
+        endpoint = getattr(self, "_privval_endpoint", None)
+        if endpoint is not None:
+            try:
+                endpoint.stop()
+            except Exception:
+                pass
         if self.indexer_service is not None:
             try:
                 self.indexer_service.stop()
@@ -552,10 +561,32 @@ class Node(BaseService):
 
 
 def default_new_node(config: Config) -> Node:
-    """node/setup.go:64 DefaultNewNode."""
+    """node/setup.go:64 DefaultNewNode.
+
+    With ``priv_validator_laddr`` set the node listens for a remote
+    signer and signs through it (setup.go:595
+    createAndStartPrivValidatorSocketClient); otherwise the file PV.
+    """
+    genesis = load_genesis(config)
+    if config.base.priv_validator_laddr:
+        from ..privval.signer import (
+            RetrySignerClient,
+            SignerClient,
+            SignerListenerEndpoint,
+        )
+
+        endpoint = SignerListenerEndpoint(config.base.priv_validator_laddr)
+        endpoint.start()
+        try:
+            pv = RetrySignerClient(SignerClient(endpoint, genesis.chain_id))
+            node = Node(config, genesis, pv)
+        except Exception:
+            endpoint.stop()
+            raise
+        node._privval_endpoint = endpoint
+        return node
     pv = FilePV.load_or_generate(
         config.base.resolve(config.base.priv_validator_key_file),
         config.base.resolve(config.base.priv_validator_state_file),
     )
-    genesis = load_genesis(config)
     return Node(config, genesis, pv)

@@ -1,0 +1,563 @@
+"""Remote signer protocol: production validators sign over a socket.
+
+Reference surface: privval/signer_client.go (SignerClient implementing
+types.PrivValidator over an endpoint), privval/signer_listener_endpoint.go
+(the NODE side — it *listens*; the remote signer dials in, tmkms-style),
+privval/signer_dialer_endpoint.go + privval/signer_server.go (the SIGNER
+side), privval/messages.go (PubKey/SignVote/SignProposal/Ping + errors),
+privval/retry_signer_client.go.
+
+Transport: `tcp://` endpoints upgrade to SecretConnection (X25519 +
+ChaCha20-Poly1305, the same channel p2p uses — privval/socket_dialers.go
+semantics); `unix://` endpoints stay raw (filesystem permissions are the
+auth boundary). Frames are uvarint-length-prefixed JSON envelopes like the
+ABCI socket codec — one codec family across all process boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import threading
+import time
+from dataclasses import dataclass
+
+from ..crypto.keys import Ed25519PrivKey, PUBKEY_TYPES
+from ..libs import log as logmod
+from ..libs.service import BaseService
+from ..types import proto
+from ..types.block import BlockID, PartSetHeader
+from ..types.priv_validator import PrivValidator
+from ..types.vote import Proposal, Vote
+
+
+class RemoteSignerError(Exception):
+    """Error returned by the remote signer (privval/errors.go)."""
+
+    def __init__(self, code: int, description: str):
+        super().__init__(f"remote signer error {code}: {description}")
+        self.code = code
+        self.description = description
+
+
+# ------------------------------------------------------------------ wire
+
+
+@dataclass(slots=True)
+class PubKeyRequest:
+    chain_id: str = ""
+
+
+@dataclass(slots=True)
+class PubKeyResponse:
+    pub_key_type: str = ""
+    pub_key_bytes: bytes = b""
+    error_code: int = 0
+    error_desc: str = ""
+
+
+@dataclass(slots=True)
+class SignVoteRequest:
+    vote: Vote | None = None
+    chain_id: str = ""
+    skip_extension_signing: bool = False
+
+
+@dataclass(slots=True)
+class SignedVoteResponse:
+    vote: Vote | None = None
+    error_code: int = 0
+    error_desc: str = ""
+
+
+@dataclass(slots=True)
+class SignProposalRequest:
+    proposal: Proposal | None = None
+    chain_id: str = ""
+
+
+@dataclass(slots=True)
+class SignedProposalResponse:
+    proposal: Proposal | None = None
+    error_code: int = 0
+    error_desc: str = ""
+
+
+@dataclass(slots=True)
+class PingRequest:
+    pass
+
+
+@dataclass(slots=True)
+class PingResponse:
+    pass
+
+
+_TYPES = {
+    cls.__name__: cls
+    for cls in (
+        PubKeyRequest,
+        PubKeyResponse,
+        SignVoteRequest,
+        SignedVoteResponse,
+        SignProposalRequest,
+        SignedProposalResponse,
+        PingRequest,
+        PingResponse,
+        Vote,
+        Proposal,
+        BlockID,
+        PartSetHeader,
+    )
+}
+
+
+def _to_jsonable(v):
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        d = {"__t": type(v).__name__}
+        for f in dataclasses.fields(v):
+            d[f.name] = _to_jsonable(getattr(v, f.name))
+        return d
+    if isinstance(v, bytes):
+        return {"__b": v.hex()}
+    if isinstance(v, (list, tuple)):
+        return [_to_jsonable(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    raise TypeError(f"cannot encode {type(v).__name__} over privval socket")
+
+
+def _from_jsonable(v):
+    if isinstance(v, dict):
+        if "__b" in v:
+            return bytes.fromhex(v["__b"])
+        if "__t" in v:
+            cls = _TYPES[v["__t"]]
+            return cls(
+                **{k: _from_jsonable(x) for k, x in v.items() if k != "__t"}
+            )
+        raise ValueError(f"unknown tagged value {list(v)}")
+    if isinstance(v, list):
+        return [_from_jsonable(x) for x in v]
+    return v
+
+
+def encode_msg(msg) -> bytes:
+    return proto.delimited(
+        json.dumps(_to_jsonable(msg), separators=(",", ":")).encode()
+    )
+
+
+MAX_MSG_BYTES = 16 * 1024 * 1024
+
+
+def decode_msg(read_exact):
+    """Read one message via ``read_exact(n) -> bytes`` (raises EOFError)."""
+    length = 0
+    shift = 0
+    while True:
+        b = read_exact(1)
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("privval frame uvarint overflow")
+    if length > MAX_MSG_BYTES:
+        raise ValueError(f"privval frame of {length} bytes exceeds limit")
+    return _from_jsonable(json.loads(read_exact(length)))
+
+
+# -------------------------------------------------------------- endpoint
+
+
+def parse_addr(addr: str) -> tuple[str, str | tuple[str, int]]:
+    """'tcp://h:p' | 'unix:///path' -> (proto, target)."""
+    if addr.startswith("tcp://"):
+        host, port = addr[6:].rsplit(":", 1)
+        return "tcp", (host, int(port))
+    if addr.startswith("unix://"):
+        return "unix", addr[7:]
+    raise ValueError(f"unsupported privval address {addr!r}")
+
+
+class _Conn:
+    """One established signer connection: framing over raw or secret."""
+
+    def __init__(self, sock, secret=None):
+        self.sock = sock
+        self.secret = secret  # SecretConnection or None (unix)
+
+    def _read_exact(self, n: int) -> bytes:
+        if self.secret is not None:
+            return self.secret.read_exact_msg(n)
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise EOFError("privval connection closed")
+            out += chunk
+        return out
+
+    def send(self, msg) -> None:
+        data = encode_msg(msg)
+        if self.secret is not None:
+            self.secret.write(data)
+        else:
+            self.sock.sendall(data)
+
+    def recv(self):
+        return decode_msg(self._read_exact)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class SignerListenerEndpoint(BaseService):
+    """Node-side endpoint: LISTENS for the remote signer to dial in
+    (privval/signer_listener_endpoint.go). Single active connection;
+    requests are serialized; a ping keep-alive detects dead signers."""
+
+    def __init__(
+        self,
+        addr: str,
+        node_priv_key: Ed25519PrivKey | None = None,
+        timeout: float = 5.0,
+        ping_interval: float = 2.0,
+        logger=None,
+    ):
+        super().__init__("SignerListenerEndpoint", logger)
+        self.addr = addr
+        self.timeout = timeout
+        self.ping_interval = ping_interval
+        # tcp upgrades to SecretConnection; the node authenticates with an
+        # ephemeral key unless a persistent node key is supplied.
+        self.node_priv_key = node_priv_key or Ed25519PrivKey.generate()
+        self.logger = logger or logmod.default_logger().with_module("privval")
+        self._listener = None
+        self._conn: _Conn | None = None
+        self._conn_ready = threading.Event()
+        self._req_mtx = threading.Lock()
+        self._accept_thread = None
+        self._ping_thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        proto_, target = parse_addr(self.addr)
+        if proto_ == "tcp":
+            self._listener = socket.create_server(
+                target, reuse_port=False
+            )
+        else:
+            import os
+
+            try:
+                os.unlink(target)
+            except FileNotFoundError:
+                pass
+            self._listener = socket.socket(socket.AF_UNIX)
+            self._listener.bind(target)
+            self._listener.listen(1)
+        self._listener.settimeout(0.2)
+        self._proto = proto_
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="privval-accept", daemon=True
+        )
+        self._accept_thread.start()
+        self._ping_thread = threading.Thread(
+            target=self._ping_loop, name="privval-ping", daemon=True
+        )
+        self._ping_thread.start()
+
+    def on_stop(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self._drop_conn()
+
+    def _drop_conn(self) -> None:
+        conn, self._conn = self._conn, None
+        self._conn_ready.clear()
+        if conn is not None:
+            conn.close()
+
+    def _accept_loop(self) -> None:
+        while self.is_running():
+            if self._conn is not None:
+                time.sleep(0.1)
+                continue
+            try:
+                sock, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                sock.settimeout(self.timeout)
+                secret = None
+                if self._proto == "tcp":
+                    from ..p2p.conn.secret_connection import SecretConnection
+
+                    secret = SecretConnection(sock, self.node_priv_key)
+                self._conn = _Conn(sock, secret)
+                self._conn_ready.set()
+                self.logger.info("remote signer connected", addr=self.addr)
+            except Exception as e:
+                self.logger.error("signer handshake failed", err=repr(e))
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _ping_loop(self) -> None:
+        while self.is_running():
+            time.sleep(self.ping_interval)
+            if self._conn is None:
+                continue
+            try:
+                self.request(PingRequest())
+            except Exception as e:
+                self.logger.error("signer ping failed", err=repr(e))
+                self._drop_conn()
+
+    # -- requests ----------------------------------------------------------
+
+    def wait_for_conn(self, timeout: float | None = None) -> bool:
+        return self._conn_ready.wait(
+            timeout if timeout is not None else self.timeout
+        )
+
+    def request(self, msg):
+        """Send one request and read its response (serialized)."""
+        with self._req_mtx:
+            conn = self._conn
+            if conn is None:
+                if not self._conn_ready.wait(self.timeout):
+                    raise TimeoutError("no remote signer connected")
+                conn = self._conn
+                if conn is None:
+                    raise TimeoutError("no remote signer connected")
+            try:
+                conn.send(msg)
+                return conn.recv()
+            except Exception:
+                self._drop_conn()
+                raise
+
+
+class SignerDialerEndpoint:
+    """Signer-side endpoint: dials the node with retries
+    (privval/signer_dialer_endpoint.go)."""
+
+    def __init__(
+        self,
+        addr: str,
+        signer_priv_key: Ed25519PrivKey | None = None,
+        timeout: float = 5.0,
+        max_retries: int = 10,
+        retry_wait: float = 0.5,
+    ):
+        self.addr = addr
+        self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry_wait = retry_wait
+        self.signer_priv_key = signer_priv_key or Ed25519PrivKey.generate()
+
+    def dial(self) -> _Conn:
+        proto_, target = parse_addr(self.addr)
+        last_err: Exception | None = None
+        for _ in range(self.max_retries):
+            try:
+                if proto_ == "tcp":
+                    sock = socket.create_connection(
+                        target, timeout=self.timeout
+                    )
+                    from ..p2p.conn.secret_connection import SecretConnection
+
+                    secret = SecretConnection(sock, self.signer_priv_key)
+                    return _Conn(sock, secret)
+                sock = socket.socket(socket.AF_UNIX)
+                sock.settimeout(self.timeout)
+                sock.connect(target)
+                return _Conn(sock)
+            except OSError as e:
+                last_err = e
+                time.sleep(self.retry_wait)
+        raise ConnectionError(
+            f"cannot reach validator at {self.addr}: {last_err!r}"
+        )
+
+
+class SignerServer(BaseService):
+    """The remote signing process: FilePV behind a socket
+    (privval/signer_server.go). Dials the validator node and serves
+    PubKey/SignVote/SignProposal/Ping until stopped."""
+
+    def __init__(
+        self, endpoint: SignerDialerEndpoint, chain_id: str, priv_val, logger=None
+    ):
+        super().__init__("SignerServer", logger)
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self.priv_val = priv_val  # any PrivValidator (FilePV in production)
+        self.logger = logger or logmod.default_logger().with_module("privval")
+        self._thread = None
+
+    def on_start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="privval-server", daemon=True
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        pass  # the serve loop exits on is_running() / connection close
+
+    def _serve_loop(self) -> None:
+        while self.is_running():
+            try:
+                conn = self.endpoint.dial()
+            except ConnectionError as e:
+                self.logger.error("dial failed", err=repr(e))
+                time.sleep(1.0)
+                continue
+            self.logger.info("serving validator", addr=self.endpoint.addr)
+            try:
+                while self.is_running():
+                    req = conn.recv()
+                    conn.send(self._handle(req))
+            except (EOFError, OSError, socket.timeout) as e:
+                if self.is_running():
+                    self.logger.error("connection lost", err=repr(e))
+            finally:
+                conn.close()
+
+    def _handle(self, req):
+        try:
+            if isinstance(req, PingRequest):
+                return PingResponse()
+            if isinstance(req, PubKeyRequest):
+                pub = self.priv_val.get_pub_key()
+                return PubKeyResponse(
+                    pub_key_type=pub.type, pub_key_bytes=pub.bytes()
+                )
+            if isinstance(req, SignVoteRequest):
+                self.priv_val.sign_vote(
+                    req.chain_id,
+                    req.vote,
+                    sign_extension=not req.skip_extension_signing,
+                )
+                return SignedVoteResponse(vote=req.vote)
+            if isinstance(req, SignProposalRequest):
+                self.priv_val.sign_proposal(req.chain_id, req.proposal)
+                return SignedProposalResponse(proposal=req.proposal)
+        except Exception as e:  # double-sign protection etc. -> error resp
+            kind = type(req).__name__
+            if isinstance(req, SignVoteRequest):
+                return SignedVoteResponse(error_code=2, error_desc=str(e))
+            if isinstance(req, SignProposalRequest):
+                return SignedProposalResponse(error_code=2, error_desc=str(e))
+            return PubKeyResponse(error_code=2, error_desc=f"{kind}: {e}")
+        return PubKeyResponse(error_code=1, error_desc="unknown request")
+
+
+class SignerClient(PrivValidator):
+    """PrivValidator over a SignerListenerEndpoint
+    (privval/signer_client.go). The consensus engine can't tell it from a
+    FilePV; double-sign protection lives with the remote key."""
+
+    def __init__(self, endpoint: SignerListenerEndpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self._pub_key = None
+
+    def close(self) -> None:
+        self.endpoint.stop()
+
+    def ping(self) -> None:
+        resp = self.endpoint.request(PingRequest())
+        if not isinstance(resp, PingResponse):
+            raise RemoteSignerError(1, f"unexpected ping response {resp!r}")
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            resp = self.endpoint.request(PubKeyRequest(chain_id=self.chain_id))
+            if not isinstance(resp, PubKeyResponse):
+                raise RemoteSignerError(1, f"unexpected response {resp!r}")
+            if resp.error_code:
+                raise RemoteSignerError(resp.error_code, resp.error_desc)
+            cls = PUBKEY_TYPES[resp.pub_key_type]
+            self._pub_key = cls(resp.pub_key_bytes)
+        return self._pub_key
+
+    def sign_vote(
+        self, chain_id: str, vote: Vote, sign_extension: bool = True
+    ) -> None:
+        resp = self.endpoint.request(
+            SignVoteRequest(
+                vote=vote,
+                chain_id=chain_id,
+                skip_extension_signing=not sign_extension,
+            )
+        )
+        if not isinstance(resp, SignedVoteResponse):
+            raise RemoteSignerError(1, f"unexpected response {resp!r}")
+        if resp.error_code:
+            raise RemoteSignerError(resp.error_code, resp.error_desc)
+        vote.signature = resp.vote.signature
+        vote.extension_signature = resp.vote.extension_signature
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        resp = self.endpoint.request(
+            SignProposalRequest(proposal=proposal, chain_id=chain_id)
+        )
+        if not isinstance(resp, SignedProposalResponse):
+            raise RemoteSignerError(1, f"unexpected response {resp!r}")
+        if resp.error_code:
+            raise RemoteSignerError(resp.error_code, resp.error_desc)
+        proposal.signature = resp.proposal.signature
+
+
+class RetrySignerClient(PrivValidator):
+    """Retry wrapper (privval/retry_signer_client.go): transient endpoint
+    failures (signer restarting, ping-dropped conn) retry with backoff;
+    remote signing REFUSALS (double-sign protection) do not."""
+
+    def __init__(self, client: SignerClient, retries: int = 5, wait: float = 0.4):
+        self.client = client
+        self.retries = retries
+        self.wait = wait
+
+    def close(self) -> None:
+        self.client.close()
+
+    def _retry(self, fn):
+        last: Exception | None = None
+        for _ in range(self.retries):
+            try:
+                return fn()
+            except RemoteSignerError:
+                raise  # the signer answered: a refusal is final
+            except Exception as e:
+                last = e
+                time.sleep(self.wait)
+        raise last
+
+    def get_pub_key(self):
+        return self._retry(self.client.get_pub_key)
+
+    def sign_vote(self, chain_id, vote, sign_extension: bool = True) -> None:
+        return self._retry(
+            lambda: self.client.sign_vote(chain_id, vote, sign_extension)
+        )
+
+    def sign_proposal(self, chain_id, proposal) -> None:
+        return self._retry(
+            lambda: self.client.sign_proposal(chain_id, proposal)
+        )

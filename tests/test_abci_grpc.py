@@ -1,0 +1,129 @@
+"""gRPC ABCI transport + conformance driver tests
+(reference: abci/client/grpc_client.go, abci/cmd/abci-cli, abci/tests/).
+"""
+
+import socket
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci.conformance import ConformanceError, run_conformance
+from cometbft_tpu.abci.grpc import GrpcClient, GrpcServer
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def grpc_pair():
+    app = KVStoreApplication()
+    server = GrpcServer(f"127.0.0.1:{_free_port()}", app)
+    server.start()
+    client = GrpcClient(f"127.0.0.1:{server.bound_port}")
+    client.start()
+    yield client, app
+    client.stop()
+    server.stop()
+
+
+def test_grpc_addr_schemes():
+    """tcp:// (the CLI default) and grpc:// prefixes map to bare targets."""
+    assert GrpcClient("tcp://1.2.3.4:5").addr == "1.2.3.4:5"
+    assert GrpcClient("grpc://1.2.3.4:5").addr == "1.2.3.4:5"
+    assert GrpcClient("1.2.3.4:5").addr == "1.2.3.4:5"
+
+
+def test_grpc_echo_info_roundtrip(grpc_pair):
+    client, _ = grpc_pair
+    assert client.echo("over-the-wire") == "over-the-wire"
+    client.flush()
+    info = client.info(abci.RequestInfo(version="t"))
+    assert info.last_block_height == 0
+
+
+def test_grpc_check_tx_sync_and_async(grpc_pair):
+    client, _ = grpc_pair
+    res = client.check_tx(abci.RequestCheckTx(tx=b"a=1"))
+    assert res.code == abci.OK
+    seen = []
+    client.set_response_callback(lambda req, res: seen.append(res))
+    rr = client.check_tx_async(abci.RequestCheckTx(tx=b"b=2"))
+    resp = rr.wait(5.0)
+    assert resp.code == abci.OK
+    assert seen and seen[0].code == abci.OK
+
+
+def test_grpc_finalize_commit_query(grpc_pair):
+    client, _ = grpc_pair
+    fin = client.finalize_block(
+        abci.RequestFinalizeBlock(
+            txs=[b"k=v"],
+            decided_last_commit=abci.CommitInfo(round=0),
+            misbehavior=[],
+            hash=b"",
+            height=1,
+            time_ns=0,
+            next_validators_hash=b"",
+            proposer_address=b"",
+        )
+    )
+    assert [r.code for r in fin.tx_results] == [abci.OK]
+    client.commit(abci.RequestCommit())
+    q = client.query(abci.RequestQuery(data=b"k", path="/key"))
+    assert q.value == b"v"
+
+
+def test_conformance_over_grpc(grpc_pair):
+    client, _ = grpc_pair
+    passed = run_conformance(client)
+    assert "finalize_block" in passed and "query_committed" in passed
+    assert len(passed) >= 10
+
+
+def test_conformance_over_local_client():
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    try:
+        passed = run_conformance(client)
+        assert "query_committed" in passed
+    finally:
+        client.stop()
+
+
+def test_conformance_catches_lying_app():
+    """A non-conformant app (wrong app hash after commit) must fail."""
+
+    class LyingApp(KVStoreApplication):
+        def info(self, req):
+            resp = super().info(req)
+            if resp.last_block_height > 0:
+                resp.last_block_app_hash = b"\x00" * 32
+            return resp
+
+    client = LocalClient(LyingApp())
+    client.start()
+    try:
+        with pytest.raises(ConformanceError):
+            run_conformance(client)
+    finally:
+        client.stop()
+
+
+def test_abci_cli_commands(tmp_path):
+    """The abci-test CLI command drives conformance end to end."""
+    from cometbft_tpu.abci.server import SocketServer
+    from cometbft_tpu.cmd.__main__ import main
+
+    addr = f"unix://{tmp_path}/abci.sock"
+    server = SocketServer(addr, KVStoreApplication())
+    server.start()
+    try:
+        rc = main(["abci-test", "--addr", addr, "--transport", "socket"])
+        assert rc == 0
+    finally:
+        server.stop()

@@ -1,0 +1,177 @@
+"""Remote signer protocol tests (privval/signer_*.go semantics).
+
+A SignerServer wrapping a FilePV dials a SignerListenerEndpoint over a
+real socket (unix raw and tcp+SecretConnection); the SignerClient must be
+indistinguishable from a local PV to the consensus engine, and remote
+double-sign refusals must surface as RemoteSignerError — not retried.
+"""
+
+import os
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.privval.file_pv import FilePV
+from cometbft_tpu.privval.signer import (
+    PingRequest,
+    RemoteSignerError,
+    RetrySignerClient,
+    SignerClient,
+    SignerDialerEndpoint,
+    SignerListenerEndpoint,
+    SignerServer,
+)
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import Proposal, Vote
+
+CHAIN_ID = "signer-chain"
+
+
+def _block_id(tag: bytes = b"\x01") -> BlockID:
+    return BlockID(
+        hash=tag * 32,
+        part_set_header=PartSetHeader(total=1, hash=b"\x02" * 32),
+    )
+
+
+def _vote(height=1, round_=0, bid=None, idx=0) -> Vote:
+    return Vote(
+        msg_type=canonical.PRECOMMIT_TYPE,
+        height=height,
+        round=round_,
+        block_id=bid if bid is not None else _block_id(),
+        timestamp_ns=1_700_000_000_000_000_000,
+        validator_address=b"\x0a" * 20,
+        validator_index=idx,
+    )
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spin_up(addr, pv):
+    """Start listener endpoint + signer server; return (client, stopper)."""
+    endpoint = SignerListenerEndpoint(addr, timeout=5.0, ping_interval=60.0)
+    endpoint.start()
+    server = SignerServer(
+        SignerDialerEndpoint(addr, timeout=5.0), CHAIN_ID, pv
+    )
+    server.start()
+    assert endpoint.wait_for_conn(5.0), "signer never connected"
+    client = SignerClient(endpoint, CHAIN_ID)
+
+    def stop():
+        server.stop()
+        endpoint.stop()
+
+    return client, stop
+
+
+@pytest.fixture(params=["unix", "tcp"])
+def signer_net(request, tmp_path):
+    if request.param == "unix":
+        addr = f"unix://{tmp_path}/pv.sock"
+    else:
+        addr = f"tcp://127.0.0.1:{_free_port()}"
+    pv = FilePV.generate(
+        str(tmp_path / "pv_key.json"), str(tmp_path / "pv_state.json")
+    )
+    client, stop = _spin_up(addr, pv)
+    yield client, pv
+    stop()
+
+
+def test_pubkey_and_sign_roundtrip(signer_net):
+    client, pv = signer_net
+    assert client.get_pub_key() == pv.get_pub_key()
+
+    vote = _vote()
+    client.sign_vote(CHAIN_ID, vote, sign_extension=False)
+    assert vote.signature
+    assert pv.get_pub_key().verify_signature(
+        vote.sign_bytes(CHAIN_ID), vote.signature
+    )
+
+    prop = Proposal(
+        height=2,
+        round=0,
+        pol_round=-1,
+        block_id=_block_id(),
+        timestamp_ns=1_700_000_000_000_000_000,
+    )
+    client.sign_proposal(CHAIN_ID, prop)
+    assert pv.get_pub_key().verify_signature(
+        prop.sign_bytes(CHAIN_ID), prop.signature
+    )
+
+    client.ping()
+
+
+def test_double_sign_refusal_propagates(signer_net):
+    client, _ = signer_net
+    v1 = _vote(height=5)
+    client.sign_vote(CHAIN_ID, v1, sign_extension=False)
+    # Same HRS, different block: the remote FilePV must refuse and the
+    # refusal must surface as RemoteSignerError (not a transport error).
+    v2 = _vote(height=5, bid=_block_id(b"\x07"))
+    with pytest.raises(RemoteSignerError):
+        client.sign_vote(CHAIN_ID, v2, sign_extension=False)
+    # retry wrapper must NOT retry a refusal into success
+    retry = RetrySignerClient(client, retries=3, wait=0.01)
+    with pytest.raises(RemoteSignerError):
+        retry.sign_vote(CHAIN_ID, v2, sign_extension=False)
+
+
+def test_signer_reconnect_after_drop(tmp_path):
+    """Kill the signer; a new one dials in; requests succeed again."""
+    addr = f"unix://{tmp_path}/pv2.sock"
+    endpoint = SignerListenerEndpoint(addr, timeout=5.0, ping_interval=60.0)
+    endpoint.start()
+    try:
+        pv = MockPV(Ed25519PrivKey.from_seed(b"\x09" * 32))
+        s1 = SignerServer(SignerDialerEndpoint(addr), CHAIN_ID, pv)
+        s1.start()
+        assert endpoint.wait_for_conn(5.0)
+        client = SignerClient(endpoint, CHAIN_ID)
+        assert client.get_pub_key() == pv.get_pub_key()
+
+        s1.stop()
+        endpoint._drop_conn()
+
+        s2 = SignerServer(SignerDialerEndpoint(addr), CHAIN_ID, pv)
+        s2.start()
+        assert endpoint.wait_for_conn(5.0)
+        retry = RetrySignerClient(client, retries=10, wait=0.2)
+        vote = _vote(height=9)
+        retry.sign_vote(CHAIN_ID, vote, sign_extension=False)
+        assert pv.get_pub_key().verify_signature(
+            vote.sign_bytes(CHAIN_ID), vote.signature
+        )
+        s2.stop()
+    finally:
+        endpoint.stop()
+
+
+def test_tcp_is_encrypted(tmp_path):
+    """The tcp transport must carry no plaintext frames on the wire."""
+    port = _free_port()
+    addr = f"tcp://127.0.0.1:{port}"
+    pv = MockPV(Ed25519PrivKey.from_seed(b"\x0b" * 32))
+    client, stop = _spin_up(addr, pv)
+    try:
+        conn = client.endpoint._conn
+        assert conn is not None and conn.secret is not None
+        vote = _vote(height=3)
+        client.sign_vote(CHAIN_ID, vote, sign_extension=False)
+        assert vote.signature
+    finally:
+        stop()
