@@ -31,6 +31,8 @@ _TYPES = {
 
 
 def _to_jsonable(v):
+    """Tagged-JSON encoding of registered dataclasses. Shared by every
+    process-boundary codec (ABCI socket/gRPC, privval socket)."""
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
         d = {"__t": type(v).__name__}
         for f in dataclasses.fields(v):
@@ -47,13 +49,19 @@ def _to_jsonable(v):
     raise TypeError(f"cannot encode {type(v).__name__} over ABCI socket")
 
 
-def _from_jsonable(v):
+def _from_jsonable(v, types=None):
+    """Inverse of :func:`_to_jsonable` against a type registry
+    (defaults to the ABCI message set)."""
+    if types is None:
+        types = _TYPES
     if isinstance(v, dict):
         if "__b" in v:
             return bytes.fromhex(v["__b"])
         if "__t" in v:
-            cls = _TYPES[v["__t"]]
-            kwargs = {k: _from_jsonable(x) for k, x in v.items() if k != "__t"}
+            cls = types[v["__t"]]
+            kwargs = {
+                k: _from_jsonable(x, types) for k, x in v.items() if k != "__t"
+            }
             obj = cls(**kwargs)
             # Restore enum types declared on the dataclass.
             for f in dataclasses.fields(cls):
@@ -67,7 +75,7 @@ def _from_jsonable(v):
             return obj
         raise ValueError(f"unknown tagged value {v.keys()}")
     if isinstance(v, list):
-        return [_from_jsonable(x) for x in v]
+        return [_from_jsonable(x, types) for x in v]
     return v
 
 
@@ -86,26 +94,20 @@ MAX_FRAME_BYTES = 64 * 1024 * 1024
 
 def read_frame(sock_file) -> tuple[str, object] | None:
     """Read one frame from a file-like socket; None on clean EOF."""
-    # uvarint length prefix, byte at a time
-    length = 0
-    shift = 0
-    while True:
-        b = sock_file.read(1)
-        if not b:
-            return None
-        length |= (b[0] & 0x7F) << shift
-        if not b[0] & 0x80:
-            break
-        shift += 7
-        if shift > 35:
-            raise ValueError("frame length uvarint overflow")
-    if length > MAX_FRAME_BYTES:
-        raise ValueError(f"frame of {length} bytes exceeds limit")
-    payload = b""
-    while len(payload) < length:
-        chunk = sock_file.read(length - len(payload))
-        if not chunk:
-            raise EOFError("truncated ABCI frame")
-        payload += chunk
+    first = sock_file.read(1)
+    if not first:
+        return None  # clean EOF between frames
+    buffered = [first]
+
+    def read_exact(n: int) -> bytes:
+        out = buffered.pop() if (buffered and n) else b""
+        while len(out) < n:
+            chunk = sock_file.read(n - len(out))
+            if not chunk:
+                raise EOFError("truncated ABCI frame")
+            out += chunk
+        return out
+
+    payload = proto.read_delimited(read_exact, MAX_FRAME_BYTES)
     obj = json.loads(payload)
     return obj["method"], _from_jsonable(obj["msg"])

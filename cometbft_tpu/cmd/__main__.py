@@ -297,6 +297,77 @@ def cmd_start(args) -> int:
     return 0
 
 
+def _debug_bundle(args, out_dir: str) -> list[str]:
+    """Collect one crash-forensics bundle from a live node
+    (cmd/cometbft/commands/debug/dump.go's artifact set)."""
+    import json as _json
+    import urllib.request
+
+    captured = []
+    os.makedirs(out_dir, exist_ok=True)
+
+    def save(name: str, data: str) -> None:
+        with open(os.path.join(out_dir, name), "w") as f:
+            f.write(data)
+        captured.append(name)
+
+    from ..rpc.client import HTTPClient
+
+    rpc = HTTPClient(args.rpc_laddr.replace("tcp://", "http://"))
+    for name, method in (
+        ("status.json", "status"),
+        ("net_info.json", "net_info"),
+        ("consensus_state.json", "dump_consensus_state"),
+    ):
+        try:
+            save(name, _json.dumps(rpc.call(method), indent=1, default=str))
+        except Exception as e:
+            save(name + ".err", repr(e))
+
+    if args.pprof_laddr:
+        base = "http://" + args.pprof_laddr.replace("tcp://", "")
+        for name, path in (
+            ("goroutines.txt", "/debug/pprof/goroutine"),
+            ("heap.txt", "/debug/pprof/heap"),
+        ):
+            try:
+                with urllib.request.urlopen(base + path, timeout=5) as r:
+                    save(name, r.read().decode())
+            except Exception as e:
+                save(name + ".err", repr(e))
+    return captured
+
+
+def cmd_debug_dump(args) -> int:
+    """debug dump: capture bundles from a live node, optionally repeating
+    (debug/dump.go's --frequency)."""
+    for i in range(args.count):
+        if i:
+            time.sleep(args.frequency)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        out = os.path.join(args.output_dir, f"dump-{stamp}-{i}")
+        captured = _debug_bundle(args, out)
+        print(f"captured {len(captured)} artifacts in {out}")
+    return 0
+
+
+def cmd_debug_kill(args) -> int:
+    """debug kill: capture a bundle, then SIGTERM the node process
+    (debug/kill.go)."""
+    out = os.path.join(
+        args.output_dir, f"kill-{time.strftime('%Y%m%d-%H%M%S')}"
+    )
+    captured = _debug_bundle(args, out)
+    print(f"captured {len(captured)} artifacts in {out}")
+    try:
+        os.kill(args.pid, signal.SIGTERM)
+        print(f"sent SIGTERM to {args.pid}")
+    except ProcessLookupError:
+        print(f"no such process {args.pid}")
+        return 1
+    return 0
+
+
 def _abci_client(args):
     """socket | grpc | local client for the abci-* commands
     (abci/cmd/abci-cli.go's --abci flag)."""
@@ -389,6 +460,17 @@ def main(argv=None) -> int:
     )
     ip = sub.add_parser("inspect")
     ip.add_argument("--rpc-laddr", dest="rpc_laddr", default=None)
+    for name in ("debug-dump", "debug-kill"):
+        dp = sub.add_parser(name)
+        dp.add_argument("--rpc-laddr", dest="rpc_laddr",
+                        default="tcp://127.0.0.1:26657")
+        dp.add_argument("--pprof-laddr", dest="pprof_laddr", default="")
+        dp.add_argument("--output-dir", dest="output_dir", default=".")
+        if name == "debug-dump":
+            dp.add_argument("--frequency", type=float, default=30.0)
+            dp.add_argument("--count", type=int, default=1)
+        else:
+            dp.add_argument("pid", type=int)
     for name in ("abci-test", "abci-console"):
         ab = sub.add_parser(name)
         ab.add_argument("--addr", default="tcp://127.0.0.1:26658")
@@ -414,6 +496,8 @@ def main(argv=None) -> int:
         "start": cmd_start,
         "abci-test": cmd_abci_test,
         "abci-console": cmd_abci_console,
+        "debug-dump": cmd_debug_dump,
+        "debug-kill": cmd_debug_kill,
     }[args.command](args)
 
 

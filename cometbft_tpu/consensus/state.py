@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import queue
 import threading
+
+from ..libs import sync as libsync
 import time
 
 from ..config import ConsensusConfig
@@ -153,7 +155,9 @@ class ConsensusState(BaseService):
 
         self.rs = RoundState()
         self.state = None  # sm.State, set by update_to_state
-        self._mtx = threading.RLock()  # guards rs reads from other threads
+        # guards rs reads from other threads; libs.sync so the deadlock
+        # tier (COMETBFT_TPU_DEADLOCK=1) instruments the consensus mutex
+        self._mtx = libsync.RLock("consensus.state")
 
         # merged inbox: ("peer"|"internal"|"timeout", payload)
         self._queue: queue.Queue = queue.Queue(maxsize=1000)

@@ -1,0 +1,20 @@
+"""E2E harness: process-level testnets, load generation, perturbations.
+
+Reference analogs: test/e2e/runner (docker-compose testnets with
+{disconnect, kill, pause, restart} perturbations — runner/perturb.go:16-31)
+and test/loadtime (tm-load-test based latency reports). Containers are
+replaced by OS processes: each node is a ``cometbft-tpu start`` child
+with its own home dir, so SIGKILL/SIGSTOP give the same crash/pause
+semantics docker kill/pause give the reference.
+"""
+
+from .load import LoadGenerator, LoadReport, load_report
+from .runner import ProcessNode, Testnet
+
+__all__ = [
+    "LoadGenerator",
+    "LoadReport",
+    "load_report",
+    "ProcessNode",
+    "Testnet",
+]

@@ -1,0 +1,187 @@
+"""Load generation + latency report (reference: test/loadtime).
+
+The generator posts self-describing transactions
+(``load:<run_id>:<seq>:<send_time_ns>:<padding>``) through
+``broadcast_tx_async`` over N connections at a target rate — the shape
+tm-load-test drives. The report walks committed blocks over RPC and
+computes per-tx latency as block_time - send_time (loadtime's
+block-timestamp method: report/report.go), so it needs no clock on the
+node, only that generator and reporter share one.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..rpc.client import HTTPClient
+from ..rpc.decoding import parse_rfc3339
+
+TX_PREFIX = b"load:"
+
+
+def make_tx(run_id: str, seq: int, size: int = 64) -> bytes:
+    body = b"load:%s:%d:%d:" % (run_id.encode(), seq, time.time_ns())
+    pad = max(0, size - len(body))
+    # kvstore txs are key=value; key must be unique per tx so each lands
+    return body + b"x" * pad + b"=1"
+
+
+def parse_tx(tx: bytes) -> tuple[str, int, int] | None:
+    """-> (run_id, seq, send_time_ns) for load txs, else None."""
+    if not tx.startswith(TX_PREFIX):
+        return None
+    try:
+        parts = tx.split(b":", 4)
+        return parts[1].decode(), int(parts[2]), int(parts[3])
+    except (IndexError, ValueError):
+        return None
+
+
+class LoadGenerator:
+    """Posts load txs at ``rate`` tx/s split across ``connections``
+    worker threads (tm-load-test's -r / -c knobs)."""
+
+    def __init__(
+        self,
+        endpoints: list[str],
+        rate: int = 100,
+        connections: int = 1,
+        tx_size: int = 64,
+        run_id: str | None = None,
+    ):
+        self.endpoints = endpoints
+        self.rate = rate
+        self.connections = connections
+        self.tx_size = tx_size
+        self.run_id = run_id or f"r{int(time.time()) % 100000}"
+        self.sent = 0
+        self.errors = 0
+        self._seq = 0
+        self._mtx = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _next_seq(self) -> int:
+        with self._mtx:
+            self._seq += 1
+            return self._seq
+
+    def _worker(self, idx: int) -> None:
+        client = HTTPClient(self.endpoints[idx % len(self.endpoints)])
+        interval = self.connections / max(self.rate, 1)
+        next_at = time.monotonic()
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now < next_at:
+                time.sleep(min(next_at - now, 0.05))
+                continue
+            next_at += interval
+            tx = make_tx(self.run_id, self._next_seq(), self.tx_size)
+            try:
+                client.call(
+                    "broadcast_tx_async",
+                    tx=base64.b64encode(tx).decode(),
+                )
+                with self._mtx:
+                    self.sent += 1
+            except Exception:
+                with self._mtx:
+                    self.errors += 1
+                time.sleep(0.2)
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._threads = [
+            threading.Thread(
+                target=self._worker, args=(i,), daemon=True,
+                name=f"load-{i}",
+            )
+            for i in range(self.connections)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(2.0)
+
+    def run_for(self, seconds: float) -> None:
+        self.start()
+        time.sleep(seconds)
+        self.stop()
+
+
+@dataclass
+class LoadReport:
+    """Latency stats from block timestamps (report/report.go)."""
+
+    run_id: str
+    txs: int = 0
+    blocks: int = 0
+    first_height: int = 0
+    last_height: int = 0
+    latencies_s: list = field(default_factory=list)
+
+    @property
+    def mean_s(self) -> float:
+        return (
+            sum(self.latencies_s) / len(self.latencies_s)
+            if self.latencies_s
+            else 0.0
+        )
+
+    def quantile(self, q: float) -> float:
+        if not self.latencies_s:
+            return 0.0
+        xs = sorted(self.latencies_s)
+        return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+    def summary(self) -> dict:
+        return {
+            "run_id": self.run_id,
+            "txs": self.txs,
+            "blocks": self.blocks,
+            "heights": [self.first_height, self.last_height],
+            "latency_mean_s": round(self.mean_s, 3),
+            "latency_p50_s": round(self.quantile(0.5), 3),
+            "latency_p99_s": round(self.quantile(0.99), 3),
+            "latency_max_s": round(max(self.latencies_s or [0.0]), 3),
+        }
+
+
+def load_report(
+    endpoint: str,
+    run_id: str,
+    from_height: int = 1,
+    to_height: int | None = None,
+) -> LoadReport:
+    """Walk committed blocks over RPC; latency = block time - send time."""
+    client = HTTPClient(endpoint)
+    if to_height is None:
+        to_height = int(
+            client.call("status")["sync_info"]["latest_block_height"]
+        )
+    rep = LoadReport(run_id=run_id)
+    for h in range(from_height, to_height + 1):
+        blk = client.call("block", height=h)
+        header = blk["block"]["header"]
+        block_time_ns = parse_rfc3339(header["time"])
+        txs = blk["block"]["data"]["txs"] or []
+        counted = False
+        for tx_b64 in txs:
+            parsed = parse_tx(base64.b64decode(tx_b64))
+            if parsed is None or parsed[0] != run_id:
+                continue
+            rep.txs += 1
+            counted = True
+            rep.latencies_s.append((block_time_ns - parsed[2]) / 1e9)
+        if counted:
+            rep.blocks += 1
+            rep.last_height = h
+            if not rep.first_height:
+                rep.first_height = h
+    return rep

@@ -1,0 +1,232 @@
+"""Process-level testnet runner with perturbations
+(reference: test/e2e/runner — main.go orchestration, perturb.go:16-31
+{disconnect, kill, pause, restart}, tests/ invariant checks).
+
+Containers are replaced by child processes of ``cometbft-tpu start``:
+
+  kill    -> SIGKILL + restart          (docker kill / start)
+  pause   -> SIGSTOP ... SIGCONT        (docker pause / unpause)
+  restart -> SIGTERM + restart          (docker restart)
+
+Disconnect-style network faults belong to the in-process tier
+(FuzzedConnection, tests/test_fault_injection.py) where the transport is
+reachable; an OS process's TCP stack isn't, without root.
+
+Invariant checks after perturbations mirror test/e2e/tests/block_test.go:
+all nodes agree on the app hash at every common height, and heights
+keep advancing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..rpc.client import HTTPClient
+
+
+class ProcessNode:
+    """One ``cometbft-tpu start`` child process + its home dir."""
+
+    def __init__(self, home: str, rpc_addr: str, env: dict | None = None):
+        self.home = home
+        self.rpc_addr = rpc_addr
+        self.env = env if env is not None else dict(os.environ)
+        self.proc: subprocess.Popen | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        assert self.proc is None or self.proc.poll() is not None
+        # Logs go to a file, not a pipe: an undrained 64 KB pipe buffer
+        # would freeze a chatty node mid-run (the docker tier's log-driver
+        # role). Append mode keeps pre-restart history.
+        self.log_path = os.path.join(self.home, "node.log")
+        self._log_f = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "cometbft_tpu.cmd",
+                "--home",
+                self.home,
+                "start",
+            ],
+            stdout=self._log_f,
+            stderr=subprocess.STDOUT,
+            env=self.env,
+        )
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self.proc is None or self.proc.poll() is not None:
+            self._close_log()
+            return
+        self.proc.terminate()
+        try:
+            self.proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.communicate(timeout=timeout)
+        self._close_log()
+
+    def _close_log(self) -> None:
+        f = getattr(self, "_log_f", None)
+        if f is not None and not f.closed:
+            f.close()
+
+    def log_tail(self, n_bytes: int = 4000) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - n_bytes))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    # -- perturbations (perturb.go:16-31) ----------------------------------
+
+    def kill(self) -> None:
+        """SIGKILL: no cleanup, no flushes — crash semantics."""
+        assert self.proc is not None
+        self.proc.kill()
+        self.proc.communicate(timeout=10)
+        self._close_log()
+
+    def pause(self) -> None:
+        """SIGSTOP: the node freezes mid-whatever (docker pause)."""
+        assert self.proc is not None and self.proc.poll() is None
+        os.kill(self.proc.pid, signal.SIGSTOP)
+
+    def unpause(self) -> None:
+        os.kill(self.proc.pid, signal.SIGCONT)
+
+    def restart(self) -> None:
+        self.stop()
+        self.start()
+
+    # -- observation -------------------------------------------------------
+
+    def client(self) -> HTTPClient:
+        return HTTPClient(self.rpc_addr)
+
+    def height(self) -> int:
+        st = self.client().call("status")
+        return int(st["sync_info"]["latest_block_height"])
+
+    def app_hash_at(self, height: int) -> str:
+        blk = self.client().call("block", height=height)
+        return blk["block"]["header"]["app_hash"]
+
+    def wait_rpc(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                self.client().call("health")
+                return True
+            except Exception:
+                time.sleep(0.3)
+        return False
+
+    def wait_height(self, target: int, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if self.height() >= target:
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.3)
+        return False
+
+
+class Testnet:
+    """N ProcessNodes over home dirs laid out by ``cometbft-tpu testnet``
+    (cmd/__main__.py cmd_testnet; reference testnet.go)."""
+
+    def __init__(self, out_dir: str, n_vals: int, starting_port: int):
+        self.out_dir = out_dir
+        self.nodes = [
+            ProcessNode(
+                home=os.path.join(out_dir, f"node{i}"),
+                rpc_addr=f"tcp://127.0.0.1:{starting_port + 2 * i + 1}",
+            )
+            for i in range(n_vals)
+        ]
+
+    @classmethod
+    def generate(
+        cls, out_dir: str, n_vals: int, starting_port: int
+    ) -> "Testnet":
+        from ..cmd.__main__ import main as cli_main
+
+        rc = cli_main(
+            [
+                "testnet",
+                "--v",
+                str(n_vals),
+                "--o",
+                out_dir,
+                "--starting-port",
+                str(starting_port),
+            ]
+        )
+        if rc != 0:
+            raise RuntimeError("testnet generation failed")
+        return cls(out_dir, n_vals, starting_port)
+
+    def start(self) -> None:
+        for n in self.nodes:
+            n.start()
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+    def live_nodes(self) -> list[ProcessNode]:
+        return [
+            n
+            for n in self.nodes
+            if n.proc is not None and n.proc.poll() is None
+        ]
+
+    def wait_all_height(self, target: int, timeout: float = 90.0) -> bool:
+        deadline = time.monotonic() + timeout
+        return all(
+            n.wait_height(target, max(deadline - time.monotonic(), 0.1))
+            for n in self.live_nodes()
+        )
+
+    # -- invariants (test/e2e/tests/block_test.go) -------------------------
+
+    def check_app_hash_agreement(self, up_to: int | None = None) -> None:
+        """Every node reports the same app hash at every common height."""
+        nodes = self.live_nodes()
+        if len(nodes) < 2:
+            return
+        common = min(n.height() for n in nodes)
+        if up_to is not None:
+            common = min(common, up_to)
+        for h in range(1, common + 1):
+            hashes = {n.app_hash_at(h) for n in nodes}
+            if len(hashes) != 1:
+                raise AssertionError(
+                    f"app hash divergence at height {h}: {hashes}"
+                )
+
+    def check_progress(self, blocks: int = 2, timeout: float = 60.0) -> None:
+        """Chain must advance ``blocks`` beyond the current max height."""
+        start = max(n.height() for n in self.live_nodes())
+        if not self.wait_all_height(start + blocks, timeout):
+            heights = [n.height() for n in self.live_nodes()]
+            lagger = min(self.live_nodes(), key=lambda n: n.height())
+            raise AssertionError(
+                f"no progress: stuck at {heights} (wanted {start + blocks})\n"
+                f"--- slowest node log tail ({lagger.home}) ---\n"
+                f"{lagger.log_tail(3000)}"
+            )

@@ -1,0 +1,215 @@
+"""Profiling/introspection HTTP server (reference: the net/http/pprof
+server gated by RPC config ``pprof_laddr`` — node/node.go:651-664 — plus
+the JAX-profiler hooks that replace Go's CPU profiles on a TPU node).
+
+Endpoints (all GET, plain text or JSON):
+
+  /debug/pprof/            index
+  /debug/pprof/goroutine   every thread's stack (goroutine dump analog)
+  /debug/pprof/heap        tracemalloc top allocations (heap profile)
+  /debug/jax/start_trace?dir=PATH   start a JAX profiler trace (TensorBoard
+                                    format) capturing kernel launches
+  /debug/jax/stop_trace             stop it
+  /debug/locks             deadlock-tier status (libs/sync)
+
+The debug CLI (``cometbft-tpu debug dump|kill``) scrapes these into a
+crash bundle the way cmd/cometbft/commands/debug does with pprof URLs.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .service import BaseService
+
+
+def thread_dump() -> str:
+    """All live threads' stacks — the goroutine-dump analog."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = io.StringIO()
+    for tid, frame in sys._current_frames().items():
+        out.write(f"--- thread {tid} ({names.get(tid, '?')}) ---\n")
+        traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+def heap_start() -> str:
+    """Explicitly enable tracemalloc (interpreter-wide allocation
+    tracking has real overhead — never switched on by a mere scrape)."""
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        return "tracemalloc already tracing\n"
+    tracemalloc.start()
+    return "tracemalloc started\n"
+
+
+def heap_stop() -> str:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        return "tracemalloc not tracing\n"
+    tracemalloc.stop()
+    return "tracemalloc stopped\n"
+
+
+def heap_dump(top: int = 40) -> str:
+    """tracemalloc top allocation sites. Read-only: reports process RSS
+    plus, when tracing was explicitly enabled via /debug/heap/start, the
+    top allocation sites — so a one-shot debug-dump bundle always gets a
+    useful artifact without permanently instrumenting the node."""
+    import resource
+    import tracemalloc
+
+    rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    head = f"max rss: {rss_kb / 1024:.1f} MB\n"
+    if not tracemalloc.is_tracing():
+        return head + (
+            "tracemalloc off (enable with /debug/heap/start for "
+            "per-site allocation stats)\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    lines = [str(s) for s in snap.statistics("lineno")[:top]]
+    total = sum(s.size for s in snap.statistics("filename"))
+    return (
+        head
+        + f"total traced: {total / 1e6:.1f} MB\n"
+        + "\n".join(lines)
+        + "\n"
+    )
+
+
+class _TraceState:
+    active_dir: str | None = None
+
+
+def start_jax_trace(trace_dir: str) -> str:
+    import jax
+
+    if _TraceState.active_dir is not None:
+        return f"trace already active at {_TraceState.active_dir}"
+    jax.profiler.start_trace(trace_dir)
+    _TraceState.active_dir = trace_dir
+    return f"tracing to {trace_dir}"
+
+
+def stop_jax_trace() -> str:
+    import jax
+
+    if _TraceState.active_dir is None:
+        return "no active trace"
+    jax.profiler.stop_trace()
+    d, _TraceState.active_dir = _TraceState.active_dir, None
+    return f"trace written to {d}"
+
+
+class PprofServer(BaseService):
+    """Tiny threaded HTTP server bound to ``pprof_laddr``."""
+
+    def __init__(self, addr: str, logger=None):
+        super().__init__("pprof", logger)
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://") :]
+        host, _, port = addr.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self._httpd = None
+
+    def on_start(self) -> None:
+        routes = self._routes()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                fn = routes.get(parsed.path)
+                if fn is None:
+                    self.send_error(404)
+                    return
+                try:
+                    body = fn(parse_qs(parsed.query)).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:
+                    self.send_error(500, repr(e))
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.bound_port = self._httpd.server_address[1]
+        threading.Thread(
+            target=self._httpd.serve_forever, name="pprof-http", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    def _routes(self):
+        def index(q):
+            return (
+                "cometbft-tpu pprof\n"
+                "/debug/pprof/goroutine  thread stacks\n"
+                "/debug/pprof/heap       rss + tracemalloc snapshot\n"
+                "/debug/heap/start       enable tracemalloc\n"
+                "/debug/heap/stop        disable tracemalloc\n"
+                "/debug/jax/start_trace?dir=PATH\n"
+                "/debug/jax/stop_trace\n"
+                "/debug/locks\n"
+            )
+
+        def goroutine(q):
+            return thread_dump()
+
+        def heap(q):
+            return heap_dump(int(q.get("top", ["40"])[0]))
+
+        def heap_on(q):
+            return heap_start()
+
+        def heap_off(q):
+            return heap_stop()
+
+        def jax_start(q):
+            dirs = q.get("dir")
+            if not dirs:
+                raise ValueError("missing ?dir=")
+            return start_jax_trace(dirs[0])
+
+        def jax_stop(q):
+            return stop_jax_trace()
+
+        def locks(q):
+            from . import sync as libsync
+
+            return json.dumps(
+                {
+                    "deadlock_detection": libsync.enabled(),
+                    "timeout_s": libsync.DEADLOCK_TIMEOUT,
+                }
+            )
+
+        return {
+            "/debug/pprof/": index,
+            "/debug/pprof": index,
+            "/debug/pprof/goroutine": goroutine,
+            "/debug/pprof/heap": heap,
+            "/debug/heap/start": heap_on,
+            "/debug/heap/stop": heap_off,
+            "/debug/jax/start_trace": jax_start,
+            "/debug/jax/stop_trace": jax_stop,
+            "/debug/locks": locks,
+        }

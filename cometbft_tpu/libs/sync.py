@@ -1,0 +1,160 @@
+"""Deadlock-detecting mutex tier (reference: libs/sync/deadlock.go —
+the ``deadlock`` build tag swaps every mutex for sasha-s/go-deadlock).
+
+``Mutex()`` / ``RLock()`` return plain ``threading`` primitives unless
+deadlock detection is enabled (env ``COMETBFT_TPU_DEADLOCK=1`` or
+:func:`enable`), in which case they return instrumented locks that:
+
+* report when an acquisition waits longer than ``DEADLOCK_TIMEOUT``
+  seconds (go-deadlock's Opts.DeadlockTimeout), dumping every thread's
+  stack plus the current holder's acquisition stack to stderr;
+* detect same-thread double-acquire of a non-reentrant Mutex
+  immediately (the classic self-deadlock), raising ``DeadlockError``.
+
+Zero overhead when disabled — the factory hands out raw
+``threading.Lock``/``RLock`` objects, so the hot consensus paths pay
+nothing in production. Long-running services construct locks through
+this module (consensus state, switch, mempool) so the whole engine
+flips with one env var — the analog of rebuilding with ``-tags
+deadlock``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+import faulthandler
+
+DEADLOCK_TIMEOUT = float(os.environ.get("COMETBFT_TPU_DEADLOCK_TIMEOUT", "30"))
+
+_enabled = os.environ.get("COMETBFT_TPU_DEADLOCK") == "1"
+
+
+def enable(timeout: float | None = None) -> None:
+    global _enabled, DEADLOCK_TIMEOUT
+    _enabled = True
+    if timeout is not None:
+        DEADLOCK_TIMEOUT = timeout
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class DeadlockError(RuntimeError):
+    pass
+
+
+def _dump_all_threads(out=None) -> None:
+    out = out or sys.stderr
+    try:
+        faulthandler.dump_traceback(file=out)
+    except Exception:
+        for tid, frame in sys._current_frames().items():
+            out.write(f"\n--- thread {tid} ---\n")
+            traceback.print_stack(frame, file=out)
+
+
+class _InstrumentedMutex:
+    """Non-reentrant lock with waiter timeout + self-deadlock detection."""
+
+    _reentrant = False
+
+    def __init__(self, name: str = ""):
+        self._name = name or f"mutex@{id(self):x}"
+        self._lock = (
+            threading.RLock() if self._reentrant else threading.Lock()
+        )
+        self._holder: int | None = None
+        self._holder_stack: str = ""
+        self._depth = 0
+
+    # -- context manager ---------------------------------------------------
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = threading.get_ident()
+        if not self._reentrant and self._holder == me:
+            raise DeadlockError(
+                f"self-deadlock: thread {me} re-acquiring {self._name}\n"
+                f"first acquired at:\n{self._holder_stack}"
+            )
+        if not blocking:
+            ok = self._lock.acquire(False)
+            if ok:
+                self._note_acquired(me)
+            return ok
+        budget = timeout if timeout > 0 else None
+        waited = 0.0
+        step = min(DEADLOCK_TIMEOUT, 5.0)
+        while True:
+            slice_ = step if budget is None else min(step, budget - waited)
+            if slice_ <= 0:
+                return False
+            if self._lock.acquire(True, slice_):
+                self._note_acquired(me)
+                return True
+            waited += slice_
+            if waited >= DEADLOCK_TIMEOUT:
+                holder = self._holder
+                sys.stderr.write(
+                    f"POSSIBLE DEADLOCK: thread {me} waited "
+                    f"{waited:.0f}s for {self._name} "
+                    f"(held by thread {holder})\n"
+                    f"holder acquired at:\n{self._holder_stack}\n"
+                )
+                _dump_all_threads()
+                # keep waiting like go-deadlock's report-and-continue
+                waited = float("-inf")
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        if self._reentrant and self._depth > 1:
+            self._depth -= 1
+        else:
+            self._holder = None
+            self._holder_stack = ""
+            self._depth = 0
+        self._lock.release()
+
+    def locked(self) -> bool:
+        if self._reentrant:
+            return self._holder is not None
+        return self._lock.locked()
+
+    def _note_acquired(self, me: int) -> None:
+        if self._reentrant and self._holder == me:
+            self._depth += 1
+            return
+        self._holder = me
+        self._depth = 1
+        self._holder_stack = "".join(traceback.format_stack(limit=12)[:-2])
+
+
+class _InstrumentedRLock(_InstrumentedMutex):
+    _reentrant = True
+
+
+def Mutex(name: str = ""):
+    """A non-reentrant lock; instrumented when deadlock detection is on."""
+    return _InstrumentedMutex(name) if _enabled else threading.Lock()
+
+
+def RLock(name: str = ""):
+    """A reentrant lock; instrumented when deadlock detection is on."""
+    return _InstrumentedRLock(name) if _enabled else threading.RLock()

@@ -10,6 +10,8 @@ block commit, ``update`` removes committed txs and re-checks the remainder
 from __future__ import annotations
 
 import threading
+
+from ..libs import sync as libsync
 from dataclasses import dataclass, field
 
 from ..abci import types as abci
@@ -65,7 +67,7 @@ class CListMempool:
             else NopTxCache()
         )
         # Consensus lock: held across Commit so no CheckTx races app state
-        self._update_mtx = threading.RLock()
+        self._update_mtx = libsync.RLock("mempool.update")
         self._size_bytes = 0
         self._recheck_cursor = None  # next element expecting a recheck result
         self._recheck_end = None

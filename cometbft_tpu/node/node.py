@@ -354,6 +354,15 @@ class Node(BaseService):
             if config.rpc.laddr
             else None
         )
+        # pprof/JAX-profiler server (node/node.go:651 startPprofServer)
+        self.pprof_server = None
+        if getattr(config.rpc, "pprof_laddr", ""):
+            from ..libs.pprof import PprofServer
+
+            self.pprof_server = PprofServer(
+                config.rpc.pprof_laddr,
+                logger=self.logger.with_module("pprof"),
+            )
         self.switch.logger = self.logger.with_module("p2p")
         self.blocksync_reactor.logger = self.logger.with_module("blocksync")
         self.statesync_reactor.logger = self.logger.with_module("statesync")
@@ -468,8 +477,13 @@ class Node(BaseService):
     # -- lifecycle (node.go:364 OnStart) -----------------------------------
 
     def on_start(self) -> None:
-        # boot order (node.go:364): RPC → transport listen → switch (starts
-        # reactors, which start consensus) → dial persistent peers
+        # boot order (node.go:364): pprof → RPC → transport listen → switch
+        # (starts reactors, which start consensus) → dial persistent peers
+        if self.pprof_server is not None:
+            self.pprof_server.start()
+            self.logger.with_module("pprof").info(
+                "pprof server listening", port=self.pprof_server.bound_port
+            )
         if self.rpc_server is not None:
             self.rpc_server.start()
             self.logger.with_module("rpc").info(
@@ -536,6 +550,11 @@ class Node(BaseService):
         if self.rpc_server is not None and self.rpc_server.is_running():
             try:
                 self.rpc_server.stop()
+            except Exception:
+                pass
+        if self.pprof_server is not None and self.pprof_server.is_running():
+            try:
+                self.pprof_server.stop()
             except Exception:
                 pass
         for svc in (self.switch, self.event_bus, self.proxy_app):

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from ..libs import sync as libsync
 import time
 
 from ..libs.service import BaseService
@@ -42,7 +44,7 @@ class Switch(BaseService):
         self._channel_to_reactor: dict[int, Reactor] = {}
         self._descriptors: list[ChannelDescriptor] = []
         self._peers: dict[str, Peer] = {}
-        self._peers_mtx = threading.RLock()
+        self._peers_mtx = libsync.RLock("p2p.switch.peers")
         self._persistent_addrs: list[str] = []
         self._dialing: set[str] = set()
 

@@ -23,6 +23,7 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..abci import codec
 from ..crypto.keys import Ed25519PrivKey, PUBKEY_TYPES
 from ..libs import log as logmod
 from ..libs.service import BaseService
@@ -113,39 +114,14 @@ _TYPES = {
 }
 
 
-def _to_jsonable(v):
-    if dataclasses.is_dataclass(v) and not isinstance(v, type):
-        d = {"__t": type(v).__name__}
-        for f in dataclasses.fields(v):
-            d[f.name] = _to_jsonable(getattr(v, f.name))
-        return d
-    if isinstance(v, bytes):
-        return {"__b": v.hex()}
-    if isinstance(v, (list, tuple)):
-        return [_to_jsonable(x) for x in v]
-    if isinstance(v, (str, int, float, bool)) or v is None:
-        return v
-    raise TypeError(f"cannot encode {type(v).__name__} over privval socket")
-
-
-def _from_jsonable(v):
-    if isinstance(v, dict):
-        if "__b" in v:
-            return bytes.fromhex(v["__b"])
-        if "__t" in v:
-            cls = _TYPES[v["__t"]]
-            return cls(
-                **{k: _from_jsonable(x) for k, x in v.items() if k != "__t"}
-            )
-        raise ValueError(f"unknown tagged value {list(v)}")
-    if isinstance(v, list):
-        return [_from_jsonable(x) for x in v]
-    return v
+# The tagged-JSON (de)serializers and the uvarint frame reader are the
+# shared process-boundary codec (abci/codec.py, types/proto.py) bound to
+# this protocol's type registry.
 
 
 def encode_msg(msg) -> bytes:
     return proto.delimited(
-        json.dumps(_to_jsonable(msg), separators=(",", ":")).encode()
+        json.dumps(codec._to_jsonable(msg), separators=(",", ":")).encode()
     )
 
 
@@ -154,19 +130,8 @@ MAX_MSG_BYTES = 16 * 1024 * 1024
 
 def decode_msg(read_exact):
     """Read one message via ``read_exact(n) -> bytes`` (raises EOFError)."""
-    length = 0
-    shift = 0
-    while True:
-        b = read_exact(1)
-        length |= (b[0] & 0x7F) << shift
-        if not b[0] & 0x80:
-            break
-        shift += 7
-        if shift > 35:
-            raise ValueError("privval frame uvarint overflow")
-    if length > MAX_MSG_BYTES:
-        raise ValueError(f"privval frame of {length} bytes exceeds limit")
-    return _from_jsonable(json.loads(read_exact(length)))
+    payload = proto.read_delimited(read_exact, MAX_MSG_BYTES)
+    return codec._from_jsonable(json.loads(payload), types=_TYPES)
 
 
 # -------------------------------------------------------------- endpoint
@@ -510,8 +475,15 @@ class SignerClient(PrivValidator):
             raise RemoteSignerError(1, f"unexpected response {resp!r}")
         if resp.error_code:
             raise RemoteSignerError(resp.error_code, resp.error_desc)
-        vote.signature = resp.vote.signature
-        vote.extension_signature = resp.vote.extension_signature
+        # Adopt the WHOLE signed vote, not just the signature: the remote
+        # FilePV's crash-replay path re-signs the same HRS by rewinding
+        # the timestamp to the originally signed one (file_pv
+        # check_only_differs_by_timestamp); pairing the caller's newer
+        # timestamp with the old-timestamp signature would make every
+        # peer reject the vote. (Reference: signer_client.go does
+        # *vote = *resp.Vote.)
+        for f in dataclasses.fields(Vote):
+            setattr(vote, f.name, getattr(resp.vote, f.name))
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         resp = self.endpoint.request(
@@ -521,7 +493,8 @@ class SignerClient(PrivValidator):
             raise RemoteSignerError(1, f"unexpected response {resp!r}")
         if resp.error_code:
             raise RemoteSignerError(resp.error_code, resp.error_desc)
-        proposal.signature = resp.proposal.signature
+        for f in dataclasses.fields(Proposal):
+            setattr(proposal, f.name, getattr(resp.proposal, f.name))
 
 
 class RetrySignerClient(PrivValidator):

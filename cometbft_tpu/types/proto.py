@@ -96,6 +96,28 @@ def delimited(msg: bytes) -> bytes:
     return uvarint(len(msg)) + msg
 
 
+def read_delimited(read_exact, max_bytes: int) -> bytes:
+    """Read one uvarint-length-prefixed frame via ``read_exact(n)``.
+
+    ``read_exact`` must return exactly n bytes or raise (EOFError on a
+    closed stream). Shared by every process-boundary codec (ABCI socket,
+    privval socket) — protoio.Reader semantics with a hard size cap.
+    """
+    length = 0
+    shift = 0
+    while True:
+        b = read_exact(1)
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 35:
+            raise ValueError("frame length uvarint overflow")
+    if length > max_bytes:
+        raise ValueError(f"frame of {length} bytes exceeds limit")
+    return read_exact(length)
+
+
 # --- Reader (for WAL / wire decode) -----------------------------------------
 
 

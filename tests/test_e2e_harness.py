@@ -1,0 +1,126 @@
+"""E2E harness tests: process testnet + load generator + perturbations
+(reference: test/e2e/runner, runner/perturb.go:16-31, test/loadtime).
+
+A real 3-validator testnet of OS processes takes tx load while one node
+is paused (SIGSTOP) and another is crash-killed and restarted; afterwards
+every node must agree on app hashes at all common heights, the chain must
+keep advancing, and the load report must account for committed load txs
+with sane latencies.
+"""
+
+import dataclasses
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from cometbft_tpu.e2e import LoadGenerator, Testnet, load_report
+from cometbft_tpu.e2e.load import make_tx, parse_tx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_MS = 1_000_000
+
+
+def _env():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if ".axon_site" not in v or k != "PYTHONPATH"
+    }
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _free_port_block(n: int = 10) -> int:
+    """A starting port with n free consecutive ports (best effort)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+    return base if base + n < 65000 else 20000
+
+
+def _speed_up(testnet: Testnet) -> None:
+    from cometbft_tpu import config_file
+
+    for node in testnet.nodes:
+        path = os.path.join(node.home, "config", "config.toml")
+        cfg = config_file.load_toml(path)
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=500 * _MS,
+            timeout_prevote_ns=250 * _MS,
+            timeout_precommit_ns=250 * _MS,
+            timeout_commit_ns=200 * _MS,
+            skip_timeout_commit=False,
+            create_empty_blocks=True,
+        )
+        config_file.save_toml(cfg, path)
+
+
+def test_load_tx_roundtrip():
+    tx = make_tx("run1", 7, size=64)
+    run_id, seq, sent_ns = parse_tx(tx)
+    assert (run_id, seq) == ("run1", 7)
+    assert abs(time.time_ns() - sent_ns) < 5e9
+    assert parse_tx(b"other=1") is None
+    assert b"=" in tx  # kvstore-accepted shape
+
+
+@pytest.mark.slow
+def test_perturbed_testnet_under_load(tmp_path):
+    port = _free_port_block()
+    # 4 validators: the smallest BFT net that tolerates one faulty
+    # node (+2/3 of 40 = 30 = 3 validators), so kill/pause of a single
+    # node must not halt the chain (e2e networks/ci.toml topology).
+    net = Testnet.generate(str(tmp_path / "net"), 4, port)
+    _speed_up(net)
+    for node in net.nodes:
+        node.env = _env()
+    net.start()
+    try:
+        assert all(n.wait_rpc(60.0) for n in net.nodes), "RPC never came up"
+        assert net.wait_all_height(2, 90.0), "testnet never made blocks"
+
+        gen = LoadGenerator(
+            [n.rpc_addr for n in net.nodes],
+            rate=20,
+            connections=2,
+            run_id="perturb1",
+        )
+        gen.start()
+        try:
+            time.sleep(2.0)
+
+            # perturbation 1: pause node2 (docker pause analog)
+            net.nodes[2].pause()
+            time.sleep(2.0)
+            net.nodes[2].unpause()
+
+            # perturbation 2: crash-kill node1, restart it
+            net.nodes[1].kill()
+            time.sleep(1.5)
+            net.nodes[1].start()
+            assert net.nodes[1].wait_rpc(60.0), "killed node never restarted"
+
+            time.sleep(2.0)
+        finally:
+            gen.stop()
+        assert gen.sent > 0, "load generator sent nothing"
+
+        # invariants (test/e2e/tests): progress + app-hash agreement
+        net.check_progress(blocks=2, timeout=90.0)
+        net.check_app_hash_agreement()
+
+        # loadtime-style report from block timestamps
+        rep = load_report(net.nodes[0].rpc_addr, "perturb1")
+        summary = rep.summary()
+        assert rep.txs > 0, f"no load txs committed: {summary}"
+        assert 0 < rep.mean_s < 60, summary
+        assert rep.quantile(0.99) >= rep.quantile(0.5) > 0, summary
+    finally:
+        net.stop()

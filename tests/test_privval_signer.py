@@ -131,6 +131,27 @@ def test_double_sign_refusal_propagates(signer_net):
         retry.sign_vote(CHAIN_ID, v2, sign_extension=False)
 
 
+def test_crash_replay_adopts_remote_timestamp(signer_net):
+    """The remote FilePV's timestamp-only replay rewinds the vote's
+    timestamp and reuses the old signature; the client must adopt the
+    WHOLE returned vote or peers would see a timestamp/signature mismatch
+    (signer_client.go *vote = *resp.Vote semantics)."""
+    client, pv = signer_net
+    v1 = _vote(height=11)
+    v1.timestamp_ns = 1_700_000_000_000_000_000
+    client.sign_vote(CHAIN_ID, v1, sign_extension=False)
+
+    # crash replay: identical vote, later timestamp
+    v2 = _vote(height=11)
+    v2.timestamp_ns = v1.timestamp_ns + 5_000_000_000
+    client.sign_vote(CHAIN_ID, v2, sign_extension=False)
+    assert v2.timestamp_ns == v1.timestamp_ns, "timestamp not rewound"
+    assert v2.signature == v1.signature
+    assert pv.get_pub_key().verify_signature(
+        v2.sign_bytes(CHAIN_ID), v2.signature
+    )
+
+
 def test_signer_reconnect_after_drop(tmp_path):
     """Kill the signer; a new one dials in; requests succeed again."""
     addr = f"unix://{tmp_path}/pv2.sock"
