@@ -262,6 +262,76 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_reindex_events(args) -> int:
+    """commands/reindex_event.go: rebuild tx/block indexes of a STOPPED
+    node from stored blocks + FinalizeBlock responses."""
+    from ..libs import db as dbm
+    from ..state import Store as StateStore
+    from ..state.indexer import KVBlockIndexer, KVTxIndexer, TxRecord
+    from ..store import BlockStore
+
+    cfg = _config(args, strict=False)  # offline repair tool
+    block_store = BlockStore(dbm.FileDB(cfg.base.resolve("data/blockstore.db")))
+    state_store = StateStore(dbm.FileDB(cfg.base.resolve("data/state.db")))
+    idx_db = dbm.FileDB(cfg.base.resolve("data/tx_index.db"))
+    tx_indexer = KVTxIndexer(idx_db)
+    block_indexer = KVBlockIndexer(idx_db)
+
+    base = max(args.start_height or block_store.base(), block_store.base())
+    head = min(args.end_height or block_store.height(), block_store.height())
+    if base <= 0 or head < base:
+        print(f"nothing to reindex (range {base}..{head})")
+        return 1
+    n_txs = 0
+    for h in range(base, head + 1):
+        blk = block_store.load_block(h)
+        resp = state_store.load_finalize_block_response(h)
+        if blk is None or resp is None:
+            print(f"height {h}: missing block or finalize response; skipped")
+            continue
+        if len(resp.tx_results) != len(blk.data.txs):
+            print(
+                f"height {h}: {len(blk.data.txs)} txs but "
+                f"{len(resp.tx_results)} results (torn write?); skipped"
+            )
+            continue
+        block_indexer.index(h, resp.events)
+        for i, tx in enumerate(blk.data.txs):
+            result = resp.tx_results[i]
+            tx_indexer.index(
+                TxRecord(height=h, index=i, tx=tx, result=result),
+                getattr(result, "events", None),
+            )
+            n_txs += 1
+    idx_db.close()
+    print(f"reindexed heights {base}..{head}: {n_txs} txs")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """commands/compact.go analog: rewrite every append-log DB of a
+    STOPPED node down to its live records."""
+    from ..libs import db as dbm
+
+    cfg = _config(args, strict=False)  # offline repair tool
+    data_dir = cfg.base.resolve("data")
+    total_before = total_after = 0
+    for name in sorted(os.listdir(data_dir)) if os.path.isdir(data_dir) else []:
+        if not name.endswith(".db"):
+            continue
+        path = os.path.join(data_dir, name)
+        before = os.path.getsize(path)
+        db = dbm.FileDB(path)
+        db.compact()
+        db.close()
+        after = os.path.getsize(path)
+        total_before += before
+        total_after += after
+        print(f"{name}: {before} -> {after} bytes")
+    print(f"total: {total_before} -> {total_after} bytes")
+    return 0
+
+
 def cmd_start(args) -> int:
     from ..node import default_new_node
 
@@ -368,6 +438,58 @@ def cmd_debug_kill(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """light proxy: a locally served RPC whose answers are light-verified
+    (cmd/cometbft light — light/proxy/proxy.go)."""
+    from ..libs import db as dbm
+    from ..light import Client, TrustOptions
+    from ..light.proxy import LightProxy
+    from ..light.rpc_provider import RPCProvider
+    from ..light.store import Store
+
+    if not args.trusted_height or not args.trusted_hash:
+        print(
+            "a subjective root of trust is required: "
+            "--trusted-height H --trusted-hash HEX"
+        )
+        return 1
+
+    primary = RPCProvider(args.primary, args.chain_id)
+    witnesses = [
+        RPCProvider(w, args.chain_id)
+        for w in (args.witnesses.split(",") if args.witnesses else [])
+        if w
+    ]
+    store_db = (
+        dbm.FileDB(os.path.join(os.path.expanduser(args.dir), "light.db"))
+        if args.dir
+        else dbm.MemDB()
+    )
+    client = Client(
+        chain_id=args.chain_id,
+        trust_options=TrustOptions(
+            period_ns=int(args.trust_period_hours * 3600 * 1e9),
+            height=args.trusted_height,
+            hash=bytes.fromhex(args.trusted_hash),
+        ),
+        primary=primary,
+        witnesses=witnesses,
+        trusted_store=Store(store_db),
+    )
+    proxy = LightProxy(client, args.primary, args.laddr)
+    proxy.start()
+    print(f"light proxy serving on {proxy.bound_addr} "
+          f"(primary {args.primary})", flush=True)
+
+    stop = {"flag": False}
+    signal.signal(signal.SIGINT, lambda *a: stop.update(flag=True))
+    signal.signal(signal.SIGTERM, lambda *a: stop.update(flag=True))
+    while not stop["flag"]:
+        time.sleep(0.25)
+    proxy.stop()
+    return 0
+
+
 def _abci_client(args):
     """socket | grpc | local client for the abci-* commands
     (abci/cmd/abci-cli.go's --abci flag)."""
@@ -460,6 +582,21 @@ def main(argv=None) -> int:
     )
     ip = sub.add_parser("inspect")
     ip.add_argument("--rpc-laddr", dest="rpc_laddr", default=None)
+    ri = sub.add_parser("reindex-events")
+    ri.add_argument("--start-height", dest="start_height", type=int, default=0)
+    ri.add_argument("--end-height", dest="end_height", type=int, default=0)
+    sub.add_parser("compact-db")
+    lt = sub.add_parser("light")
+    lt.add_argument("chain_id")
+    lt.add_argument("--primary", required=True, help="primary RPC addr")
+    lt.add_argument("--witnesses", default="", help="comma-separated RPCs")
+    lt.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    lt.add_argument("--trusted-height", dest="trusted_height", type=int,
+                    default=0)
+    lt.add_argument("--trusted-hash", dest="trusted_hash", default="")
+    lt.add_argument("--trust-period-hours", dest="trust_period_hours",
+                    type=float, default=168.0)
+    lt.add_argument("--dir", default="", help="trusted store directory")
     for name in ("debug-dump", "debug-kill"):
         dp = sub.add_parser(name)
         dp.add_argument("--rpc-laddr", dest="rpc_laddr",
@@ -498,6 +635,9 @@ def main(argv=None) -> int:
         "abci-console": cmd_abci_console,
         "debug-dump": cmd_debug_dump,
         "debug-kill": cmd_debug_kill,
+        "light": cmd_light,
+        "reindex-events": cmd_reindex_events,
+        "compact-db": cmd_compact_db,
     }[args.command](args)
 
 

@@ -223,10 +223,21 @@ class Testnet:
         """Chain must advance ``blocks`` beyond the current max height."""
         start = max(n.height() for n in self.live_nodes())
         if not self.wait_all_height(start + blocks, timeout):
-            heights = [n.height() for n in self.live_nodes()]
-            lagger = min(self.live_nodes(), key=lambda n: n.height())
+            # diagnostics only: a node whose RPC is hung (often the very
+            # reason progress stalled) must not turn the curated error
+            # into a raw network traceback
+            def safe_height(n):
+                try:
+                    return n.height()
+                except Exception:
+                    return -1
+
+            nodes = self.live_nodes()
+            heights = [safe_height(n) for n in nodes]
+            lagger = nodes[heights.index(min(heights))]
             raise AssertionError(
-                f"no progress: stuck at {heights} (wanted {start + blocks})\n"
+                f"no progress: stuck at {heights} (wanted {start + blocks};"
+                f" -1 = RPC unreachable)\n"
                 f"--- slowest node log tail ({lagger.home}) ---\n"
                 f"{lagger.log_tail(3000)}"
             )

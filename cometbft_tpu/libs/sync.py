@@ -101,16 +101,17 @@ class _InstrumentedMutex:
             return ok
         budget = timeout if timeout > 0 else None
         waited = 0.0
+        next_report = DEADLOCK_TIMEOUT
         step = min(DEADLOCK_TIMEOUT, 5.0)
         while True:
             slice_ = step if budget is None else min(step, budget - waited)
             if slice_ <= 0:
-                return False
+                return False  # caller's timeout wins, report or not
             if self._lock.acquire(True, slice_):
                 self._note_acquired(me)
                 return True
             waited += slice_
-            if waited >= DEADLOCK_TIMEOUT:
+            if waited >= next_report:
                 holder = self._holder
                 sys.stderr.write(
                     f"POSSIBLE DEADLOCK: thread {me} waited "
@@ -119,8 +120,9 @@ class _InstrumentedMutex:
                     f"holder acquired at:\n{self._holder_stack}\n"
                 )
                 _dump_all_threads()
-                # keep waiting like go-deadlock's report-and-continue
-                waited = float("-inf")
+                # report-and-continue, re-reporting each further interval
+                # (go-deadlock keeps flagging a wedged lock)
+                next_report += DEADLOCK_TIMEOUT
 
     def release(self) -> None:
         me = threading.get_ident()

@@ -1,0 +1,161 @@
+"""Light-client RPC proxy (reference: light/proxy/proxy.go +
+light/rpc/client.go — ``cometbft light`` command).
+
+Serves the standard RPC surface on a local address while routing data
+through the light client's verification:
+
+* ``commit`` / ``validators`` / ``header`` answer FROM the verified
+  light-block store — the strongest guarantee, no primary data at all;
+* ``block`` fetches the full block from the primary and accepts it only
+  if (a) the header hash equals the light-verified header's hash and
+  (b) the transactions re-hash to the verified header's ``data_hash``
+  (light/rpc/client.go Block: untrusted data is cross-checked against
+  the trusted header before being returned);
+* tx submission, ``status``, ``health``, ``tx``, ``abci_query`` pass
+  through to the primary (abci_query proof verification requires
+  app-side proof ops — documented passthrough, as in the reference's
+  default ``DefaultMerkleKeyPathFn``-less mode).
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+
+from ..crypto import merkle, tmhash
+from ..libs.service import BaseService
+from ..rpc import encoding as enc
+from ..rpc.client import HTTPClient
+from ..rpc.jsonrpc.server import RPCServer
+from .client import Client
+from .errors import LightClientError
+
+
+class LightProxy(BaseService):
+    """RPC server whose read routes are light-verified."""
+
+    def __init__(
+        self,
+        light_client: Client,
+        primary_addr: str,
+        laddr: str,
+        logger=None,
+    ):
+        super().__init__("light-proxy", logger)
+        self.light_client = light_client
+        self.primary = HTTPClient(primary_addr)
+        self._server = RPCServer(
+            env=None, laddr=laddr, logger=logger, routes=self._routes()
+        )
+
+    @property
+    def bound_addr(self) -> str:
+        return self._server.bound_addr
+
+    def on_start(self) -> None:
+        self._server.start()
+
+    def on_stop(self) -> None:
+        self._server.stop()
+
+    # -- route table -------------------------------------------------------
+
+    def _verified(self, height) -> "LightBlock":  # noqa: F821
+        if height is None:
+            raise LightClientError("height is required on a light proxy")
+        return self.light_client.verify_light_block_at_height(
+            int(height), time.time_ns()
+        )
+
+    def _routes(self) -> dict:
+        lp = self
+
+        def health(env):
+            return lp.primary.call("health")
+
+        def status(env):
+            st = lp.primary.call("status")
+            latest = lp.light_client.trusted_light_block(0)
+            st["light_client_info"] = {
+                "trusted_height": latest.height,
+                "trusted_hash": (latest.hash() or b"").hex().upper(),
+            }
+            return st
+
+        def commit(env, height=None):
+            lb = lp._verified(height)
+            return {
+                "signed_header": {
+                    "header": enc.enc_header(lb.signed_header.header),
+                    "commit": enc.enc_commit(lb.signed_header.commit),
+                },
+                "canonical": True,
+            }
+
+        def header(env, height=None):
+            lb = lp._verified(height)
+            return {"header": enc.enc_header(lb.signed_header.header)}
+
+        def validators(env, height=None):
+            lb = lp._verified(height)
+            vs = lb.validator_set
+            return {
+                "block_height": lb.height,
+                "validators": [enc.enc_validator(v) for v in vs.validators],
+                "count": len(vs.validators),
+                "total": len(vs.validators),
+            }
+
+        def block(env, height=None):
+            lb = lp._verified(height)
+            raw = lp.primary.call("block", height=int(height))
+            verified_hash = (lb.hash() or b"").hex().upper()
+            got_hash = raw["block_id"]["hash"].upper()
+            if got_hash != verified_hash:
+                raise LightClientError(
+                    f"primary returned block {got_hash}, light client "
+                    f"verified {verified_hash} at height {height}"
+                )
+            txs = [
+                base64.b64decode(t)
+                for t in (raw["block"]["data"]["txs"] or [])
+            ]
+            # data_hash = merkle root of tx HASHES (types.Data.hash)
+            data_hash = merkle.hash_from_byte_slices(
+                [tmhash.sum(tx) for tx in txs]
+            )
+            want = lb.signed_header.header.data_hash
+            if data_hash != want:
+                raise LightClientError(
+                    "primary block transactions do not hash to the "
+                    "verified data_hash"
+                )
+            return raw
+
+        def passthrough(method):
+            def fn(env, **params):
+                return lp.primary.call(method, **params)
+
+            return fn
+
+        routes = {
+            "health": health,
+            "status": status,
+            "commit": commit,
+            "header": header,
+            "validators": validators,
+            "block": block,
+        }
+        for m in (
+            "broadcast_tx_sync",
+            "broadcast_tx_async",
+            "broadcast_tx_commit",
+            "tx",
+            "abci_query",
+            "abci_info",
+            "net_info",
+            "unconfirmed_txs",
+            "num_unconfirmed_txs",
+        ):
+            routes[m] = passthrough(m)
+        return routes

@@ -97,12 +97,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             req = json.loads(self.rfile.read(length) or b"{}")
-        except json.JSONDecodeError as e:
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+            # non-UTF8 / non-JSON bodies are wire noise, not a server error
             self._send_json(
                 _rpc_response(None, error=_rpc_error(-32700, f"parse error: {e}"))
             )
             return
         if isinstance(req, list):
+            if not req:  # JSON-RPC 2.0: empty batch is an invalid request
+                self._send_json(
+                    _rpc_response(
+                        None, error=_rpc_error(-32600, "empty batch")
+                    )
+                )
+                return
             self._send_json([self._handle_one(r) for r in req])
         else:
             self._send_json(self._handle_one(req))
@@ -376,11 +384,14 @@ class _Server(ThreadingHTTPServer):
 class RPCServer(BaseService):
     """HTTP JSON-RPC server bound to config.rpc.laddr."""
 
-    def __init__(self, env, laddr: str, logger=None):
+    def __init__(self, env, laddr: str, logger=None, routes=None):
         super().__init__("rpc-server")
         self.env = env
         self.laddr = laddr
         self.logger = logger
+        # Optional route-table override (the light proxy serves the same
+        # JSON-RPC protocol over verified closures instead of core ROUTES).
+        self.routes = routes
         self._httpd: _Server | None = None
         self._thread: threading.Thread | None = None
 
@@ -393,7 +404,10 @@ class RPCServer(BaseService):
 
     def on_start(self) -> None:
         host, port = _parse_laddr(self.laddr)
-        handler = type("BoundHandler", (_Handler,), {"env": self.env})
+        attrs = {"env": self.env}
+        if self.routes is not None:
+            attrs["routes"] = self.routes
+        handler = type("BoundHandler", (_Handler,), attrs)
         self._httpd = _Server((host, port), handler)
         self._httpd.logger = self.logger
         self._thread = threading.Thread(

@@ -1,0 +1,84 @@
+"""reindex-events + compact-db CLI tests
+(reference: cmd/cometbft/commands/{reindex_event,compact}.go).
+"""
+
+import base64
+import dataclasses
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.cmd.__main__ import main
+from cometbft_tpu.config import default_config
+from cometbft_tpu.libs import db as dbm
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.rpc import HTTPClient
+from cometbft_tpu.state.indexer import KVTxIndexer
+
+from helpers import make_genesis
+
+_MS = 1_000_000
+
+
+@pytest.fixture
+def node_home(tmp_path):
+    cfg = default_config()
+    cfg.base.home = str(tmp_path)
+    cfg.base.db_backend = "file"
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=400 * _MS,
+        timeout_prevote_ns=200 * _MS,
+        timeout_precommit_ns=200 * _MS,
+        timeout_commit_ns=150 * _MS,
+        skip_timeout_commit=False,
+        create_empty_blocks=True,
+    )
+    init_files(cfg)
+    genesis, pvs = make_genesis(1)
+    n = Node(cfg, genesis, pvs[0])
+    n.start()
+    try:
+        client = HTTPClient(n.rpc_server.bound_addr)
+        res = client.call(
+            "broadcast_tx_commit",
+            tx=base64.b64encode(b"reindex-me=yes").decode(),
+        )
+        assert int(res["tx_result"]["code"]) == 0
+        deadline = time.monotonic() + 20
+        while n.block_store.height() < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        n.stop()
+    return str(tmp_path)
+
+
+def test_reindex_events_rebuilds_index(node_home):
+    # wipe the tx index, then rebuild it offline
+    idx_path = os.path.join(node_home, "data", "tx_index.db")
+    os.unlink(idx_path)
+    rc = main(["--home", node_home, "reindex-events"])
+    assert rc == 0
+
+    idx = KVTxIndexer(dbm.FileDB(idx_path))
+    hits = idx.search("tx.height >= 1")
+    assert any(b"reindex-me=yes" == r.tx for r in hits), [r.tx for r in hits]
+
+
+def test_compact_db_shrinks_logs(node_home, capsys):
+    # bloat one db with dead records, then compact everything
+    state_path = os.path.join(node_home, "data", "state.db")
+    db = dbm.FileDB(state_path, compact_factor=10_000)
+    for i in range(300):
+        db.set(b"bloat", b"x" * 512)
+    db.close()
+    before = os.path.getsize(state_path)
+    rc = main(["--home", node_home, "compact-db"])
+    assert rc == 0
+    after = os.path.getsize(state_path)
+    assert after < before
+    out = capsys.readouterr().out
+    assert "state.db" in out and "total:" in out

@@ -1,0 +1,250 @@
+"""Deterministic fuzz of wire-facing surfaces (reference: test/fuzz/tests
+— mempool CheckTx, p2p SecretConnection, rpc jsonrpc server).
+
+Seeded random inputs (reproducible) hammer each boundary; the invariant
+is always the same: malformed input produces a clean error or rejection,
+never a crash, hang, or corrupted internal state.
+"""
+
+import json
+import random
+import socket
+import threading
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.abci import codec as abci_codec
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+rng = random.Random(0xF022)
+
+
+def _rand_bytes(n: int) -> bytes:
+    return rng.randbytes(n)
+
+
+class TestMempoolCheckTxFuzz:
+    """test/fuzz/tests/mempool_test.go: random txs through CheckTx."""
+
+    def test_random_txs_never_crash(self):
+        app = KVStoreApplication()
+        accepted = rejected = 0
+        for _ in range(300):
+            tx = _rand_bytes(rng.randrange(0, 128))
+            res = app.check_tx(abci.RequestCheckTx(tx=tx))
+            if res.code == abci.OK:
+                accepted += 1
+            else:
+                rejected += 1
+        assert accepted + rejected == 300
+
+    def test_mempool_ingest_random(self):
+        from cometbft_tpu.abci.client import LocalClient
+        from cometbft_tpu.mempool.clist_mempool import CListMempool
+
+        client = LocalClient(KVStoreApplication())
+        client.start()
+        try:
+            from cometbft_tpu.config import MempoolConfig
+
+            mp = CListMempool(MempoolConfig(), client)
+            for i in range(200):
+                tx = _rand_bytes(rng.randrange(0, 64))
+                try:
+                    mp.check_tx(tx)
+                except Exception as e:
+                    # only well-formed mempool errors are acceptable
+                    from cometbft_tpu.mempool.clist_mempool import (
+                        MempoolError,
+                    )
+
+                    assert isinstance(e, MempoolError), repr(e)
+            assert mp.size() >= 0
+        finally:
+            client.stop()
+
+
+class TestSecretConnectionFuzz:
+    """test/fuzz/tests/p2p_secretconnection_test.go: garbage on the wire."""
+
+    def _pipe(self):
+        a, b = socket.socketpair()
+        return a, b
+
+    def test_garbage_handshake_rejected(self):
+        from cometbft_tpu.p2p.conn.secret_connection import (
+            SecretConnection,
+            SecretConnectionError,
+        )
+
+        for trial in range(4):
+            a, b = self._pipe()
+            # enough bytes that every handshake read completes instantly
+            # with garbage instead of blocking to its timeout
+            garbage = _rand_bytes(4096)
+
+            def attacker():
+                try:
+                    b.sendall(garbage)
+                    b.recv(4096)
+                except OSError:
+                    pass
+                finally:
+                    b.close()
+
+            t = threading.Thread(target=attacker, daemon=True)
+            t.start()
+            a.settimeout(3.0)
+            with pytest.raises(
+                (SecretConnectionError, EOFError, OSError, ValueError)
+            ):
+                SecretConnection(a, Ed25519PrivKey.generate())
+            a.close()
+            t.join(2.0)
+
+    def test_frame_corruption_detected(self):
+        """Bit flips in sealed frames must fail AEAD, not decode."""
+        from cometbft_tpu.p2p.conn.secret_connection import (
+            SecretConnection,
+            SecretConnectionError,
+        )
+
+        a, b = self._pipe()
+        holder = {}
+
+        def peer():
+            holder["conn"] = SecretConnection(b, Ed25519PrivKey.generate())
+
+        t = threading.Thread(target=peer, daemon=True)
+        t.start()
+        conn_a = SecretConnection(a, Ed25519PrivKey.generate())
+        t.join(5.0)
+        conn_b = holder["conn"]
+
+        # inject a full sealed-frame of garbage: AEAD must reject it
+        from cometbft_tpu.p2p.conn.secret_connection import SEALED_FRAME_SIZE
+
+        b.settimeout(3.0)
+        a.sendall(_rand_bytes(SEALED_FRAME_SIZE))
+        with pytest.raises((SecretConnectionError, EOFError, OSError)):
+            conn_b.read(1024)
+        a.close()
+        b.close()
+
+
+class TestABCICodecFuzz:
+    """Frame decoding of random bytes must raise cleanly."""
+
+    def test_random_frames(self):
+        import io
+
+        for _ in range(200):
+            payload = _rand_bytes(rng.randrange(0, 96))
+            f = io.BytesIO(payload)
+            try:
+                abci_codec.read_frame(f)
+            except (
+                ValueError,
+                EOFError,
+                KeyError,
+                TypeError,
+                UnicodeDecodeError,
+            ):
+                pass  # clean rejection
+
+    def test_privval_decode_random_frames(self):
+        from cometbft_tpu.privval import signer as pv_signer
+        from cometbft_tpu.types import proto
+
+        for _ in range(150):
+            blob = _rand_bytes(rng.randrange(0, 64))
+            framed = proto.delimited(blob)
+            try:
+                pv_signer.decode_msg(io_read_exact(framed))
+            except (ValueError, EOFError, KeyError, TypeError) as e:
+                pass  # clean rejection of non-JSON / unknown-tag frames
+
+    def test_privval_roundtrip_survives_fuzz(self):
+        """After the garbage, well-formed messages still decode."""
+        from cometbft_tpu.privval import signer as pv_signer
+
+        msg = pv_signer.PubKeyRequest(chain_id="x")
+        out = pv_signer.decode_msg(io_read_exact(pv_signer.encode_msg(msg)))
+        assert out == msg
+
+
+def io_read_exact(data: bytes):
+    import io
+
+    f = io.BytesIO(data)
+
+    def read_exact(n: int) -> bytes:
+        out = f.read(n)
+        if len(out) < n:
+            raise EOFError("eof")
+        return out
+
+    return read_exact
+
+
+class TestRPCServerFuzz:
+    """test/fuzz/tests/rpc_jsonrpc_server_test.go: random HTTP bodies."""
+
+    @pytest.fixture(scope="class")
+    def server(self):
+        from cometbft_tpu.rpc import Environment, RPCServer
+
+        env = Environment(config=None, genesis=None)
+        s = RPCServer(env, "tcp://127.0.0.1:0")
+        s.start()
+        yield s
+        s.stop()
+
+    def _post(self, server, body: bytes) -> dict | None:
+        req = urllib.request.Request(
+            f"http://{server.bound_addr}/",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 404, 405, 500)
+            return None
+
+    def test_random_bodies_answer_cleanly(self, server):
+        for _ in range(60):
+            body = _rand_bytes(rng.randrange(0, 200))
+            res = self._post(server, body)
+            if res is not None:
+                assert "error" in res or "result" in res
+
+    def test_malformed_jsonrpc_envelopes(self, server):
+        cases = [
+            b"{}",
+            b"[]",
+            b'{"jsonrpc":"2.0"}',
+            b'{"jsonrpc":"2.0","method":12,"id":1}',
+            b'{"jsonrpc":"2.0","method":"nope","id":1}',
+            b'{"jsonrpc":"2.0","method":"status","params":"zz","id":1}',
+            b'{"method":"' + b"a" * 10_000 + b'","id":1}',
+        ]
+        for body in cases:
+            res = self._post(server, body)
+            if res is not None:
+                assert "error" in res, body[:40]
+
+    def test_server_still_alive_after_fuzz(self, server):
+        # health exists even with a bare env? status requires stores; use
+        # a guaranteed-missing method and expect a -32601, proving the
+        # dispatch loop survived everything above.
+        res = self._post(
+            server,
+            b'{"jsonrpc":"2.0","method":"__definitely_missing__","id":9}',
+        )
+        assert res is not None and res["error"]["code"] == -32601

@@ -1,0 +1,174 @@
+"""Light proxy tests (reference: light/proxy + light/rpc/client.go).
+
+A LightProxy in front of a live single-validator node must serve
+commit/validators/header from VERIFIED light blocks, cross-check full
+blocks against the verified header (hash + data_hash), pass tx
+submission through, and reject primary data that does not match the
+verified chain.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from cometbft_tpu.config import default_config
+from cometbft_tpu.light import Client, TrustOptions
+from cometbft_tpu.light.proxy import LightProxy
+from cometbft_tpu.light.rpc_provider import RPCProvider
+from cometbft_tpu.node import Node, init_files
+from cometbft_tpu.rpc import HTTPClient, RPCError
+
+from helpers import make_genesis
+
+_MS = 1_000_000
+
+
+@pytest.fixture(scope="module")
+def node(tmp_path_factory):
+    home = tmp_path_factory.mktemp("lightproxy-node")
+    cfg = default_config()
+    cfg.base.home = str(home)
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=400 * _MS,
+        timeout_prevote_ns=200 * _MS,
+        timeout_precommit_ns=200 * _MS,
+        timeout_commit_ns=150 * _MS,
+        skip_timeout_commit=False,
+        create_empty_blocks=True,
+    )
+    init_files(cfg)
+    genesis, pvs = make_genesis(1)
+    n = Node(cfg, genesis, pvs[0])
+    n.start()
+    deadline = time.monotonic() + 30
+    while n.block_store.height() < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert n.block_store.height() >= 3
+    yield n
+    n.stop()
+
+
+@pytest.fixture(scope="module")
+def proxy(node):
+    # subjective root of trust: block 2's verified hash from the store
+    trusted_h = 2
+    meta = node.block_store.load_block_meta(trusted_h)
+    client = Client(
+        chain_id=node.genesis.chain_id,
+        trust_options=TrustOptions(
+            period_ns=int(3600e9),
+            height=trusted_h,
+            hash=meta.block_id.hash,
+        ),
+        primary=RPCProvider(
+            node.rpc_server.bound_addr, node.genesis.chain_id
+        ),
+    )
+    p = LightProxy(
+        client, node.rpc_server.bound_addr, "tcp://127.0.0.1:0"
+    )
+    p.start()
+    yield p
+    p.stop()
+
+
+@pytest.fixture
+def pclient(proxy):
+    return HTTPClient(proxy.bound_addr)
+
+
+def test_commit_and_header_are_verified(pclient, node):
+    h = 3
+    res = pclient.call("commit", height=h)
+    assert res["canonical"] is True
+    hdr = res["signed_header"]["header"]
+    assert hdr["chain_id"] == node.genesis.chain_id
+    assert int(hdr["height"]) == h
+    res2 = pclient.call("header", height=h)
+    assert res2["header"]["height"] == hdr["height"]
+
+
+def test_validators_from_verified_set(pclient, node):
+    res = pclient.call("validators", height=3)
+    assert res["count"] == 1
+    addr = res["validators"][0]["address"]
+    assert addr == node.state.validators.validators[0].address.hex().upper()
+
+
+def test_block_cross_checked(pclient, node):
+    res = pclient.call("block", height=3)
+    meta = node.block_store.load_block_meta(3)
+    assert res["block_id"]["hash"].upper() == meta.block_id.hash.hex().upper()
+
+
+def test_height_required(pclient):
+    with pytest.raises(RPCError):
+        pclient.call("commit")
+
+
+def test_tx_passthrough_lands_and_verifies(pclient, node):
+    import base64
+
+    tx = base64.b64encode(b"light-proxy=works").decode()
+    res = pclient.call("broadcast_tx_sync", tx=tx)
+    assert int(res["code"]) == 0
+    # wait for it to land, then read the block THROUGH the proxy (full
+    # verification incl. data_hash re-hash of the txs)
+    deadline = time.monotonic() + 20
+    found = False
+    while time.monotonic() < deadline and not found:
+        latest = node.block_store.height()
+        for h in range(3, latest + 1):
+            blk = node.block_store.load_block(h)
+            if blk and any(b"light-proxy=works" in t for t in blk.data.txs):
+                got = pclient.call("block", height=h)
+                assert any(
+                    b"light-proxy=works" in base64.b64decode(t)
+                    for t in got["block"]["data"]["txs"]
+                )
+                found = True
+                break
+        time.sleep(0.1)
+    assert found, "tx never landed in a proxied block"
+
+
+def test_status_carries_light_info(pclient):
+    st = pclient.call("status")
+    assert "light_client_info" in st
+    assert int(st["light_client_info"]["trusted_height"]) >= 2
+
+
+def test_lying_primary_rejected(node):
+    """A proxy whose primary serves a DIFFERENT chain's data must refuse."""
+
+    class LyingPrimary(HTTPClient):
+        def call(self, method, **params):
+            res = super().call(method, **params)
+            if method == "block":
+                res["block_id"]["hash"] = "AB" * 32
+            return res
+
+    meta = node.block_store.load_block_meta(2)
+    client = Client(
+        chain_id=node.genesis.chain_id,
+        trust_options=TrustOptions(
+            period_ns=int(3600e9), height=2, hash=meta.block_id.hash
+        ),
+        primary=RPCProvider(
+            node.rpc_server.bound_addr, node.genesis.chain_id
+        ),
+    )
+    p = LightProxy(client, node.rpc_server.bound_addr, "tcp://127.0.0.1:0")
+    p.primary = LyingPrimary(node.rpc_server.bound_addr)
+    p._server.routes = p._routes()  # rebind closures over the liar
+    p.start()
+    try:
+        c = HTTPClient(p.bound_addr)
+        with pytest.raises(RPCError):
+            c.call("block", height=3)
+    finally:
+        p.stop()
