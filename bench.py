@@ -261,7 +261,73 @@ def bench_mixed(n: int):
     return n / dt, dt
 
 
+def _probe_device(timeout_s: float = 240.0) -> bool:
+    """Device liveness probe in a killable subprocess.
+
+    The tunneled TPU can wedge in PJRT init (blocking forever, no
+    exception); probing in-process would hang the whole benchmark. On
+    probe failure the benchmark re-execs itself on the CPU backend so
+    the driver still gets honest (clearly labeled) numbers instead of a
+    timeout.
+    """
+    import os
+    import subprocess
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return True  # already on the fallback
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.devices(); print('ok')",
+            ],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+_UNIT = "sigs/sec"
+
+
 def main() -> None:
+    global _UNIT
+    import os
+
+    if not _probe_device():
+        # No chip: emit an honest, clearly-labeled host-path measurement
+        # quickly rather than hanging the driver (XLA:CPU compiles of the
+        # wide verify buckets take tens of minutes — not a usable
+        # fallback either).
+        _eprint(
+            {
+                "warning": "TPU device unreachable (PJRT init hang); "
+                "reporting HOST verifier throughput, not chip numbers"
+            }
+        )
+        single = _cpu_single_baseline()
+        from cometbft_tpu.crypto import fast25519
+
+        pubkeys, msgs, sigs = _make_ed_batch(4096)
+        dt = _steady(lambda: fast25519.verify_many(pubkeys, msgs, sigs))
+        print(
+            json.dumps(
+                {
+                    "metric": "ed25519_batch_verify_throughput",
+                    "value": round(4096 / dt, 1),
+                    "unit": "sigs/sec (host fallback: tpu unreachable)",
+                    "vs_baseline": round(
+                        (4096 / dt) / (single * VOI_BATCH_FACTOR), 2
+                    ),
+                }
+            )
+        )
+        return
+
     single = _cpu_single_baseline()
     batch_baseline = single * VOI_BATCH_FACTOR
     _eprint(
@@ -330,7 +396,7 @@ def main() -> None:
             {
                 "metric": "ed25519_batch_verify_throughput",
                 "value": round(tput, 1),
-                "unit": "sigs/sec",
+                "unit": _UNIT,
                 "vs_baseline": round(tput / batch_baseline, 2),
             }
         )

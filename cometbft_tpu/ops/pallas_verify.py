@@ -184,7 +184,35 @@ def _fold_cols(cols):
 
 
 def _sq(a):
-    return _mul(a, a)
+    """Squaring at ~half the multiplies of _mul (210 vs 400).
+
+    cols[c] = 2 * sum_{i<j, i+j=c} a_i*a_j + (c even ? a_{c/2}^2 : 0).
+    Overflow check under the lazy bound (limbs <= 10015, products
+    <= 1.0030e8): worst cross column has 10 pairs -> doubled sum
+    <= 2.006e9; worst mixed column 9 pairs + diagonal
+    <= 2 * 9 * 1.0030e8 + 1.0030e8 = 1.906e9 — both < 2^31 - 1.
+    """
+    batch = a.shape[-1]
+    rows = 2 * NLIMB - 1
+    cross = None
+    for i in range(NLIMB - 1):
+        t = a[i : i + 1] * a[i + 1 :]  # a_i * a_j, j > i: (19-i, B)
+        top = 2 * i + 1  # lands at rows [2i+1, i+20)
+        bottom = rows - top - (NLIMB - 1 - i)
+        parts = [jnp.zeros((top, batch), jnp.int32), t]
+        if bottom:
+            parts.append(jnp.zeros((bottom, batch), jnp.int32))
+        term = jnp.concatenate(parts, axis=0)
+        cross = term if cross is None else cross + term
+    d = a * a  # diagonals: a_i^2 at row 2i
+    zero1 = jnp.zeros((1, batch), jnp.int32)
+    diag_parts = []
+    for i in range(NLIMB):
+        diag_parts.append(d[i : i + 1])
+        if i != NLIMB - 1:
+            diag_parts.append(zero1)
+    diag = jnp.concatenate(diag_parts, axis=0)  # (39, B)
+    return _fold_cols(cross + cross + diag)
 
 
 def _canonical(x):

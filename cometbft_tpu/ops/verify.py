@@ -330,6 +330,10 @@ def _materialize(out, used_pallas: bool, buf):
 # launches instead of one giant one.
 _CHUNK = 4096
 
+# verify_batch pipelines pack->dispatch at this granularity (half _CHUNK:
+# two in-flight launches hide one chunk's packing time).
+_PIPE_CHUNK = 2048
+
 
 def verify_bytes_async(buf: np.ndarray, n: int):
     """Dispatch a packed wire buffer to the device without blocking.
@@ -370,7 +374,23 @@ def verify_batch(pubkeys, msgs, sigs) -> tuple[bool, np.ndarray]:
     n = len(pubkeys)
     if n == 0:
         return True, np.zeros(0, bool)
-    buf, host_ok = pack_bytes(pubkeys, msgs, sigs)
-    device_ok = verify_bytes_async(buf, n)()
+    if n <= _PIPE_CHUNK:
+        buf, host_ok = pack_bytes(pubkeys, msgs, sigs)
+        device_ok = verify_bytes_async(buf, n)()
+    else:
+        # Pipeline host packing with device execution: each chunk is
+        # dispatched as soon as it is packed, so the per-lane SHA-512 /
+        # packing cost of chunk i+1 overlaps chunk i's kernel time
+        # (~15% of the round trip at 4096 lanes otherwise serialized).
+        finals, host_oks = [], []
+        for lo in range(0, n, _PIPE_CHUNK):
+            hi = min(lo + _PIPE_CHUNK, n)
+            buf, hok = pack_bytes(
+                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]
+            )
+            finals.append(verify_bytes_async(buf, hi - lo))
+            host_oks.append(hok)
+        device_ok = np.concatenate([f() for f in finals])
+        host_ok = np.concatenate(host_oks)
     valid = device_ok & host_ok
     return bool(valid.all()), valid
