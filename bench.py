@@ -291,13 +291,7 @@ def _probe_device(timeout_s: float = 240.0) -> bool:
         return False
 
 
-_UNIT = "sigs/sec"
-
-
 def main() -> None:
-    global _UNIT
-    import os
-
     if not _probe_device():
         # No chip: emit an honest, clearly-labeled host-path measurement
         # quickly rather than hanging the driver (XLA:CPU compiles of the
@@ -396,7 +390,7 @@ def main() -> None:
             {
                 "metric": "ed25519_batch_verify_throughput",
                 "value": round(tput, 1),
-                "unit": _UNIT,
+                "unit": "sigs/sec",
                 "vs_baseline": round(tput / batch_baseline, 2),
             }
         )

@@ -22,6 +22,7 @@ import time
 
 from ..config import ConsensusConfig
 from ..crypto import batch as crypto_batch
+from ..libs import metrics as libmetrics
 from ..libs.events import EventSwitch
 from ..libs.service import BaseService
 from ..types import BlockID, PartSet, canonical
@@ -404,7 +405,11 @@ class ConsensusState(BaseService):
     def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id
         if isinstance(msg, ProposalMessage):
-            self._set_proposal(msg.proposal)
+            try:
+                self._set_proposal(msg.proposal)
+            except ConsensusError:
+                libmetrics.node_metrics().proposals.labels("rejected").inc()
+                raise
         elif isinstance(msg, BlockPartMessage):
             self._add_proposal_block_part(msg, peer_id)
         elif isinstance(msg, VoteMessage):
@@ -502,7 +507,7 @@ class ConsensusState(BaseService):
                 self.config.commit_timeout() * 1e9
             )
         rs.round = 0
-        rs.step = RoundStep.NEW_HEIGHT
+        self._set_step(rs, RoundStep.NEW_HEIGHT)
         rs.validators = state.validators
         rs.proposal = None
         rs.proposal_block = None
@@ -568,19 +573,37 @@ class ConsensusState(BaseService):
 
     # -- NewRound (state.go:1018) ------------------------------------------
 
+    def _set_step(self, rs, step) -> None:
+        """Step transition + per-step timing
+        (consensus/metrics.go StepDurationSeconds)."""
+        now = time.monotonic()
+        started = getattr(self, "_step_started", None)
+        if started is not None:
+            libmetrics.node_metrics().step_duration.labels(
+                rs.step.name
+            ).observe(now - started)
+        self._step_started = now
+        rs.step = step
+
     def _enter_new_round(self, height: int, round_: int) -> None:
         rs = self.rs
         if rs.height != height or round_ < rs.round or (
             rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
         ):
             return
+        m = libmetrics.node_metrics()
+        now_mono = time.monotonic()
+        if getattr(self, "_round_started", None) is not None:
+            m.round_duration.observe(now_mono - self._round_started)
+        self._round_started = now_mono
+        m.rounds.set(round_)
         validators = rs.validators
         if rs.round < round_:
             validators = validators.copy_increment_proposer_priority(
                 round_ - rs.round
             )
         rs.round = round_
-        rs.step = RoundStep.NEW_ROUND
+        self._set_step(rs, RoundStep.NEW_ROUND)
         rs.validators = validators
         if round_ != 0:
             # round 0 keeps proposal from NEW_HEIGHT reset
@@ -619,7 +642,7 @@ class ConsensusState(BaseService):
         ):
             return
         rs.round = round_
-        rs.step = RoundStep.PROPOSE
+        self._set_step(rs, RoundStep.PROPOSE)
         self._new_step()
         self._schedule_timeout(
             self.config.propose_timeout(round_), height, round_,
@@ -704,6 +727,7 @@ class ConsensusState(BaseService):
         ):
             raise ConsensusError("invalid proposal signature")
         rs.proposal = proposal
+        libmetrics.node_metrics().proposals.labels("accepted").inc()
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header
@@ -758,7 +782,7 @@ class ConsensusState(BaseService):
         ):
             return
         rs.round = round_
-        rs.step = RoundStep.PREVOTE
+        self._set_step(rs, RoundStep.PREVOTE)
         self._new_step()
         self._do_prevote(height, round_)
 
@@ -849,7 +873,7 @@ class ConsensusState(BaseService):
         if prevotes is None or not prevotes.has_two_thirds_any():
             raise ConsensusError("enterPrevoteWait without any +2/3 prevotes")
         rs.round = round_
-        rs.step = RoundStep.PREVOTE_WAIT
+        self._set_step(rs, RoundStep.PREVOTE_WAIT)
         self._new_step()
         self._schedule_timeout(
             self.config.prevote_timeout(round_), height, round_,
@@ -865,7 +889,7 @@ class ConsensusState(BaseService):
         ):
             return
         rs.round = round_
-        rs.step = RoundStep.PRECOMMIT
+        self._set_step(rs, RoundStep.PRECOMMIT)
         self._new_step()
         prevotes = rs.votes.prevotes(round_)
         maj23 = prevotes.two_thirds_majority() if prevotes else None
@@ -949,7 +973,7 @@ class ConsensusState(BaseService):
         maj23 = precommits.two_thirds_majority()
         if maj23 is None or maj23.is_nil():
             raise ConsensusError("enterCommit without +2/3 for a block")
-        rs.step = RoundStep.COMMIT
+        self._set_step(rs, RoundStep.COMMIT)
         rs.commit_round = commit_round
         rs.commit_time_ns = time.time_ns()
         self._new_step()
@@ -1025,6 +1049,7 @@ class ConsensusState(BaseService):
         try:
             return self._add_vote(vote, peer_id)
         except ConflictingVoteError as e:
+            libmetrics.node_metrics().duplicate_votes.inc()
             if (
                 self.priv_validator_pub_key is not None
                 and vote.validator_address
@@ -1059,6 +1084,12 @@ class ConsensusState(BaseService):
             return True
 
         if vote.height != rs.height:
+            if vote.height < rs.height:
+                libmetrics.node_metrics().late_votes.labels(
+                    "precommit"
+                    if vote.msg_type == canonical.PRECOMMIT_TYPE
+                    else "prevote"
+                ).inc()
             return False
 
         extensions_enabled = rs.votes.extensions_enabled

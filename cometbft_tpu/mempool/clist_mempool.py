@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 
+from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from dataclasses import dataclass, field
 
@@ -123,6 +124,9 @@ class CListMempool:
                 if el is not None and sender:
                     el.value.senders.add(sender)
                 raise TxInCacheError(key.hex())
+            # first-seen only (mempool/metrics.go TxSizeBytes): duplicates
+            # and rejected-before-cache txs must not shift the histogram
+            libmetrics.node_metrics().mempool_tx_size.observe(len(tx))
             if sender:
                 self._pending_senders[key] = sender
             reqres = self.proxy_app.check_tx_async(
@@ -168,11 +172,13 @@ class CListMempool:
                 self._size_bytes += len(tx)
                 self._notify_txs_available()
             else:
+                libmetrics.node_metrics().mempool_failed_txs.inc()
                 self._pending_senders.pop(key, None)
                 if not self.config.keep_invalid_txs_in_cache:
                     self.cache.remove(key)
 
     def _res_cb_recheck(self, req, res) -> None:
+        libmetrics.node_metrics().mempool_rechecks.inc()
         with self._update_mtx:
             el = self._recheck_cursor
             if el is None:

@@ -379,12 +379,38 @@ class Node(BaseService):
         self._last_commit_time = now
         if meta is not None:
             self.metrics.block_txs.set(meta.num_txs)
+            self.metrics.block_size.set(meta.block_size)
+            self.metrics.total_txs.inc(meta.num_txs)
             self.logger.with_module("consensus").info(
                 "finalized block",
                 height=height,
                 num_txs=meta.num_txs,
                 app_hash=meta.header.app_hash,
             )
+        # Absent signers of the block's own seen commit
+        # (consensus/metrics.go MissingValidators{,Power}).
+        try:
+            commit = self.block_store.load_seen_commit()
+            if commit is not None and commit.height == height:
+                from ..types.block import BLOCK_ID_FLAG_ABSENT
+
+                # the set that SIGNED height h is the per-height persisted
+                # one — node.state is the boot-time snapshot and goes
+                # stale immediately (review finding)
+                vals = self.state_store.load_validators(height)
+                if vals is None:
+                    return
+                missing = missing_power = 0
+                for idx, cs in enumerate(commit.signatures):
+                    if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+                        missing += 1
+                        val = vals.get_by_index(idx)
+                        if val is not None:
+                            missing_power += val.voting_power
+                self.metrics.missing_validators.set(missing)
+                self.metrics.missing_validators_power.set(missing_power)
+        except Exception:
+            pass  # metrics must never break the commit path
 
     def _refresh_metrics(self) -> None:
         """Pull-time gauges (collector pattern): cheap reads at scrape —
@@ -396,6 +422,22 @@ class Node(BaseService):
             vals = self.consensus.rs.validators
         if vals is not None:
             self.metrics.validators.set(len(vals))
+            self.metrics.validators_power.set(vals.total_voting_power())
+        if self.evidence_pool is not None:
+            try:
+                offenders = set()
+                # walk the gossip clist directly: pending_evidence()
+                # serializes every item for its byte cap — too heavy for
+                # the scrape path
+                for el in self.evidence_pool.evidence_list:
+                    ev = el.value
+                    if hasattr(ev, "vote_a"):  # DuplicateVoteEvidence
+                        offenders.add(bytes(ev.vote_a.validator_address))
+                    for v in getattr(ev, "byzantine_validators", []):
+                        offenders.add(bytes(v.address))
+                self.metrics.byzantine_validators.set(len(offenders))
+            except Exception:
+                pass
 
     def _make_state_provider(self):
         """Light-client state provider from config.state_sync

@@ -14,6 +14,8 @@ Packet wire format (binary, little-endian):
 from __future__ import annotations
 
 import struct
+
+from ...libs import metrics as libmetrics
 import threading
 import time
 from dataclasses import dataclass
@@ -116,6 +118,17 @@ class MConnection(BaseService):
         self.conn = conn
         self.config = config or MConnConfig()
         self.channels = {d.id: _Channel(d) for d in channels}
+        # Labeled-counter children resolved ONCE per channel: the wire
+        # loops must not pay a registry lookup + label format per packet.
+        # Bound at connection setup — connections are created after node
+        # boot, when the node registry is installed.
+        m = libmetrics.node_metrics()
+        self._send_ctr = {
+            d.id: m.p2p_send_bytes.labels(f"{d.id:#04x}") for d in channels
+        }
+        self._recv_ctr = {
+            d.id: m.p2p_recv_bytes.labels(f"{d.id:#04x}") for d in channels
+        }
         self.on_receive = on_receive
         self.on_error = on_error
         self.send_monitor = Monitor()
@@ -246,6 +259,7 @@ class MConnection(BaseService):
             + chunk
         )
         self.send_monitor.update(len(chunk) + 5)
+        self._send_ctr[best.desc.id].inc(len(chunk) + 5)
         return True
 
     def _write_packet(self, data: bytes) -> None:
@@ -280,6 +294,9 @@ class MConnection(BaseService):
                     raise ValueError(f"unknown packet type {ptype}")
                 ch_id, eof, length = struct.unpack("<BBH", self._read_exact(4))
                 data = self._read_exact(length) if length else b""
+                ctr = self._recv_ctr.get(ch_id)
+                if ctr is not None:
+                    ctr.inc(length + 5)
                 self.recv_monitor.limit(length + 5, self.config.recv_rate)
                 self.recv_monitor.update(length + 5)
                 ch = self.channels.get(ch_id)

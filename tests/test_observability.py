@@ -153,6 +153,30 @@ class TestNodeObservability:
             ][0]
             assert float(height_line.split()[-1]) >= 3
             assert "cometbft_tpu_consensus_block_interval_seconds_count" in text
+            # expanded per-package families (consensus/metrics.go,
+            # p2p/metrics.go, mempool/metrics.go parity)
+            for family in (
+                "cometbft_tpu_consensus_step_duration_seconds",
+                "cometbft_tpu_consensus_round_duration_seconds",
+                "cometbft_tpu_consensus_validators_power",
+                "cometbft_tpu_consensus_missing_validators",
+                "cometbft_tpu_consensus_total_txs",
+                "cometbft_tpu_consensus_block_size_bytes",
+                "cometbft_tpu_mempool_tx_size_bytes",
+                "cometbft_tpu_p2p_message_send_bytes_total",
+            ):
+                assert family in text, family
+            # a single-validator node really times its steps
+            step_counts = [
+                ln
+                for ln in text.splitlines()
+                if ln.startswith(
+                    "cometbft_tpu_consensus_step_duration_seconds_count"
+                )
+            ]
+            assert step_counts and any(
+                float(ln.split()[-1]) > 0 for ln in step_counts
+            )
             logs = sink.getvalue()
             assert "finalized block" in logs
             assert "module=consensus" in logs
