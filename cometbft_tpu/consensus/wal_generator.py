@@ -1,0 +1,93 @@
+"""WAL fixture generator (reference: consensus/wal_generator.go:31).
+
+Runs a REAL single-validator node against the in-process kvstore app
+until ``num_blocks`` are committed, then hands back the node's consensus
+WAL — authentic fixture content (proposals, block parts, votes,
+timeouts, end-height markers in true order) for replay/corruption tests,
+instead of hand-assembled message sequences.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import tempfile
+import time
+
+_MS = 1_000_000
+
+
+def generate_wal(
+    out_path: str, num_blocks: int = 3, timeout_s: float = 60.0
+) -> str:
+    """Produce a WAL covering >= ``num_blocks`` committed heights.
+
+    Returns ``out_path`` (the WAL head file; rotated tail files, if any,
+    are copied alongside). The node runs in a throwaway home with
+    mem-backed stores except the WAL itself.
+    """
+    from ..config import default_config
+    from ..node import Node, init_files, load_genesis
+
+    home = tempfile.mkdtemp(prefix="walgen-")
+    try:
+        cfg = default_config()
+        cfg.base.home = home
+        cfg.base.db_backend = "mem"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""  # no RPC needed for fixture generation
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=400 * _MS,
+            timeout_prevote_ns=200 * _MS,
+            timeout_precommit_ns=200 * _MS,
+            timeout_commit_ns=100 * _MS,
+            skip_timeout_commit=False,
+            create_empty_blocks=True,
+        )
+        init_files(cfg)
+        from ..privval import FilePV
+
+        pv = FilePV.load_or_generate(
+            cfg.base.resolve(cfg.base.priv_validator_key_file),
+            cfg.base.resolve(cfg.base.priv_validator_state_file),
+        )
+        node = Node(cfg, load_genesis(cfg), pv)
+        node.start()
+        try:
+            deadline = time.monotonic() + timeout_s
+            while (
+                node.block_store.height() < num_blocks
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            if node.block_store.height() < num_blocks:
+                raise RuntimeError(
+                    f"wal generator made only {node.block_store.height()} "
+                    f"of {num_blocks} blocks in {timeout_s}s"
+                )
+        finally:
+            node.stop()
+
+        wal_dir = os.path.dirname(
+            cfg.base.resolve(cfg.consensus.wal_file)
+        )
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        head = cfg.base.resolve(cfg.consensus.wal_file)
+        shutil.copy(head, out_path)
+        # Rotated tails travel with the head, RENAMED to out_path's
+        # basename: autofile.Group discovers tails by the head's own
+        # basename prefix, so copying them under the source name would
+        # silently orphan them whenever out_path is named differently.
+        src_base = os.path.basename(head)
+        dst_base = os.path.basename(out_path)
+        dst_dir = os.path.dirname(out_path) or "."
+        for name in sorted(os.listdir(wal_dir)):
+            src = os.path.join(wal_dir, name)
+            if src != head and name.startswith(src_base):
+                suffix = name[len(src_base):]
+                shutil.copy(src, os.path.join(dst_dir, dst_base + suffix))
+        return out_path
+    finally:
+        shutil.rmtree(home, ignore_errors=True)

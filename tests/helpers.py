@@ -145,7 +145,7 @@ class ChainDriver:
 # consensus/common_test.go:765 — perfect-gossip wiring instead of p2p) ----
 
 
-def make_consensus_node(genesis, pv, config=None, home=None):
+def make_consensus_node(genesis, pv, config=None, home=None, app=None):
     """One full single-process node core: kvstore app + stores + executor
     + consensus state. Returns (cs, parts) where parts has handles."""
     from cometbft_tpu import proxy
@@ -159,8 +159,10 @@ def make_consensus_node(genesis, pv, config=None, home=None):
     from cometbft_tpu.types.event_bus import EventBus
 
     cfg = config or test_config()
+    app_db = None  # only when WE build the app: an injected app owns its own storage
     if home is None:
-        app_db = dbm.MemDB()
+        if app is None:
+            app_db = dbm.MemDB()
         state_db = dbm.MemDB()
         block_db = dbm.MemDB()
         wal = None
@@ -168,11 +170,12 @@ def make_consensus_node(genesis, pv, config=None, home=None):
         import os
 
         os.makedirs(home, exist_ok=True)
-        app_db = dbm.FileDB(f"{home}/app.db")
+        if app is None:
+            app_db = dbm.FileDB(f"{home}/app.db")
         state_db = dbm.FileDB(f"{home}/state.db")
         block_db = dbm.FileDB(f"{home}/blocks.db")
         wal = WAL(f"{home}/cs.wal/wal")
-    app = KVStoreApplication(app_db)
+    app = app if app is not None else KVStoreApplication(app_db)
     conns = proxy.AppConns(proxy.local_client_creator(app))
     conns.start()
     state_store = Store(state_db)
@@ -198,7 +201,9 @@ def make_consensus_node(genesis, pv, config=None, home=None):
     parts = dict(
         app=app, conns=conns, state_store=state_store,
         block_store=block_store, bus=bus, executor=executor, config=cfg,
-        dbs=(app_db, state_db, block_db),
+        dbs=tuple(
+            db for db in (app_db, state_db, block_db) if db is not None
+        ),
     )
     return cs, parts
 

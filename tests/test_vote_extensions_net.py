@@ -1,0 +1,151 @@
+"""Live-net vote extensions end to end (reference: the ABCI 2.0 vote-
+extension flow — consensus/state.go:2207-2215 extension verification on
+ingest, ExtendVote at precommit signing, votesFromExtendedCommit +
+ExtendedCommitInfo into PrepareProposal; app side mirrors
+test/e2e/app/app.go:443,479).
+
+A 4-validator in-process net runs with vote_extensions_enable_height=1
+and an app that produces height-dependent extensions and verifies its
+peers'. Asserts: blocks commit, every stored extended commit carries all
+four validators' extensions with valid extension signatures, and the
+proposer's PrepareProposal receives the full ExtendedCommitInfo.
+"""
+
+import dataclasses
+import time
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.types.params import ABCIParams
+
+from helpers import (
+    make_consensus_node,
+    make_genesis,
+    stop_node,
+    wire_perfect_gossip,
+)
+
+
+class ExtensionApp(KVStoreApplication):
+    """kvstore + deterministic vote extensions (e2e app analog)."""
+
+    def __init__(self):
+        super().__init__()
+        self.seen_extended_commits = []  # (height, ExtendedCommitInfo)
+        self.verified = 0
+
+    @staticmethod
+    def _ext_for(height: int) -> bytes:
+        return b"extension@%d" % height
+
+    def extend_vote(self, req):
+        return abci.ResponseExtendVote(
+            vote_extension=self._ext_for(req.height)
+        )
+
+    def verify_vote_extension(self, req):
+        ok = req.vote_extension == self._ext_for(req.height)
+        self.verified += 1
+        return abci.ResponseVerifyVoteExtension(
+            status=abci.VerifyVoteExtensionStatus.ACCEPT
+            if ok
+            else abci.VerifyVoteExtensionStatus.REJECT
+        )
+
+    def prepare_proposal(self, req):
+        if req.local_last_commit is not None:
+            self.seen_extended_commits.append(
+                (req.height, req.local_last_commit)
+            )
+        return super().prepare_proposal(req)
+
+
+def test_vote_extensions_flow_through_live_net():
+    genesis, pvs = make_genesis(4)
+    genesis.consensus_params = dataclasses.replace(
+        genesis.consensus_params,
+        abci=ABCIParams(vote_extensions_enable_height=1),
+    )
+    apps = [ExtensionApp() for _ in range(4)]
+    nodes = [
+        make_consensus_node(genesis, pvs[i], app=apps[i]) for i in range(4)
+    ]
+    try:
+        wire_perfect_gossip(nodes)
+        for cs, _ in nodes:
+            cs.start()
+        target = 3
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if all(
+                parts["block_store"].height() >= target
+                for _, parts in nodes
+            ):
+                break
+            time.sleep(0.05)
+        heights = [parts["block_store"].height() for _, parts in nodes]
+        assert all(h >= target for h in heights), heights
+
+        # every node's stored extended commits carry all 4 extensions
+        # with verifying extension signatures
+        chain_id = nodes[0][0].state.chain_id
+        vals = nodes[0][0].state.validators
+        checked = 0
+        for _, parts in nodes:
+            store = parts["block_store"]
+            for h in range(1, target):
+                ec = store.load_block_extended_commit(h)
+                assert ec is not None, f"no extended commit at {h}"
+                assert len(ec.extended_signatures) == 4
+                from cometbft_tpu.types.block import BLOCK_ID_FLAG_COMMIT
+
+                present = [
+                    es
+                    for es in ec.extended_signatures
+                    if es.commit_sig.block_id_flag == BLOCK_ID_FLAG_COMMIT
+                ]
+                # +2/3 suffices for a commit: late precommits may be ABSENT
+                assert len(present) >= 3, f"height {h}"
+                for idx, es in enumerate(ec.extended_signatures):
+                    if es.commit_sig.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                        assert es.extension == b""  # absent carries none
+                        continue
+                    assert es.extension == b"extension@%d" % h
+                    val = vals.get_by_index(idx)
+                    # extension signature verifies under the validator key
+                    # (canonical extension sign bytes: chain/height/round/ext)
+                    from cometbft_tpu.types import canonical
+
+                    sign_bytes = canonical.vote_extension_sign_bytes(
+                        chain_id, h, ec.round, es.extension
+                    )
+                    assert val.pub_key.verify_signature(
+                        sign_bytes, es.extension_signature
+                    ), (h, idx)
+                    checked += 1
+        assert checked >= 3 * (target - 1)
+
+        # some proposer saw the previous height's full ExtendedCommitInfo
+        flat = [
+            (h, eci)
+            for app in apps
+            for (h, eci) in app.seen_extended_commits
+            if h >= 2
+        ]
+        assert flat, "no PrepareProposal carried ExtendedCommitInfo"
+        h, eci = flat[0]
+        assert len(eci.votes) == 4
+        from cometbft_tpu.types.block import BLOCK_ID_FLAG_COMMIT as _C
+
+        with_ext = [
+            vi for vi in eci.votes if vi.block_id_flag == _C
+        ]
+        assert len(with_ext) >= 3
+        assert all(
+            vi.vote_extension == b"extension@%d" % (h - 1)
+            for vi in with_ext
+        )
+        assert all(app.verified > 0 for app in apps)
+    finally:
+        for cs, parts in nodes:
+            stop_node(cs, parts)

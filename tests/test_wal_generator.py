@@ -1,0 +1,52 @@
+"""WAL generator tests (reference: consensus/wal_generator.go +
+consensus/wal_test.go's use of generated fixtures).
+"""
+
+import os
+
+import pytest
+
+from cometbft_tpu.consensus.wal import WAL, EndHeightMessage, MsgInfo
+from cometbft_tpu.consensus.wal_generator import generate_wal
+
+
+@pytest.mark.slow
+def test_generated_wal_is_authentic_and_replayable(tmp_path):
+    path = generate_wal(str(tmp_path / "fixture" / "wal"), num_blocks=3)
+    assert os.path.exists(path)
+
+    wal = WAL(path)
+    try:
+        msgs = list(wal.iter_messages())
+        assert msgs, "empty generated WAL"
+        # authentic content: end-height markers for every committed height
+        ends = [
+            m.height for m in msgs if isinstance(m, EndHeightMessage)
+        ]
+        assert set(ends) >= {1, 2, 3}, ends
+        # real consensus traffic in between (votes/proposals/timeouts)
+        assert sum(1 for m in msgs if isinstance(m, MsgInfo)) > len(ends)
+        # the replay entrypoint the node uses on boot finds each height
+        for h in (1, 2, 3):
+            assert wal.search_for_end_height(h) is not None, h
+    finally:
+        wal.close()
+
+
+@pytest.mark.slow
+def test_generated_wal_survives_truncation(tmp_path):
+    """Chop the tail mid-record: the prefix must still replay cleanly —
+    the property the crash-recovery tests rely on (wal_test.go)."""
+    path = generate_wal(str(tmp_path / "f2" / "wal"), num_blocks=2)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - size // 4)
+    wal = WAL(path)
+    try:
+        msgs = list(wal.iter_messages())  # no exception: stops at tear
+        assert msgs
+        assert any(
+            isinstance(m, EndHeightMessage) and m.height == 1 for m in msgs
+        )
+    finally:
+        wal.close()
