@@ -145,7 +145,8 @@ class ChainDriver:
 # consensus/common_test.go:765 — perfect-gossip wiring instead of p2p) ----
 
 
-def make_consensus_node(genesis, pv, config=None, home=None, app=None):
+def make_consensus_node(genesis, pv, config=None, home=None, app=None,
+                        with_evidence=False):
     """One full single-process node core: kvstore app + stores + executor
     + consensus state. Returns (cs, parts) where parts has handles."""
     from cometbft_tpu import proxy
@@ -186,8 +187,14 @@ def make_consensus_node(genesis, pv, config=None, home=None, app=None):
     if state is None:
         state = make_genesis_state(genesis)
         state_store.save(state)
+    evidence_pool = None
+    if with_evidence:
+        from cometbft_tpu.evidence import EvidencePool
+
+        evidence_pool = EvidencePool(dbm.MemDB(), state_store, block_store)
     executor = BlockExecutor(
-        state_store, conns.consensus, block_store=block_store, event_bus=bus
+        state_store, conns.consensus, block_store=block_store, event_bus=bus,
+        evidence_pool=evidence_pool,
     )
     cs = ConsensusState(
         cfg.consensus,
@@ -195,12 +202,14 @@ def make_consensus_node(genesis, pv, config=None, home=None, app=None):
         executor,
         block_store,
         event_bus=bus,
+        evidence_pool=evidence_pool,
         wal=wal,
     )
     cs.set_priv_validator(pv)
     parts = dict(
         app=app, conns=conns, state_store=state_store,
         block_store=block_store, bus=bus, executor=executor, config=cfg,
+        evidence_pool=evidence_pool,
         dbs=tuple(
             db for db in (app_db, state_db, block_db) if db is not None
         ),

@@ -1,0 +1,138 @@
+"""Byzantine validator in a live net (reference:
+consensus/byzantine_test.go — a decorated validator double-signs;
+honest nodes must keep committing, build DuplicateVoteEvidence, include
+it in a later block, and deliver it to the app as misbehavior).
+"""
+
+import copy
+import time
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+from helpers import (
+    make_consensus_node,
+    make_genesis,
+    stop_node,
+    wire_perfect_gossip,
+)
+
+
+class MisbehaviorApp(KVStoreApplication):
+    """Records the misbehavior list FinalizeBlock delivers."""
+
+    def __init__(self):
+        super().__init__()
+        self.misbehavior = []  # (height, [Misbehavior])
+
+    def finalize_block(self, req):
+        if req.misbehavior:
+            self.misbehavior.append((req.height, list(req.misbehavior)))
+        return super().finalize_block(req)
+
+
+def _equivocate(byz_idx, nodes, css):
+    """Intercept the byzantine node's own votes: honest peers receive a
+    CONFLICTING duplicate (same H/R/type, different block id) alongside
+    the real vote — the double-sign a byzantine validator would emit."""
+    byz_cs = css[byz_idx]
+    byz_pv = byz_cs.priv_validator
+    orig = byz_cs._send_internal  # already wrapped by perfect gossip
+
+    def send(msg, orig=orig):
+        from cometbft_tpu.consensus.messages import VoteMessage
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+
+        orig(msg)
+        if not isinstance(msg, VoteMessage):
+            return
+        vote = msg.vote
+        if vote.msg_type != canonical.PREVOTE_TYPE or vote.block_id.is_nil():
+            return
+        evil = copy.copy(vote)
+        evil.block_id = BlockID(
+            b"\xEE" * 32, PartSetHeader(total=1, hash=b"\xDD" * 32)
+        )
+        evil.signature = b""
+        byz_pv.sign_vote(byz_cs.state.chain_id, evil, sign_extension=False)
+        for j, other in enumerate(css):
+            if j != byz_idx:
+                other.add_vote_from_peer(evil, f"byz{byz_idx}")
+
+    byz_cs._send_internal = send
+
+
+def test_byzantine_double_sign_becomes_block_evidence():
+    genesis, pvs = make_genesis(4)
+    apps = [MisbehaviorApp() for _ in range(4)]
+    nodes = [
+        make_consensus_node(
+            genesis, pvs[i], app=apps[i], with_evidence=True
+        )
+        for i in range(4)
+    ]
+    css = [cs for cs, _ in nodes]
+    byz_idx = 3
+    try:
+        wire_perfect_gossip(nodes)
+        _equivocate(byz_idx, nodes, css)
+        for cs in css:
+            cs.start()
+
+        # HONEST nodes must keep committing despite the equivocation.
+        # (The byzantine node may strand itself mid-height: the perfect-
+        # gossip harness has no catch-up gossip, and its fate is not the
+        # test's subject — byzantine_test.go likewise waits on honest
+        # nodes only.)
+        honest = [p for i, (_, p) in enumerate(nodes) if i != byz_idx]
+        target = 4
+        deadline = time.monotonic() + 90
+        evidenced = None
+        while time.monotonic() < deadline:
+            heights = [p["block_store"].height() for p in honest]
+            if min(heights) >= target:
+                # look for a block carrying the duplicate-vote evidence
+                for parts in honest:
+                    store = parts["block_store"]
+                    for h in range(2, store.height() + 1):
+                        blk = store.load_block(h)
+                        if blk and blk.evidence:
+                            evidenced = (h, blk.evidence)
+                            break
+                    if evidenced:
+                        break
+                if evidenced:
+                    break
+            time.sleep(0.05)
+
+        heights = [p["block_store"].height() for p in honest]
+        assert min(heights) >= target, f"no progress: {heights}"
+        assert evidenced, "duplicate-vote evidence never entered a block"
+        h, evs = evidenced
+        ev = evs[0]
+        assert isinstance(ev, DuplicateVoteEvidence)
+        byz_addr = bytes(pvs[byz_idx].get_pub_key().address())
+        assert bytes(ev.vote_a.validator_address) == byz_addr
+        assert ev.vote_a.block_id != ev.vote_b.block_id
+
+        # the app learned about it as misbehavior (state/execution.go
+        # buildLastCommitInfo + misbehavior conversion)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not any(
+            a.misbehavior for a in apps
+        ):
+            time.sleep(0.05)
+        reported = [a.misbehavior for a in apps if a.misbehavior]
+        assert reported, "no app received misbehavior"
+        _, mbs = reported[0][0]
+        assert any(
+            bytes(mb.validator.address) == byz_addr for mb in mbs
+        )
+        assert all(
+            mb.type == abci.MisbehaviorType.DUPLICATE_VOTE for mb in mbs
+        )
+    finally:
+        for cs, parts in nodes:
+            stop_node(cs, parts)
