@@ -49,6 +49,9 @@ class RPCConfig:
     timeout_broadcast_tx_commit_ns: int = 10_000 * _MS
     max_body_bytes: int = 1_000_000
     pprof_laddr: str = ""
+    # operator-only routes (dial_seeds/dial_peers/unsafe_flush_mempool):
+    # rpc/core/routes.go AddUnsafeRoutes, config.go RPC.Unsafe
+    unsafe: bool = False
 
 
 @dataclass(slots=True)

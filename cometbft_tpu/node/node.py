@@ -345,11 +345,18 @@ class Node(BaseService):
         )
         self.rpc_env.extra["metrics"] = self.metrics
         self.rpc_env.extra["refresh_metrics"] = self._refresh_metrics
+        self.rpc_env.extra["pex_reactor"] = self.pex_reactor
+        rpc_routes = None
+        if getattr(config.rpc, "unsafe", False):
+            from ..rpc.core.routes import ROUTES, UNSAFE_ROUTES
+
+            rpc_routes = {**ROUTES, **UNSAFE_ROUTES}
         self.rpc_server = (
             RPCServer(
                 self.rpc_env,
                 config.rpc.laddr,
                 logger=self.logger.with_module("rpc"),
+                routes=rpc_routes,
             )
             if config.rpc.laddr
             else None

@@ -154,6 +154,44 @@ def genesis(env) -> dict:
     return {"genesis": _json.loads(env.genesis.to_json())}
 
 
+GENESIS_CHUNK_SIZE = 16 * 1024 * 1024  # net.go:16 genesisChunkSize
+
+
+def genesis_chunked(env, chunk=None) -> dict:
+    """Large genesis docs fetched in 16 MB base64 chunks
+    (rpc/core/net.go GenesisChunked)."""
+    import base64 as _b64
+
+    # serialize once per process (env.go InitGenesisChunks caches too):
+    # the route exists precisely because the doc can be huge
+    doc = env.extra.get("_genesis_encoded")
+    if doc is None:
+        doc = env.genesis.to_json().encode()
+        env.extra["_genesis_encoded"] = doc
+    total = max(1, (len(doc) + GENESIS_CHUNK_SIZE - 1) // GENESIS_CHUNK_SIZE)
+    idx = int(chunk or 0)
+    if not 0 <= idx < total:
+        raise RPCError(
+            f"chunk {idx} out of range (0..{total - 1})", code=-32602
+        )
+    piece = doc[idx * GENESIS_CHUNK_SIZE : (idx + 1) * GENESIS_CHUNK_SIZE]
+    return {
+        "chunk": idx,
+        "total": total,
+        "data": _b64.b64encode(piece).decode(),
+    }
+
+
+def header_by_hash(env, hash=None) -> dict:  # noqa: A002
+    if not hash:
+        raise RPCError("hash is required", code=-32602)
+    raw = bytes.fromhex(hash) if isinstance(hash, str) else bytes(hash)
+    meta = env.block_store.load_block_meta_by_hash(raw)
+    if meta is None:
+        raise RPCError(f"header with hash {hash} not found")
+    return {"header": enc.enc_header(meta.header)}
+
+
 def block(env, height=None) -> dict:
     h = _height_or_latest(env, height)
     blk = env.block_store.load_block(h)
@@ -626,7 +664,66 @@ def block_search(env, query=None, page=None, per_page=None, order_by=None) -> di
 
 
 def broadcast_evidence(env, evidence=None) -> dict:
-    raise RPCError("evidence broadcast over RPC not supported yet")
+    """Submit evidence (base64 of the canonical serialization) to the
+    pool — the light client's detector reports attacks through this
+    (rpc/core/evidence.go BroadcastEvidence)."""
+    import base64 as _b64
+
+    if not evidence:
+        raise RPCError("evidence is required", code=-32602)
+    if env.evidence_pool is None:
+        raise RPCError("this node has no evidence pool")
+    from ...types import serialization as ser
+
+    try:
+        ev = ser.loads(_b64.b64decode(evidence))
+    except Exception as e:
+        raise RPCError(f"undecodable evidence: {e}", code=-32602)
+    try:
+        env.evidence_pool.add_evidence(ev)
+    except Exception as e:
+        raise RPCError(f"evidence rejected: {e}")
+    return {"hash": ev.hash().hex().upper()}
+
+
+def unsafe_flush_mempool(env) -> dict:
+    """Drop every pending tx (rpc/core/mempool.go UnsafeFlushMempool;
+    registered only with unsafe routes enabled)."""
+    env.mempool.flush()
+    return {}
+
+
+def unsafe_dial_seeds(env, seeds=None) -> dict:
+    """Crawl the given seeds immediately (rpc/core/net.go UnsafeDialSeeds)."""
+    if not seeds:
+        raise RPCError("seeds are required", code=-32602)
+    if env.switch is None:
+        raise RPCError("p2p switch unavailable")
+    # best-effort book insert so PEX keeps the addresses, but the dial
+    # itself needs only the switch (net.go UnsafeDialSeeds works with
+    # PEX disabled)
+    pex = env.extra.get("pex_reactor")
+    book = getattr(pex, "book", None) if pex is not None else None
+    if book is not None:
+        for addr in seeds:
+            try:
+                book.add_address(addr, src="rpc")
+            except Exception:
+                pass
+    env.switch.dial_peers_async(list(seeds))
+    return {}
+
+
+def unsafe_dial_peers(env, peers=None, persistent=False) -> dict:
+    """Dial peers directly (rpc/core/net.go UnsafeDialPeers). The
+    ``persistent`` flag is accepted for API parity; persistence is
+    decided by the switch's configured persistent set."""
+    if not peers:
+        raise RPCError("peers are required", code=-32602)
+    if env.switch is None:
+        raise RPCError("p2p switch unavailable")
+    env.switch.dial_peers_async(list(peers))
+    return {}
 
 
 # ---------------------------------------------------------------------------
@@ -660,4 +757,14 @@ ROUTES = {
     "tx_search": tx_search,
     "block_search": block_search,
     "broadcast_evidence": broadcast_evidence,
+    "genesis_chunked": genesis_chunked,
+    "header_by_hash": header_by_hash,
+}
+
+# Operator-only routes, merged in when config.rpc.unsafe is set
+# (rpc/core/routes.go AddUnsafeRoutes).
+UNSAFE_ROUTES = {
+    "unsafe_flush_mempool": unsafe_flush_mempool,
+    "dial_seeds": unsafe_dial_seeds,
+    "dial_peers": unsafe_dial_peers,
 }

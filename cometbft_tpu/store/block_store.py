@@ -134,6 +134,12 @@ class BlockStore:
         raw = self.db.get(b"BH:" + block_hash)
         return self.load_block(int(raw)) if raw else None
 
+    def load_block_meta_by_hash(self, block_hash: bytes):
+        """Meta-only hash lookup: one small read via the BH: index —
+        header consumers must not pay the O(parts) full-block reassembly."""
+        raw = self.db.get(b"BH:" + block_hash)
+        return self.load_block_meta(int(raw)) if raw else None
+
     def load_block_commit(self, height: int) -> Commit | None:
         """The canonical commit FOR block ``height`` (from block height+1)."""
         raw = self.db.get(_h(b"C:", height))

@@ -64,6 +64,97 @@ class TestInfoRoutes:
     def test_health(self, client):
         assert client.health() == {}
 
+    def test_genesis_chunked(self, client, node):
+        res = client.call("genesis_chunked", chunk=0)
+        assert res["chunk"] == 0 and res["total"] >= 1
+        doc = base64.b64decode(res["data"])
+        assert node.genesis.chain_id.encode() in doc
+        with pytest.raises(RPCError):
+            client.call("genesis_chunked", chunk=res["total"])
+
+    def test_header_by_hash(self, client, node):
+        meta = node.block_store.load_block_meta(2)
+        res = client.call("header_by_hash", hash=meta.block_id.hash.hex())
+        assert int(res["header"]["height"]) == 2
+        with pytest.raises(RPCError):
+            client.call("header_by_hash", hash="ab" * 32)
+
+    def test_unsafe_routes_absent_by_default(self, client):
+        with pytest.raises(RPCError):
+            client.call("unsafe_flush_mempool")
+
+    def test_unsafe_routes_when_enabled(self, node):
+        from cometbft_tpu.rpc import RPCServer
+        from cometbft_tpu.rpc.core.routes import ROUTES, UNSAFE_ROUTES
+
+        server = RPCServer(
+            node.rpc_env,
+            "tcp://127.0.0.1:0",
+            routes={**ROUTES, **UNSAFE_ROUTES},
+        )
+        server.start()
+        try:
+            c = HTTPClient(server.bound_addr)
+            node.mempool.check_tx(b"flushme=1")
+            deadline = time.monotonic() + 5
+            while node.mempool.size() == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert node.mempool.size() > 0
+            assert c.call("unsafe_flush_mempool") == {}
+            assert node.mempool.size() == 0
+            with pytest.raises(RPCError):
+                c.call("dial_peers")  # peers required
+        finally:
+            server.stop()
+
+    def test_broadcast_evidence_roundtrip(self, client, node):
+        import time as _time
+
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types import serialization as ser
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.vote import Vote
+
+        # real equivocation by the (only) validator at a committed height
+        st = node.state_store.load()
+        vals = node.state_store.load_validators(2)
+        pv = node.consensus.priv_validator
+        addr = vals.validators[0].address
+
+        def mk(tag):
+            return Vote(
+                msg_type=canonical.PRECOMMIT_TYPE,
+                height=2,
+                round=0,
+                block_id=BlockID(
+                    tag * 32, PartSetHeader(total=1, hash=tag * 32)
+                ),
+                timestamp_ns=_time.time_ns(),
+                validator_address=addr,
+                validator_index=0,
+            )
+
+        v1, v2 = mk(b"\x31"), mk(b"\x32")
+        pv.sign_vote(node.genesis.chain_id, v1, sign_extension=False)
+        pv.sign_vote(node.genesis.chain_id, v2, sign_extension=False)
+        meta2 = node.block_store.load_block_meta(2)
+        ev = DuplicateVoteEvidence.from_conflicting_votes(
+            v1, v2, meta2.header.time_ns, vals
+        )
+        res = client.call(
+            "broadcast_evidence",
+            evidence=base64.b64encode(ser.dumps(ev)).decode(),
+        )
+        assert res["hash"] == ev.hash().hex().upper()
+        assert node.evidence_pool.is_pending(ev)
+        # garbage must be rejected cleanly
+        with pytest.raises(RPCError):
+            client.call(
+                "broadcast_evidence",
+                evidence=base64.b64encode(b"junk").decode(),
+            )
+
     def test_status(self, client, node):
         st = client.status()
         assert st["node_info"]["network"] == node.genesis.chain_id
