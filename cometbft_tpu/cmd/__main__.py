@@ -53,6 +53,19 @@ def _config(args, strict: bool = True):
     return cfg
 
 
+def _open_db(cfg, relpath: str):
+    """Offline tools must open the SAME backend the node wrote with:
+    running the pure-Python log reader over a native-engine file (or
+    vice versa) reads nothing — and compaction would then erase it."""
+    from ..libs import db as dbm
+
+    if cfg.base.db_backend == "native":
+        from ..libs.db_native import NativeDB
+
+        return NativeDB(cfg.base.resolve(relpath))
+    return dbm.FileDB(cfg.base.resolve(relpath))
+
+
 def cmd_version(args) -> int:
     from ..state.state import ABCI_SEMVER, BLOCK_PROTOCOL, SOFTWARE_VERSION
 
@@ -207,8 +220,8 @@ def cmd_rollback(args) -> int:
     from ..store import BlockStore
 
     cfg = _config(args, strict=False)
-    state_db = dbm.FileDB(cfg.base.resolve("data/state.db"))
-    block_db = dbm.FileDB(cfg.base.resolve("data/blockstore.db"))
+    state_db = _open_db(cfg, "data/state.db")
+    block_db = _open_db(cfg, "data/blockstore.db")
     try:
         state_store = StateStore(state_db)
         block_store = BlockStore(block_db)
@@ -238,9 +251,9 @@ def cmd_inspect(args) -> int:
     cfg = _config(args)
     with open(cfg.base.resolve(cfg.base.genesis_file)) as f:
         genesis = GenesisDoc.from_json(f.read())
-    state_db = dbm.FileDB(cfg.base.resolve("data/state.db"))
-    block_db = dbm.FileDB(cfg.base.resolve("data/blockstore.db"))
-    idx_db = dbm.FileDB(cfg.base.resolve("data/tx_index.db"))
+    state_db = _open_db(cfg, "data/state.db")
+    block_db = _open_db(cfg, "data/blockstore.db")
+    idx_db = _open_db(cfg, "data/tx_index.db")
     env = Environment(
         block_store=BlockStore(block_db),
         state_store=StateStore(state_db),
@@ -271,9 +284,9 @@ def cmd_reindex_events(args) -> int:
     from ..store import BlockStore
 
     cfg = _config(args, strict=False)  # offline repair tool
-    block_store = BlockStore(dbm.FileDB(cfg.base.resolve("data/blockstore.db")))
-    state_store = StateStore(dbm.FileDB(cfg.base.resolve("data/state.db")))
-    idx_db = dbm.FileDB(cfg.base.resolve("data/tx_index.db"))
+    block_store = BlockStore(_open_db(cfg, "data/blockstore.db"))
+    state_store = StateStore(_open_db(cfg, "data/state.db"))
+    idx_db = _open_db(cfg, "data/tx_index.db")
     tx_indexer = KVTxIndexer(idx_db)
     block_indexer = KVBlockIndexer(idx_db)
 
@@ -321,7 +334,7 @@ def cmd_compact_db(args) -> int:
             continue
         path = os.path.join(data_dir, name)
         before = os.path.getsize(path)
-        db = dbm.FileDB(path)
+        db = _open_db(cfg, f"data/{name}")
         db.compact()
         db.close()
         after = os.path.getsize(path)

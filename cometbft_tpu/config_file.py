@@ -115,7 +115,7 @@ def validate_basic(cfg: Config) -> None:
     b = cfg.base
     need(b.log_level in ("debug", "info", "error", "none"),
          f"base.log_level invalid: {b.log_level!r}")
-    need(b.db_backend in ("file", "mem"),
+    need(b.db_backend in ("file", "mem", "native"),
          f"base.db_backend invalid: {b.db_backend!r}")
     need(bool(b.proxy_app), "base.proxy_app must be set")
 

@@ -1,0 +1,233 @@
+"""NativeDB: the C++ storage engine behind the DB interface.
+
+The reference's production nodes run cgo storage backends (cleveldb /
+rocksdb via cometbft-db, config/config.go:256); this is that tier for
+the framework — cometbft_tpu/native/nkv.cpp compiled on first use with
+the baked-in g++ and driven through ctypes (pybind11 is not in the
+image). Same on-disk guarantees as libs/db.FileDB: CRC-framed append
+log, atomic batches (one framed record), torn-tail tolerance,
+live-set compaction.
+
+Select with ``db_backend = "native"``; construction raises if the
+toolchain or compile is unavailable, and node assembly falls back to
+the pure-Python FileDB with a logged warning.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+from .db import DB, prefix_end  # noqa: F401  (prefix_end re-export parity)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "nkv.cpp"))
+_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_nkv.so"))
+
+_build_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build() -> str:
+    """Compile nkv.cpp -> _nkv.so once (rebuild when the source is newer)."""
+    with _build_lock:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(
+            _SRC
+        ):
+            return _SO
+        cmd = [
+            "g++",
+            "-O2",
+            "-shared",
+            "-fPIC",
+            "-std=c++17",
+            _SRC,
+            "-o",
+            _SO + ".tmp",
+        ]
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise NativeBuildError(f"g++ unavailable: {e!r}")
+        if r.returncode != 0:
+            raise NativeBuildError(f"nkv.cpp compile failed:\n{r.stderr}")
+        os.replace(_SO + ".tmp", _SO)
+        return _SO
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_build())
+    c_ubyte_p = ctypes.POINTER(ctypes.c_ubyte)
+    lib.nkv_open.restype = ctypes.c_void_p
+    lib.nkv_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.nkv_get.restype = ctypes.c_int
+    lib.nkv_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(c_ubyte_p), ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.nkv_set.restype = ctypes.c_int
+    lib.nkv_set.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+    ]
+    lib.nkv_delete.restype = ctypes.c_int
+    lib.nkv_delete.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int
+    ]
+    lib.nkv_batch.restype = ctypes.c_int
+    lib.nkv_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int
+    ]
+    lib.nkv_range.restype = ctypes.c_int
+    lib.nkv_range.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.POINTER(c_ubyte_p), ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.nkv_free.argtypes = [c_ubyte_p]
+    lib.nkv_compact.restype = ctypes.c_int
+    lib.nkv_compact.argtypes = [ctypes.c_void_p]
+    lib.nkv_count.restype = ctypes.c_size_t
+    lib.nkv_count.argtypes = [ctypes.c_void_p]
+    lib.nkv_sync.restype = ctypes.c_int
+    lib.nkv_sync.argtypes = [ctypes.c_void_p]
+    lib.nkv_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeDB(DB):
+    """C++-backed durable KV store (DB-interface conformant)."""
+
+    def __init__(self, path: str, compact_factor: int = 4):
+        self._lib = _load()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._h = self._lib.nkv_open(path.encode(), compact_factor)
+        if not self._h:
+            raise NativeBuildError(f"nkv_open failed for {path!r}")
+        self._mtx = threading.RLock()
+        self._closed = False
+
+    # -- point ops ----------------------------------------------------------
+
+    def get(self, key: bytes) -> bytes | None:
+        key = bytes(key)
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        n = ctypes.c_size_t()
+        with self._mtx:
+            rc = self._lib.nkv_get(
+                self._h, key, len(key), ctypes.byref(out), ctypes.byref(n)
+            )
+            if rc != 0:
+                return None
+            try:
+                return ctypes.string_at(out, n.value)
+            finally:
+                self._lib.nkv_free(out)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._set(key, value, sync=0)
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self._set(key, value, sync=1)
+
+    def _set(self, key: bytes, value: bytes, sync: int) -> None:
+        key, value = bytes(key), bytes(value)
+        with self._mtx:
+            if self._lib.nkv_set(
+                self._h, key, len(key), value, len(value), sync
+            ):
+                raise OSError("native set failed")
+
+    def delete(self, key: bytes) -> None:
+        self._delete(key, 0)
+
+    def delete_sync(self, key: bytes) -> None:
+        self._delete(key, 1)
+
+    def _delete(self, key: bytes, sync: int) -> None:
+        key = bytes(key)
+        with self._mtx:
+            if self._lib.nkv_delete(self._h, key, len(key), sync):
+                raise OSError("native delete failed")
+
+    # -- batches ------------------------------------------------------------
+
+    def apply_batch(self, ops) -> None:
+        blob = bytearray()
+        for is_set, k, v in ops:
+            k, v = bytes(k), bytes(v)
+            blob.append(1 if is_set else 2)
+            blob += struct.pack("<II", len(k), len(v) if is_set else 0)
+            blob += k
+            if is_set:
+                blob += v
+        blob = bytes(blob)
+        with self._mtx:
+            if self._lib.nkv_batch(self._h, blob, len(blob), 1):
+                raise OSError("native batch failed")
+
+    # -- iteration ----------------------------------------------------------
+
+    def _range(self, start, end, rev: int):
+        s = bytes(start) if start is not None else None
+        e = bytes(end) if end is not None else None
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        n = ctypes.c_size_t()
+        with self._mtx:
+            rc = self._lib.nkv_range(
+                self._h,
+                s, len(s) if s is not None else 0,
+                e, len(e) if e is not None else 0,
+                rev, ctypes.byref(out), ctypes.byref(n),
+            )
+            if rc != 0:
+                raise OSError("native range failed")
+            try:
+                buf = ctypes.string_at(out, n.value)
+            finally:
+                self._lib.nkv_free(out)
+        pos = 0
+        items = []
+        while pos < len(buf):
+            (klen,) = struct.unpack_from("<I", buf, pos)
+            k = buf[pos + 4 : pos + 4 + klen]
+            pos += 4 + klen
+            (vlen,) = struct.unpack_from("<I", buf, pos)
+            v = buf[pos + 4 : pos + 4 + vlen]
+            pos += 4 + vlen
+            items.append((k, v))
+        return items
+
+    def iterator(self, start=None, end=None):
+        yield from self._range(start, end, 0)
+
+    def reverse_iterator(self, start=None, end=None):
+        yield from self._range(start, end, 1)
+
+    # -- maintenance ---------------------------------------------------------
+
+    def compact(self) -> None:
+        with self._mtx:
+            if self._lib.nkv_compact(self._h):
+                raise OSError("native compact failed")
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return int(self._lib.nkv_count(self._h))
+
+    def close(self) -> None:
+        with self._mtx:
+            if not self._closed:
+                self._closed = True
+                self._lib.nkv_close(self._h)

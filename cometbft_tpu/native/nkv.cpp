@@ -1,0 +1,299 @@
+// Native log-structured KV storage engine (the framework's analog of the
+// reference's cgo storage backends — cleveldb/rocksdb slots in
+// cometbft-db, config/config.go:256). Same semantics as libs/db.py's
+// FileDB: ordered index, append-only CRC-framed log, atomic batches,
+// torn-tail tolerance, live-set compaction — implemented in C++ for the
+// node's disk hot path and exposed through a minimal C ABI consumed via
+// ctypes (no pybind11 in the image).
+//
+// Record framing: [u8 op][u32 klen][u32 vlen][key][value][u32 crc]
+//   op: 1=SET 2=DEL 3=BATCH (value = concatenated sub-records, no crc)
+//   crc: CRC32 over op|klen|vlen|key|value
+// A torn/corrupt tail record terminates replay (crash mid-append loses
+// at most the final record; a BATCH is one record, hence atomic).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <map>
+#include <string>
+#include <vector>
+
+#include <unistd.h>  // fsync, ftruncate, fileno
+
+extern "C" {
+
+struct NKV;
+
+}  // extern "C"
+
+namespace {
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+    if (crc_init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_table[i] = c;
+    }
+    crc_init_done = true;
+}
+
+uint32_t crc32(uint32_t crc, const uint8_t* buf, size_t len) {
+    crc = crc ^ 0xFFFFFFFFu;
+    for (size_t i = 0; i < len; i++)
+        crc = crc_table[(crc ^ buf[i]) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+void put_u32(std::string& out, uint32_t v) {
+    out.push_back((char)(v & 0xFF));
+    out.push_back((char)((v >> 8) & 0xFF));
+    out.push_back((char)((v >> 16) & 0xFF));
+    out.push_back((char)((v >> 24) & 0xFF));
+}
+
+uint32_t get_u32(const uint8_t* p) {
+    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+}
+
+std::string frame(uint8_t op, const std::string& k, const std::string& v,
+                  bool with_crc) {
+    std::string rec;
+    rec.push_back((char)op);
+    put_u32(rec, (uint32_t)k.size());
+    put_u32(rec, (uint32_t)v.size());
+    rec += k;
+    rec += v;
+    if (with_crc) {
+        uint32_t c = crc32(0, (const uint8_t*)rec.data(), rec.size());
+        put_u32(rec, c);
+    }
+    return rec;
+}
+
+// Parse one record at buf[pos..len). Returns false on truncation/corruption.
+bool parse_record(const uint8_t* buf, size_t len, size_t& pos, bool with_crc,
+                  uint8_t& op, std::string& k, std::string& v) {
+    if (pos + 9 > len) return false;
+    op = buf[pos];
+    uint32_t klen = get_u32(buf + pos + 1);
+    uint32_t vlen = get_u32(buf + pos + 5);
+    size_t body = 9 + (size_t)klen + vlen;
+    size_t total = body + (with_crc ? 4 : 0);
+    if (pos + total > len) return false;
+    if (with_crc) {
+        uint32_t want = get_u32(buf + pos + body);
+        uint32_t got = crc32(0, buf + pos, body);
+        if (want != got) return false;
+    }
+    k.assign((const char*)buf + pos + 9, klen);
+    v.assign((const char*)buf + pos + 9 + klen, vlen);
+    pos += total;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+struct NKV {
+    std::string path;
+    std::map<std::string, std::string> data;
+    FILE* log = nullptr;
+    size_t records = 0;       // total records appended since open/compact
+    int compact_factor = 4;   // compact when records > factor * live
+};
+
+static void nkv_apply(NKV* h, uint8_t op, const std::string& k,
+                      const std::string& v) {
+    if (op == 1) {
+        h->data[k] = v;
+    } else if (op == 2) {
+        h->data.erase(k);
+    } else if (op == 3) {
+        size_t pos = 0;
+        const uint8_t* buf = (const uint8_t*)v.data();
+        uint8_t sop;
+        std::string sk, sv;
+        while (pos < v.size() &&
+               parse_record(buf, v.size(), pos, /*crc=*/false, sop, sk, sv))
+            nkv_apply(h, sop, sk, sv);
+    }
+}
+
+NKV* nkv_open(const char* path, int compact_factor) {
+    crc_init();
+    NKV* h = new NKV();
+    h->path = path;
+    h->compact_factor = compact_factor > 0 ? compact_factor : 4;
+    // replay existing log
+    FILE* f = fopen(path, "rb");
+    if (f) {
+        fseek(f, 0, SEEK_END);
+        long sz = ftell(f);
+        fseek(f, 0, SEEK_SET);
+        std::vector<uint8_t> buf((size_t)(sz > 0 ? sz : 0));
+        if (sz > 0 && fread(buf.data(), 1, (size_t)sz, f) != (size_t)sz) {
+            fclose(f);
+            delete h;
+            return nullptr;
+        }
+        fclose(f);
+        size_t pos = 0;
+        uint8_t op;
+        std::string k, v;
+        while (pos < buf.size() &&
+               parse_record(buf.data(), buf.size(), pos, true, op, k, v)) {
+            nkv_apply(h, op, k, v);
+            h->records++;
+        }
+        // truncate any torn tail so future appends start clean
+        if (pos < buf.size()) {
+            FILE* t = fopen(path, "rb+");
+            if (t) {
+                if (ftruncate(fileno(t), (off_t)pos) != 0) { /* best effort */ }
+                fclose(t);
+            }
+        }
+    }
+    h->log = fopen(path, "ab");
+    if (!h->log) {
+        delete h;
+        return nullptr;
+    }
+    return h;
+}
+
+static int nkv_append(NKV* h, uint8_t op, const std::string& k,
+                      const std::string& v, int sync) {
+    std::string rec = frame(op, k, v, true);
+    if (fwrite(rec.data(), 1, rec.size(), h->log) != rec.size()) return -1;
+    if (fflush(h->log) != 0) return -1;
+    if (sync && fsync(fileno(h->log)) != 0) return -1;
+    h->records++;
+    return 0;
+}
+
+static void nkv_maybe_compact(NKV* h);
+
+int nkv_set(NKV* h, const uint8_t* k, size_t klen, const uint8_t* v,
+            size_t vlen, int sync) {
+    std::string key((const char*)k, klen), val((const char*)v, vlen);
+    if (nkv_append(h, 1, key, val, sync) != 0) return -1;
+    h->data[key] = val;
+    nkv_maybe_compact(h);
+    return 0;
+}
+
+int nkv_delete(NKV* h, const uint8_t* k, size_t klen, int sync) {
+    std::string key((const char*)k, klen);
+    if (h->data.find(key) == h->data.end()) return 0;
+    if (nkv_append(h, 2, key, "", sync) != 0) return -1;
+    h->data.erase(key);
+    nkv_maybe_compact(h);
+    return 0;
+}
+
+int nkv_get(NKV* h, const uint8_t* k, size_t klen, uint8_t** out,
+            size_t* outlen) {
+    auto it = h->data.find(std::string((const char*)k, klen));
+    if (it == h->data.end()) return 1;  // not found
+    *out = (uint8_t*)malloc(it->second.size());
+    memcpy(*out, it->second.data(), it->second.size());
+    *outlen = it->second.size();
+    return 0;
+}
+
+// ops buffer: concatenated crc-less records (op|klen|vlen|key|value)*
+int nkv_batch(NKV* h, const uint8_t* ops, size_t len, int sync) {
+    std::string blob((const char*)ops, len);
+    if (nkv_append(h, 3, "", blob, sync) != 0) return -1;
+    nkv_apply(h, 3, "", blob);
+    nkv_maybe_compact(h);
+    return 0;
+}
+
+// Range [start, end) in order (rev=1: reversed). NULL start/end = open.
+// Returns a malloc'd buffer of (u32 klen|key|u32 vlen|value)*.
+int nkv_range(NKV* h, const uint8_t* start, size_t slen, const uint8_t* end,
+              size_t elen, int rev, uint8_t** out, size_t* outlen) {
+    auto lo = start ? h->data.lower_bound(std::string((const char*)start, slen))
+                    : h->data.begin();
+    auto hi = end ? h->data.lower_bound(std::string((const char*)end, elen))
+                  : h->data.end();
+    std::string buf;
+    if (!rev) {
+        for (auto it = lo; it != hi; ++it) {
+            put_u32(buf, (uint32_t)it->first.size());
+            buf += it->first;
+            put_u32(buf, (uint32_t)it->second.size());
+            buf += it->second;
+        }
+    } else {
+        for (auto it = hi; it != lo;) {
+            --it;
+            put_u32(buf, (uint32_t)it->first.size());
+            buf += it->first;
+            put_u32(buf, (uint32_t)it->second.size());
+            buf += it->second;
+        }
+    }
+    *out = (uint8_t*)malloc(buf.size() ? buf.size() : 1);
+    memcpy(*out, buf.data(), buf.size());
+    *outlen = buf.size();
+    return 0;
+}
+
+void nkv_free(uint8_t* p) { free(p); }
+
+int nkv_compact(NKV* h) {
+    std::string tmp = h->path + ".compact";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    for (auto& kv : h->data) {
+        std::string rec = frame(1, kv.first, kv.second, true);
+        if (fwrite(rec.data(), 1, rec.size(), f) != rec.size()) {
+            fclose(f);
+            remove(tmp.c_str());
+            return -1;
+        }
+    }
+    if (fflush(f) != 0 || fsync(fileno(f)) != 0) {
+        fclose(f);
+        remove(tmp.c_str());
+        return -1;
+    }
+    fclose(f);
+    fclose(h->log);
+    if (rename(tmp.c_str(), h->path.c_str()) != 0) {
+        h->log = fopen(h->path.c_str(), "ab");
+        return -1;
+    }
+    h->log = fopen(h->path.c_str(), "ab");
+    h->records = h->data.size();
+    return h->log ? 0 : -1;
+}
+
+static void nkv_maybe_compact(NKV* h) {
+    if (h->records > 64 &&
+        h->records > (size_t)h->compact_factor * (h->data.size() + 1))
+        nkv_compact(h);
+}
+
+size_t nkv_count(NKV* h) { return h->data.size(); }
+
+int nkv_sync(NKV* h) { return fsync(fileno(h->log)) == 0 ? 0 : -1; }
+
+void nkv_close(NKV* h) {
+    if (h->log) fclose(h->log);
+    delete h;
+}
+
+}  // extern "C"

@@ -85,7 +85,18 @@ def _make_db(config: Config, name: str) -> dbm.DB:
     if config.base.db_backend == "mem":
         return dbm.MemDB()
     data_dir = config.base.resolve("data")
-    return dbm.FileDB(os.path.join(data_dir, f"{name}.db"))
+    path = os.path.join(data_dir, f"{name}.db")
+    if config.base.db_backend == "native":
+        # C++ engine (the cgo-backend tier of cometbft-db). An unusable
+        # backend is FATAL, not a fallback: silently writing FileDB
+        # format under a db_backend=native config would poison every
+        # offline tool that later trusts the config (compacting a
+        # foreign-format file erases it). Reference behavior: the node
+        # refuses to start when the configured backend can't open.
+        from ..libs.db_native import NativeDB
+
+        return NativeDB(path)
+    return dbm.FileDB(path)
 
 
 def _app_client_creator(config: Config, app_db: dbm.DB):

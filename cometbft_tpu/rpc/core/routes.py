@@ -169,7 +169,7 @@ def genesis_chunked(env, chunk=None) -> dict:
         doc = env.genesis.to_json().encode()
         env.extra["_genesis_encoded"] = doc
     total = max(1, (len(doc) + GENESIS_CHUNK_SIZE - 1) // GENESIS_CHUNK_SIZE)
-    idx = int(chunk or 0)
+    idx = _int(chunk, "chunk", 0) or 0
     if not 0 <= idx < total:
         raise RPCError(
             f"chunk {idx} out of range (0..{total - 1})", code=-32602
@@ -695,8 +695,8 @@ def unsafe_flush_mempool(env) -> dict:
 
 def unsafe_dial_seeds(env, seeds=None) -> dict:
     """Crawl the given seeds immediately (rpc/core/net.go UnsafeDialSeeds)."""
-    if not seeds:
-        raise RPCError("seeds are required", code=-32602)
+    if not seeds or not isinstance(seeds, (list, tuple)):
+        raise RPCError("seeds must be a non-empty list", code=-32602)
     if env.switch is None:
         raise RPCError("p2p switch unavailable")
     # best-effort book insert so PEX keeps the addresses, but the dial
@@ -718,8 +718,8 @@ def unsafe_dial_peers(env, peers=None, persistent=False) -> dict:
     """Dial peers directly (rpc/core/net.go UnsafeDialPeers). The
     ``persistent`` flag is accepted for API parity; persistence is
     decided by the switch's configured persistent set."""
-    if not peers:
-        raise RPCError("peers are required", code=-32602)
+    if not peers or not isinstance(peers, (list, tuple)):
+        raise RPCError("peers must be a non-empty list", code=-32602)
     if env.switch is None:
         raise RPCError("p2p switch unavailable")
     env.switch.dial_peers_async(list(peers))
