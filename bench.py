@@ -261,6 +261,91 @@ def bench_mixed(n: int):
     return n / dt, dt
 
 
+def bench_wal_decode():
+    """WAL encode/decode round trip (consensus/wal_test.go:264-283)."""
+    import tempfile
+
+    from cometbft_tpu.consensus.messages import VoteMessage
+    from cometbft_tpu.consensus.wal import WAL, MsgInfo
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import BlockID
+    from cometbft_tpu.types.vote import Vote
+
+    n = 2000
+    path = tempfile.mktemp(suffix="wal")
+    wal = WAL(path)
+    vote = Vote(
+        msg_type=canonical.PREVOTE_TYPE, height=1, round=0,
+        block_id=BlockID(), timestamp_ns=1, validator_address=b"\x01" * 20,
+        validator_index=0, signature=b"\x02" * 64,
+    )
+    t0 = time.perf_counter()
+    for _ in range(n):
+        wal.write(MsgInfo(VoteMessage(vote), "p"))
+    wal.flush_and_sync()
+    t_write = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    count = sum(1 for m in wal.iter_messages() if isinstance(m, MsgInfo))
+    t_read = time.perf_counter() - t0
+    wal.close()
+    assert count == n, count
+    return {
+        "writes_per_sec": round(n / t_write, 1),
+        "decodes_per_sec": round(n / t_read, 1),
+    }
+
+
+def bench_mempool():
+    """CheckTx ingest + reap (mempool/bench_test.go:20-109)."""
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import MempoolConfig
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    try:
+        mp = CListMempool(MempoolConfig(size=20000), client)
+        n = 5000
+        t0 = time.perf_counter()
+        for i in range(n):
+            mp.check_tx(b"bench-%d=%d" % (i, i))
+        t_check = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        txs = mp.reap_max_bytes_max_gas(1 << 30, -1)
+        t_reap = time.perf_counter() - t0
+        return {
+            "check_tx_per_sec": round(n / t_check, 1),
+            "reap_txs": len(txs),
+            "reap_ms": round(t_reap * 1e3, 2),
+        }
+    finally:
+        client.stop()
+
+
+def bench_valset_update():
+    """Incremental validator-set updates (types/validator_set_test.go:1550)."""
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    n = 150
+    vals = ValidatorSet(
+        [
+            Validator(
+                Ed25519PrivKey.from_seed(i.to_bytes(32, "big")).pub_key(),
+                voting_power=10,
+            )
+            for i in range(1, n + 1)
+        ]
+    )
+    reps = 200
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        vals = vals.copy_increment_proposer_priority(1)
+    dt = time.perf_counter() - t0
+    return {"priority_increments_per_sec": round(reps / dt, 1)}
+
+
 def _probe_device(timeout_s: float = 240.0) -> bool:
     """Device liveness probe in a killable subprocess.
 
@@ -382,6 +467,16 @@ def main() -> None:
             "vs_batch_baseline": round(tput / batch_baseline, 2),
         }
     )
+
+    for name, fn in (
+        ("6_wal_decode", bench_wal_decode),
+        ("7_mempool", bench_mempool),
+        ("8_valset_update", bench_valset_update),
+    ):
+        try:
+            _eprint({"config": name, **fn()})
+        except Exception as e:  # micro extras must never sink the bench
+            _eprint({"config": name, "error": repr(e)[:200]})
 
     # Headline: 4096-lane flat ed25519 batch (round-1-comparable metric).
     tput, dt = bench_flat_batch(4096)

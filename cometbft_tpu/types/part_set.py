@@ -65,7 +65,14 @@ class PartSet:
             return False
         if part.proof.index != part.index or part.proof.total != self.header.total:
             raise PartSetError("part proof index/total mismatch")
-        part.proof.verify(self.header.hash, part.bytes_)
+        try:
+            part.proof.verify(self.header.hash, part.bytes_)
+        except ValueError as e:
+            # a bad proof is a protocol-level rejection, not an internal
+            # error: callers catch PartSetError to drop bad peer parts
+            # (consensus addProposalBlockPart; a cross-round or byzantine
+            # part must not escape that guard)
+            raise PartSetError(f"invalid part proof: {e}")
         self.parts[part.index] = part
         self.parts_bit_array.set_index(part.index, True)
         self.count += 1
