@@ -1,0 +1,267 @@
+"""Legacy AEAD helpers + ASCII armor (reference: crypto/xchacha20poly1305,
+crypto/xsalsa20symmetric, crypto/armor — used for encrypted key files and
+armored key export, NOT on any consensus path).
+
+XChaCha20-Poly1305: HChaCha20 subkey derivation (pure-Python ChaCha
+core — the 24-byte-nonce variant isn't in the `cryptography` wheel) over
+the wheel's IETF ChaCha20-Poly1305.
+
+XSalsa20: pure-Python Salsa20 core with the classic HSalsa20 key setup
+(NaCl secretbox's stream layer); `xsalsa20symmetric` matches the
+reference's `EncryptSymmetric`/`DecryptSymmetric` shape — secretbox-like
+framing with the MAC provided by Poly1305 in NaCl, here by sha256 MAC
+over ciphertext like the reference's legacy scheme is NOT reproduced;
+instead we provide the modern authenticated construction the reference
+migrated toward (xchacha) and keep xsalsa20 as the raw stream cipher the
+legacy decoder needs.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# ------------------------------------------------------------- chacha core
+
+
+def _rotl(v: int, n: int) -> int:
+    v &= 0xFFFFFFFF
+    return ((v << n) | (v >> (32 - n))) & 0xFFFFFFFF
+
+
+def _chacha_quarter(s, a, b, c, d):
+    s[a] = (s[a] + s[b]) & 0xFFFFFFFF
+    s[d] = _rotl(s[d] ^ s[a], 16)
+    s[c] = (s[c] + s[d]) & 0xFFFFFFFF
+    s[b] = _rotl(s[b] ^ s[c], 12)
+    s[a] = (s[a] + s[b]) & 0xFFFFFFFF
+    s[d] = _rotl(s[d] ^ s[a], 8)
+    s[c] = (s[c] + s[d]) & 0xFFFFFFFF
+    s[b] = _rotl(s[b] ^ s[c], 7)
+
+
+_CHACHA_CONST = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def _chacha_rounds(state: list[int]) -> list[int]:
+    s = list(state)
+    for _ in range(10):  # 20 rounds = 10 double rounds
+        _chacha_quarter(s, 0, 4, 8, 12)
+        _chacha_quarter(s, 1, 5, 9, 13)
+        _chacha_quarter(s, 2, 6, 10, 14)
+        _chacha_quarter(s, 3, 7, 11, 15)
+        _chacha_quarter(s, 0, 5, 10, 15)
+        _chacha_quarter(s, 1, 6, 11, 12)
+        _chacha_quarter(s, 2, 7, 8, 13)
+        _chacha_quarter(s, 3, 4, 9, 14)
+    return s
+
+
+def hchacha20(key: bytes, nonce16: bytes) -> bytes:
+    """HChaCha20 subkey derivation (draft-irtf-cfrg-xchacha §2.2)."""
+    if len(key) != 32 or len(nonce16) != 16:
+        raise ValueError("hchacha20 needs 32-byte key, 16-byte nonce")
+    state = list(_CHACHA_CONST)
+    state += list(struct.unpack("<8L", key))
+    state += list(struct.unpack("<4L", nonce16))
+    s = _chacha_rounds(state)
+    out = s[0:4] + s[12:16]
+    return struct.pack("<8L", *out)
+
+
+def xchacha20poly1305_encrypt(
+    key: bytes, nonce24: bytes, plaintext: bytes, aad: bytes = b""
+) -> bytes:
+    """XChaCha20-Poly1305 seal (crypto/xchacha20poly1305 semantics)."""
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    if len(nonce24) != 24:
+        raise ValueError("xchacha nonce must be 24 bytes")
+    subkey = hchacha20(key, nonce24[:16])
+    iv = b"\x00" * 4 + nonce24[16:]
+    return ChaCha20Poly1305(subkey).encrypt(iv, plaintext, aad)
+
+
+def xchacha20poly1305_decrypt(
+    key: bytes, nonce24: bytes, ciphertext: bytes, aad: bytes = b""
+) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+    if len(nonce24) != 24:
+        raise ValueError("xchacha nonce must be 24 bytes")
+    subkey = hchacha20(key, nonce24[:16])
+    iv = b"\x00" * 4 + nonce24[16:]
+    return ChaCha20Poly1305(subkey).decrypt(iv, ciphertext, aad)
+
+
+# ------------------------------------------------------------- salsa core
+
+
+def _salsa_quarter(s, a, b, c, d):
+    s[b] ^= _rotl((s[a] + s[d]) & 0xFFFFFFFF, 7)
+    s[c] ^= _rotl((s[b] + s[a]) & 0xFFFFFFFF, 9)
+    s[d] ^= _rotl((s[c] + s[b]) & 0xFFFFFFFF, 13)
+    s[a] ^= _rotl((s[d] + s[c]) & 0xFFFFFFFF, 18)
+
+
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+
+
+def _salsa20_block(key32: bytes, nonce8: bytes, counter: int) -> bytes:
+    k = struct.unpack("<8L", key32)
+    n = struct.unpack("<2L", nonce8)
+    state = [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        counter & 0xFFFFFFFF, (counter >> 32) & 0xFFFFFFFF,
+        _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+    s = list(state)
+    for _ in range(10):
+        # column rounds
+        _salsa_quarter(s, 0, 4, 8, 12)
+        _salsa_quarter(s, 5, 9, 13, 1)
+        _salsa_quarter(s, 10, 14, 2, 6)
+        _salsa_quarter(s, 15, 3, 7, 11)
+        # row rounds
+        _salsa_quarter(s, 0, 1, 2, 3)
+        _salsa_quarter(s, 5, 6, 7, 4)
+        _salsa_quarter(s, 10, 11, 8, 9)
+        _salsa_quarter(s, 15, 12, 13, 14)
+    out = [(s[i] + state[i]) & 0xFFFFFFFF for i in range(16)]
+    return struct.pack("<16L", *out)
+
+
+def hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    """HSalsa20 (NaCl's XSalsa20 key setup)."""
+    k = struct.unpack("<8L", key)
+    n = struct.unpack("<4L", nonce16)
+    s = [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        n[2], n[3], _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+    z = list(s)
+    for _ in range(10):
+        _salsa_quarter(z, 0, 4, 8, 12)
+        _salsa_quarter(z, 5, 9, 13, 1)
+        _salsa_quarter(z, 10, 14, 2, 6)
+        _salsa_quarter(z, 15, 3, 7, 11)
+        _salsa_quarter(z, 0, 1, 2, 3)
+        _salsa_quarter(z, 5, 6, 7, 4)
+        _salsa_quarter(z, 10, 11, 8, 9)
+        _salsa_quarter(z, 15, 12, 13, 14)
+    out = [z[0], z[5], z[10], z[15], z[6], z[7], z[8], z[9]]
+    return struct.pack("<8L", *out)
+
+
+def xsalsa20_stream_xor(key: bytes, nonce24: bytes, data: bytes) -> bytes:
+    """XSalsa20 stream XOR (crypto/xsalsa20symmetric's cipher layer)."""
+    if len(key) != 32 or len(nonce24) != 24:
+        raise ValueError("xsalsa20 needs 32-byte key, 24-byte nonce")
+    subkey = hsalsa20(key, nonce24[:16])
+    out = bytearray()
+    counter = 0
+    for i in range(0, len(data), 64):
+        block = _salsa20_block(subkey, nonce24[16:], counter)
+        chunk = data[i : i + 64]
+        out += bytes(a ^ b for a, b in zip(chunk, block))
+        counter += 1
+    return bytes(out)
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """Authenticated symmetric encryption for key files
+    (crypto/xsalsa20symmetric EncryptSymmetric's role, modern AEAD):
+    random 24-byte nonce || XChaCha20-Poly1305 box."""
+    import os
+
+    if len(secret) != 32:
+        raise ValueError("secret must be 32 bytes (use a KDF)")
+    nonce = os.urandom(24)
+    return nonce + xchacha20poly1305_encrypt(secret, nonce, plaintext)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    if len(secret) != 32:
+        raise ValueError("secret must be 32 bytes (use a KDF)")
+    if len(ciphertext) < 24 + 16:
+        raise ValueError("ciphertext too short")
+    return xchacha20poly1305_decrypt(
+        secret, ciphertext[:24], ciphertext[24:]
+    )
+
+
+# ---------------------------------------------------------------- armor
+
+
+_ARMOR_HEAD = "-----BEGIN {}-----"
+_ARMOR_TAIL = "-----END {}-----"
+
+
+def _crc24(data: bytes) -> int:
+    """OpenPGP CRC-24 (RFC 4880 §6.1)."""
+    crc = 0xB704CE
+    for b in data:
+        crc ^= b << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= 0x1864CFB
+    return crc & 0xFFFFFF
+
+
+def armor_encode(
+    data: bytes, block_type: str, headers: dict[str, str] | None = None
+) -> str:
+    """ASCII armor (crypto/armor.EncodeArmor; OpenPGP-style framing)."""
+    import base64
+    import textwrap
+
+    lines = [_ARMOR_HEAD.format(block_type)]
+    for k, v in (headers or {}).items():
+        lines.append(f"{k}: {v}")
+    lines.append("")
+    body = base64.b64encode(data).decode()
+    lines.extend(textwrap.wrap(body, 64))
+    crc = base64.b64encode(struct.pack(">I", _crc24(data))[1:]).decode()
+    lines.append("=" + crc)
+    lines.append(_ARMOR_TAIL.format(block_type))
+    return "\n".join(lines) + "\n"
+
+
+def armor_decode(text: str) -> tuple[str, dict[str, str], bytes]:
+    """-> (block_type, headers, data); raises ValueError on bad framing/CRC."""
+    import base64
+
+    lines = [ln.strip() for ln in text.strip().splitlines()]
+    if not lines or not lines[0].startswith("-----BEGIN "):
+        raise ValueError("missing armor header")
+    block_type = lines[0][len("-----BEGIN ") : -5]
+    if lines[-1] != _ARMOR_TAIL.format(block_type):
+        raise ValueError("missing/mismatched armor tail")
+    headers: dict[str, str] = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i]:
+        if ":" not in lines[i]:
+            break
+        k, _, v = lines[i].partition(":")
+        headers[k.strip()] = v.strip()
+        i += 1
+    if i < len(lines) and not lines[i]:
+        i += 1
+    body_lines = []
+    crc_line = None
+    for ln in lines[i:-1]:
+        if ln.startswith("="):
+            crc_line = ln[1:]
+        elif ln:
+            body_lines.append(ln)
+    data = base64.b64decode("".join(body_lines))
+    if crc_line is not None:
+        want = base64.b64decode(crc_line)
+        got = struct.pack(">I", _crc24(data))[1:]
+        if want != got:
+            raise ValueError("armor CRC mismatch")
+    return block_type, headers, data

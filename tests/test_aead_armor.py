@@ -1,0 +1,92 @@
+"""AEAD helpers + ASCII armor tests (reference: crypto/xchacha20poly1305,
+crypto/xsalsa20symmetric, crypto/armor). Vectors from
+draft-irtf-cfrg-xchacha and the NaCl/Salsa20 spec pin the cores.
+"""
+
+import pytest
+
+from cometbft_tpu.crypto import aead
+
+
+def test_hchacha20_rfc_vector():
+    # draft-irtf-cfrg-xchacha §2.2.1 test vector
+    key = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f"
+        "101112131415161718191a1b1c1d1e1f"
+    )
+    nonce = bytes.fromhex("000000090000004a0000000031415927")
+    want = bytes.fromhex(
+        "82413b4227b27bfed30e42508a877d73"
+        "a0f9e4d58a74a853c12ec41326d3ecdc"
+    )
+    assert aead.hchacha20(key, nonce) == want
+
+
+def test_xchacha20poly1305_roundtrip_and_tamper():
+    key = bytes(range(32))
+    nonce = bytes(range(24))
+    msg = b"the privval key file body"
+    aad = b"v1"
+    ct = aead.xchacha20poly1305_encrypt(key, nonce, msg, aad)
+    assert aead.xchacha20poly1305_decrypt(key, nonce, ct, aad) == msg
+    bad = ct[:-1] + bytes([ct[-1] ^ 1])
+    with pytest.raises(Exception):
+        aead.xchacha20poly1305_decrypt(key, nonce, bad, aad)
+    with pytest.raises(Exception):
+        aead.xchacha20poly1305_decrypt(key, nonce, ct, b"v2")
+
+
+def test_xchacha_draft_vector():
+    # draft-irtf-cfrg-xchacha A.3 (plaintext/ciphertext excerpt check)
+    key = bytes.fromhex(
+        "808182838485868788898a8b8c8d8e8f"
+        "909192939495969798999a9b9c9d9e9f"
+    )
+    nonce = bytes.fromhex("404142434445464748494a4b4c4d4e4f5051525354555657")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    ct = aead.xchacha20poly1305_encrypt(key, nonce, pt, aad)
+    assert ct[:16].hex() == "bd6d179d3e83d43b9576579493c0e939"
+    assert aead.xchacha20poly1305_decrypt(key, nonce, ct, aad) == pt
+
+
+def test_xsalsa20_stream_properties():
+    key = b"\x07" * 32
+    nonce = b"\x0a" * 24
+    msg = b"x" * 150
+    ct = aead.xsalsa20_stream_xor(key, nonce, msg)
+    assert ct != msg and len(ct) == len(msg)
+    # XOR stream: applying twice restores
+    assert aead.xsalsa20_stream_xor(key, nonce, ct) == msg
+    # nonce sensitivity
+    assert aead.xsalsa20_stream_xor(key, b"\x0b" * 24, msg) != ct
+
+
+def test_encrypt_symmetric_roundtrip():
+    secret = b"\x42" * 32
+    ct = aead.encrypt_symmetric(b"secret key material", secret)
+    assert aead.decrypt_symmetric(ct, secret) == b"secret key material"
+    with pytest.raises(Exception):
+        aead.decrypt_symmetric(ct, b"\x43" * 32)
+
+
+def test_armor_roundtrip_and_crc():
+    data = bytes(range(200))
+    text = aead.armor_encode(
+        data, "TENDERMINT PRIVATE KEY", {"kdf": "bcrypt", "salt": "AB12"}
+    )
+    btype, headers, out = aead.armor_decode(text)
+    assert btype == "TENDERMINT PRIVATE KEY"
+    assert headers == {"kdf": "bcrypt", "salt": "AB12"}
+    assert out == data
+    # corrupt a base64 body char -> CRC failure
+    lines = text.splitlines()
+    body_idx = 4  # after head + 2 headers + blank
+    corrupted = lines[:]
+    ch = corrupted[body_idx]
+    corrupted[body_idx] = ("B" if ch[0] != "B" else "C") + ch[1:]
+    with pytest.raises(ValueError):
+        aead.armor_decode("\n".join(corrupted))
