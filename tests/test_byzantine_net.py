@@ -64,6 +64,71 @@ def _equivocate(byz_idx, nodes, css):
     byz_cs._send_internal = send
 
 
+def _send_invalid_votes(byz_idx, css):
+    """consensus/invalid_test.go: a byzantine validator floods peers with
+    malformed precommits — garbage signature, wrong validator index,
+    absurd round. Honest vote sets must reject them all without crashing
+    or stalling."""
+    import copy as _copy
+
+    byz_cs = css[byz_idx]
+    orig = byz_cs._send_internal
+
+    def send(msg, orig=orig):
+        from cometbft_tpu.consensus.messages import VoteMessage
+
+        orig(msg)
+        if not isinstance(msg, VoteMessage):
+            return
+        base = msg.vote
+        variants = []
+        v1 = _copy.copy(base)
+        v1.signature = b"\xAB" * 64  # garbage signature
+        variants.append(v1)
+        v2 = _copy.copy(base)
+        v2.validator_index = 99  # index out of set
+        variants.append(v2)
+        v3 = _copy.copy(base)
+        v3.round = base.round + 7  # vote for a far-future round
+        variants.append(v3)
+        for j, other in enumerate(css):
+            if j == byz_idx:
+                continue
+            for v in variants:
+                other.add_vote_from_peer(v, f"byz{byz_idx}")
+
+    byz_cs._send_internal = send
+
+
+def test_invalid_votes_do_not_stall_the_net():
+    genesis, pvs = make_genesis(4)
+    nodes = [make_consensus_node(genesis, pvs[i]) for i in range(4)]
+    css = [cs for cs, _ in nodes]
+    try:
+        wire_perfect_gossip(nodes)
+        _send_invalid_votes(3, css)
+        for cs in css:
+            cs.start()
+        target = 4
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if min(p["block_store"].height() for _, p in nodes) >= target:
+                break
+            time.sleep(0.05)
+        heights = [p["block_store"].height() for _, p in nodes]
+        assert min(heights) >= target, f"stalled under invalid votes: {heights}"
+        # and no fork
+        for h in range(1, min(heights) + 1):
+            ids = {
+                p["block_store"].load_block_meta(h).block_id.hash
+                for _, p in nodes
+            }
+            assert len(ids) == 1, f"fork at {h}"
+    finally:
+        for cs, parts in nodes:
+            stop_node(cs, parts)
+
+
 def test_byzantine_double_sign_becomes_block_evidence():
     genesis, pvs = make_genesis(4)
     apps = [MisbehaviorApp() for _ in range(4)]
