@@ -62,5 +62,17 @@ class RPCProvider(Provider):
         return dec.dec_validator_set(rows)
 
     def report_evidence(self, ev) -> None:
-        # evidence submission lands with the broadcast_evidence route
-        pass
+        """Submit attack evidence to the node's broadcast_evidence route
+        (light/provider/http ReportEvidence). Failures are swallowed:
+        the detector reports to every witness best-effort."""
+        import base64
+
+        from ..types import serialization as ser
+
+        try:
+            self._client.call(
+                "broadcast_evidence",
+                evidence=base64.b64encode(ser.dumps(ev)).decode(),
+            )
+        except Exception:
+            pass

@@ -142,6 +142,45 @@ def test_status_carries_light_info(pclient):
     assert int(st["light_client_info"]["trusted_height"]) >= 2
 
 
+def test_provider_report_evidence_lands_in_pool(node):
+    """The detector's evidence submission path: RPCProvider.report_evidence
+    -> broadcast_evidence route -> the node's evidence pool
+    (light/provider/http ReportEvidence)."""
+    import time as _time
+
+    from cometbft_tpu.light.rpc_provider import RPCProvider
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block import BlockID, PartSetHeader
+    from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+    from cometbft_tpu.types.vote import Vote
+
+    vals = node.state_store.load_validators(2)
+    pv = node.consensus.priv_validator
+    addr = vals.validators[0].address
+
+    def mk(tag):
+        return Vote(
+            msg_type=canonical.PRECOMMIT_TYPE,
+            height=2,
+            round=0,
+            block_id=BlockID(tag * 32, PartSetHeader(total=1, hash=tag * 32)),
+            timestamp_ns=_time.time_ns(),
+            validator_address=addr,
+            validator_index=0,
+        )
+
+    v1, v2 = mk(b"\x61"), mk(b"\x62")
+    pv.sign_vote(node.genesis.chain_id, v1, sign_extension=False)
+    pv.sign_vote(node.genesis.chain_id, v2, sign_extension=False)
+    meta2 = node.block_store.load_block_meta(2)
+    ev = DuplicateVoteEvidence.from_conflicting_votes(
+        v1, v2, meta2.header.time_ns, vals
+    )
+    provider = RPCProvider(node.rpc_server.bound_addr, node.genesis.chain_id)
+    provider.report_evidence(ev)
+    assert node.evidence_pool.is_pending(ev)
+
+
 def test_lying_primary_rejected(node):
     """A proxy whose primary serves a DIFFERENT chain's data must refuse."""
 
