@@ -214,9 +214,15 @@ class GrpcClient(Client):
             try:
                 resp = f.result()
             except grpc.RpcError as e:
-                rr._complete_error(
-                    ConnectionError(f"ABCI gRPC check_tx: {e.code().name}")
+                err = ConnectionError(
+                    f"ABCI gRPC check_tx: {e.code().name}"
                 )
+                rr._complete_error(err)
+                # same client-level bookkeeping as the sync path: the
+                # proxy layer fail-stops the node through this callback
+                self._err = self._err or err
+                if self._on_error is not None:
+                    self._on_error(err)
                 return
             rr._complete(resp)
             if self._global_cb is not None:

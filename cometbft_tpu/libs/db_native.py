@@ -118,6 +118,14 @@ class NativeDB(DB):
         self._mtx = threading.RLock()
         self._closed = False
 
+    def _live(self):
+        """The handle, or a clean error after close() — every native call
+        must come through here: nkv_close frees the C++ object, so a
+        dangling call would be a use-after-free, not an exception."""
+        if self._closed:
+            raise OSError("native db is closed")
+        return self._h
+
     # -- point ops ----------------------------------------------------------
 
     def get(self, key: bytes) -> bytes | None:
@@ -126,7 +134,7 @@ class NativeDB(DB):
         n = ctypes.c_size_t()
         with self._mtx:
             rc = self._lib.nkv_get(
-                self._h, key, len(key), ctypes.byref(out), ctypes.byref(n)
+                self._live(), key, len(key), ctypes.byref(out), ctypes.byref(n)
             )
             if rc != 0:
                 return None
@@ -145,7 +153,7 @@ class NativeDB(DB):
         key, value = bytes(key), bytes(value)
         with self._mtx:
             if self._lib.nkv_set(
-                self._h, key, len(key), value, len(value), sync
+                self._live(), key, len(key), value, len(value), sync
             ):
                 raise OSError("native set failed")
 
@@ -158,7 +166,7 @@ class NativeDB(DB):
     def _delete(self, key: bytes, sync: int) -> None:
         key = bytes(key)
         with self._mtx:
-            if self._lib.nkv_delete(self._h, key, len(key), sync):
+            if self._lib.nkv_delete(self._live(), key, len(key), sync):
                 raise OSError("native delete failed")
 
     # -- batches ------------------------------------------------------------
@@ -174,7 +182,7 @@ class NativeDB(DB):
                 blob += v
         blob = bytes(blob)
         with self._mtx:
-            if self._lib.nkv_batch(self._h, blob, len(blob), 1):
+            if self._lib.nkv_batch(self._live(), blob, len(blob), 1):
                 raise OSError("native batch failed")
 
     # -- iteration ----------------------------------------------------------
@@ -186,7 +194,7 @@ class NativeDB(DB):
         n = ctypes.c_size_t()
         with self._mtx:
             rc = self._lib.nkv_range(
-                self._h,
+                self._live(),
                 s, len(s) if s is not None else 0,
                 e, len(e) if e is not None else 0,
                 rev, ctypes.byref(out), ctypes.byref(n),
@@ -219,12 +227,12 @@ class NativeDB(DB):
 
     def compact(self) -> None:
         with self._mtx:
-            if self._lib.nkv_compact(self._h):
+            if self._lib.nkv_compact(self._live()):
                 raise OSError("native compact failed")
 
     def __len__(self) -> int:
         with self._mtx:
-            return int(self._lib.nkv_count(self._h))
+            return int(self._lib.nkv_count(self._live()))
 
     def close(self) -> None:
         with self._mtx:

@@ -99,6 +99,13 @@ class _InstrumentedMutex:
             if ok:
                 self._note_acquired(me)
             return ok
+        # threading.Lock semantics: timeout < 0 means wait forever,
+        # timeout == 0 is an immediate poll
+        if timeout == 0:
+            ok = self._lock.acquire(False)
+            if ok:
+                self._note_acquired(me)
+            return ok
         budget = timeout if timeout > 0 else None
         waited = 0.0
         next_report = DEADLOCK_TIMEOUT

@@ -173,6 +173,7 @@ NKV* nkv_open(const char* path, int compact_factor) {
 
 static int nkv_append(NKV* h, uint8_t op, const std::string& k,
                       const std::string& v, int sync) {
+    if (!h->log) return -1;  // a failed compaction reopen: fail cleanly
     std::string rec = frame(op, k, v, true);
     if (fwrite(rec.data(), 1, rec.size(), h->log) != rec.size()) return -1;
     if (fflush(h->log) != 0) return -1;
@@ -224,6 +225,16 @@ int nkv_batch(NKV* h, const uint8_t* ops, size_t len, int sync) {
 // Returns a malloc'd buffer of (u32 klen|key|u32 vlen|value)*.
 int nkv_range(NKV* h, const uint8_t* start, size_t slen, const uint8_t* end,
               size_t elen, int rev, uint8_t** out, size_t* outlen) {
+    // An inverted range (start ordered at/after end) is empty — matching
+    // the Python backends; iterating lo..hi with lo past hi would walk
+    // off the map (UB).
+    if (start && end &&
+        std::string((const char*)start, slen) >=
+            std::string((const char*)end, elen)) {
+        *out = (uint8_t*)malloc(1);
+        *outlen = 0;
+        return 0;
+    }
     auto lo = start ? h->data.lower_bound(std::string((const char*)start, slen))
                     : h->data.begin();
     auto hi = end ? h->data.lower_bound(std::string((const char*)end, elen))
@@ -272,11 +283,14 @@ int nkv_compact(NKV* h) {
     }
     fclose(f);
     fclose(h->log);
+    h->log = nullptr;
     if (rename(tmp.c_str(), h->path.c_str()) != 0) {
         h->log = fopen(h->path.c_str(), "ab");
         return -1;
     }
     h->log = fopen(h->path.c_str(), "ab");
+    if (!h->log)  // retry once; appends return -1 while it stays null
+        h->log = fopen(h->path.c_str(), "ab");
     h->records = h->data.size();
     return h->log ? 0 : -1;
 }

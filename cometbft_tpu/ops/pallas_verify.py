@@ -28,6 +28,7 @@ Differences from the XLA path, all for Mosaic friendliness:
 
 from __future__ import annotations
 
+import threading
 from functools import lru_cache
 
 import numpy as np
@@ -66,23 +67,28 @@ def _rows(limbs, batch) -> jnp.ndarray:
 class _TraceConsts:
     """Trace-time constants, built lazily per (name, lane width).
 
-    The cache must be reset at each kernel trace entry so tracers never
-    leak between traces; constants are needed at two widths (B for the
-    ladder, 2B for the fused A+R decompression).
+    The cache is THREAD-LOCAL and reset at each kernel trace entry so
+    tracers never leak between traces — two threads tracing concurrently
+    (e.g. blocksync and consensus both compiling on first use) must not
+    share or wipe each other's tracer-backed constants. Widths: B for
+    the ladder, 2B for the fused A+R decompression.
     """
 
-    cache: dict = {}
+    _tls = threading.local()
 
     @classmethod
     def reset(cls):
-        cls.cache = {}
+        cls._tls.cache = {}
 
     @classmethod
     def _get(cls, key, limbs, batch):
+        cache = getattr(cls._tls, "cache", None)
+        if cache is None:
+            cache = cls._tls.cache = {}
         k = (key, batch)
-        if k not in cls.cache:
-            cls.cache[k] = _rows(limbs, batch)
-        return cls.cache[k]
+        if k not in cache:
+            cache[k] = _rows(limbs, batch)
+        return cache[k]
 
     @classmethod
     def sub_bias(cls, batch):

@@ -475,6 +475,8 @@ class SignerClient(PrivValidator):
             raise RemoteSignerError(1, f"unexpected response {resp!r}")
         if resp.error_code:
             raise RemoteSignerError(resp.error_code, resp.error_desc)
+        if resp.vote is None:
+            raise RemoteSignerError(1, "signed-vote response missing vote")
         # Adopt the WHOLE signed vote, not just the signature: the remote
         # FilePV's crash-replay path re-signs the same HRS by rewinding
         # the timestamp to the originally signed one (file_pv
@@ -493,6 +495,10 @@ class SignerClient(PrivValidator):
             raise RemoteSignerError(1, f"unexpected response {resp!r}")
         if resp.error_code:
             raise RemoteSignerError(resp.error_code, resp.error_desc)
+        if resp.proposal is None:
+            raise RemoteSignerError(
+                1, "signed-proposal response missing proposal"
+            )
         for f in dataclasses.fields(Proposal):
             setattr(proposal, f.name, getattr(resp.proposal, f.name))
 
