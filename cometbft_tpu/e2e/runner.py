@@ -146,6 +146,8 @@ class Testnet:
     """N ProcessNodes over home dirs laid out by ``cometbft-tpu testnet``
     (cmd/__main__.py cmd_testnet; reference testnet.go)."""
 
+    __test__ = False  # not a pytest class despite the name
+
     def __init__(self, out_dir: str, n_vals: int, starting_port: int):
         self.out_dir = out_dir
         self.nodes = [
