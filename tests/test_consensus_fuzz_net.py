@@ -15,7 +15,7 @@ import time
 
 import pytest
 
-from cometbft_tpu.config import test_config
+from cometbft_tpu.config import test_config as make_test_config
 
 from helpers import make_consensus_node, make_genesis, stop_node
 
@@ -25,7 +25,7 @@ _MS = 1_000_000
 def _lossy_config():
     """Timeouts comfortably above the fuzzer's max delivery delay —
     rounds must outlive in-flight messages or the net spins forever."""
-    cfg = test_config()
+    cfg = make_test_config()
     cfg.consensus = dataclasses.replace(
         cfg.consensus,
         timeout_propose_ns=400 * _MS,
