@@ -1,0 +1,127 @@
+"""Legacy gRPC broadcast API (reference: rpc/grpc/api.go — the
+deprecated-but-shipped BroadcastAPI service with Ping and BroadcastTx;
+kept for operator/tool parity alongside the JSON-RPC surface).
+
+Same transport approach as the ABCI gRPC boundary (abci/grpc.py): real
+gRPC/HTTP-2 via generic method handlers; payloads are plain JSON (the
+service carries only strings and flat response dicts).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+
+import grpc
+
+from ..libs.service import BaseService
+
+_SERVICE = "cometbft.rpc.BroadcastAPI"
+
+
+def _ser(msg) -> bytes:
+    # plain JSON: the BroadcastAPI payloads are strings and flat dicts
+    # (the tagged dataclass codec is for typed message sets)
+    return json.dumps(msg, separators=(",", ":")).encode()
+
+
+def _de(data: bytes):
+    return json.loads(data)
+
+
+class BroadcastAPIServer(BaseService):
+    """Ping + BroadcastTx over gRPC (rpc/grpc/api.go)."""
+
+    def __init__(self, addr: str, env, max_workers: int = 4):
+        super().__init__("rpc-grpc-broadcast")
+        for scheme in ("grpc://", "tcp://"):
+            if addr.startswith(scheme):
+                addr = addr[len(scheme) :]
+        self.addr = addr
+        self.env = env  # rpc.core Environment (mempool + stores)
+        self._max_workers = max_workers
+        self._server = None
+
+    def on_start(self) -> None:
+        from .core.routes import broadcast_tx_sync
+
+        env = self.env
+
+        def ping(request, context):
+            return {}
+
+        def broadcast_tx(request, context):
+            # request: base64 tx string, same shape as the JSON-RPC param
+            res = broadcast_tx_sync(env, tx=request)
+            return {
+                "check_tx": {
+                    "code": int(res["code"]),
+                    "data": res.get("data", ""),
+                    "log": res.get("log", ""),
+                },
+                "hash": res.get("hash", ""),
+            }
+
+        handlers = {
+            "ping": grpc.unary_unary_rpc_method_handler(
+                ping, request_deserializer=_de, response_serializer=_ser
+            ),
+            "broadcast_tx": grpc.unary_unary_rpc_method_handler(
+                broadcast_tx,
+                request_deserializer=_de,
+                response_serializer=_ser,
+            ),
+        }
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="rpc-grpc",
+            )
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        bound = self._server.add_insecure_port(self.addr)
+        if bound == 0:
+            raise OSError(f"cannot bind BroadcastAPI at {self.addr}")
+        self.bound_port = bound
+        self._server.start()
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0).wait(2.0)
+
+
+class BroadcastAPIClient:
+    """Client for the BroadcastAPI service (rpc/grpc/client.go)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        for scheme in ("grpc://", "tcp://"):
+            if addr.startswith(scheme):
+                addr = addr[len(scheme) :]
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(addr)
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        self._ping = self._channel.unary_unary(
+            f"/{_SERVICE}/ping",
+            request_serializer=_ser,
+            response_deserializer=_de,
+        )
+        self._btx = self._channel.unary_unary(
+            f"/{_SERVICE}/broadcast_tx",
+            request_serializer=_ser,
+            response_deserializer=_de,
+        )
+
+    def ping(self) -> dict:
+        return self._ping("", timeout=self.timeout)
+
+    def broadcast_tx(self, tx: bytes) -> dict:
+        import base64
+
+        return self._btx(
+            base64.b64encode(tx).decode(), timeout=self.timeout
+        )
+
+    def close(self) -> None:
+        self._channel.close()

@@ -107,6 +107,39 @@ class TestInfoRoutes:
         finally:
             server.stop()
 
+    def test_grpc_broadcast_api(self, node):
+        """Legacy gRPC BroadcastAPI (rpc/grpc/api.go): ping + broadcast_tx
+        land a real tx in the mempool/chain."""
+        from cometbft_tpu.rpc.grpc_api import (
+            BroadcastAPIClient,
+            BroadcastAPIServer,
+        )
+
+        server = BroadcastAPIServer("127.0.0.1:0", node.rpc_env)
+        server.start()
+        try:
+            c = BroadcastAPIClient(f"127.0.0.1:{server.bound_port}")
+            assert c.ping() == {}
+            res = c.broadcast_tx(b"grpc-bcast=1")
+            assert res["check_tx"]["code"] == 0
+            assert res["hash"]
+            deadline = time.monotonic() + 20
+            found = False
+            while time.monotonic() < deadline and not found:
+                latest = node.block_store.height()
+                for h in range(1, latest + 1):
+                    blk = node.block_store.load_block(h)
+                    if blk and any(
+                        b"grpc-bcast=1" in t for t in blk.data.txs
+                    ):
+                        found = True
+                        break
+                time.sleep(0.1)
+            assert found, "gRPC-broadcast tx never committed"
+            c.close()
+        finally:
+            server.stop()
+
     def test_broadcast_evidence_roundtrip(self, client, node):
         import time as _time
 
