@@ -153,6 +153,35 @@ class LoadReport:
         }
 
 
+def block_interval_stats(
+    endpoint: str, from_height: int = 1, to_height: int | None = None
+) -> dict:
+    """Block-production statistics over committed headers
+    (test/e2e/runner/benchmark.go:14-45: mean/std/min/max interval)."""
+    client = HTTPClient(endpoint)
+    if to_height is None:
+        to_height = int(
+            client.call("status")["sync_info"]["latest_block_height"]
+        )
+    times = []
+    for h in range(from_height, to_height + 1):
+        hdr = client.call("header", height=h)["header"]
+        times.append(parse_rfc3339(hdr["time"]) / 1e9)
+    intervals = [b - a for a, b in zip(times, times[1:])]
+    if not intervals:
+        return {"blocks": len(times), "intervals": 0}
+    mean = sum(intervals) / len(intervals)
+    var = sum((x - mean) ** 2 for x in intervals) / len(intervals)
+    return {
+        "blocks": len(times),
+        "intervals": len(intervals),
+        "interval_mean_s": round(mean, 3),
+        "interval_std_s": round(var**0.5, 3),
+        "interval_min_s": round(min(intervals), 3),
+        "interval_max_s": round(max(intervals), 3),
+    }
+
+
 def load_report(
     endpoint: str,
     run_id: str,

@@ -18,6 +18,7 @@ import time
 import pytest
 
 from cometbft_tpu.e2e import LoadGenerator, Testnet, load_report
+from cometbft_tpu.e2e.load import block_interval_stats
 from cometbft_tpu.e2e.load import make_tx, parse_tx
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -122,5 +123,11 @@ def test_perturbed_testnet_under_load(tmp_path):
         assert rep.txs > 0, f"no load txs committed: {summary}"
         assert 0 < rep.mean_s < 60, summary
         assert rep.quantile(0.99) >= rep.quantile(0.5) > 0, summary
+
+        # block-production stats (runner/benchmark.go analog)
+        stats = block_interval_stats(net.nodes[0].rpc_addr)
+        assert stats["blocks"] >= 4
+        assert 0 < stats["interval_mean_s"] < 30, stats
+        assert stats["interval_min_s"] <= stats["interval_max_s"], stats
     finally:
         net.stop()
