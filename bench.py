@@ -35,8 +35,17 @@ import time
 import numpy as np
 
 
+_DETAILS: list = []
+
+
 def _eprint(obj) -> None:
     print(json.dumps(obj), file=sys.stderr, flush=True)
+    _DETAILS.append(obj)
+    try:  # persist incrementally: the judge reads this file per round
+        with open("BENCH_DETAILS.json", "w") as f:
+            json.dump(_DETAILS, f, indent=1)
+    except OSError:
+        pass
 
 
 def _make_ed_batch(n: int, seed: int = 3):
