@@ -40,10 +40,16 @@ class LightProxy(BaseService):
         primary_addr: str,
         laddr: str,
         logger=None,
+        update_interval: float = 8.0,
     ):
         super().__init__("light-proxy", logger)
         self.light_client = light_client
         self.primary = HTTPClient(primary_addr)
+        # Background head-tracking (light/proxy keeps the trusted store
+        # near the chain tip so request-time verification is one hop,
+        # and the trusting period never lapses while the proxy idles).
+        self.update_interval = update_interval
+        self._update_thread = None
         self._server = RPCServer(
             env=None, laddr=laddr, logger=logger, routes=self._routes()
         )
@@ -54,6 +60,20 @@ class LightProxy(BaseService):
 
     def on_start(self) -> None:
         self._server.start()
+        if self.update_interval > 0:
+            import threading
+
+            self._update_thread = threading.Thread(
+                target=self._update_loop, name="light-update", daemon=True
+            )
+            self._update_thread.start()
+
+    def _update_loop(self) -> None:
+        while not self.quit_event().wait(self.update_interval):
+            try:
+                self.light_client.update(time.time_ns())
+            except Exception:
+                pass  # primary hiccup: try again next tick
 
     def on_stop(self) -> None:
         self._server.stop()
