@@ -393,11 +393,15 @@ class ConsensusState(BaseService):
             return None
         try:
             verifier = crypto_batch.create_batch_verifier(triples[0][0])
-        except ValueError:
-            return None  # key type without a batch backend
-        for pub_key, sign_bytes, sig in triples:
-            verifier.add(pub_key, sign_bytes, sig)
-        _, bits = verifier.verify()
+            for pub_key, sign_bytes, sig in triples:
+                verifier.add(pub_key, sign_bytes, sig)
+            _, bits = verifier.verify()
+        except (ValueError, TypeError):
+            # no batch backend for this key type, or a MIXED-key validator
+            # set (add rejects foreign keys): skip pre-verification —
+            # admission falls back to per-vote verify, never crashes the
+            # receive loop
+            return None
         for (pub_key, sign_bytes, sig), ok in zip(triples, bits):
             memo[(pub_key.bytes(), sign_bytes, sig)] = bool(ok)
         return memo
