@@ -189,8 +189,12 @@ class GrpcClient(Client):
     def verify_vote_extension(self, req):
         return self._call("verify_vote_extension", req)
 
-    def commit(self, req):
-        return self._call("commit", req)
+    def commit(self, req=None):
+        # Client contract: the executor calls commit() bare
+        # (abci/client.py:125; Commit carries no fields)
+        from . import types as abci
+
+        return self._call("commit", req if req is not None else abci.RequestCommit())
 
     def list_snapshots(self, req):
         return self._call("list_snapshots", req)

@@ -1066,6 +1066,21 @@ class ConsensusState(BaseService):
         except Exception:
             if self.replay_mode:
                 raise
+            # NOT silent: a vote can trigger the whole commit chain
+            # (enterCommit -> finalize -> ApplyBlock), and an ABCI or
+            # storage failure swallowed here once hid a wedged node with
+            # zero trace. Peer votes may legitimately fail validation, but
+            # the traceback must reach the logs.
+            import traceback
+
+            if self.logger is not None:
+                self.logger.error(
+                    "exception adding vote",
+                    height=vote.height,
+                    round=vote.round,
+                    peer=peer_id,
+                )
+            traceback.print_exc()
             return False
 
     def _add_vote(self, vote: Vote, peer_id: str) -> bool:

@@ -108,6 +108,8 @@ def _app_client_creator(config: Config, app_db: dbm.DB):
         from ..abci.application import BaseApplication
 
         return proxy.local_client_creator(BaseApplication()), True
+    if pa.startswith("grpc://"):
+        return proxy.grpc_client_creator(pa), False
     if pa.startswith(("tcp://", "unix://")):
         return proxy.socket_client_creator(pa), False
     raise ValueError(f"unknown proxy_app {pa!r}")

@@ -33,6 +33,13 @@ def socket_client_creator(addr: str) -> ClientCreator:
     return lambda: SocketClient(addr)
 
 
+def grpc_client_creator(addr: str) -> ClientCreator:
+    """ABCI over gRPC (proxy/client.go's grpc transport option)."""
+    from .abci.grpc import GrpcClient
+
+    return lambda: GrpcClient(addr)
+
+
 class AppConns(BaseService):
     def __init__(
         self,

@@ -114,6 +114,63 @@ def test_conformance_catches_lying_app():
         client.stop()
 
 
+@pytest.mark.slow
+def test_node_over_grpc_proxy_app(tmp_path):
+    """A full node whose ABCI app lives behind gRPC (proxy_app=grpc://)
+    commits blocks — proxy/client.go's grpc transport end to end."""
+    import dataclasses
+    import time
+
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.node import Node, init_files
+
+    from helpers import make_genesis
+
+    _MS = 1_000_000
+    app_server = GrpcServer("127.0.0.1:0", KVStoreApplication())
+    app_server.start()
+    try:
+        cfg = default_config()
+        cfg.base.home = str(tmp_path)
+        cfg.base.proxy_app = f"grpc://127.0.0.1:{app_server.bound_port}"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=400 * _MS,
+            timeout_prevote_ns=200 * _MS,
+            timeout_precommit_ns=200 * _MS,
+            timeout_commit_ns=100 * _MS,
+            skip_timeout_commit=False,
+            create_empty_blocks=True,
+        )
+        init_files(cfg)
+        genesis, pvs = make_genesis(1)
+        n = Node(cfg, genesis, pvs[0])
+        n.start()
+        try:
+            deadline = time.monotonic() + 30
+            while (
+                n.block_store.height() < 3 and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert n.block_store.height() >= 3
+            n.mempool.check_tx(b"grpc-app=1")
+            deadline = time.monotonic() + 20
+            found = False
+            while time.monotonic() < deadline and not found:
+                for h in range(1, n.block_store.height() + 1):
+                    blk = n.block_store.load_block(h)
+                    if blk and any(b"grpc-app=1" in t for t in blk.data.txs):
+                        found = True
+                time.sleep(0.1)
+            assert found
+        finally:
+            n.stop()
+    finally:
+        app_server.stop()
+
+
 def test_abci_cli_commands(tmp_path):
     """The abci-test CLI command drives conformance end to end."""
     from cometbft_tpu.abci.server import SocketServer
