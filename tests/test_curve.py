@@ -162,6 +162,25 @@ def test_verify_zip215_small_order():
     assert ok and mask.all()
 
 
+def test_verify_batch_pipelined_chunks():
+    """verify_batch's pipelined pack->dispatch path (n > _PIPE_CHUNK)
+    maps lanes to the right outputs across chunk boundaries."""
+    from cometbft_tpu.ops import verify as ov
+
+    old = ov._PIPE_CHUNK
+    ov._PIPE_CHUNK = 8
+    try:
+        pks, msgs, sigs = make_batch(20)  # 3 chunks: 8 + 8 + 4
+        bad = {3, 9, 17}  # one per chunk
+        for i in bad:
+            sigs[i] = bytes([sigs[i][0] ^ 1]) + sigs[i][1:]
+        ok, mask = ov.verify_batch(pks, msgs, sigs)
+        assert not ok
+        assert [bool(m) for m in mask] == [i not in bad for i in range(20)]
+    finally:
+        ov._PIPE_CHUNK = old
+
+
 def test_verify_agrees_with_oracle_fuzz():
     """Randomized cross-check device vs oracle on mutated signatures."""
     pks, msgs, sigs = make_batch(10)
