@@ -39,7 +39,7 @@ def _lossy_config():
     )
     return cfg
 
-SEEDS = [7, 21]
+SEEDS = [7, 21, 1234, 5150]
 
 
 def wire_lossy_gossip(nodes, rng, drop=0.06, dup=0.05, max_delay=0.05):
