@@ -388,9 +388,9 @@ def _probe_device(timeout_s: float = 240.0) -> bool:
 def main() -> None:
     if not _probe_device():
         # No chip: emit an honest, clearly-labeled host-path measurement
-        # quickly rather than hanging the driver (XLA:CPU compiles of the
-        # wide verify buckets take tens of minutes — not a usable
-        # fallback either).
+        # quickly rather than hanging the driver. (Even JAX_PLATFORMS=cpu
+        # would not be safe here: the axon sitecustomize hook intercepts
+        # get_backend and the first jit would hang on the dead tunnel.)
         _eprint(
             {
                 "warning": "TPU device unreachable (PJRT init hang); "
