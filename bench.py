@@ -355,7 +355,7 @@ def bench_valset_update():
     return {"priority_increments_per_sec": round(reps / dt, 1)}
 
 
-def _probe_device(timeout_s: float = 240.0) -> bool:
+def _probe_device(timeout_s: float = 150.0) -> bool:
     """Device liveness probe in a killable subprocess.
 
     The tunneled TPU can wedge in PJRT init (blocking forever, no
