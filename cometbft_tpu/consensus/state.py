@@ -14,6 +14,7 @@ own-vote signing ``sign_vote:2355``/``sign_add_vote:2426``.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 
@@ -53,6 +54,18 @@ EVENT_PROPOSAL_BLOCK_PART = "ProposalBlockPart"
 
 class ConsensusError(Exception):
     pass
+
+
+class FatalConsensusError(ConsensusError):
+    """A failure inside the commit chain (save → ApplyBlock → advance).
+
+    The reference PANICS here (state.go finalizeCommit): past +2/3
+    precommits the node must either fully apply the block or stop —
+    continuing with a half-applied height (block saved, state not)
+    operates on inconsistent state. Never absorbed by vote-admission
+    error handling; propagates to the receive loop, which fail-stops
+    the node.
+    """
 
 
 def commit_to_vote_set(chain_id: str, commit, validators) -> VoteSet:
@@ -168,6 +181,9 @@ class ConsensusState(BaseService):
         self.replay_mode = False
         self.do_wal_catchup = True
         self._on_block_committed = []  # test/metrics hooks: f(height)
+        # Fail-stop hook for FatalConsensusError (node wires this to a
+        # full node stop; None → os._exit, never a silent dead thread).
+        self.on_fatal = None
 
         self.update_to_state(state)
         self.reconstruct_last_commit_if_needed(state)
@@ -248,9 +264,12 @@ class ConsensusState(BaseService):
         if self.ticker.is_running():
             self.ticker.stop()
         self._queue.put(("quit", None))
-        # Drain the loop before the WAL can be closed under it.
-        if getattr(self, "_receive_thread", None) is not None:
-            self._receive_thread.join(timeout=5)
+        # Drain the loop before the WAL can be closed under it. (Skipped
+        # when stop() is reached FROM the receive thread — the fail-stop
+        # path after FatalConsensusError — joining yourself raises.)
+        rt = getattr(self, "_receive_thread", None)
+        if rt is not None and rt is not threading.current_thread():
+            rt.join(timeout=5)
         self.wal.flush_and_sync()
 
     def _tock_forwarder(self) -> None:
@@ -331,6 +350,20 @@ class ConsensusState(BaseService):
                         elif kind == "txs_available":
                             with self._mtx:
                                 self._handle_txs_available()
+                    except FatalConsensusError as e:
+                        # Fail-stop (state.go finalizeCommit panics): the
+                        # node must not keep running on a half-applied
+                        # height. The on_fatal hook (node wiring) stops
+                        # the whole node; without one, kill the process —
+                        # a dead consensus thread with a live node would
+                        # be the silent wedge this guards against.
+                        import traceback
+
+                        traceback.print_exc()
+                        if self.on_fatal is not None:
+                            self.on_fatal(e)
+                            return
+                        os._exit(1)
                     except Exception:
                         if self.replay_mode:
                             raise
@@ -1008,6 +1041,17 @@ class ConsensusState(BaseService):
         rs = self.rs
         if rs.height != height or rs.step != RoundStep.COMMIT:
             return
+        try:
+            self._finalize_commit_locked(height)
+        except FatalConsensusError:
+            raise
+        except Exception as e:
+            raise FatalConsensusError(
+                f"failure finalizing height {height}: {e!r}"
+            ) from e
+
+    def _finalize_commit_locked(self, height: int) -> None:
+        rs = self.rs
         precommits = rs.votes.precommits(rs.commit_round)
         block_id = precommits.two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
@@ -1063,13 +1107,16 @@ class ConsensusState(BaseService):
             if self.evidence_pool is not None:
                 self.evidence_pool.report_conflicting_votes(e.new, e.existing)
             return False
+        except FatalConsensusError:
+            # Commit-chain failure triggered by this vote (enterCommit →
+            # finalize → ApplyBlock): NOT a vote-admission error — the
+            # node may hold a half-applied block. Propagate; the receive
+            # loop fail-stops (reference panics in finalizeCommit).
+            raise
         except Exception:
             if self.replay_mode:
                 raise
-            # NOT silent: a vote can trigger the whole commit chain
-            # (enterCommit -> finalize -> ApplyBlock), and an ABCI or
-            # storage failure swallowed here once hid a wedged node with
-            # zero trace. Peer votes may legitimately fail validation, but
+            # NOT silent: peer votes may legitimately fail validation, but
             # the traceback must reach the logs.
             import traceback
 

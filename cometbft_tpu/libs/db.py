@@ -146,9 +146,15 @@ def prefix_end(prefix: bytes) -> bytes | None:
     return bytes(p)
 
 
-# FileDB record framing: u8 op | u32 klen | u32 vlen | key | value
+# FileDB file framing: 5-byte magic, then records of
+# u8 op | u32 klen | u32 vlen | key | value.
+# The magic distinguishes this format from the native engine's
+# CRC-framed "NKV1\n" files: opening a foreign-format file raises
+# instead of parsing zero records and truncating the database to zero
+# (a flipped db_backend in config must not silently erase data).
 _HDR = struct.Struct("<BII")
 _OP_SET, _OP_DEL, _OP_BATCH = 1, 2, 3
+_MAGIC = b"FKV1\n"
 
 
 class FileDB(MemDB):
@@ -171,12 +177,32 @@ class FileDB(MemDB):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._replay()
         self._f = open(path, "ab")
+        if self._f.tell() == 0:
+            self._f.write(_MAGIC)
+            self._f.flush()
 
     def _replay(self) -> None:
         if not os.path.exists(self._path):
             return
-        good = 0
+        if os.path.getsize(self._path) == 0:
+            return
+        good = len(_MAGIC)
         with open(self._path, "rb") as f:
+            head = f.read(len(_MAGIC))
+            if len(head) < len(_MAGIC) and head == _MAGIC[: len(head)]:
+                # crash between file creation and the magic becoming
+                # durable: a strict prefix of the magic is a torn tail of
+                # an EMPTY database, not a foreign format — reset to
+                # empty (the constructor rewrites the magic)
+                with open(self._path, "r+b") as t:
+                    t.truncate(0)
+                return
+            if head != _MAGIC:
+                raise ValueError(
+                    f"{self._path}: not a FileDB file (bad magic "
+                    f"{head!r}; native-engine files start with b'NKV1\\n' "
+                    f"— was db_backend changed?)"
+                )
             while True:
                 hdr = f.read(_HDR.size)
                 if len(hdr) < _HDR.size:
@@ -292,6 +318,7 @@ class FileDB(MemDB):
         with self._mtx:
             tmp = self._path + ".compact"
             with open(tmp, "wb") as out:
+                out.write(_MAGIC)
                 for k in self._keys:
                     v = self._data[k]
                     out.write(_HDR.pack(_OP_SET, len(k), len(v)) + k + v)

@@ -114,7 +114,11 @@ class NativeDB(DB):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._h = self._lib.nkv_open(path.encode(), compact_factor)
         if not self._h:
-            raise NativeBuildError(f"nkv_open failed for {path!r}")
+            raise NativeBuildError(
+                f"nkv_open failed for {path!r} (unreadable, or a "
+                f"foreign-format file — FileDB files start with b'FKV1\\n', "
+                f"native files with b'NKV1\\n'; was db_backend changed?)"
+            )
         self._mtx = threading.RLock()
         self._closed = False
 

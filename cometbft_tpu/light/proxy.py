@@ -6,11 +6,12 @@ through the light client's verification:
 
 * ``commit`` / ``validators`` / ``header`` answer FROM the verified
   light-block store — the strongest guarantee, no primary data at all;
-* ``block`` fetches the full block from the primary and accepts it only
-  if (a) the header hash equals the light-verified header's hash and
-  (b) the transactions re-hash to the verified header's ``data_hash``
-  (light/rpc/client.go Block: untrusted data is cross-checked against
-  the trusted header before being returned);
+* ``block`` fetches the full block from the primary, decodes it, and
+  accepts it only if the block hash RECOMPUTED FROM CONTENT (after
+  ValidateBasic, which re-hashes txs against ``data_hash`` and the last
+  commit against ``last_commit_hash``) equals the light-verified hash
+  (light/rpc/client.go:319-340 recomputes ``res.Block.Hash()`` — the
+  primary's claimed block_id is never trusted);
 * tx submission, ``status``, ``health``, ``tx``, ``abci_query`` pass
   through to the primary (abci_query proof verification requires
   app-side proof ops — documented passthrough, as in the reference's
@@ -19,10 +20,8 @@ through the light client's verification:
 
 from __future__ import annotations
 
-import base64
 import time
 
-from ..crypto import merkle, tmhash
 from ..libs.service import BaseService
 from ..rpc import encoding as enc
 from ..rpc.client import HTTPClient
@@ -127,28 +126,57 @@ class LightProxy(BaseService):
             }
 
         def block(env, height=None):
+            # Verify from CONTENT, never from the primary's claimed
+            # block_id: decode the returned block, ValidateBasic it
+            # (which re-hashes txs against data_hash and the last commit
+            # against last_commit_hash), then recompute the header hash
+            # and compare against the light-verified hash
+            # (light/rpc/client.go:319-340 recomputes res.Block.Hash()).
             lb = lp._verified(height)
             raw = lp.primary.call("block", height=int(height))
-            verified_hash = (lb.hash() or b"").hex().upper()
-            got_hash = raw["block_id"]["hash"].upper()
-            if got_hash != verified_hash:
+            try:
+                blk = enc.dec_block(raw["block"])
+                blk.validate_basic()
+            except Exception as e:
                 raise LightClientError(
-                    f"primary returned block {got_hash}, light client "
-                    f"verified {verified_hash} at height {height}"
+                    f"primary returned an invalid block at height "
+                    f"{height}: {e}"
                 )
-            txs = [
-                base64.b64decode(t)
-                for t in (raw["block"]["data"]["txs"] or [])
-            ]
-            # data_hash = merkle root of tx HASHES (types.Data.hash)
-            data_hash = merkle.hash_from_byte_slices(
-                [tmhash.sum(tx) for tx in txs]
-            )
-            want = lb.signed_header.header.data_hash
-            if data_hash != want:
+            if blk.header.height == 1 and (
+                (raw["block"].get("last_commit") or {}).get("signatures")
+            ):
+                # Block 1 carries an EMPTY last commit; ValidateBasic only
+                # cross-checks last_commit_hash above height 1, so signed
+                # commit data injected here would relay unverified.
                 raise LightClientError(
-                    "primary block transactions do not hash to the "
-                    "verified data_hash"
+                    "primary returned a signed last_commit on block 1"
+                )
+            ev = (raw["block"].get("evidence") or {}).get("evidence") or []
+            if ev:
+                # This framework's RPC never carries evidence in blocks
+                # (enc_block), so a non-empty list is unverifiable
+                # primary-supplied content — refuse it.
+                raise LightClientError(
+                    "primary returned evidence the light proxy cannot "
+                    "verify against evidence_hash"
+                )
+            verified_hash = lb.hash() or b""
+            content_hash = blk.hash() or b""
+            if content_hash != verified_hash:
+                raise LightClientError(
+                    f"primary returned block {content_hash.hex().upper()} "
+                    f"(recomputed from content), light client verified "
+                    f"{verified_hash.hex().upper()} at height {height}"
+                )
+            # The response's block_id travels back to the caller, so it
+            # must match the recomputed hash too (light/rpc/client.go
+            # Block(): res.BlockID.Hash is compared against
+            # res.Block.Hash()) — never relay an attacker-chosen id.
+            claimed = (raw.get("block_id") or {}).get("hash", "").upper()
+            if claimed != content_hash.hex().upper():
+                raise LightClientError(
+                    f"primary's claimed block_id {claimed} does not match "
+                    f"the verified block hash at height {height}"
                 )
             return raw
 

@@ -6,11 +6,15 @@
 // node's disk hot path and exposed through a minimal C ABI consumed via
 // ctypes (no pybind11 in the image).
 //
-// Record framing: [u8 op][u32 klen][u32 vlen][key][value][u32 crc]
+// File framing: 5-byte magic "NKV1\n", then records:
+//   [u8 op][u32 klen][u32 vlen][key][value][u32 crc]
 //   op: 1=SET 2=DEL 3=BATCH (value = concatenated sub-records, no crc)
 //   crc: CRC32 over op|klen|vlen|key|value
 // A torn/corrupt tail record terminates replay (crash mid-append loses
 // at most the final record; a BATCH is one record, hence atomic).
+// A non-empty file whose head is not the magic is a FOREIGN format
+// (e.g. Python FileDB, magic "FKV1\n") — open refuses (-1) rather than
+// parsing zero records and truncating someone else's database to zero.
 
 #include <cstdint>
 #include <cstdio>
@@ -128,11 +132,14 @@ static void nkv_apply(NKV* h, uint8_t op, const std::string& k,
     }
 }
 
+static const char kMagic[5] = {'N', 'K', 'V', '1', '\n'};
+
 NKV* nkv_open(const char* path, int compact_factor) {
     crc_init();
     NKV* h = new NKV();
     h->path = path;
     h->compact_factor = compact_factor > 0 ? compact_factor : 4;
+    bool need_magic = true;
     // replay existing log
     FILE* f = fopen(path, "rb");
     if (f) {
@@ -146,25 +153,54 @@ NKV* nkv_open(const char* path, int compact_factor) {
             return nullptr;
         }
         fclose(f);
-        size_t pos = 0;
-        uint8_t op;
-        std::string k, v;
-        while (pos < buf.size() &&
-               parse_record(buf.data(), buf.size(), pos, true, op, k, v)) {
-            nkv_apply(h, op, k, v);
-            h->records++;
-        }
-        // truncate any torn tail so future appends start clean
-        if (pos < buf.size()) {
+        if (!buf.empty() && buf.size() < sizeof(kMagic) &&
+            memcmp(buf.data(), kMagic, buf.size()) == 0) {
+            // Crash between creation and the magic becoming durable: a
+            // strict prefix of the magic is a torn tail of an EMPTY
+            // database — reset to empty, not a foreign-format refusal.
             FILE* t = fopen(path, "rb+");
             if (t) {
-                if (ftruncate(fileno(t), (off_t)pos) != 0) { /* best effort */ }
+                if (ftruncate(fileno(t), 0) != 0) { /* best effort */ }
                 fclose(t);
+            }
+            buf.clear();
+        }
+        if (!buf.empty()) {
+            // Foreign on-disk format (FileDB or anything else): refuse —
+            // truncating an unparseable file would erase it.
+            if (buf.size() < sizeof(kMagic) ||
+                memcmp(buf.data(), kMagic, sizeof(kMagic)) != 0) {
+                delete h;
+                return nullptr;
+            }
+            need_magic = false;
+            size_t pos = sizeof(kMagic);
+            uint8_t op;
+            std::string k, v;
+            while (pos < buf.size() &&
+                   parse_record(buf.data(), buf.size(), pos, true, op, k, v)) {
+                nkv_apply(h, op, k, v);
+                h->records++;
+            }
+            // truncate any torn tail so future appends start clean
+            if (pos < buf.size()) {
+                FILE* t = fopen(path, "rb+");
+                if (t) {
+                    if (ftruncate(fileno(t), (off_t)pos) != 0) { /* best effort */ }
+                    fclose(t);
+                }
             }
         }
     }
     h->log = fopen(path, "ab");
     if (!h->log) {
+        delete h;
+        return nullptr;
+    }
+    if (need_magic &&
+        (fwrite(kMagic, 1, sizeof(kMagic), h->log) != sizeof(kMagic) ||
+         fflush(h->log) != 0)) {
+        fclose(h->log);
         delete h;
         return nullptr;
     }
@@ -268,6 +304,11 @@ int nkv_compact(NKV* h) {
     std::string tmp = h->path + ".compact";
     FILE* f = fopen(tmp.c_str(), "wb");
     if (!f) return -1;
+    if (fwrite(kMagic, 1, sizeof(kMagic), f) != sizeof(kMagic)) {
+        fclose(f);
+        remove(tmp.c_str());
+        return -1;
+    }
     for (auto& kv : h->data) {
         std::string rec = frame(1, kv.first, kv.second, true);
         if (fwrite(rec.data(), 1, rec.size(), f) != rec.size()) {
@@ -303,7 +344,10 @@ static void nkv_maybe_compact(NKV* h) {
 
 size_t nkv_count(NKV* h) { return h->data.size(); }
 
-int nkv_sync(NKV* h) { return fsync(fileno(h->log)) == 0 ? 0 : -1; }
+int nkv_sync(NKV* h) {
+    if (!h->log) return -1;  // failed compaction reopen, same as nkv_append
+    return fsync(fileno(h->log)) == 0 ? 0 : -1;
+}
 
 void nkv_close(NKV* h) {
     if (h->log) fclose(h->log);

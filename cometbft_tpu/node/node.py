@@ -211,6 +211,9 @@ class Node(BaseService):
         self._txs_available_thread: threading.Thread | None = None
         self._last_commit_time = 0.0
         self.consensus.add_block_committed_hook(self._on_block_committed)
+        # Commit-chain failures fail-stop the whole node (the reference
+        # panics in finalizeCommit) — same posture as _on_app_error.
+        self.consensus.on_fatal = self._on_app_error
 
         # 9. P2P: transport + switch + reactors (setup.go:325,394)
         self.node_key = NodeKey.load_or_generate(

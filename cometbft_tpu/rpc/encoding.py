@@ -25,9 +25,18 @@ def b64_decode(s: str) -> bytes:
 
 
 def rfc3339(ns: int) -> str:
-    dt = datetime.fromtimestamp(ns / 1e9, tz=timezone.utc)
-    frac = ns % 1_000_000_000
-    return dt.strftime("%Y-%m-%dT%H:%M:%S") + f".{frac:09d}Z"
+    # Integer split: float ns/1e9 rounds fractions near 1s up to the
+    # next second while the digits stay, producing a string 1s off —
+    # which would break the decode round-trip the light proxy's
+    # content-hash verification depends on.
+    secs, frac = divmod(ns, 1_000_000_000)
+    dt = datetime.fromtimestamp(secs, tz=timezone.utc)
+    # manual format: strftime("%Y") does not zero-pad year 1 (Go's zero
+    # time) on glibc, producing "1-01-01…" instead of "0001-01-01…"
+    return (
+        f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+        f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}.{frac:09d}Z"
+    )
 
 
 def enc_block_id(bid) -> dict:
@@ -84,6 +93,118 @@ def enc_block(b) -> dict:
         "evidence": {"evidence": []},
         "last_commit": enc_commit(b.last_commit) if b.last_commit else None,
     }
+
+
+# -- decoders (JSON → data model) -----------------------------------------
+#
+# The light proxy must re-verify primary-supplied blocks from CONTENT
+# (light/rpc/client.go:319-340 recomputes res.Block.Hash()), so it needs
+# the inverse of the encoders above.
+
+
+def parse_rfc3339(s: str) -> int:
+    """RFC3339 (with up to nanosecond fraction) → unix ns."""
+    if not s:
+        return 0
+    base, _, rest = s.partition(".")
+    if rest:
+        frac = rest.rstrip("Z")
+        ns = int(frac.ljust(9, "0")[:9])
+    else:
+        base = base.rstrip("Z")
+        ns = 0
+    base = base.rstrip("Z")
+    # tolerate unpadded years (older encoders emitted "1-01-01…" for
+    # Go's zero time)
+    ymd, _, hms = base.partition("T")
+    y, m, d = ymd.split("-")
+    dt = datetime.strptime(
+        f"{int(y):04d}-{m}-{d}T{hms}", "%Y-%m-%dT%H:%M:%S"
+    ).replace(tzinfo=timezone.utc)
+    # integer seconds-since-epoch (float timestamp() loses precision at
+    # year-1 magnitudes used by Go's zero time)
+    delta = dt - datetime(1970, 1, 1, tzinfo=timezone.utc)
+    secs = delta.days * 86400 + delta.seconds
+    return secs * 1_000_000_000 + ns
+
+
+def dec_hex(s: str | None) -> bytes:
+    return bytes.fromhex(s) if s else b""
+
+
+def dec_block_id(d: dict):
+    from ..types.block import BlockID, PartSetHeader
+
+    parts = d.get("parts") or {}
+    return BlockID(
+        hash=dec_hex(d.get("hash")),
+        part_set_header=PartSetHeader(
+            total=int(parts.get("total", 0)), hash=dec_hex(parts.get("hash"))
+        ),
+    )
+
+
+def dec_header(d: dict):
+    from ..types.block import Header, Version
+
+    v = d.get("version") or {}
+    return Header(
+        version=Version(
+            block=int(v.get("block", 0)), app=int(v.get("app", 0))
+        ),
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time_ns=parse_rfc3339(d["time"]),
+        last_block_id=dec_block_id(d.get("last_block_id") or {}),
+        last_commit_hash=dec_hex(d.get("last_commit_hash")),
+        data_hash=dec_hex(d.get("data_hash")),
+        validators_hash=dec_hex(d.get("validators_hash")),
+        next_validators_hash=dec_hex(d.get("next_validators_hash")),
+        consensus_hash=dec_hex(d.get("consensus_hash")),
+        app_hash=dec_hex(d.get("app_hash")),
+        last_results_hash=dec_hex(d.get("last_results_hash")),
+        evidence_hash=dec_hex(d.get("evidence_hash")),
+        proposer_address=dec_hex(d.get("proposer_address")),
+    )
+
+
+def dec_commit_sig(d: dict):
+    from ..types.block import CommitSig
+
+    sig = d.get("signature")
+    return CommitSig(
+        block_id_flag=int(d["block_id_flag"]),
+        validator_address=dec_hex(d.get("validator_address")),
+        timestamp_ns=parse_rfc3339(d.get("timestamp") or ""),
+        signature=base64.b64decode(sig) if sig else b"",
+    )
+
+
+def dec_commit(d: dict):
+    from ..types.block import Commit
+
+    return Commit(
+        height=int(d["height"]),
+        round=int(d["round"]),
+        block_id=dec_block_id(d.get("block_id") or {}),
+        signatures=[dec_commit_sig(s) for s in d.get("signatures") or []],
+    )
+
+
+def dec_block(d: dict):
+    from ..types.block import Block, Data
+
+    lc = d.get("last_commit")
+    return Block(
+        header=dec_header(d["header"]),
+        data=Data(
+            txs=[
+                base64.b64decode(t)
+                for t in (d.get("data") or {}).get("txs") or []
+            ]
+        ),
+        last_commit=dec_commit(lc) if lc and lc.get("signatures") else None,
+    )
 
 
 def enc_block_meta(m) -> dict:

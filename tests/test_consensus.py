@@ -143,6 +143,36 @@ def test_single_validator_produces_blocks():
         stop_node(cs, parts)
 
 
+def test_commit_chain_failure_fail_stops():
+    """An ABCI/storage failure inside the commit chain (triggered by a
+    vote) must NOT be absorbed by vote-admission error handling: the
+    node fail-stops via on_fatal (the reference panics on ApplyBlock
+    failure — a half-applied block is inconsistent state)."""
+    from cometbft_tpu.consensus.state import FatalConsensusError
+
+    genesis, pvs = make_genesis(1)
+    cs, parts = make_consensus_node(genesis, pvs[0])
+
+    def boom(*a, **k):
+        raise RuntimeError("abci exploded mid-apply")
+
+    parts["executor"].apply_block = boom
+    fatal = []
+    cs.on_fatal = fatal.append
+    cs.start()
+    try:
+        deadline = time.monotonic() + 20
+        while not fatal and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fatal, "commit-chain failure was swallowed"
+        assert isinstance(fatal[0], FatalConsensusError)
+        assert "abci exploded" in str(fatal[0])
+        # the chain must NOT have advanced past the failed apply
+        assert parts["state_store"].load().last_block_height == 0
+    finally:
+        stop_node(cs, parts)
+
+
 # -- 4-validator in-process net --------------------------------------------
 
 

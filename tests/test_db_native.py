@@ -103,6 +103,74 @@ def test_batch_atomic_under_torn_tail(tmp_path):
         t.close()
 
 
+def test_foreign_format_refused_not_erased(tmp_path):
+    """Opening a FileDB file with the native engine (or vice versa — a
+    flipped db_backend in config) must REFUSE, not parse zero records
+    and truncate the database to zero."""
+    from cometbft_tpu.libs.db_native import NativeBuildError
+
+    # FileDB file → native engine refuses, file untouched
+    fp = str(tmp_path / "file.db")
+    fdb = dbm.FileDB(fp)
+    fdb.set_sync(b"precious", b"data")
+    fdb.close()
+    size = os.path.getsize(fp)
+    with pytest.raises(NativeBuildError):
+        NativeDB(fp)
+    assert os.path.getsize(fp) == size
+    fdb2 = dbm.FileDB(fp)
+    assert fdb2.get(b"precious") == b"data"
+    fdb2.close()
+
+    # native file → FileDB refuses, file untouched
+    np_ = str(tmp_path / "native.db")
+    ndb = NativeDB(np_)
+    ndb.set_sync(b"precious", b"data")
+    ndb.close()
+    size = os.path.getsize(np_)
+    with pytest.raises(ValueError):
+        dbm.FileDB(np_)
+    assert os.path.getsize(np_) == size
+    ndb2 = NativeDB(np_)
+    assert ndb2.get(b"precious") == b"data"
+    ndb2.close()
+
+    # a strict PREFIX of the magic (crash before first-open magic write
+    # became durable) is a torn-empty database, not a foreign format —
+    # both engines recover to an empty store
+    for n in (1, 3):
+        pp = str(tmp_path / f"partial{n}.db")
+        with open(pp, "wb") as f:
+            f.write(b"NKV1\n"[:n])
+        r = NativeDB(pp)
+        assert len(r) == 0
+        r.set_sync(b"k", b"v")
+        r.close()
+        r2 = NativeDB(pp)
+        assert r2.get(b"k") == b"v"
+        r2.close()
+
+        fp2 = str(tmp_path / f"fpartial{n}.db")
+        with open(fp2, "wb") as f:
+            f.write(b"FKV1\n"[:n])
+        fr = dbm.FileDB(fp2)
+        fr.set_sync(b"k", b"v")
+        fr.close()
+        fr2 = dbm.FileDB(fp2)
+        assert fr2.get(b"k") == b"v"
+        fr2.close()
+
+    # arbitrary garbage → both refuse
+    gp = str(tmp_path / "garbage.db")
+    with open(gp, "wb") as f:
+        f.write(b"\x00\x01\x02 not a database \xff" * 4)
+    with pytest.raises(NativeBuildError):
+        NativeDB(gp)
+    with pytest.raises(ValueError):
+        dbm.FileDB(gp)
+    assert os.path.getsize(gp) > 0
+
+
 def test_compaction_shrinks_and_preserves(tmp_path):
     p = str(tmp_path / "c.db")
     db = NativeDB(p, compact_factor=10_000)  # no auto-compact

@@ -181,14 +181,14 @@ def test_provider_report_evidence_lands_in_pool(node):
     assert node.evidence_pool.is_pending(ev)
 
 
-def test_lying_primary_rejected(node):
-    """A proxy whose primary serves a DIFFERENT chain's data must refuse."""
+def _lying_proxy(node, tamper):
+    """Proxy whose primary mutates the ``block`` response via ``tamper``."""
 
     class LyingPrimary(HTTPClient):
         def call(self, method, **params):
             res = super().call(method, **params)
             if method == "block":
-                res["block_id"]["hash"] = "AB" * 32
+                tamper(res)
             return res
 
     meta = node.block_store.load_block_meta(2)
@@ -204,6 +204,11 @@ def test_lying_primary_rejected(node):
     p = LightProxy(client, node.rpc_server.bound_addr, "tcp://127.0.0.1:0")
     p.primary = LyingPrimary(node.rpc_server.bound_addr)
     p._server.routes = p._routes()  # rebind closures over the liar
+    return p
+
+
+def _assert_block_refused(node, tamper):
+    p = _lying_proxy(node, tamper)
     p.start()
     try:
         c = HTTPClient(p.bound_addr)
@@ -211,3 +216,81 @@ def test_lying_primary_rejected(node):
             c.call("block", height=3)
     finally:
         p.stop()
+
+
+def test_lying_primary_wrong_block_id_rejected(node):
+    """A tampered block_id alongside GENUINE content must still be
+    refused — the id travels back to the caller (light/rpc/client.go
+    Block() compares res.BlockID.Hash to the recomputed block hash)."""
+
+    def tamper(res):
+        res["block_id"]["hash"] = "AB" * 32
+
+    _assert_block_refused(node, tamper)
+
+
+def test_lying_primary_tampered_header_rejected(node):
+    """The advisor's attack: tampered header CONTENT (app_hash) alongside
+    the CORRECT claimed block_id hash must be refused — verification has
+    to recompute the hash from content (light/rpc/client.go:319-340)."""
+
+    def tamper(res):
+        res["block"]["header"]["app_hash"] = "CD" * 32
+
+    _assert_block_refused(node, tamper)
+
+
+def test_lying_primary_tampered_time_rejected(node):
+    def tamper(res):
+        res["block"]["header"]["time"] = "2030-01-01T00:00:00.000000000Z"
+
+    _assert_block_refused(node, tamper)
+
+
+def test_lying_primary_injected_evidence_rejected(node):
+    def tamper(res):
+        res["block"]["evidence"] = {"evidence": [{"fake": True}]}
+
+    _assert_block_refused(node, tamper)
+
+
+def test_lying_primary_injected_commit_on_block1_rejected(node):
+    """Block 1's last commit is empty and not covered by any hash check
+    at that height — injected signed commit data must be refused."""
+
+    def tamper(res):
+        if int(res["block"]["header"]["height"]) == 1:
+            res["block"]["last_commit"] = {
+                "height": "0",
+                "round": 0,
+                "block_id": {
+                    "hash": "AB" * 32,
+                    "parts": {"total": 1, "hash": "AB" * 32},
+                },
+                "signatures": [
+                    {
+                        "block_id_flag": 2,
+                        "validator_address": "CD" * 20,
+                        "timestamp": "2026-01-01T00:00:00.000000000Z",
+                        "signature": "QUJDRA==",
+                    }
+                ],
+            }
+
+    p = _lying_proxy(node, tamper)
+    p.start()
+    try:
+        c = HTTPClient(p.bound_addr)
+        with pytest.raises(RPCError):
+            c.call("block", height=1)
+    finally:
+        p.stop()
+
+
+def test_lying_primary_tampered_txs_rejected(node):
+    def tamper(res):
+        import base64 as _b64
+
+        res["block"]["data"]["txs"] = [_b64.b64encode(b"evil").decode()]
+
+    _assert_block_refused(node, tamper)
