@@ -28,7 +28,10 @@ Configs (BASELINE.md "North-star target", crypto/ed25519/bench_test.go:31-68):
 
 from __future__ import annotations
 
+import glob
 import json
+import os
+import re
 import sys
 import time
 
@@ -38,12 +41,49 @@ import numpy as np
 _DETAILS: list = []
 
 
+def _round_number() -> int:
+    """Current round = 1 + highest BENCH_r{N}.json already recorded.
+
+    The driver writes BENCH_r{N}.json AFTER round N finishes, so during
+    round N only 1..N-1 exist."""
+    best = 0
+    for p in glob.glob("BENCH_r*.json"):
+        m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            best = max(best, int(m.group(1)))
+    return best + 1
+
+
+_ROUND = _round_number()
+
+
 def _eprint(obj) -> None:
     print(json.dumps(obj), file=sys.stderr, flush=True)
     _DETAILS.append(obj)
-    try:  # persist incrementally: the judge reads this file per round
-        with open("BENCH_DETAILS.json", "w") as f:
-            json.dump(_DETAILS, f, indent=1)
+    # Persist incrementally, PER ROUND (a fallback run must never destroy
+    # an earlier round's chip table — r02's overwrite lost the only
+    # detailed chip data the project had).
+    for path in ("BENCH_DETAILS.json", f"BENCH_DETAILS_r{_ROUND:02d}.json"):
+        try:
+            with open(path, "w") as f:
+                json.dump(_DETAILS, f, indent=1)
+        except OSError:
+            pass
+
+
+def _load_last_chip_table():
+    """Most recent per-config table measured on the chip, if any."""
+    try:
+        with open("BENCH_CHIP_TABLE.json") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _save_chip_table() -> None:
+    try:
+        with open("BENCH_CHIP_TABLE.json", "w") as f:
+            json.dump({"round": _ROUND, "table": _DETAILS}, f, indent=1)
     except OSError:
         pass
 
@@ -85,9 +125,25 @@ def _cpu_single_baseline(n_sample: int = 512) -> float:
     return n_sample / (time.perf_counter() - t0)
 
 
-# voi batch-verify speedup proxy over its own single verify (see module
-# docstring); applied to the OpenSSL single-verify measurement.
-VOI_BATCH_FACTOR = 2.0
+def _cpu_batch_baseline(n: int = 4096) -> float:
+    """MEASURED host batch-verify throughput (sigs/sec, one core).
+
+    This is the actual voi algorithm — random-linear-combination over
+    the cofactored equation, one Pippenger multiscalar multiplication —
+    implemented natively (cometbft_tpu/native/edbatch.cpp, driven by
+    crypto/host_batch.py). It replaces the former documented guess of
+    OpenSSL-single x 2.0 (VOI_BATCH_FACTOR): every vs_baseline below is
+    now against a measurement on this machine.
+    """
+    from cometbft_tpu.crypto import host_batch
+
+    pubkeys, msgs, sigs = _make_ed_batch(n)
+    assert all(host_batch.verify_many(pubkeys, msgs, sigs))  # warm-up
+    t0 = time.perf_counter()
+    out = host_batch.verify_many(pubkeys, msgs, sigs)
+    dt = time.perf_counter() - t0
+    assert all(out)
+    return n / dt
 
 
 def _steady(fn, reps: int = 3) -> float:
@@ -270,6 +326,95 @@ def bench_mixed(n: int):
     return n / dt, dt
 
 
+def bench_device_floor():
+    """Break down the device round trip and derive the host crossover.
+
+    The ~70 ms device floor was asserted as a constant and routed around
+    (crypto/batch.HOST_BATCH_THRESHOLD); this measures where it actually
+    goes — host packing, dispatch (includes transfer under jit's async
+    dispatch), readback sync — at realistic commit sizes, for both the
+    uncached kernel and the expanded-pubkey cached path, and reports the
+    measured crossover against the native host batch verifier.
+    """
+    from cometbft_tpu.crypto import host_batch
+    from cometbft_tpu.ops import verify as ov
+
+    rows = []
+    crossover = None
+    for n in (64, 150, 256, 512, 768, 1024, 2048):
+        pubkeys, msgs, sigs = _make_ed_batch(n, seed=n)
+        # warm both paths (compile + cache build)
+        ov.verify_batch(pubkeys, msgs, sigs)
+        host_batch.verify_many(pubkeys, msgs, sigs)
+
+        t0 = time.perf_counter()
+        buf, host_ok = ov.pack_bytes(pubkeys, msgs, sigs)
+        t_pack = time.perf_counter() - t0
+
+        # measure BOTH device paths explicitly (the warm-up populated
+        # the pubkey cache, so steady state is "cached"; "uncached" is
+        # the cold-cache / evicted-validator first-launch cost)
+        reps = 3
+
+        def timed(launch):
+            t_disp = t_read = 0.0
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fin = launch()
+                t1 = time.perf_counter()
+                fin()
+                t2 = time.perf_counter()
+                t_disp += t1 - t0
+                t_read += t2 - t1
+            return t_disp / reps, t_read / reps
+
+        d_unc, r_unc = timed(lambda: ov.verify_bytes_async(buf, n))
+        hit = ov._PUBKEY_CACHE.lookup(pubkeys)
+        if hit is not None:
+            idxs, arena, arena_ok = hit
+            d_cac, r_cac = timed(
+                lambda: ov.verify_rsk_async(
+                    buf[32:], idxs, arena, arena_ok, n
+                )
+            )
+        else:
+            d_cac = r_cac = None
+
+        t0 = time.perf_counter()
+        host_batch.verify_many(pubkeys, msgs, sigs)
+        t_host = time.perf_counter() - t0
+
+        dev_total = t_pack + (
+            (d_cac + r_cac) if d_cac is not None else (d_unc + r_unc)
+        )
+        rows.append(
+            {
+                "n": n,
+                "pack_ms": round(t_pack * 1e3, 2),
+                "uncached_dispatch_ms": round(d_unc * 1e3, 2),
+                "uncached_readback_ms": round(r_unc * 1e3, 2),
+                "cached_dispatch_ms": (
+                    round(d_cac * 1e3, 2) if d_cac is not None else None
+                ),
+                "cached_readback_ms": (
+                    round(r_cac * 1e3, 2) if r_cac is not None else None
+                ),
+                "device_total_ms": round(dev_total * 1e3, 2),
+                "host_rlc_ms": round(t_host * 1e3, 2),
+                "device_wins": bool(dev_total < t_host),
+            }
+        )
+        if crossover is None and dev_total < t_host:
+            crossover = n
+    return {
+        "rows": rows,
+        "measured_crossover_lanes": crossover,
+        "current_HOST_BATCH_THRESHOLD": __import__(
+            "cometbft_tpu.crypto.batch", fromlist=["x"]
+        ).HOST_BATCH_THRESHOLD,
+    }
+
+
 def bench_wal_decode():
     """WAL encode/decode round trip (consensus/wal_test.go:264-283)."""
     import tempfile
@@ -355,34 +500,43 @@ def bench_valset_update():
     return {"priority_increments_per_sec": round(reps / dt, 1)}
 
 
-def _probe_device(timeout_s: float = 150.0) -> bool:
-    """Device liveness probe in a killable subprocess.
+def _probe_device(timeout_s: float = 60.0, attempts: int = 3) -> bool:
+    """Device liveness probe in a killable subprocess, with retries.
 
     The tunneled TPU can wedge in PJRT init (blocking forever, no
-    exception); probing in-process would hang the whole benchmark. On
-    probe failure the benchmark re-execs itself on the CPU backend so
-    the driver still gets honest (clearly labeled) numbers instead of a
-    timeout.
+    exception); probing in-process would hang the whole benchmark, and a
+    single attempt forfeits the whole round's chip numbers to one
+    transient tunnel hiccup (this killed round 2). 3 x 60 s with backoff
+    before conceding.
     """
-    import os
     import subprocess
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return True  # already on the fallback
-    try:
-        r = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; jax.devices(); print('ok')",
-            ],
-            capture_output=True,
-            timeout=timeout_s,
-            text=True,
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(5 * attempt)  # backoff: 5 s, 10 s
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; jax.devices(); print('ok')",
+                ],
+                capture_output=True,
+                timeout=timeout_s,
+                text=True,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        print(
+            json.dumps({"probe_attempt": attempt + 1, "alive": False}),
+            file=sys.stderr,
+            flush=True,
         )
-        return r.returncode == 0 and "ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    return False
 
 
 def main() -> None:
@@ -397,33 +551,58 @@ def main() -> None:
                 "reporting HOST verifier throughput, not chip numbers"
             }
         )
+        stale = _load_last_chip_table()
+        if stale is not None:
+            # Carry the last measured chip table forward, clearly marked,
+            # so one dead tunnel doesn't erase the project's chip record.
+            _eprint(
+                {
+                    "stale": True,
+                    "note": "last chip-measured per-config table "
+                    f"(round {stale.get('round')}); NOT this round's "
+                    "hardware",
+                    "chip_table": stale.get("table"),
+                }
+            )
         single = _cpu_single_baseline()
-        from cometbft_tpu.crypto import fast25519
+        batch_baseline = _cpu_batch_baseline()
+        _eprint(
+            {
+                "config": "cpu_baseline",
+                "openssl_single_sigs_per_sec": round(single, 1),
+                "native_rlc_batch_sigs_per_sec": round(batch_baseline, 1),
+                "note": "baseline MEASURED: native RLC multiscalar batch "
+                "(the voi algorithm), crypto/host_batch.py",
+            }
+        )
+        # The host production path IS the native batch verifier now, so
+        # the fallback headline measures it (vs_baseline ~1.0 by
+        # construction — the chip is what moves it).
+        from cometbft_tpu.crypto import host_batch
 
         pubkeys, msgs, sigs = _make_ed_batch(4096)
-        dt = _steady(lambda: fast25519.verify_many(pubkeys, msgs, sigs))
+        dt = _steady(lambda: host_batch.verify_many(pubkeys, msgs, sigs))
         print(
             json.dumps(
                 {
                     "metric": "ed25519_batch_verify_throughput",
                     "value": round(4096 / dt, 1),
                     "unit": "sigs/sec (host fallback: tpu unreachable)",
-                    "vs_baseline": round(
-                        (4096 / dt) / (single * VOI_BATCH_FACTOR), 2
-                    ),
+                    "vs_baseline": round((4096 / dt) / batch_baseline, 2),
                 }
             )
         )
         return
 
     single = _cpu_single_baseline()
-    batch_baseline = single * VOI_BATCH_FACTOR
+    batch_baseline = _cpu_batch_baseline()
     _eprint(
         {
             "config": "cpu_baseline",
             "openssl_single_sigs_per_sec": round(single, 1),
-            "voi_batch_proxy_sigs_per_sec": round(batch_baseline, 1),
-            "note": "proxy = single x 2.0 (voi batch speedup stand-in)",
+            "native_rlc_batch_sigs_per_sec": round(batch_baseline, 1),
+            "note": "baseline MEASURED: native RLC multiscalar batch "
+            "(the voi algorithm), crypto/host_batch.py",
         }
     )
 
@@ -481,6 +660,7 @@ def main() -> None:
         ("6_wal_decode", bench_wal_decode),
         ("7_mempool", bench_mempool),
         ("8_valset_update", bench_valset_update),
+        ("9_device_floor", bench_device_floor),
     ):
         try:
             _eprint({"config": name, **fn()})
@@ -489,6 +669,14 @@ def main() -> None:
 
     # Headline: 4096-lane flat ed25519 batch (round-1-comparable metric).
     tput, dt = bench_flat_batch(4096)
+    _eprint(
+        {
+            "config": "headline_flat4096",
+            "sigs_per_sec": round(tput, 1),
+            "latency_ms": round(dt * 1e3, 2),
+        }
+    )
+    _save_chip_table()  # durably record this chip-measured table
     print(
         json.dumps(
             {

@@ -174,7 +174,15 @@ class BlockPool:
         with self._mtx:
             if not self.peers:
                 return False
-            return self.height >= self.max_peer_height
+            # maxPeerHeight - 1, NOT maxPeerHeight (pool.go IsCaughtUp):
+            # the tip block can only be VERIFIED by the next block's
+            # LastCommit, which doesn't exist yet — requiring equality
+            # deadlocks a restarted validator against the very consensus
+            # that needs it (peers can't produce block H+1 without us,
+            # we wait in blocksync for H+1 to verify H, and wait_sync
+            # drops every consensus vote meanwhile). The final block is
+            # fetched by consensus catch-up gossip instead.
+            return self.height >= self.max_peer_height - 1
 
     def stop(self) -> None:
         with self._mtx:

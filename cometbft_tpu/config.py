@@ -147,7 +147,7 @@ class StorageConfig:
 
 @dataclass(slots=True)
 class TxIndexConfig:
-    indexer: str = "kv"  # kv | null | psql
+    indexer: str = "kv"  # kv | sqlite (external-DB sink) | null
 
 
 @dataclass(slots=True)

@@ -151,7 +151,7 @@ def validate_basic(cfg: Config) -> None:
              "statesync.trust_hash must be 32 hex bytes")
         need(s.trust_period_ns > 0, "statesync.trust_period must be > 0")
 
-    need(cfg.tx_index.indexer in ("kv", "null"),
+    need(cfg.tx_index.indexer in ("kv", "sqlite", "null"),
          f"tx_index.indexer invalid: {cfg.tx_index.indexer!r}")
 
     if errs:

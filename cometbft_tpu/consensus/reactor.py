@@ -149,15 +149,30 @@ class PeerState:
                 ba.set_index(index, True)
 
     def apply_vote_set_bits(self, msg: VoteSetBitsMessage, our_votes) -> None:
+        """Overwrite our has-vote marks with the peer's OWN report
+        (reactor.go ApplyVoteSetBitsMessage). This must be able to CLEAR
+        bits, not just set them: a vote we sent while the peer was still
+        syncing (wait_sync drops it) stays marked as delivered forever,
+        and with it the liveness self-heal — the maj23 query → VoteSetBits
+        reply loop is how a rejoining node gets its round's votes
+        re-gossiped. For votes in ``our_votes`` the peer's word is
+        authoritative; marks for votes we don't even have stay (we could
+        never resend them anyway)."""
         with self.mtx:
             ba = self._votes_bitarray(
                 msg.height, msg.round, msg.msg_type,
                 msg.votes.size() if msg.votes else 0,
             )
-            if ba is not None and msg.votes is not None:
-                for i in range(min(ba.size(), msg.votes.size())):
-                    if msg.votes.get_index(i):
-                        ba.set_index(i, True)
+            if ba is None or msg.votes is None:
+                return
+            if our_votes is None or our_votes.size() != ba.size():
+                new = msg.votes
+            else:
+                new = ba.sub(our_votes).or_(msg.votes)
+            for i in range(ba.size()):
+                ba.set_index(
+                    i, new.get_index(i) if i < new.size() else False
+                )
 
     def pick_vote_to_send(self, votes) -> object | None:
         """A vote from ``votes`` (a VoteSet) the peer hasn't seen."""
@@ -295,9 +310,16 @@ class ConsensusReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         ps = peer.get("consensus_peer_state")
-        # announce our current step so the peer can route gossip
-        rs = self.cs.get_round_state()
-        peer.try_send(STATE_CHANNEL, ser.dumps(self._round_step_msg(rs)))
+        # Announce our current step so the peer can route gossip — but
+        # NOT while we're still syncing (reactor.go AddPeer: "If we're
+        # syncing, broadcast a RoundStepMessage later upon
+        # SwitchToConsensus"). Announcing invites vote gossip that
+        # wait_sync DROPS while the sender marks it delivered — a
+        # restarting validator then wedges missing exactly those votes.
+        # switch_to_consensus broadcasts the round step when we're ready.
+        if not self.wait_sync:
+            rs = self.cs.get_round_state()
+            peer.try_send(STATE_CHANNEL, ser.dumps(self._round_step_msg(rs)))
         for fn, name in (
             (self._gossip_data_routine, "gossip-data"),
             (self._gossip_votes_routine, "gossip-votes"),
@@ -359,7 +381,19 @@ class ConsensusReactor(Reactor):
                 self.cs.add_vote_from_peer(msg.vote, peer.id)
         elif ch_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage):
-                ps.apply_vote_set_bits(msg, None)
+                # our own bits for the claimed block decide which of the
+                # peer's reports are authoritative (reactor.go:316-330)
+                rs = self.cs.get_round_state()
+                our = None
+                if rs.height == msg.height and rs.votes is not None:
+                    vs = (
+                        rs.votes.prevotes(msg.round)
+                        if msg.msg_type == canonical.PREVOTE_TYPE
+                        else rs.votes.precommits(msg.round)
+                    )
+                    if vs is not None:
+                        our = vs.bit_array_by_block_id(msg.block_id)
+                ps.apply_vote_set_bits(msg, our)
 
     def _handle_maj23(self, peer, ps: PeerState, msg: VoteSetMaj23Message):
         """reactor.go: record claim, respond with our vote bits."""
@@ -587,6 +621,32 @@ class ConsensusReactor(Reactor):
                                     )
                                 ),
                             )
+                # Catch-up query (reactor.go:938-960): a peer stuck on an
+                # OLDER height is asked against our STORED commit. Its
+                # VoteSetBits reply exposes which precommits it actually
+                # holds, clearing stale has-vote marks (votes we sent
+                # while it was syncing were dropped but stayed marked) so
+                # the last-commit/catch-up gossip resends them — without
+                # this, a validator that restarts during its own commit
+                # wedges one height behind forever.
+                elif (
+                    ps.height > 0
+                    and ps.height < rs.height
+                    and self.cs.block_store is not None
+                ):
+                    commit = self.cs.block_store.load_block_commit(ps.height)
+                    if commit is not None:
+                        peer.try_send(
+                            STATE_CHANNEL,
+                            ser.dumps(
+                                VoteSetMaj23Message(
+                                    height=ps.height,
+                                    round=commit.round,
+                                    msg_type=canonical.PRECOMMIT_TYPE,
+                                    block_id=commit.block_id,
+                                )
+                            ),
+                        )
             except Exception:
                 pass
             time.sleep(self._maj23_sleep)

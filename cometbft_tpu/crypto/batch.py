@@ -36,11 +36,15 @@ class BatchVerifier:
         raise NotImplementedError
 
 
-# Below this size a single CPU core (~9k OpenSSL verifies/s) finishes
-# before the device round trip's fixed latency floor (~70 ms through the
-# relay) — measured crossover ~768 lanes on a v5e. The reference has the
-# inverse constant (batchVerifyThreshold, types/validation.go:13-17: below
-# it batching isn't worth setup); here the host/device split plays the role.
+# Below this size the host finishes before the device round trip's fixed
+# latency floor (~70 ms through the relay) — measured crossover ~768
+# lanes on a v5e against the old sequential-OpenSSL host path. The host
+# path is now the native RLC batch verifier (crypto/host_batch.py,
+# ~1.5-3x sequential OpenSSL), which pushes the true crossover HIGHER;
+# re-derive against chip latency when the tunnel is reachable (the
+# device side also got faster via the expanded-pubkey arena). The
+# reference has the inverse constant (batchVerifyThreshold,
+# types/validation.go:13-17: below it batching isn't worth setup).
 HOST_BATCH_THRESHOLD = 768
 
 
@@ -67,9 +71,12 @@ class Ed25519BatchVerifier(BatchVerifier):
 
         t0 = _time.perf_counter()
         if len(self._pubkeys) < HOST_BATCH_THRESHOLD:
-            from . import fast25519
+            # Native RLC batch (one multiscalar mult, the voi algorithm);
+            # falls back to sequential OpenSSL inside when the native
+            # engine can't build.
+            from . import host_batch
 
-            bitmap = fast25519.verify_many(
+            bitmap = host_batch.verify_many(
                 self._pubkeys, self._msgs, self._sigs
             )
             _observe("ed25519-host", t0, len(bitmap))
@@ -140,7 +147,11 @@ class Sr25519BatchVerifier(BatchVerifier):
                 (ref.compress(a_pt), ref.compress(r_pt), s_int, k_int)
             )
         buf, host_ok = ov.pack_parts(parts)
-        device_ok = ov.verify_bytes_async(buf, n)()
+        # The expanded-point cache is keyed by the edwards A encoding, so
+        # sr25519 validators (converted ristretto points) share the same
+        # arena as ed25519 pubkeys.
+        a_keys = [p[0] if p is not None else b"" for p in parts]
+        device_ok = ov.verify_prepacked(buf, a_keys, n)()
         valid = device_ok & host_ok
         _observe("sr25519-tpu", t0, n)
         return bool(valid.all()), list(np.asarray(valid, bool))

@@ -107,6 +107,29 @@ class ProcessNode:
         self.stop()
         self.start()
 
+    def upgrade(self, version: str, config_mutator=None) -> None:
+        """The ``upgrade`` perturbation (runner/perturb.go:16-31): clean
+        stop, swap the "image" — here the advertised software version
+        (env override) plus optional config changes the new version
+        ships — and start over the SAME data dir. Chain continuity is
+        the caller's invariant: the node must handshake-replay its
+        store, rejoin, and keep signing."""
+        self.stop()
+        self.env = dict(self.env)
+        self.env["COMETBFT_TPU_SOFTWARE_VERSION"] = version
+        if config_mutator is not None:
+            from ..config_file import load_toml, save_toml
+
+            path = os.path.join(self.home, "config", "config.toml")
+            cfg = load_toml(path)
+            cfg.base.home = self.home
+            config_mutator(cfg)
+            save_toml(cfg, path)
+        self.start()
+
+    def advertised_version(self) -> str:
+        return self.client().call("status")["node_info"]["version"]
+
     # -- observation -------------------------------------------------------
 
     def client(self) -> HTTPClient:
@@ -178,6 +201,61 @@ class Testnet:
         if rc != 0:
             raise RuntimeError("testnet generation failed")
         return cls(out_dir, n_vals, starting_port)
+
+    @classmethod
+    def generate_randomized(
+        cls, out_dir: str, seed: int, starting_port: int
+    ) -> "Testnet":
+        """Seeded randomized-manifest generator (the reference's
+        ``e2e generator``, test/e2e/README.md:36-60 + pkg/testnet.go):
+        draws validator count, consensus timeouts, topology (full mesh
+        vs ring of persistent peers, PEX on/off), storage backend and
+        block-production mode from ``seed``, writes the manifest next to
+        the node homes for reproduction, and post-edits each generated
+        config accordingly."""
+        import json
+        import random
+
+        from ..config_file import load_toml, save_toml
+
+        rng = random.Random(seed)
+        n_vals = rng.choice([2, 3, 4])
+        manifest = {
+            "seed": seed,
+            "validators": n_vals,
+            "topology": rng.choice(["mesh", "ring"]),
+            "pex": rng.random() < 0.5,
+            "db_backend": rng.choice(["file", "native"]),
+            "timeout_commit_ms": rng.choice([100, 250, 500]),
+            "timeout_propose_ms": rng.choice([400, 800]),
+            "create_empty_blocks": rng.random() < 0.8,
+        }
+        net = cls.generate(out_dir, n_vals, starting_port)
+        with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=1)
+        ms = 1_000_000
+        for i, node in enumerate(net.nodes):
+            path = os.path.join(node.home, "config", "config.toml")
+            cfg = load_toml(path)
+            cfg.base.home = node.home
+            cfg.base.db_backend = manifest["db_backend"]
+            cfg.p2p.pex = manifest["pex"]
+            if manifest["topology"] == "ring":
+                # keep only the next node as a persistent peer; gossip
+                # still reaches everyone around the ring
+                peers = cfg.p2p.persistent_peers.split(",")
+                cfg.p2p.persistent_peers = peers[i % len(peers)]
+            import dataclasses
+
+            cfg.consensus = dataclasses.replace(
+                cfg.consensus,
+                timeout_commit_ns=manifest["timeout_commit_ms"] * ms,
+                timeout_propose_ns=manifest["timeout_propose_ms"] * ms,
+                create_empty_blocks=manifest["create_empty_blocks"],
+            )
+            save_toml(cfg, path)
+        net.manifest = manifest
+        return net
 
     def start(self) -> None:
         for n in self.nodes:

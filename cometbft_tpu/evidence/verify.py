@@ -2,7 +2,6 @@
 
 from __future__ import annotations
 
-from ..types import canonical
 from ..types.evidence import (
     DuplicateVoteEvidence,
     EvidenceError,
@@ -48,8 +47,12 @@ def verify_duplicate_vote(
             f"address {ev.vote_a.validator_address.hex()} was not a "
             "validator at the evidence height"
         )
-    if ev.vote_a.msg_type != canonical.PRECOMMIT_TYPE:
-        raise EvidenceError("duplicate votes must be precommits")
+    # NOTE: no vote-TYPE restriction — the reference punishes PREVOTE
+    # equivocation too (VerifyDuplicateVote:174-181 only requires equal
+    # H/R/Type and differing block IDs). A precommit-only rule here once
+    # made a proposer pack prevote-equivocation evidence its own block
+    # validation then rejected — fatal at finalize (the evidence pool
+    # and this verifier must accept the same set).
     ev.validate_basic()
     # recorded powers must match the set we verified against
     if ev.validator_power != val.voting_power:

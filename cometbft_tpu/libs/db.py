@@ -152,6 +152,10 @@ def prefix_end(prefix: bytes) -> bytes | None:
 # CRC-framed "NKV1\n" files: opening a foreign-format file raises
 # instead of parsing zero records and truncating the database to zero
 # (a flipped db_backend in config must not silently erase data).
+# Deliberately NO legacy (pre-magic) acceptance path: the formats are
+# pre-release with no deployed data dirs, and sniffing legacy records
+# is exactly the ambiguity that allowed cross-format erasure (both
+# framings begin with a plausible op byte).
 _HDR = struct.Struct("<BII")
 _OP_SET, _OP_DEL, _OP_BATCH = 1, 2, 3
 _MAGIC = b"FKV1\n"

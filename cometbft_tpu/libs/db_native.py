@@ -18,55 +18,27 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 import threading
 
 from .db import DB, prefix_end  # noqa: F401  (prefix_end re-export parity)
+from .native_build import NativeBuildError, build_and_load  # noqa: F401
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "nkv.cpp"))
 _SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_nkv.so"))
 
-_build_lock = threading.Lock()
+_load_lock = threading.Lock()
 _lib = None
-
-
-class NativeBuildError(RuntimeError):
-    pass
-
-
-def _build() -> str:
-    """Compile nkv.cpp -> _nkv.so once (rebuild when the source is newer)."""
-    with _build_lock:
-        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(
-            _SRC
-        ):
-            return _SO
-        cmd = [
-            "g++",
-            "-O2",
-            "-shared",
-            "-fPIC",
-            "-std=c++17",
-            _SRC,
-            "-o",
-            _SO + ".tmp",
-        ]
-        try:
-            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-        except (OSError, subprocess.TimeoutExpired) as e:
-            raise NativeBuildError(f"g++ unavailable: {e!r}")
-        if r.returncode != 0:
-            raise NativeBuildError(f"nkv.cpp compile failed:\n{r.stderr}")
-        os.replace(_SO + ".tmp", _SO)
-        return _SO
 
 
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(_build())
+    with _load_lock:
+        if _lib is not None:
+            return _lib
+        lib = build_and_load(_SRC, _SO)
     c_ubyte_p = ctypes.POINTER(ctypes.c_ubyte)
     lib.nkv_open.restype = ctypes.c_void_p
     lib.nkv_open.argtypes = [ctypes.c_char_p, ctypes.c_int]

@@ -1,0 +1,56 @@
+"""Shared compile-and-load machinery for the C++ engines.
+
+Both native tiers — the storage engine (libs/db_native.py over
+native/nkv.cpp) and the host batch verifier (crypto/host_batch.py over
+native/edbatch.cpp) — build a shared object on first use with the
+baked-in g++ and load it via ctypes (no pybind11 in the image). One
+implementation of the staleness check / atomic replace / failure
+handling keeps the two paths from drifting.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_and_load(
+    src: str,
+    so: str,
+    extra_flags: tuple[str, ...] = (),
+    timeout: float = 120.0,
+) -> ctypes.CDLL:
+    """Compile ``src`` -> ``so`` (when missing or stale) and dlopen it.
+
+    Raises NativeBuildError when the toolchain is unavailable or the
+    compile fails; callers decide their own fallback policy.
+    """
+    with _lock:
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(
+            src
+        ):
+            cmd = [
+                "g++", "-O3", "-funroll-loops", "-shared", "-fPIC",
+                "-std=c++17", *extra_flags, src, "-o", so + ".tmp",
+            ]
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=timeout
+                )
+            except (OSError, subprocess.TimeoutExpired) as e:
+                raise NativeBuildError(f"g++ unavailable: {e!r}")
+            if r.returncode != 0:
+                raise NativeBuildError(
+                    f"{os.path.basename(src)} compile failed:\n"
+                    f"{r.stderr[:800]}"
+                )
+            os.replace(so + ".tmp", so)
+        return ctypes.CDLL(so)

@@ -10,8 +10,10 @@ through the light client's verification:
   accepts it only if the block hash RECOMPUTED FROM CONTENT (after
   ValidateBasic, which re-hashes txs against ``data_hash`` and the last
   commit against ``last_commit_hash``) equals the light-verified hash
-  (light/rpc/client.go:319-340 recomputes ``res.Block.Hash()`` — the
-  primary's claimed block_id is never trusted);
+  (light/rpc/client.go:319-340 recomputes ``res.Block.Hash()``). The
+  response is a RE-ENCODING of the verified decoded block — nothing
+  from the primary's raw JSON (claimed block_id, injected evidence,
+  extra keys) is ever relayed;
 * tx submission, ``status``, ``health``, ``tx``, ``abci_query`` pass
   through to the primary (abci_query proof verification requires
   app-side proof ops — documented passthrough, as in the reference's
@@ -86,6 +88,18 @@ class LightProxy(BaseService):
             int(height), time.time_ns()
         )
 
+    @staticmethod
+    def _verified_block_id(lb, content_hash: bytes):
+        """The BlockID to return for a verified block: the one the
+        validators signed (the light block's own commit), sanity-checked
+        against the recomputed content hash."""
+        bid = lb.signed_header.commit.block_id
+        if bid.hash != content_hash:
+            raise LightClientError(
+                "light block commit id does not match the verified header"
+            )
+        return bid
+
     def _routes(self) -> dict:
         lp = self
 
@@ -142,23 +156,12 @@ class LightProxy(BaseService):
                     f"primary returned an invalid block at height "
                     f"{height}: {e}"
                 )
-            if blk.header.height == 1 and (
-                (raw["block"].get("last_commit") or {}).get("signatures")
-            ):
+            if blk.header.height == 1 and blk.last_commit is not None:
                 # Block 1 carries an EMPTY last commit; ValidateBasic only
                 # cross-checks last_commit_hash above height 1, so signed
                 # commit data injected here would relay unverified.
                 raise LightClientError(
                     "primary returned a signed last_commit on block 1"
-                )
-            ev = (raw["block"].get("evidence") or {}).get("evidence") or []
-            if ev:
-                # This framework's RPC never carries evidence in blocks
-                # (enc_block), so a non-empty list is unverifiable
-                # primary-supplied content — refuse it.
-                raise LightClientError(
-                    "primary returned evidence the light proxy cannot "
-                    "verify against evidence_hash"
                 )
             verified_hash = lb.hash() or b""
             content_hash = blk.hash() or b""
@@ -168,17 +171,18 @@ class LightProxy(BaseService):
                     f"(recomputed from content), light client verified "
                     f"{verified_hash.hex().upper()} at height {height}"
                 )
-            # The response's block_id travels back to the caller, so it
-            # must match the recomputed hash too (light/rpc/client.go
-            # Block(): res.BlockID.Hash is compared against
-            # res.Block.Hash()) — never relay an attacker-chosen id.
-            claimed = (raw.get("block_id") or {}).get("hash", "").upper()
-            if claimed != content_hash.hex().upper():
-                raise LightClientError(
-                    f"primary's claimed block_id {claimed} does not match "
-                    f"the verified block hash at height {height}"
-                )
-            return raw
+            # Never relay the primary's raw JSON: anything outside the
+            # decode/re-hash surface (claimed block_id, injected
+            # evidence, unknown extra keys) would pass through
+            # unverified. The response is a RE-ENCODING of the verified
+            # decoded block, with the block_id taken from the
+            # light-verified commit (hash + part-set header both signed).
+            return {
+                "block_id": enc.enc_block_id(
+                    lp._verified_block_id(lb, content_hash)
+                ),
+                "block": enc.enc_block(blk),
+            }
 
         def passthrough(method):
             def fn(env, **params):

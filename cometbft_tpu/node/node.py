@@ -250,11 +250,20 @@ class Node(BaseService):
             # the event for plain non-blocksync nodes only
             self.blocksync_reactor.synced.clear()
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+        # Advertised software version; env-overridable so the e2e upgrade
+        # perturbation (restart under a bumped version — the reference's
+        # docker-image swap, runner/perturb.go:16-31) is observable over
+        # RPC/p2p while staying protocol-compatible.
+        from ..state.state import SOFTWARE_VERSION
+
         self.node_info = NodeInfo(
             node_id=self.node_key.node_id,
             listen_addr="",
             network=genesis.chain_id,
             moniker=config.base.moniker,
+            version=os.environ.get(
+                "COMETBFT_TPU_SOFTWARE_VERSION", SOFTWARE_VERSION
+            ),
         )
         self.transport = MultiplexTransport(
             self.node_key,
@@ -325,14 +334,32 @@ class Node(BaseService):
             self.indexer_db = _make_db(config, "tx_index")
             self.tx_indexer = KVTxIndexer(self.indexer_db)
             self.block_indexer = KVBlockIndexer(self.indexer_db)
+        elif config.tx_index.indexer == "sqlite":
+            # external-DB sink (the reference's psql-sink tier,
+            # state/indexer/sink/psql/psql.go:250): relational event
+            # storage, SQL-translated search
+            from ..state.sink import (
+                SQLiteBlockIndexer,
+                SQLiteEventSink,
+                SQLiteTxIndexer,
+            )
+
+            self.indexer_db = None
+            self.event_sink = SQLiteEventSink(
+                os.path.join(config.base.resolve("data"), "events.sqlite")
+            )
+            self.tx_indexer = SQLiteTxIndexer(self.event_sink)
+            self.block_indexer = SQLiteBlockIndexer(self.event_sink)
+        else:
+            self.indexer_db = None
+            self.tx_indexer = None
+            self.block_indexer = None
+        if self.tx_indexer is not None:
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus
             )
             self.indexer_service.start()
         else:
-            self.indexer_db = None
-            self.tx_indexer = None
-            self.block_indexer = None
             self.indexer_service = None
 
         # 10. RPC environment + server (node.go:536 startRPC)
@@ -634,7 +661,7 @@ class Node(BaseService):
             pass
         for db in (
             self.app_db, self.block_db, self.state_db, self.evidence_db,
-            self.indexer_db,
+            self.indexer_db, getattr(self, "event_sink", None),
         ):
             if db is None:
                 continue

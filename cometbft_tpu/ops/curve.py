@@ -140,6 +140,26 @@ def point_double(p: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([mul(e, f), mul(g, h), mul(f, g), mul(e, h)])
 
 
+def point_double_n(p: jnp.ndarray, n: int) -> jnp.ndarray:
+    """n consecutive doublings, skipping T on all but the last.
+
+    Doubling reads only (X, Y, Z); T (the E*H product) is needed only by
+    the *add* that follows a doubling chain. Dropping it from the first
+    n-1 doublings saves one field mul each — doubling chains are ~2/3 of
+    the ladder's muls, so this is a free ~5% (64 windows x 3 muls)."""
+    x1, y1, z1 = p[0], p[1], p[2]
+    for i in range(n):
+        a = sq(x1)
+        b = sq(y1)
+        c = dbl2(sq(z1))
+        h = add(a, b)
+        e = sub(h, sq(add(x1, y1)))
+        g = sub(a, b)
+        f = add(c, g)
+        x1, y1, z1 = mul(e, f), mul(g, h), mul(f, g)
+    return jnp.stack([x1, y1, z1, mul(e, h)])
+
+
 def point_neg(p: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([neg(p[0]), p[1], p[2], neg(p[3])])
 
@@ -259,6 +279,56 @@ def _select(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(oh * table, axis=0)
 
 
+def build_pubkey_tables(y_a: jnp.ndarray, sign_a: jnp.ndarray):
+    """Decompress pubkeys and expand their 16-entry Niels tables.
+
+    The device-side half of the expanded-pubkey cache (the reference keeps
+    a 4096-entry LRU of expanded keys, crypto/ed25519/ed25519.go:31,56;
+    SURVEY §7(c) calls for HBM-resident tables keyed by validator set).
+    Validators recur every round — paying the ~254-squaring sqrt chain and
+    the 14-point-op table build once per KEY instead of once per LAUNCH
+    removes ~11% of the per-signature muls in steady state.
+
+    Returns (table (16, 4, 20, *B) int32, ok (*B,) bool).
+    """
+    a_pt, ok = decompress(y_a, sign_a)
+    return _build_a_table(a_pt), ok
+
+
+def verify_kernel_cached(
+    table_a: jnp.ndarray,
+    y_r: jnp.ndarray,
+    sign_r: jnp.ndarray,
+    s_nibs: jnp.ndarray,
+    kneg_nibs: jnp.ndarray,
+) -> jnp.ndarray:
+    """Cofactored verification with a PRE-EXPANDED pubkey table.
+
+    Same math as :func:`verify_kernel` minus A's decompression and table
+    build — callers gather per-lane tables from the HBM-resident cache
+    (ops/verify.PubkeyTableCache) and pass them in. Only R decompresses
+    here. Returns (*B,) bool; the caller must AND in the cached per-key
+    decompress-ok bits.
+    """
+    batch = y_r.shape[1:]
+    r_pt, ok_r = decompress(y_r, sign_r)
+    table_b = jnp.asarray(
+        _BASE_TABLE.reshape((TSIZE, 3, field.NLIMB) + (1,) * len(batch))
+    )
+    ident = broadcast_point(const_point(IDENTITY_INT), batch)
+
+    def body(j, acc):
+        acc = point_double_n(acc, WBITS)
+        acc = niels_add(acc, _select(table_a, kneg_nibs[j]))
+        acc = affine_niels_add(acc, _select(table_b, s_nibs[j]))
+        return acc
+
+    acc = jax.lax.fori_loop(0, WINDOWS, body, ident)
+    acc = affine_niels_add(acc, to_affine_niels(point_neg(r_pt)))
+    acc = point_double(point_double(point_double(acc)))
+    return is_identity(acc) & ok_r
+
+
 def verify_kernel(
     y_a: jnp.ndarray,
     sign_a: jnp.ndarray,
@@ -299,8 +369,7 @@ def verify_kernel(
     ident = broadcast_point(const_point(IDENTITY_INT), batch)
 
     def body(j, acc):
-        for _ in range(WBITS):
-            acc = point_double(acc)
+        acc = point_double_n(acc, WBITS)
         acc = niels_add(acc, _select(table_a, kneg_nibs[j]))
         acc = affine_niels_add(acc, _select(table_b, s_nibs[j]))
         return acc

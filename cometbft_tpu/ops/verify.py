@@ -22,6 +22,8 @@ nibbles) — see ops/field.py for why batch-minor wins on TPU.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from functools import lru_cache
 
 import numpy as np
@@ -170,6 +172,38 @@ def pack_inputs(pubkeys, msgs, sigs):
     return arrays, host_ok
 
 
+# -- device-side byte unpacking helpers (shared by the uncached, cached
+# and builder unpackers; a fork here would silently diverge the paths) --
+
+
+def _dev_le_bits(rows):  # (32, N) int32 -> (256, N)
+    import jax.numpy as jnp
+
+    shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
+    bits = (rows[:, None, :] >> shifts) & 1
+    return bits.reshape(256, rows.shape[-1])
+
+
+def _dev_y_limbs(bits):  # (256, N) -> (20, N)
+    import jax.numpy as jnp
+
+    n = bits.shape[-1]
+    padded = jnp.concatenate(
+        [bits[:255], jnp.zeros((5, n), jnp.int32)], axis=0
+    )
+    w = (1 << jnp.arange(field.BITS, dtype=jnp.int32)).reshape(1, -1, 1)
+    return jnp.sum(padded.reshape(field.NLIMB, field.BITS, n) * w, axis=1)
+
+
+def _dev_msb_nibbles(rows):  # (32, N) -> (64, N), MSB-first windows
+    import jax.numpy as jnp
+
+    lo = rows & 15
+    hi = rows >> 4
+    nibs = jnp.stack([lo, hi], axis=1).reshape(64, rows.shape[-1])
+    return nibs[::-1]
+
+
 def unpack_on_device(buf):
     """(128, N) uint8 wire buffer -> verify_kernel arrays, on device.
 
@@ -179,42 +213,226 @@ def unpack_on_device(buf):
     import jax.numpy as jnp
 
     b = buf.astype(jnp.int32)
-
-    def le_bits(rows):  # (32, N) -> (256, N)
-        shifts = jnp.arange(8, dtype=jnp.int32).reshape(1, 8, 1)
-        bits = (rows[:, None, :] >> shifts) & 1
-        return bits.reshape(256, rows.shape[-1])
-
-    def y_limbs(bits):  # (256, N) -> (20, N)
-        n = bits.shape[-1]
-        padded = jnp.concatenate(
-            [bits[:255], jnp.zeros((5, n), jnp.int32)], axis=0
-        )
-        w = (1 << jnp.arange(field.BITS, dtype=jnp.int32)).reshape(1, -1, 1)
-        return jnp.sum(
-            padded.reshape(field.NLIMB, field.BITS, n) * w, axis=1
-        )
-
-    def msb_nibbles(rows):  # (32, N) -> (64, N), MSB-first windows
-        lo = rows & 15
-        hi = rows >> 4
-        nibs = jnp.stack([lo, hi], axis=1).reshape(64, rows.shape[-1])
-        return nibs[::-1]
-
-    pk_bits = le_bits(b[0:32])
-    rr_bits = le_bits(b[32:64])
+    pk_bits = _dev_le_bits(b[0:32])
+    rr_bits = _dev_le_bits(b[32:64])
     return {
-        "y_a": y_limbs(pk_bits),
+        "y_a": _dev_y_limbs(pk_bits),
         "sign_a": pk_bits[255],
-        "y_r": y_limbs(rr_bits),
+        "y_r": _dev_y_limbs(rr_bits),
         "sign_r": rr_bits[255],
-        "s_nibs": msb_nibbles(b[64:96]),
-        "kneg_nibs": msb_nibbles(b[96:128]),
+        "s_nibs": _dev_msb_nibbles(b[64:96]),
+        "kneg_nibs": _dev_msb_nibbles(b[96:128]),
     }
 
 
 def _kernel_from_bytes(buf):
     return curve.verify_kernel(**unpack_on_device(buf))
+
+
+# ------------------------------------------------------------------ cache
+# HBM-resident expanded-pubkey cache. The reference keeps a 4096-entry
+# LRU of expanded pubkeys because validators recur every round
+# (crypto/ed25519/ed25519.go:31,56); the TPU analog caches each key's
+# DECOMPRESSED point + 16-entry Niels table in a device arena, so a
+# steady-state commit verify ships only (R, S, -k) plus 4-byte slot
+# indices and skips the ~254-squaring sqrt chain and the 14-point-op
+# table build entirely (~11% of per-signature muls, SURVEY §7(c)).
+
+
+def _unpack_rsk_on_device(buf):
+    """(96, N) uint8 rows R|S|kneg -> cached-kernel arrays, on device."""
+    import jax.numpy as jnp
+
+    b = buf.astype(jnp.int32)
+    rr_bits = _dev_le_bits(b[0:32])
+    return {
+        "y_r": _dev_y_limbs(rr_bits),
+        "sign_r": rr_bits[255],
+        "s_nibs": _dev_msb_nibbles(b[32:64]),
+        "kneg_nibs": _dev_msb_nibbles(b[64:96]),
+    }
+
+
+def _cached_kernel(arena, arena_ok, idxs, buf):
+    arrays = _unpack_rsk_on_device(buf)
+    table = arena[:, :, :, idxs]
+    ok = curve.verify_kernel_cached(table, **arrays)
+    return ok & arena_ok[idxs]
+
+
+def _cached_kernel_pallas(arena, arena_ok, idxs, buf):
+    from . import pallas_verify
+
+    arrays = _unpack_rsk_on_device(buf)
+    table = arena[:, :, :, idxs]
+    return pallas_verify.verify_kernel_cached(
+        table, arena_ok[idxs], **arrays
+    )
+
+
+def _builder_kernel(buf):
+    """(32, M) uint8 pubkey bytes -> (table, ok) for the arena."""
+    import jax.numpy as jnp
+
+    bits = _dev_le_bits(buf.astype(jnp.int32))
+    return curve.build_pubkey_tables(_dev_y_limbs(bits), bits[255])
+
+
+def _scatter_kernel(arena, arena_ok, slots, tables, oks):
+    arena = arena.at[:, :, :, slots].set(tables)
+    arena_ok = arena_ok.at[slots].set(oks)
+    return arena, arena_ok
+
+
+@lru_cache(maxsize=None)
+def _cached_jits():
+    _enable_compilation_cache()
+    # NOTE: the scatter deliberately does NOT donate the arena — a verify
+    # thread may hold the previous arena reference (handed out by lookup)
+    # and dispatch against it after the update; donation would invalidate
+    # that buffer under it. Updates are rare (new validator keys), the
+    # ~21 MB copy is cheap. (The verify-side jits live in
+    # _jitted_cached_kernel, keyed by lowering.)
+    return (
+        jax.jit(_builder_kernel),
+        jax.jit(_scatter_kernel),
+    )
+
+
+@lru_cache(maxsize=None)
+def _jitted_cached_kernel(which: str):
+    _enable_compilation_cache()
+    fn = _cached_kernel_pallas if which == "pallas" else _cached_kernel
+    return jax.jit(fn)
+
+
+def _run_cached_kernel(arena, arena_ok, idxs, buf):
+    """Cached-table launch with the same Pallas/XLA selection and Mosaic
+    fallback discipline as :func:`_run_kernel`."""
+    if (
+        buf.shape[1] >= _PALLAS_MIN_LANES
+        and _pallas_wanted()
+        and not _PALLAS_BROKEN
+    ):
+        try:
+            return (
+                _jitted_cached_kernel("pallas")(arena, arena_ok, idxs, buf),
+                True,
+            )
+        except Exception as e:
+            _note_pallas_broken(e)
+    return _jitted_cached_kernel("xla")(arena, arena_ok, idxs, buf), False
+
+
+class PubkeyTableCache:
+    """LRU arena of expanded pubkey tables resident on device.
+
+    ``lookup`` maps pubkey byte strings to slot indices, building missing
+    entries in one bucketed launch and scattering them into the arena.
+    Thread-safe: verify paths run from consensus, blocksync and RPC
+    threads concurrently; a scatter produces a NEW arena value (no
+    donation), so a verify dispatched against the previous arena keeps a
+    live buffer and gathers never race an eviction.
+    """
+
+    CAPACITY = 4096  # matches the reference LRU; ~21 MB of HBM
+
+    def __init__(self, capacity: int = CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._slots: OrderedDict[bytes, int] = OrderedDict()
+        self._arena = None
+        self._arena_ok = None
+        self.hits = 0
+        self.misses = 0
+
+    def _ensure_arena(self):
+        import jax.numpy as jnp
+
+        if self._arena is None:
+            # +1 scratch slot: bucket-padding lanes of a build scatter
+            # there (duplicate scatter indices have an unspecified
+            # winner, so pads must never alias a real slot)
+            self._arena = jnp.zeros(
+                (curve.TSIZE, 4, field.NLIMB, self.capacity + 1), jnp.int32
+            )
+            self._arena_ok = jnp.zeros((self.capacity + 1,), bool)
+
+    def lookup(self, pubkeys):
+        """Per-pubkey slot indices into the arena, building misses.
+
+        Returns (idxs (N,) int32, arena, arena_ok), or None when the
+        call's UNIQUE keys exceed the arena (every lane of one gather
+        needs a live slot — callers fall back to the uncached kernel).
+        Keys used by the current call are pinned: eviction never frees a
+        slot this call's gather will read. The arrays are returned
+        together under the lock so a concurrent update can't tear the
+        (idxs, arena) pairing.
+        """
+        builder, scatter = _cached_jits()
+        with self._lock:
+            self._ensure_arena()
+            in_use = {bytes(pk) for pk in pubkeys}
+            if len(in_use) > self.capacity:
+                return None
+            idxs = np.empty(len(pubkeys), np.int32)
+            missing: dict[bytes, list[int]] = {}
+            for i, pk in enumerate(pubkeys):
+                pk = bytes(pk)
+                slot = self._slots.get(pk)
+                if slot is not None:
+                    self._slots.move_to_end(pk)
+                    idxs[i] = slot
+                    self.hits += 1
+                else:
+                    missing.setdefault(pk, []).append(i)
+                    self.misses += 1
+            if missing:
+                new_keys = list(missing.keys())
+                m = len(new_keys)
+                size = _MIN_BUCKET
+                while size < m:
+                    size *= 2
+                buf = np.zeros((32, size), np.uint8)
+                for j, pk in enumerate(new_keys):
+                    if len(pk) == 32:
+                        buf[:, j] = np.frombuffer(pk, np.uint8)
+                tables, oks = builder(buf)
+                slots = np.full(size, self.capacity, np.int32)  # scratch
+                for j, pk in enumerate(new_keys):
+                    if len(self._slots) >= self.capacity:
+                        # evict the oldest key NOT referenced by this
+                        # call (an in-use eviction would redirect an
+                        # already-assigned idx to a foreign table)
+                        slot = None
+                        for old in self._slots:
+                            if old not in in_use:
+                                slot = self._slots.pop(old)
+                                break
+                        # unreachable: len(in_use) <= capacity guarantees
+                        # an evictable slot exists
+                        assert slot is not None
+                    else:
+                        slot = len(self._slots)
+                    self._slots[pk] = slot
+                    slots[j] = slot
+                    for i in missing[pk]:
+                        idxs[i] = slot
+                import jax.numpy as jnp
+
+                host_wellformed = np.array(
+                    [len(pk) == 32 for pk in new_keys]
+                    + [True] * (size - m),
+                    bool,
+                )
+                oks = jnp.logical_and(oks, jnp.asarray(host_wellformed))
+                self._arena, self._arena_ok = scatter(
+                    self._arena, self._arena_ok, slots, tables, oks
+                )
+            return idxs, self._arena, self._arena_ok
+
+
+_PUBKEY_CACHE = PubkeyTableCache()
 
 
 def _kernel_from_bytes_pallas(buf):
@@ -363,6 +581,121 @@ def verify_bytes_async(buf: np.ndarray, n: int):
     return lambda: _materialize(out, used_pallas, buf)[:n]
 
 
+def _cache_enabled() -> bool:
+    import os
+
+    return os.environ.get("COMETBFT_TPU_PUBKEY_CACHE", "1") != "0"
+
+
+def _shard_devices():
+    """Devices to shard verify_batch over, or None for single-device.
+
+    COMETBFT_TPU_SHARD: "1" forces sharding whenever >1 device exists
+    (the CPU virtual-device tier), "0" disables, default "auto" shards
+    only on real accelerator backends — the 8-device virtual CPU mesh
+    used by the test suite must not silently reroute every unit test
+    through pjit. SURVEY §2.9: production batches shard over the
+    signature axis when the host has multiple chips.
+    """
+    import os
+
+    mode = os.environ.get("COMETBFT_TPU_SHARD", "auto")
+    if mode == "0":
+        return None
+    try:
+        devs = jax.devices()
+    except Exception:
+        return None
+    if len(devs) < 2:
+        return None
+    if mode != "1" and jax.default_backend() not in ("tpu", "axon"):
+        return None
+    return devs
+
+
+def _verify_batch_sharded(pubkeys, msgs, sigs, n_dev: int):
+    """Shard one flat batch over the signature axis of the device mesh.
+
+    Lanes are padded to n_dev x pow2 so each (device-count, bucket)
+    shape compiles once; the one cross-device collective is the 1-byte
+    per-commit verdict all-reduce (parallel/mesh.py).
+    """
+    from ..parallel import mesh as pmesh
+
+    n = len(pubkeys)
+    arrays, host_ok = pack_inputs(pubkeys, msgs, sigs)
+    per_dev = _MIN_BUCKET
+    while per_dev * n_dev < n:
+        per_dev *= 2
+    nb = per_dev * n_dev
+    if nb != n:
+        arrays = {
+            k: np.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, nb - n)])
+            for k, v in arrays.items()
+        }
+        host_ok = np.pad(host_ok, (0, nb - n))
+    ok = pmesh.verify_sharded(
+        arrays, host_ok, pmesh.default_mesh(), 1, nb
+    )[0][:n]
+    return bool(ok.all()), ok
+
+
+def verify_rsk_async(buf: np.ndarray, idxs: np.ndarray, arena, arena_ok,
+                     n: int):
+    """Dispatch a cached-table launch: (96, n) R|S|kneg rows + arena slots.
+
+    Same async contract as :func:`verify_bytes_async`. ``n`` must be
+    <= _CHUNK (callers chunk above that)."""
+    size = bucket_size(n)
+    if size != n:
+        buf = np.pad(buf, [(0, 0), (0, size - n)])
+        idxs = np.pad(idxs, (0, size - n))  # slot 0 gather: harmless
+    out, used_pallas = _run_cached_kernel(arena, arena_ok, idxs, buf)
+
+    def materialize():
+        try:
+            return np.asarray(out)[:n]
+        except Exception as e:
+            if not used_pallas:
+                raise
+            _note_pallas_broken(e)
+            return np.asarray(
+                _jitted_cached_kernel("xla")(arena, arena_ok, idxs, buf)
+            )[:n]
+
+    return materialize
+
+
+def verify_prepacked(buf: np.ndarray, keys, n: int):
+    """Async verify of a pre-packed (128, n) wire buffer with cache routing.
+
+    ``keys``: per-lane 32-byte edwards A encodings (b"" / short for
+    host-rejected lanes — they verify False via the arena ok bit). Used
+    by schemes that pack their own challenge (sr25519: merlin transcript
+    k, crypto/sr25519.py) but share the cofactored kernel — and the
+    expanded-point cache, since the arena is keyed by the edwards
+    encoding itself.
+    """
+    if not _cache_enabled():
+        return verify_bytes_async(buf, n)
+    finals = []
+    for lo in range(0, n, _CHUNK):
+        hi = min(lo + _CHUNK, n)
+        hit = _PUBKEY_CACHE.lookup(keys[lo:hi])
+        if hit is not None:
+            idxs, arena, arena_ok = hit
+            finals.append(
+                verify_rsk_async(
+                    buf[32:, lo:hi], idxs, arena, arena_ok, hi - lo
+                )
+            )
+        else:
+            finals.append(verify_bytes_async(buf[:, lo:hi], hi - lo))
+    if len(finals) == 1:
+        return finals[0]
+    return lambda: np.concatenate([f() for f in finals])
+
+
 def verify_batch(pubkeys, msgs, sigs) -> tuple[bool, np.ndarray]:
     """Verify a batch of ed25519 signatures on device.
 
@@ -370,26 +703,38 @@ def verify_batch(pubkeys, msgs, sigs) -> tuple[bool, np.ndarray]:
     engine's crypto.BatchVerifier.Verify (crypto/crypto.go:45-54), including
     per-lane results so callers can attribute failures without a second pass
     (types/validation.go:243-250's find-first-invalid fallback).
+
+    Steady state routes through the expanded-pubkey cache: per lane the
+    device receives 96 bytes (R, S, -k) plus a 4-byte arena slot, and the
+    kernel skips pubkey decompression + table build entirely.
     """
     n = len(pubkeys)
     if n == 0:
         return True, np.zeros(0, bool)
-    if n <= _PIPE_CHUNK:
-        buf, host_ok = pack_bytes(pubkeys, msgs, sigs)
-        device_ok = verify_bytes_async(buf, n)()
-    else:
+    devs = _shard_devices()
+    if devs is not None:
+        return _verify_batch_sharded(pubkeys, msgs, sigs, len(devs))
+    use_cache = _cache_enabled()
+    finals, host_oks = [], []
+    step = min(_PIPE_CHUNK, _CHUNK)
+    for lo in range(0, n, step):
+        hi = min(lo + step, n)
         # Pipeline host packing with device execution: each chunk is
         # dispatched as soon as it is packed, so the per-lane SHA-512 /
-        # packing cost of chunk i+1 overlaps chunk i's kernel time
-        # (~15% of the round trip at 4096 lanes otherwise serialized).
-        finals, host_oks = [], []
-        for lo in range(0, n, _PIPE_CHUNK):
-            hi = min(lo + _PIPE_CHUNK, n)
-            buf, hok = pack_bytes(
-                pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi]
+        # packing cost of chunk i+1 overlaps chunk i's kernel time.
+        buf, hok = pack_bytes(pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi])
+        hit = _PUBKEY_CACHE.lookup(pubkeys[lo:hi]) if use_cache else None
+        if hit is not None:
+            idxs, arena, arena_ok = hit
+            finals.append(
+                verify_rsk_async(buf[32:], idxs, arena, arena_ok, hi - lo)
             )
+        else:
             finals.append(verify_bytes_async(buf, hi - lo))
-            host_oks.append(hok)
+        host_oks.append(hok)
+    if len(finals) == 1:
+        device_ok, host_ok = finals[0](), host_oks[0]
+    else:
         device_ok = np.concatenate([f() for f in finals])
         host_ok = np.concatenate(host_oks)
     valid = device_ok & host_ok

@@ -80,6 +80,14 @@ def pad_to(n: int, multiple: int) -> int:
     return (n + multiple - 1) // multiple * multiple
 
 
+@lru_cache(maxsize=None)
+def default_mesh() -> Mesh:
+    """Process-wide (1, n_devices) mesh: one commit, all chips on the
+    signature axis — the consensus hot-path layout. Cached so the
+    production dispatch (ops/verify.verify_batch) builds it once."""
+    return make_mesh(commit_axis=1)
+
+
 def verify_sharded(
     arrays: dict,
     host_ok: np.ndarray,

@@ -122,6 +122,7 @@ def _node_info_json(env) -> dict:
         "id": ni.node_id,
         "listen_addr": ni.listen_addr,
         "network": ni.network,
+        "version": ni.version,
         "moniker": ni.moniker,
         "channels": enc.hex_bytes(bytes(ni.channels or [])),
     }

@@ -10,7 +10,12 @@ Reference: types/vote_set.go. Key behaviors preserved:
 * signature verification happens BEFORE admission. Beyond the reference,
   ``add_votes_batch`` admits a whole micro-batch through the device
   verifier in one launch (the SURVEY §7(d) vote-ingest design; single
-  ``add_vote`` keeps the reference's per-vote path).
+  ``add_vote`` keeps the reference's per-vote path);
+* an internal mutex (vote_set.go:60 ``mtx``): admission runs on the
+  consensus receive thread, but per-peer gossip routines concurrently
+  read bit arrays / tallies and blocksync builds commits — multi-field
+  state (votes, bit array, sum, maj23) must never tear across readers
+  (exercised by tests/test_stress_concurrency.py, the ``-race`` tier).
 """
 
 from __future__ import annotations
@@ -18,6 +23,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..crypto import batch as crypto_batch
+from ..libs import sync as libsync
 from ..libs.bits import BitArray
 from . import canonical
 from .block import (
@@ -88,6 +94,7 @@ class VoteSet:
         # skips the signature check (SURVEY §7(d)); entries are popped on
         # use to bound memory.
         self.sig_memo = sig_memo
+        self._mtx = libsync.RLock("vote_set")
         self.chain_id = chain_id
         self.height = height
         self.round = round_
@@ -122,17 +129,21 @@ class VoteSet:
     def has_two_thirds_any(self) -> bool:
         # Integer math: float division diverges from the reference's int64
         # arithmetic once total power exceeds 2^53 (vote_set.go:340).
-        return 3 * self.sum > 2 * self.val_set.total_voting_power()
+        with self._mtx:
+            return 3 * self.sum > 2 * self.val_set.total_voting_power()
 
     def has_all(self) -> bool:
-        return self.sum == self.val_set.total_voting_power()
+        with self._mtx:
+            return self.sum == self.val_set.total_voting_power()
 
     def bit_array(self) -> BitArray:
-        return self.votes_bit_array.copy()
+        with self._mtx:
+            return self.votes_bit_array.copy()
 
     def bit_array_by_block_id(self, block_id: BlockID) -> BitArray | None:
-        bv = self.votes_by_block.get(block_id.key())
-        return bv.bit_array.copy() if bv is not None else None
+        with self._mtx:
+            bv = self.votes_by_block.get(block_id.key())
+            return bv.bit_array.copy() if bv is not None else None
 
     # --- vote admission ------------------------------------------------------
 
@@ -141,10 +152,11 @@ class VoteSet:
 
         Returns True if the vote was newly added; raises on invalid votes.
         """
-        self._check_vote(vote)
-        val = self.val_set.get_by_index(vote.validator_index)
-        self._verify_vote_signature(vote, val.pub_key)
-        return self._admit(vote, val)
+        with self._mtx:
+            self._check_vote(vote)
+            val = self.val_set.get_by_index(vote.validator_index)
+            self._verify_vote_signature(vote, val.pub_key)
+            return self._admit(vote, val)
 
     def add_votes_batch(
         self, votes: list[Vote]
@@ -161,6 +173,10 @@ class VoteSet:
         bad signature / malformed vote) so the batched path surfaces the
         same signals as single ``add_vote``.
         """
+        with self._mtx:
+            return self._add_votes_batch_locked(votes)
+
+    def _add_votes_batch_locked(self, votes):
         n = len(votes)
         added = [False] * n
         errors: list[Exception | None] = [None] * n
@@ -217,6 +233,10 @@ class VoteSet:
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
         """Record a peer's claim of 2/3 for a block (vote_set.go:335-378):
         future conflicting votes for that block become admissible."""
+        with self._mtx:
+            self._set_peer_maj23_locked(peer_id, block_id)
+
+    def _set_peer_maj23_locked(self, peer_id: str, block_id: BlockID) -> None:
         existing = self.peer_maj23s.get(peer_id)
         if existing is not None:
             if existing == block_id:
@@ -367,6 +387,10 @@ class VoteSet:
 
     def make_commit(self) -> Commit:
         """Build a Commit from the 2/3 majority (vote_set.go MakeCommit)."""
+        with self._mtx:
+            return self._make_commit_locked()
+
+    def _make_commit_locked(self) -> Commit:
         if self.signed_msg_type != canonical.PRECOMMIT_TYPE:
             raise VoteSetError("cannot MakeCommit from non-precommit set")
         if self.maj23 is None:
@@ -396,7 +420,15 @@ class VoteSet:
         """Commit + vote extensions (vote_set.go MakeExtendedCommit:636)."""
         from .block import ExtendedCommit, ExtendedCommitSig
 
-        commit = self.make_commit()
+        with self._mtx:
+            return self._make_extended_commit_locked(
+                require_extensions, ExtendedCommit, ExtendedCommitSig
+            )
+
+    def _make_extended_commit_locked(
+        self, require_extensions, ExtendedCommit, ExtendedCommitSig
+    ):
+        commit = self._make_commit_locked()
         ext_sigs = []
         for i, cs in enumerate(commit.signatures):
             vote = self.votes[i]

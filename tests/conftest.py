@@ -29,3 +29,38 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# The quick tier (`pytest -m quick`, < 60 s): suites with no JAX kernel
+# compilation, no multi-node nets, no process spawning — the inner-loop
+# answer to the full run's ~10 minutes. CI runs both tiers.
+_QUICK_FILES = {
+    "test_abci.py",
+    "test_aead_armor.py",
+    "test_cli_config.py",
+    "test_cli_reindex_compact.py",
+    "test_crypto_host.py",
+    "test_db_native.py",
+    "test_evidence.py",
+    "test_host_batch.py",
+    "test_indexer.py",
+    "test_libs.py",
+    "test_light.py",
+    "test_observability.py",
+    "test_p2p.py",
+    "test_pex.py",
+    "test_rpc.py",
+    "test_sink.py",
+    "test_state_exec.py",
+    "test_types.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (
+            item.fspath.basename in _QUICK_FILES
+            and "slow" not in item.keywords
+        ):
+            item.add_marker(pytest.mark.quick)

@@ -486,7 +486,11 @@ class TestBatchedVoteIngest:
 
         verify_time = 0.0
         many_calls = 0
-        orig_many = fast25519.verify_many
+        # the host batch path is the native RLC verifier now
+        # (crypto/host_batch); fall back probe kept on fast25519 too
+        from cometbft_tpu.crypto import host_batch
+
+        orig_many = host_batch.verify_many
 
         def timed_many(*a, **k):
             nonlocal verify_time, many_calls
@@ -497,7 +501,7 @@ class TestBatchedVoteIngest:
             return out
 
         ed25519_ref.verify = counting_ref_verify
-        fast25519.verify_many = timed_many
+        host_batch.verify_many = timed_many
         try:
             cs.start()
             deadline = _time.time() + 10
@@ -545,7 +549,7 @@ class TestBatchedVoteIngest:
             ingest = _time.perf_counter() - t0
         finally:
             ed25519_ref.verify = orig_ref_verify
-            fast25519.verify_many = orig_many
+            host_batch.verify_many = orig_many
             helpers.stop_node(cs, parts)
 
         assert ref_calls == 0, (

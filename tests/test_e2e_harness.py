@@ -73,6 +73,98 @@ def test_load_tx_roundtrip():
 
 
 @pytest.mark.slow
+def test_restart_in_full_quorum_net_keeps_liveness(tmp_path):
+    """Regression: restarting ANY validator of a 3-node net (ALL three
+    needed for +2/3) must not wedge consensus. This caught three real
+    bugs: (1) blocksync demanding height == maxPeerHeight deadlocks at
+    the tip (the last block is only verifiable by consensus catch-up,
+    pool.go IsCaughtUp uses maxPeerHeight-1); (2) announcing our round
+    step in add_peer while wait_sync invites vote gossip that is dropped
+    but marked delivered (reference AddPeer skips the announcement);
+    (3) apply_vote_set_bits could only SET has-vote marks, never CLEAR
+    them, disabling the maj23-query self-heal."""
+    port = _free_port_block()
+    net = Testnet.generate(str(tmp_path / "net"), 3, port)
+    _speed_up(net)
+    for node in net.nodes:
+        node.env = _env()
+    net.start()
+    try:
+        assert all(n.wait_rpc(60.0) for n in net.nodes)
+        assert net.wait_all_height(3, 90.0), "testnet never made blocks"
+        for i in (0, 1):  # restart two different nodes in sequence
+            pre = max(n.height() for n in net.live_nodes())
+            net.nodes[i].restart()
+            assert net.nodes[i].wait_rpc(60.0), f"node{i} never came back"
+            assert net.wait_all_height(pre + 2, 90.0), (
+                f"wedged after restarting node{i}: "
+                f"{[n.height() for n in net.live_nodes()]}"
+            )
+        net.check_app_hash_agreement()
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_generated_topology_with_upgrade(tmp_path):
+    """The reference's generator + upgrade story (test/e2e/README.md:36-60,
+    runner/perturb.go:16-31): a SEEDED randomized manifest (validator
+    count, topology, timeouts, storage backend) runs under load while one
+    node is upgraded mid-run — clean stop, restart under a bumped
+    advertised version + new-version config defaults, SAME data dir. The
+    upgraded node must rejoin via handshake replay, the chain must keep
+    advancing, app hashes must agree, and mixed versions must interoperate.
+    """
+    port = _free_port_block()
+    net = Testnet.generate_randomized(str(tmp_path / "net"), seed=1337,
+                                      starting_port=port)
+    assert os.path.exists(str(tmp_path / "net" / "manifest.json"))
+    _speed_up(net)  # keep CI time bounded regardless of drawn timeouts
+    for node in net.nodes:
+        node.env = _env()
+    net.start()
+    try:
+        assert all(n.wait_rpc(60.0) for n in net.nodes), "RPC never came up"
+        assert net.wait_all_height(2, 90.0), "testnet never made blocks"
+
+        gen = LoadGenerator(
+            [n.rpc_addr for n in net.nodes],
+            rate=10,
+            connections=1,
+            run_id="upg1",
+        )
+        gen.start()
+        try:
+            time.sleep(1.5)
+            pre_h = net.nodes[0].height()
+
+            def v2_config(cfg):
+                cfg.consensus = dataclasses.replace(
+                    cfg.consensus, timeout_commit_ns=150 * _MS
+                )
+
+            net.nodes[0].upgrade(
+                "cometbft-tpu/0.2.0-rc1", config_mutator=v2_config
+            )
+            assert net.nodes[0].wait_rpc(60.0), "upgraded node never rejoined"
+            assert net.nodes[0].advertised_version() == "cometbft-tpu/0.2.0-rc1"
+            # chain continuity: the upgraded node resumes FROM its data
+            # dir (handshake replay), it does not restart at zero
+            assert net.nodes[0].wait_height(pre_h, 60.0), (
+                "upgraded node lost its chain"
+            )
+            time.sleep(1.5)
+        finally:
+            gen.stop()
+        assert gen.sent > 0
+
+        net.check_progress(blocks=2, timeout=90.0)
+        net.check_app_hash_agreement()
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
 def test_perturbed_testnet_under_load(tmp_path):
     port = _free_port_block()
     # 4 validators: the smallest BFT net that tolerates one faulty

@@ -1,0 +1,111 @@
+"""Native RLC batch verifier tests (crypto/host_batch.py + edbatch.cpp).
+
+Reference analog: curve25519-voi batch verification behind
+crypto/ed25519/ed25519.go:196-228 — RLC over the cofactored equation,
+one multiscalar multiplication, binary-split attribution on failure.
+Must agree lane-for-lane with the pure-Python ZIP-215 oracle.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.crypto import fast25519, host_batch
+
+pytestmark = pytest.mark.skipif(
+    not host_batch.available(), reason="native toolchain unavailable"
+)
+
+rng = random.Random(42)
+
+
+def _make(n, base=1):
+    seeds = [bytes([base + i % 40]) + bytes(31) for i in range(n)]
+    pks = [fast25519.pubkey_from_seed(s) for s in seeds]
+    msgs = [b"hb-%d" % i for i in range(n)]
+    sigs = [fast25519.sign_one(seeds[i], msgs[i]) for i in range(n)]
+    return pks, msgs, sigs
+
+
+def test_all_valid_batch():
+    pks, msgs, sigs = _make(40)
+    assert host_batch.verify_many(pks, msgs, sigs) == [True] * 40
+
+
+def test_attribution_matches_oracle():
+    pks, msgs, sigs = _make(32)
+    bad = {0, 7, 19, 31}
+    for b in bad:
+        sigs[b] = sigs[b][:-1] + bytes([sigs[b][-1] ^ 1])
+    msgs[3] = b"tampered"
+    pks[5] = b"short"  # malformed length
+    pks[6] = (2).to_bytes(32, "little")  # not on the curve
+    sigs[9] = sigs[9][:32] + ref.L.to_bytes(32, "little")  # S >= L
+    out = host_batch.verify_many(pks, msgs, sigs)
+    expect = [
+        len(pks[i]) == 32 and ref.verify(pks[i], msgs[i], sigs[i])
+        for i in range(32)
+    ]
+    assert out == expect
+
+
+def test_zip215_exceptional_lanes():
+    """Non-canonical identity encoding (y = 1 + p) and an order-8 pubkey
+    accepted only by the cofactored equation — the consensus-critical
+    acceptance set (crypto/ed25519/ed25519.go:26-29)."""
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_curve import _order8_point
+
+    nc_ident = (1 + ref.P).to_bytes(32, "little")
+    s = 5
+    r_enc = ref.compress(ref.scalar_mult(s, ref.BASE))
+    sig_ident = r_enc + s.to_bytes(32, "little")
+
+    a_enc = ref.compress(_order8_point())
+    zmsg = next(
+        b"z%d" % i
+        for i in range(64)
+        if ref.challenge_scalar(r_enc, a_enc, b"z%d" % i) % 8 != 0
+    )
+    sig8 = r_enc + s.to_bytes(32, "little")
+    assert ref.verify(nc_ident, b"anything", sig_ident)
+    assert ref.verify(a_enc, zmsg, sig8)
+
+    pks, msgs, sigs = _make(3, base=60)
+    sigs[1] = sigs[2]  # corrupt middle lane
+    out = host_batch.verify_many(
+        [pks[0], nc_ident, pks[1], a_enc, pks[2]],
+        [msgs[0], b"anything", msgs[1], zmsg, msgs[2]],
+        [sigs[0], sig_ident, sigs[1], sig8, sigs[2]],
+    )
+    assert out == [True, True, False, True, True]
+
+
+def test_random_fuzz_vs_oracle():
+    pks, msgs, sigs = _make(24, base=100)
+    for i in range(24):
+        mode = rng.randrange(4)
+        if mode == 1:
+            b = bytearray(sigs[i])
+            b[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sigs[i] = bytes(b)
+        elif mode == 2:
+            b = bytearray(pks[i])
+            b[rng.randrange(32)] ^= 1 << rng.randrange(8)
+            pks[i] = bytes(b)
+        elif mode == 3:
+            msgs[i] = msgs[i] + b"x"
+    out = host_batch.verify_many(pks, msgs, sigs)
+    expect = [ref.verify(pks[i], msgs[i], sigs[i]) for i in range(24)]
+    assert out == expect
+
+
+def test_single_lane_and_empty():
+    pks, msgs, sigs = _make(1)
+    assert host_batch.verify_many(pks, msgs, sigs) == [True]
+    assert host_batch.verify_many([], [], []) == []
+    sigs[0] = bytes(64)
+    assert host_batch.verify_many(pks, msgs, sigs) == [False]

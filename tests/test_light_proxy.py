@@ -218,15 +218,27 @@ def _assert_block_refused(node, tamper):
         p.stop()
 
 
-def test_lying_primary_wrong_block_id_rejected(node):
-    """A tampered block_id alongside GENUINE content must still be
-    refused — the id travels back to the caller (light/rpc/client.go
-    Block() compares res.BlockID.Hash to the recomputed block hash)."""
+def test_lying_primary_block_id_never_relayed(node):
+    """The primary's claimed block_id is NEVER relayed: the response is
+    a re-encoding of the verified block, its id taken from the
+    light-verified commit. Tampering the claimed id changes nothing."""
 
     def tamper(res):
         res["block_id"]["hash"] = "AB" * 32
 
-    _assert_block_refused(node, tamper)
+    p = _lying_proxy(node, tamper)
+    p.start()
+    try:
+        c = HTTPClient(p.bound_addr)
+        res = c.call("block", height=3)
+        meta = node.block_store.load_block_meta(3)
+        assert res["block_id"]["hash"] == meta.block_id.hash.hex().upper()
+        assert (
+            res["block_id"]["parts"]["hash"]
+            == meta.block_id.part_set_header.hash.hex().upper()
+        )
+    finally:
+        p.stop()
 
 
 def test_lying_primary_tampered_header_rejected(node):
@@ -247,11 +259,21 @@ def test_lying_primary_tampered_time_rejected(node):
     _assert_block_refused(node, tamper)
 
 
-def test_lying_primary_injected_evidence_rejected(node):
+def test_lying_primary_injected_evidence_not_relayed(node):
+    """Injected evidence JSON is outside the verified surface; the
+    re-encoded response must not carry it."""
+
     def tamper(res):
         res["block"]["evidence"] = {"evidence": [{"fake": True}]}
 
-    _assert_block_refused(node, tamper)
+    p = _lying_proxy(node, tamper)
+    p.start()
+    try:
+        c = HTTPClient(p.bound_addr)
+        res = c.call("block", height=3)
+        assert res["block"]["evidence"]["evidence"] == []
+    finally:
+        p.stop()
 
 
 def test_lying_primary_injected_commit_on_block1_rejected(node):
@@ -283,6 +305,33 @@ def test_lying_primary_injected_commit_on_block1_rejected(node):
         c = HTTPClient(p.bound_addr)
         with pytest.raises(RPCError):
             c.call("block", height=1)
+    finally:
+        p.stop()
+
+
+def test_lying_primary_unsigned_commit_metadata_not_relayed(node):
+    """Fabricated commit METADATA with empty signatures on block 1 (the
+    review's bypass of the signed-commit guard) must not survive the
+    re-encoding."""
+
+    def tamper(res):
+        if int(res["block"]["header"]["height"]) == 1:
+            res["block"]["last_commit"] = {
+                "height": "999",
+                "round": 9,
+                "block_id": {
+                    "hash": "AB" * 32,
+                    "parts": {"total": 1, "hash": "AB" * 32},
+                },
+                "signatures": [],
+            }
+
+    p = _lying_proxy(node, tamper)
+    p.start()
+    try:
+        c = HTTPClient(p.bound_addr)
+        res = c.call("block", height=1)
+        assert res["block"]["last_commit"] is None
     finally:
         p.stop()
 

@@ -1,0 +1,219 @@
+"""SQLite event sink tests (reference analog: the psql sink,
+state/indexer/sink/psql/psql.go:250 + psql_test.go).
+
+The core assertion is QUERY PARITY: over a generated chain of events,
+every search the kv indexer answers must be answered identically by the
+SQL-translated sink — same tx sets, same ordering, same heights.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+from cometbft_tpu.crypto import tmhash
+from cometbft_tpu.state.indexer import KVBlockIndexer, KVTxIndexer, TxRecord
+from cometbft_tpu.state.sink import SQLiteEventSink
+
+
+def _rec(height, index, tx):
+    return TxRecord(
+        height=height, index=index, tx=tx, result=ExecTxResult(code=0)
+    )
+
+
+def _ev(type_, **attrs):
+    return Event(
+        type=type_,
+        attributes=[
+            EventAttribute(key=k, value=v, index=True)
+            for k, v in attrs.items()
+        ],
+    )
+
+
+@pytest.fixture
+def pair():
+    """(kv_tx, kv_blk, sink) fed the SAME generated chain."""
+    kv_tx = KVTxIndexer()
+    kv_blk = KVBlockIndexer()
+    sink = SQLiteEventSink()
+    rng = random.Random(9)
+    senders = ["alice", "bob", "carol"]
+    idx = 0
+    for height in range(1, 21):
+        blk_events = [
+            _ev("block_meta", proposer=senders[height % 3]),
+            _ev("rewards", amount=str(height * 10)),
+            # OVERLAPS tx event types: block searches must not match
+            # tx-event attributes and vice versa (separate keyspaces in
+            # the kv indexers; tx_id discriminator in the sink)
+            _ev("transfer", sender="block-scope", amount=str(height)),
+        ]
+        kv_blk.index(height, blk_events)
+        sink.index_block(height, blk_events)
+        for i in range(rng.randrange(0, 4)):
+            tx = b"tx-%d" % idx
+            idx += 1
+            events = [
+                _ev(
+                    "transfer",
+                    sender=senders[rng.randrange(3)],
+                    amount=str(rng.randrange(1, 500)),
+                ),
+                _ev("app", key="k%d" % (idx % 5)),
+            ]
+            kv_tx.index(_rec(height, i, tx), events)
+            sink.index_tx(_rec(height, i, tx), events)
+    yield kv_tx, kv_blk, sink
+    sink.close()
+
+
+TX_QUERIES = [
+    "transfer.sender = 'alice'",
+    "transfer.sender = 'bob' AND transfer.amount > 100",
+    "transfer.amount >= 250",
+    "transfer.amount < 20",
+    "tx.height = 7",
+    "tx.height >= 15",
+    "tx.height > 3 AND tx.height <= 9",
+    "app.key = 'k2'",
+    "transfer.sender CONTAINS 'ali'",
+    "app.key EXISTS",
+    "transfer.sender = 'nobody'",
+]
+
+BLOCK_QUERIES = [
+    "block_meta.proposer = 'alice'",
+    "rewards.amount > 100",
+    "rewards.amount <= 50",
+    "block.height = 4",
+    "block.height > 10",
+    "block_meta.proposer CONTAINS 'bo'",
+    "rewards.amount EXISTS",
+    "block_meta.proposer = 'nobody'",
+]
+
+
+def test_tx_query_parity(pair):
+    kv_tx, _, sink = pair
+    for q in TX_QUERIES:
+        kv = [(r.height, r.index, r.tx) for r in kv_tx.search(q)]
+        sq = [(r.height, r.index, r.tx) for r in sink.search_txs(q)]
+        assert kv == sq, q
+
+
+def test_block_query_parity(pair):
+    _, kv_blk, sink = pair
+    for q in BLOCK_QUERIES:
+        assert kv_blk.search(q) == sink.search_blocks(q), q
+
+
+def test_get_by_hash_parity(pair):
+    kv_tx, _, sink = pair
+    h = tmhash.sum(b"tx-0")
+    a, b = kv_tx.get(h), sink.get_tx(h)
+    assert a is not None and b is not None
+    assert (a.height, a.index, a.tx) == (b.height, b.index, b.tx)
+    assert kv_tx.get(tmhash.sum(b"missing")) is None
+    assert sink.get_tx(tmhash.sum(b"missing")) is None
+
+
+def test_cross_scope_queries_do_not_leak(pair):
+    """A tx-event value must not satisfy a block search and vice versa
+    (the review's repro: tx transfer.amount=200 leaking into
+    block_search('transfer.amount > 100'))."""
+    _, kv_blk, sink = pair
+    kv_tx = pair[0]
+    q = "transfer.sender = 'block-scope'"
+    assert sink.search_txs(q) == [] == kv_tx.search(q)
+    q2 = "transfer.amount > 100"  # tx amounts go up to 500, blocks to 20
+    kv_heights = kv_blk.search(q2)
+    assert sink.search_blocks(q2) == kv_heights
+    assert all(h <= 20 for h in kv_heights)
+
+
+def test_reindex_does_not_orphan_attributes(tmp_path):
+    """Crash-replay re-indexes the same (height, tx_index): attribute
+    rows of the replaced tx row must be deleted, not orphaned."""
+    sink = SQLiteEventSink()
+    for _ in range(5):  # five replay cycles
+        sink.index_tx(_rec(3, 0, b"replayed"),
+                      [_ev("transfer", sender="alice")])
+    n_attr = sink._conn.execute(
+        "SELECT COUNT(*) FROM attributes WHERE tx_id IS NOT NULL"
+    ).fetchone()[0]
+    # one tx: exactly its own attribute rows (transfer.sender + the
+    # implicit tx.height / tx.hash pseudo-events), no dead duplicates
+    assert n_attr <= 4, f"{n_attr} attribute rows after 5 replays"
+    assert [r.tx for r in sink.search_txs("transfer.sender = 'alice'")] == [
+        b"replayed"
+    ]
+    sink.close()
+
+
+def test_sink_is_durable(tmp_path):
+    p = str(tmp_path / "events.sqlite")
+    sink = SQLiteEventSink(p)
+    sink.index_tx(_rec(3, 0, b"keep"), [_ev("transfer", sender="alice")])
+    sink.index_block(3, [_ev("rewards", amount="30")])
+    sink.close()
+    sink2 = SQLiteEventSink(p)
+    assert [r.tx for r in sink2.search_txs("transfer.sender = 'alice'")] == [
+        b"keep"
+    ]
+    assert sink2.search_blocks("rewards.amount = 30") == [3]
+    sink2.close()
+
+
+def test_node_runs_with_sqlite_indexer(tmp_path):
+    """End to end: a node configured with tx_index.indexer = "sqlite"
+    indexes committed txs into the relational sink and serves them
+    through the standard tx_search RPC route."""
+    import base64
+    import dataclasses
+    import sys
+    import time
+
+    sys.path.insert(0, "tests")
+    from helpers import make_genesis
+
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.node import Node, init_files
+    from cometbft_tpu.rpc import HTTPClient
+
+    _MS = 1_000_000
+    cfg = default_config()
+    cfg.base.home = str(tmp_path)
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.tx_index = dataclasses.replace(cfg.tx_index, indexer="sqlite")
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=400 * _MS,
+        timeout_prevote_ns=200 * _MS,
+        timeout_precommit_ns=200 * _MS,
+        timeout_commit_ns=100 * _MS,
+        skip_timeout_commit=False,
+        create_empty_blocks=True,
+    )
+    init_files(cfg)
+    genesis, pvs = make_genesis(1)
+    n = Node(cfg, genesis, pvs[0])
+    n.start()
+    try:
+        c = HTTPClient(n.rpc_server.bound_addr)
+        tx = base64.b64encode(b"sink-test=1").decode()
+        res = c.call("broadcast_tx_sync", tx=tx)
+        assert int(res["code"]) == 0
+        deadline = time.monotonic() + 20
+        found = []
+        while time.monotonic() < deadline and not found:
+            found = n.tx_indexer.search("tx.height > 0")
+            time.sleep(0.1)
+        assert found and any(b"sink-test=1" in r.tx for r in found)
+        # and through the RPC route
+        res = c.call("tx_search", query="tx.height > 0")
+        assert int(res["total_count"]) >= 1
+    finally:
+        n.stop()

@@ -1,0 +1,246 @@
+"""Concurrency stress tier — the framework's answer to ``go test -race``
+(reference: tests.mk:67-69).
+
+Python's GIL hides data races' torn reads but NOT logic races (lost
+updates, double counting, ordering violations across lock boundaries),
+so this tier drives the shared structures from many threads under
+seeded schedules and asserts the INVARIANTS the reference's race
+detector guards:
+
+  * VoteSet under concurrent ingest: every admitted vote counted exactly
+    once, power tally == sum of distinct admitted validators, 2/3
+    decisions stable once made.
+  * A live node under concurrent RPC broadcast + queries: no accepted tx
+    lost or applied twice, heights strictly monotone, node stays live.
+  * WAL ordering: ENDHEIGHT markers strictly increasing after the run.
+
+Three seeds vary the interleavings (sleeps + work order).
+"""
+
+import base64
+import dataclasses
+import random
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.vote import Vote
+from cometbft_tpu.types.vote_set import VoteSet
+
+from helpers import make_genesis
+
+pytestmark = pytest.mark.slow
+
+_MS = 1_000_000
+
+
+def _valset(n):
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    pvs = [
+        MockPV(Ed25519PrivKey.from_seed(i.to_bytes(32, "big")))
+        for i in range(1, n + 1)
+    ]
+    vals = ValidatorSet(
+        [Validator(pv.get_pub_key(), voting_power=10) for pv in pvs]
+    )
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+    ordered = [by_addr[bytes(v.address)] for v in vals.validators]
+    return vals, ordered
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_voteset_concurrent_ingest(seed):
+    """100-validator prevote ingest from 8 threads: overlapping slices,
+    duplicate deliveries, interleaved with tally reads."""
+    n_vals = 100
+    chain_id = "stress-chain"
+    vals, pvs = _valset(n_vals)
+    bid = BlockID(bytes(range(32)), PartSetHeader(total=1, hash=bytes(32)))
+    votes = []
+    for idx, (val, pv) in enumerate(zip(vals.validators, pvs)):
+        v = Vote(
+            msg_type=canonical.PREVOTE_TYPE,
+            height=3,
+            round=0,
+            block_id=bid,
+            timestamp_ns=1_700_000_000_000_000_000 + idx,
+            validator_address=val.address,
+            validator_index=idx,
+        )
+        pv.sign_vote(chain_id, v, sign_extension=False)
+        votes.append(v)
+
+    vs = VoteSet(chain_id, 3, 0, canonical.PREVOTE_TYPE, vals)
+    rng = random.Random(seed)
+    slices = []
+    for t in range(8):
+        sl = list(range(n_vals))
+        rng.shuffle(sl)
+        slices.append(sl[: rng.randrange(60, n_vals + 1)])
+    maj_seen = []
+    errs = []
+
+    def ingest(order):
+        try:
+            r = random.Random(hash((seed, tuple(order[:3]))))
+            for i in order:
+                if r.random() < 0.3:
+                    time.sleep(0)  # force a scheduling point
+                vs.add_vote(votes[i])  # duplicates must be no-ops
+                m = vs.two_thirds_majority()
+                if m is not None:
+                    maj_seen.append(m)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=ingest, args=(sl,)) for sl in slices
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    # every validator delivered by at least one thread must be counted
+    # EXACTLY once: tally equals 10 x distinct validators delivered
+    delivered = set()
+    for sl in slices:
+        delivered.update(sl)
+    assert vs.sum == 10 * len(delivered)
+    # a 2/3 decision, once observed, never changes
+    assert all(m == maj_seen[0] for m in maj_seen)
+    assert vs.two_thirds_majority() == bid
+
+
+@pytest.mark.parametrize("seed", [7, 8, 9])
+def test_node_under_concurrent_load(tmp_path, seed):
+    """Single-validator node: 3 broadcast threads + 2 query threads for
+    ~8 s. Invariants: every accepted tx lands exactly once; NewBlock
+    heights strictly monotone; WAL ENDHEIGHT markers strictly
+    increasing; the node is still making progress at the end."""
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.node import Node, init_files
+    from cometbft_tpu.rpc import HTTPClient
+    from cometbft_tpu.types.event_bus import QUERY_NEW_BLOCK
+
+    cfg = default_config()
+    cfg.base.home = str(tmp_path)
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=400 * _MS,
+        timeout_prevote_ns=200 * _MS,
+        timeout_precommit_ns=200 * _MS,
+        timeout_commit_ns=80 * _MS,
+        skip_timeout_commit=False,
+        create_empty_blocks=True,
+    )
+    init_files(cfg)
+    genesis, pvs = make_genesis(1)
+    n = Node(cfg, genesis, pvs[0])
+    sub = n.event_bus.subscribe("stress", QUERY_NEW_BLOCK, capacity=0)
+    n.start()
+    accepted = []
+    acc_lock = threading.Lock()
+    stop = threading.Event()
+    errs = []
+
+    def broadcaster(tid):
+        try:
+            c = HTTPClient(n.rpc_server.bound_addr)
+            r = random.Random((seed, tid))
+            i = 0
+            while not stop.is_set():
+                key = f"s{seed}t{tid}i{i}"
+                tx = base64.b64encode(
+                    f"{key}={i}".encode()
+                ).decode()
+                res = c.call("broadcast_tx_sync", tx=tx)
+                if int(res["code"]) == 0:
+                    with acc_lock:
+                        accepted.append(f"{key}={i}".encode())
+                i += 1
+                time.sleep(r.uniform(0, 0.02))
+        except Exception as e:  # pragma: no cover
+            if not stop.is_set():
+                errs.append(e)
+
+    def querier(tid):
+        try:
+            c = HTTPClient(n.rpc_server.bound_addr)
+            last = 0
+            while not stop.is_set():
+                st = c.call("status")
+                h = int(st["sync_info"]["latest_block_height"])
+                assert h >= last, "status height went backwards"
+                last = h
+                if h >= 2:
+                    blk = c.call("block", height=h - 1)
+                    assert int(blk["block"]["header"]["height"]) == h - 1
+                time.sleep(0.03)
+        except Exception as e:  # pragma: no cover
+            if not stop.is_set():
+                errs.append(e)
+
+    threads = [
+        threading.Thread(target=broadcaster, args=(t,)) for t in range(3)
+    ] + [threading.Thread(target=querier, args=(t,)) for t in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(8)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+
+    # drain until every accepted tx landed (commit lags acceptance)
+    deadline = time.monotonic() + 30
+    landed: list[bytes] = []
+    while time.monotonic() < deadline:
+        landed = []
+        for h in range(1, n.block_store.height() + 1):
+            blk = n.block_store.load_block(h)
+            if blk:
+                landed.extend(blk.data.txs)
+        if set(accepted) <= set(landed):
+            break
+        time.sleep(0.2)
+
+    assert not errs, errs[:3]
+    # exactly once: no accepted tx lost, none applied twice
+    missing = set(accepted) - set(landed)
+    assert not missing, f"lost {len(missing)} accepted txs"
+    assert len(landed) == len(set(landed)), "a tx landed twice"
+
+    # heights from the event bus are strictly monotone +1
+    heights = []
+    while True:
+        try:
+            msg = sub.out.get_nowait()
+        except Exception:
+            break
+        heights.append(msg.data.block.header.height)
+    assert heights == sorted(heights)
+    assert all(b - a == 1 for a, b in zip(heights, heights[1:]))
+
+    final_h = n.block_store.height()
+    n.stop()
+
+    # WAL ordering: ENDHEIGHT markers strictly increasing
+    from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+
+    w = WAL(cfg.base.resolve(cfg.consensus.wal_file))
+    ends = [
+        m.height
+        for m in w.iter_messages()
+        if isinstance(m, EndHeightMessage)
+    ]
+    w.close()
+    assert ends == sorted(set(ends)), "WAL ENDHEIGHT not strictly increasing"
+    assert ends and ends[-1] >= final_h - 1
