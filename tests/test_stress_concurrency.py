@@ -155,7 +155,7 @@ def test_node_under_concurrent_load(tmp_path, seed):
     def broadcaster(tid):
         try:
             c = HTTPClient(n.rpc_server.bound_addr)
-            r = random.Random((seed, tid))
+            r = random.Random(hash((seed, tid)))
             i = 0
             while not stop.is_set():
                 key = f"s{seed}t{tid}i{i}"
