@@ -37,20 +37,44 @@ def build_and_load(
         if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(
             src
         ):
-            cmd = [
-                "g++", "-O3", "-funroll-loops", "-shared", "-fPIC",
-                "-std=c++17", *extra_flags, src, "-o", so + ".tmp",
-            ]
+            _compile(src, so, extra_flags, timeout)
+        try:
+            return ctypes.CDLL(so)
+        except OSError:
+            # A pre-existing .so that won't dlopen (truncated artifact,
+            # wrong architecture) must not take down callers that have a
+            # pure-Python fallback: rebuild once from source, and map any
+            # remaining failure to NativeBuildError so the callers'
+            # fallback policy applies.
             try:
-                r = subprocess.run(
-                    cmd, capture_output=True, text=True, timeout=timeout
-                )
-            except (OSError, subprocess.TimeoutExpired) as e:
-                raise NativeBuildError(f"g++ unavailable: {e!r}")
-            if r.returncode != 0:
+                os.remove(so)
+            except OSError:
+                pass
+            _compile(src, so, extra_flags, timeout)
+            try:
+                return ctypes.CDLL(so)
+            except OSError as e:
                 raise NativeBuildError(
-                    f"{os.path.basename(src)} compile failed:\n"
-                    f"{r.stderr[:800]}"
+                    f"{os.path.basename(so)} rebuilt but won't load: {e!r}"
                 )
-            os.replace(so + ".tmp", so)
-        return ctypes.CDLL(so)
+
+
+def _compile(
+    src: str, so: str, extra_flags: tuple[str, ...], timeout: float
+) -> None:
+    cmd = [
+        "g++", "-O3", "-funroll-loops", "-shared", "-fPIC",
+        "-std=c++17", *extra_flags, src, "-o", so + ".tmp",
+    ]
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise NativeBuildError(f"g++ unavailable: {e!r}")
+    if r.returncode != 0:
+        raise NativeBuildError(
+            f"{os.path.basename(src)} compile failed:\n"
+            f"{r.stderr[:800]}"
+        )
+    os.replace(so + ".tmp", so)

@@ -365,71 +365,93 @@ class PubkeyTableCache:
         call's UNIQUE keys exceed the arena (every lane of one gather
         needs a live slot — callers fall back to the uncached kernel).
         Keys used by the current call are pinned: eviction never frees a
-        slot this call's gather will read. The arrays are returned
-        together under the lock so a concurrent update can't tear the
-        (idxs, arena) pairing.
+        slot this call's gather will read.
+
+        Locking: the builder launch (a full device round trip for new
+        keys) runs OUTSIDE the lock, so a cache miss on one path
+        (a new validator key seen by RPC) never stalls concurrent
+        hit-only lookups from consensus/blocksync. Slot assignment,
+        the scatter, and the final (idxs, arena, arena_ok) capture all
+        happen under one lock hold, so a concurrent update can't tear
+        the pairing; tables are a pure function of the key, so two
+        threads racing to build the same key scatter identical values.
         """
         builder, scatter = _cached_jits()
-        with self._lock:
-            self._ensure_arena()
-            in_use = {bytes(pk) for pk in pubkeys}
-            if len(in_use) > self.capacity:
-                return None
-            idxs = np.empty(len(pubkeys), np.int32)
-            missing: dict[bytes, list[int]] = {}
-            for i, pk in enumerate(pubkeys):
-                pk = bytes(pk)
-                slot = self._slots.get(pk)
-                if slot is not None:
-                    self._slots.move_to_end(pk)
-                    idxs[i] = slot
-                    self.hits += 1
-                else:
-                    missing.setdefault(pk, []).append(i)
-                    self.misses += 1
-            if missing:
-                new_keys = list(missing.keys())
-                m = len(new_keys)
-                size = _MIN_BUCKET
-                while size < m:
-                    size *= 2
-                buf = np.zeros((32, size), np.uint8)
-                for j, pk in enumerate(new_keys):
-                    if len(pk) == 32:
-                        buf[:, j] = np.frombuffer(pk, np.uint8)
-                tables, oks = builder(buf)
-                slots = np.full(size, self.capacity, np.int32)  # scratch
-                for j, pk in enumerate(new_keys):
-                    if len(self._slots) >= self.capacity:
-                        # evict the oldest key NOT referenced by this
-                        # call (an in-use eviction would redirect an
-                        # already-assigned idx to a foreign table)
-                        slot = None
-                        for old in self._slots:
-                            if old not in in_use:
-                                slot = self._slots.pop(old)
-                                break
-                        # unreachable: len(in_use) <= capacity guarantees
-                        # an evictable slot exists
-                        assert slot is not None
-                    else:
-                        slot = len(self._slots)
-                    self._slots[pk] = slot
-                    slots[j] = slot
-                    for i in missing[pk]:
-                        idxs[i] = slot
-                import jax.numpy as jnp
+        keys = [bytes(pk) for pk in pubkeys]
+        in_use = set(keys)
+        if len(in_use) > self.capacity:
+            return None
+        built: list[tuple[list[bytes], object, object]] = []
+        built_keys: set[bytes] = set()
+        while True:
+            with self._lock:
+                self._ensure_arena()
+                to_build = [
+                    pk
+                    for pk in dict.fromkeys(keys)
+                    if pk not in self._slots and pk not in built_keys
+                ]
+                if not to_build:
+                    for batch_keys, tables, oks in built:
+                        size = int(tables.shape[-1])
+                        slots = np.full(
+                            size, self.capacity, np.int32
+                        )  # pads -> scratch slot
+                        for j, pk in enumerate(batch_keys):
+                            slot = self._slots.get(pk)
+                            if slot is None:
+                                if len(self._slots) >= self.capacity:
+                                    # evict the oldest key NOT referenced
+                                    # by this call (an in-use eviction
+                                    # would redirect an already-assigned
+                                    # idx to a foreign table)
+                                    slot = None
+                                    for old in self._slots:
+                                        if old not in in_use:
+                                            slot = self._slots.pop(old)
+                                            break
+                                    # unreachable: len(in_use) <=
+                                    # capacity guarantees an evictable
+                                    # slot exists
+                                    assert slot is not None
+                                else:
+                                    slot = len(self._slots)
+                                self._slots[pk] = slot
+                            slots[j] = slot
+                        self._arena, self._arena_ok = scatter(
+                            self._arena, self._arena_ok, slots, tables, oks
+                        )
+                    idxs = np.empty(len(keys), np.int32)
+                    for i, pk in enumerate(keys):
+                        idxs[i] = self._slots[pk]
+                        self._slots.move_to_end(pk)
+                        if pk in built_keys:
+                            self.misses += 1
+                        else:
+                            self.hits += 1
+                    return idxs, self._arena, self._arena_ok
+            # Outside the lock: one bucketed builder launch for the keys
+            # still missing. A key evicted between iterations (another
+            # thread filling the arena mid-build) sends us around again;
+            # with in_use pinned per call that is vanishingly rare.
+            m = len(to_build)
+            size = _MIN_BUCKET
+            while size < m:
+                size *= 2
+            buf = np.zeros((32, size), np.uint8)
+            for j, pk in enumerate(to_build):
+                if len(pk) == 32:
+                    buf[:, j] = np.frombuffer(pk, np.uint8)
+            tables, oks = builder(buf)
+            import jax.numpy as jnp
 
-                host_wellformed = np.array(
-                    [len(pk) == 32 for pk in new_keys]
-                    + [True] * (size - m),
-                    bool,
-                )
-                oks = jnp.logical_and(oks, jnp.asarray(host_wellformed))
-                self._arena, self._arena_ok = scatter(
-                    self._arena, self._arena_ok, slots, tables, oks
-                )
-            return idxs, self._arena, self._arena_ok
+            host_wellformed = np.array(
+                [len(pk) == 32 for pk in to_build] + [True] * (size - m),
+                bool,
+            )
+            oks = jnp.logical_and(oks, jnp.asarray(host_wellformed))
+            built.append((to_build, tables, oks))
+            built_keys.update(to_build)
 
 
 _PUBKEY_CACHE = PubkeyTableCache()

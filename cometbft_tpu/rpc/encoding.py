@@ -86,11 +86,73 @@ def enc_commit(c) -> dict:
     }
 
 
+def enc_vote(v) -> dict:
+    return {
+        "type": v.msg_type,
+        "height": str(v.height),
+        "round": v.round,
+        "block_id": enc_block_id(v.block_id),
+        "timestamp": rfc3339(v.timestamp_ns),
+        "validator_address": hex_bytes(v.validator_address),
+        "validator_index": v.validator_index,
+        "signature": b64(v.signature) if v.signature else None,
+    }
+
+
+def enc_evidence(ev) -> dict:
+    """Registry-wrapped evidence JSON (the reference wraps each evidence
+    item in a {"type","value"} envelope via libs/json; types/evidence.go)."""
+    from ..types.evidence import (
+        DuplicateVoteEvidence,
+        LightClientAttackEvidence,
+    )
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        return {
+            "type": "tendermint/DuplicateVoteEvidence",
+            "value": {
+                "vote_a": enc_vote(ev.vote_a),
+                "vote_b": enc_vote(ev.vote_b),
+                "total_voting_power": str(ev.total_voting_power),
+                "validator_power": str(ev.validator_power),
+                "timestamp": rfc3339(ev.timestamp_ns),
+            },
+        }
+    if isinstance(ev, LightClientAttackEvidence):
+        sh = ev.conflicting_block.signed_header
+        return {
+            "type": "tendermint/LightClientAttackEvidence",
+            "value": {
+                "conflicting_block": {
+                    "signed_header": {
+                        "header": enc_header(sh.header),
+                        "commit": enc_commit(sh.commit),
+                    },
+                    "validator_set": {
+                        "validators": [
+                            enc_validator(v)
+                            for v in ev.conflicting_block.validator_set.validators
+                        ],
+                    },
+                },
+                "common_height": str(ev.common_height),
+                "byzantine_validators": [
+                    enc_validator(v) for v in ev.byzantine_validators
+                ],
+                "total_voting_power": str(ev.total_voting_power),
+                "timestamp": rfc3339(ev.timestamp_ns),
+            },
+        }
+    raise ValueError(f"unsupported evidence type {type(ev).__name__}")
+
+
 def enc_block(b) -> dict:
     return {
         "header": enc_header(b.header),
         "data": {"txs": [b64(tx) for tx in b.data.txs]},
-        "evidence": {"evidence": []},
+        "evidence": {
+            "evidence": [enc_evidence(ev) for ev in b.evidence]
+        },
         "last_commit": enc_commit(b.last_commit) if b.last_commit else None,
     }
 
@@ -191,6 +253,91 @@ def dec_commit(d: dict):
     )
 
 
+def dec_vote(d: dict):
+    from ..types.vote import Vote
+
+    sig = d.get("signature")
+    return Vote(
+        msg_type=int(d["type"]),
+        height=int(d["height"]),
+        round=int(d["round"]),
+        block_id=dec_block_id(d.get("block_id") or {}),
+        timestamp_ns=parse_rfc3339(d.get("timestamp") or ""),
+        validator_address=dec_hex(d.get("validator_address")),
+        validator_index=int(d.get("validator_index", 0)),
+        signature=base64.b64decode(sig) if sig else b"",
+    )
+
+
+def dec_validator(d: dict):
+    from ..crypto.keys import PUBKEY_TYPES, register_extra_key_types
+    from ..types.validator_set import Validator
+
+    pk = d.get("pub_key") or {}
+    type_name = pk.get("type", "tendermint/PubKeyEd25519")
+    key_type = {
+        "tendermint/PubKeyEd25519": "ed25519",
+        "tendermint/PubKeySecp256k1": "secp256k1",
+        "tendermint/PubKeySr25519": "sr25519",
+    }.get(type_name)
+    if key_type is None:
+        raise ValueError(f"unknown pubkey type {type_name!r}")
+    register_extra_key_types()
+    pub_key = PUBKEY_TYPES[key_type](base64.b64decode(pk.get("value", "")))
+    return Validator(
+        pub_key=pub_key,
+        voting_power=int(d.get("voting_power", 0)),
+        proposer_priority=int(d.get("proposer_priority", 0)),
+    )
+
+
+def dec_evidence(d: dict):
+    from ..types.evidence import (
+        DuplicateVoteEvidence,
+        LightClientAttackEvidence,
+    )
+    from ..types.light_block import LightBlock, SignedHeader
+    from ..types.validator_set import ValidatorSet
+
+    t, v = d.get("type"), d.get("value") or {}
+    if t == "tendermint/DuplicateVoteEvidence":
+        return DuplicateVoteEvidence(
+            vote_a=dec_vote(v["vote_a"]),
+            vote_b=dec_vote(v["vote_b"]),
+            total_voting_power=int(v.get("total_voting_power", 0)),
+            validator_power=int(v.get("validator_power", 0)),
+            timestamp_ns=parse_rfc3339(v.get("timestamp") or ""),
+        )
+    if t == "tendermint/LightClientAttackEvidence":
+        cb = v.get("conflicting_block") or {}
+        sh = cb.get("signed_header") or {}
+        return LightClientAttackEvidence(
+            conflicting_block=LightBlock(
+                signed_header=SignedHeader(
+                    header=dec_header(sh["header"]),
+                    commit=dec_commit(sh["commit"]),
+                ),
+                validator_set=ValidatorSet(
+                    [
+                        dec_validator(x)
+                        for x in (cb.get("validator_set") or {}).get(
+                            "validators"
+                        )
+                        or []
+                    ]
+                ),
+            ),
+            common_height=int(v.get("common_height", 0)),
+            byzantine_validators=[
+                dec_validator(x)
+                for x in v.get("byzantine_validators") or []
+            ],
+            total_voting_power=int(v.get("total_voting_power", 0)),
+            timestamp_ns=parse_rfc3339(v.get("timestamp") or ""),
+        )
+    raise ValueError(f"unknown evidence type {t!r}")
+
+
 def dec_block(d: dict):
     from ..types.block import Block, Data
 
@@ -203,6 +350,10 @@ def dec_block(d: dict):
                 for t in (d.get("data") or {}).get("txs") or []
             ]
         ),
+        evidence=[
+            dec_evidence(e)
+            for e in (d.get("evidence") or {}).get("evidence") or []
+        ],
         last_commit=dec_commit(lc) if lc and lc.get("signatures") else None,
     )
 

@@ -357,6 +357,15 @@ class Block:
                 raise ValueError("last commit hash mismatch")
         if self.header.data_hash != self.data.hash():
             raise ValueError("data hash mismatch")
+        for ev in self.evidence:
+            ev.validate_basic()
+        # Cross-check the evidence section against the committed header
+        # hash (types/block.go:98) — without this, a relay could strip or
+        # alter evidence while the header still content-verifies.
+        if self.header.evidence_hash != merkle.hash_from_byte_slices(
+            [ev.hash() for ev in self.evidence]
+        ):
+            raise ValueError("evidence hash mismatch")
 
 
 @dataclass(slots=True)

@@ -181,3 +181,83 @@ def test_expired_evidence_rejected(rig):
 
     with pytest.raises(EvidenceError, match="too old"):
         verify_evidence(ev, st, vals)
+
+
+def test_evidence_json_roundtrip_and_block_hash_check(rig):
+    """RPC JSON codec round-trips evidence bit-exactly, and
+    Block.validate_basic cross-checks header.evidence_hash against the
+    evidence section (types/block.go:98) — a relay stripping evidence
+    must no longer content-verify."""
+    import json
+
+    from cometbft_tpu.rpc import encoding as enc
+    from cometbft_tpu.types.block import Block
+
+    genesis, pvs, driver, pool, *_ = rig
+    vals = driver.state.validators
+    v1, v2 = _double_vote(
+        pvs[2], 2, vals.validators[2].address, 1, genesis.chain_id
+    )
+    ev = DuplicateVoteEvidence.from_conflicting_votes(
+        v1, v2, driver.state.last_block_time_ns, vals
+    )
+    proposer = driver.state.validators.get_proposer()
+    block = driver.state.make_block(
+        height=2,
+        txs=[b"k=v"],
+        last_commit=driver.last_commit,
+        evidence=[ev],
+        proposer_address=proposer.address,
+        time_ns=driver.state.last_block_time_ns + 1_000_000_000,
+    )
+    assert block.evidence
+
+    # JSON round-trip through the wire form (what the light proxy sees)
+    wire = json.loads(json.dumps(enc.enc_block(block)))
+    blk2 = enc.dec_block(wire)
+    assert [e.hash() for e in blk2.evidence] == [
+        e.hash() for e in block.evidence
+    ]
+    blk2.validate_basic()  # evidence_hash cross-check passes
+    assert blk2.hash() == block.hash()
+
+    # stripping the evidence section must now fail validate_basic
+    stripped = Block(
+        header=blk2.header,
+        data=blk2.data,
+        evidence=[],
+        last_commit=blk2.last_commit,
+    )
+    with pytest.raises(ValueError, match="evidence hash"):
+        stripped.validate_basic()
+
+
+def test_light_attack_evidence_json_roundtrip():
+    """LightClientAttackEvidence survives the JSON codec (hash-identical),
+    including its embedded light block and byzantine validator set."""
+    import json
+
+    from cometbft_tpu.rpc import encoding as enc
+    from cometbft_tpu.types.evidence import LightClientAttackEvidence
+
+    from helpers import make_light_chain
+
+    chain = make_light_chain(3, n_vals=3)
+    lb = chain[2]
+    ev = LightClientAttackEvidence(
+        conflicting_block=lb,
+        common_height=1,
+        byzantine_validators=list(lb.validator_set.validators[:2]),
+        total_voting_power=30,
+        timestamp_ns=1_700_000_000_000_000_000,
+    )
+    wire = json.loads(json.dumps(enc.enc_evidence(ev)))
+    ev2 = enc.dec_evidence(wire)
+    assert isinstance(ev2, LightClientAttackEvidence)
+    assert ev2.hash() == ev.hash()
+    assert ev2.conflicting_block.signed_header.header.hash() == (
+        lb.signed_header.header.hash()
+    )
+    assert [v.address for v in ev2.byzantine_validators] == [
+        v.address for v in ev.byzantine_validators
+    ]

@@ -259,9 +259,12 @@ def test_lying_primary_tampered_time_rejected(node):
     _assert_block_refused(node, tamper)
 
 
-def test_lying_primary_injected_evidence_not_relayed(node):
-    """Injected evidence JSON is outside the verified surface; the
-    re-encoded response must not carry it."""
+def test_lying_primary_injected_evidence_rejected(node):
+    """Evidence is part of the verified content surface
+    (types/block.go:98): undecodable injected evidence fails the decode,
+    and decodable-but-uncommitted evidence fails the evidence_hash
+    cross-check in validate_basic — either way the proxy refuses the
+    block rather than silently stripping or relaying the injection."""
 
     def tamper(res):
         res["block"]["evidence"] = {"evidence": [{"fake": True}]}
@@ -270,8 +273,8 @@ def test_lying_primary_injected_evidence_not_relayed(node):
     p.start()
     try:
         c = HTTPClient(p.bound_addr)
-        res = c.call("block", height=3)
-        assert res["block"]["evidence"]["evidence"] == []
+        with pytest.raises(RPCError, match="invalid block"):
+            c.call("block", height=3)
     finally:
         p.stop()
 
