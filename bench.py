@@ -40,6 +40,27 @@ import numpy as np
 
 _DETAILS: list = []
 
+# COMETBFT_BENCH_TINY=1 shrinks every config so the FULL capture path —
+# probe, 5-config table, extras, kernel A/B, chip-table save — executes
+# end to end in minutes on CPU. This is the driver-independent dry run
+# proving the one-window chip capture works before a chip is reachable
+# (tests/test_bench_capture.py).
+_TINY = os.environ.get("COMETBFT_BENCH_TINY") == "1"
+
+
+def _sz(normal: int, tiny: int) -> int:
+    return tiny if _TINY else normal
+
+
+def _pin_cpu_if_requested() -> None:
+    """JAX_PLATFORMS=cpu must actually displace the axon tunnel plugin:
+    the env var alone does not deregister an already-registered
+    accelerator plugin, and a dead tunnel hangs the first dispatch."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
 
 def _round_number() -> int:
     """Current round = 1 + highest BENCH_r{N}.json already recorded.
@@ -341,7 +362,7 @@ def bench_device_floor():
 
     rows = []
     crossover = None
-    for n in (64, 150, 256, 512, 768, 1024, 2048):
+    for n in ((64, 150) if _TINY else (64, 150, 256, 512, 768, 1024, 2048)):
         pubkeys, msgs, sigs = _make_ed_batch(n, seed=n)
         # warm both paths (compile + cache build)
         ov.verify_batch(pubkeys, msgs, sigs)
@@ -413,6 +434,55 @@ def bench_device_floor():
             "cometbft_tpu.crypto.batch", fromlist=["x"]
         ).HOST_BATCH_THRESHOLD,
     }
+
+
+def bench_kernel_ab():
+    """One-window lowering A/B: XLA vs 8-bit-window vs Pallas, each on
+    the uncached and cached-arena paths, same batch, same chip session.
+
+    This is the capture the round-3 verdict prescribed: every prior chip
+    number measured ONE lowering, so cross-round comparisons conflated
+    kernel changes with tunnel luck. Pallas runs only on accelerator
+    backends (interpret mode on CPU takes minutes per trace).
+    """
+    import jax
+
+    from cometbft_tpu.ops import verify as ov
+
+    n = _sz(4096, 256)
+    pubkeys, msgs, sigs = _make_ed_batch(n, seed=7)
+    buf, _host_ok = ov.pack_bytes(pubkeys, msgs, sigs)
+    size = ov.bucket_size(n) if n <= ov._CHUNK else n
+    if size != n:
+        buf = np.pad(buf, [(0, 0), (0, size - n)])
+    on_accel = jax.default_backend() in ("tpu", "axon")
+    out = {"lanes": n}
+    lowerings = ["xla", "xla8"] + (["pallas"] if on_accel else [])
+    for which in lowerings:
+        try:
+            fn = ov._jitted_kernel(which)
+            np.asarray(fn(buf))  # compile + warm
+            dt = _steady(lambda: np.asarray(fn(buf)))
+            out[f"{which}_uncached_sigs_per_sec"] = round(n / dt, 1)
+        except Exception as e:
+            out[f"{which}_uncached_error"] = repr(e)[:160]
+    hit = ov._PUBKEY_CACHE.lookup(pubkeys)
+    if hit is not None:
+        idxs, arena, arena_ok = hit
+        if size != n:
+            idxs = np.pad(idxs, (0, size - n))
+        rsk = buf[32:]
+        for which in lowerings:
+            try:
+                fn = ov._jitted_cached_kernel(which)
+                np.asarray(fn(arena, arena_ok, idxs, rsk))
+                dt = _steady(
+                    lambda: np.asarray(fn(arena, arena_ok, idxs, rsk))
+                )
+                out[f"{which}_cached_sigs_per_sec"] = round(n / dt, 1)
+            except Exception as e:
+                out[f"{which}_cached_error"] = repr(e)[:160]
+    return out
 
 
 def bench_wal_decode():
@@ -540,6 +610,7 @@ def _probe_device(timeout_s: float = 60.0, attempts: int = 3) -> bool:
 
 
 def main() -> None:
+    _pin_cpu_if_requested()
     if not _probe_device():
         # No chip: emit an honest, clearly-labeled host-path measurement
         # quickly rather than hanging the driver. (Even JAX_PLATFORMS=cpu
@@ -606,7 +677,7 @@ def main() -> None:
         }
     )
 
-    tput, dt = bench_flat_batch(64)
+    tput, dt = bench_flat_batch(_sz(64, 64))
     _eprint(
         {
             "config": "1_batch64",
@@ -616,7 +687,7 @@ def main() -> None:
         }
     )
 
-    tput, dt = bench_commit_verify(150, light=False)
+    tput, dt = bench_commit_verify(_sz(150, 24), light=False)
     _eprint(
         {
             "config": "2_commit150_verify",
@@ -626,7 +697,7 @@ def main() -> None:
         }
     )
 
-    tput, dt = bench_vote_round(1000)
+    tput, dt = bench_vote_round(_sz(1000, 32))
     _eprint(
         {
             "config": "3_round1000_votes",
@@ -636,7 +707,7 @@ def main() -> None:
         }
     )
 
-    tput, dt = bench_commit_verify(10_000, light=True)
+    tput, dt = bench_commit_verify(_sz(10_000, 48), light=True)
     _eprint(
         {
             "config": "4_light10k_commit_verify",
@@ -646,7 +717,7 @@ def main() -> None:
         }
     )
 
-    tput, dt = bench_mixed(4096)
+    tput, dt = bench_mixed(_sz(4096, 64))
     _eprint(
         {
             "config": "5_mixed4096_ed_sr",
@@ -661,6 +732,7 @@ def main() -> None:
         ("7_mempool", bench_mempool),
         ("8_valset_update", bench_valset_update),
         ("9_device_floor", bench_device_floor),
+        ("10_kernel_ab", bench_kernel_ab),
     ):
         try:
             _eprint({"config": name, **fn()})
@@ -668,7 +740,7 @@ def main() -> None:
             _eprint({"config": name, "error": repr(e)[:200]})
 
     # Headline: 4096-lane flat ed25519 batch (round-1-comparable metric).
-    tput, dt = bench_flat_batch(4096)
+    tput, dt = bench_flat_batch(_sz(4096, 256))
     _eprint(
         {
             "config": "headline_flat4096",

@@ -649,6 +649,16 @@ class ConsensusState(BaseService):
             rs.proposal_block_parts = None
         rs.triggered_timeout_precommit = False
         rs.votes.set_round(round_ + 1)
+        # Pre-stage the validator set's expanded-pubkey tables device-side
+        # so this round's vote/commit verifies ship only R|S|k (zero
+        # builder launches in steady state). Fingerprinted by valset hash:
+        # rounds without churn are a dict no-op.
+        vhash = validators.hash()
+        if vhash != getattr(self, "_prestaged_valset", None):
+            from ..crypto import batch as crypto_batch
+
+            crypto_batch.prestage_validators(validators)
+            self._prestaged_valset = vhash
         self.event_bus.publish_new_round(
             EventDataNewRound(
                 height=height,

@@ -177,6 +177,29 @@ def _observe(backend: str, t0: float, n: int) -> None:
         m.verify_batch_sigs.labels(backend).inc(n)
 
 
+def prestage_validators(validator_set) -> int:
+    """Warm the device pubkey arena for a validator set's ed25519 keys.
+
+    The FSM calls this at enter-new-round so steady-state commit/vote
+    verification ships only R|S|k (ops/verify.prestage_pubkeys; the
+    device analog of the reference's expanded-pubkey LRU being hot,
+    crypto/ed25519/ed25519.go:31,56). sr25519 keys are skipped: their
+    arena key is the CONVERTED edwards encoding, and the conversion
+    itself is the expensive host step — converting eagerly per round
+    would cost more than the build it saves.
+    """
+    keys_bytes = [
+        v.pub_key.data
+        for v in getattr(validator_set, "validators", [])
+        if getattr(v.pub_key, "type", None) == keys.ED25519_KEY_TYPE
+    ]
+    if not keys_bytes:
+        return 0
+    from ..ops import verify as ov
+
+    return ov.prestage_pubkeys(keys_bytes)
+
+
 def supports_batch_verifier(pub_key) -> bool:
     return getattr(pub_key, "type", None) in _BATCH_BACKENDS
 

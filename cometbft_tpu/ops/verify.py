@@ -229,6 +229,26 @@ def _kernel_from_bytes(buf):
     return curve.verify_kernel(**unpack_on_device(buf))
 
 
+def _kernel_from_bytes8(buf):
+    """8-bit fixed-base-window lowering (COMETBFT_TPU_KERNEL=xla8).
+
+    S rides as raw little-endian bytes: byte j IS the 8-bit window of
+    weight 2^(8j), so the wire format needs no new rows."""
+    import jax.numpy as jnp
+
+    b = buf.astype(jnp.int32)
+    pk_bits = _dev_le_bits(b[0:32])
+    rr_bits = _dev_le_bits(b[32:64])
+    return curve.verify_kernel8(
+        y_a=_dev_y_limbs(pk_bits),
+        sign_a=pk_bits[255],
+        y_r=_dev_y_limbs(rr_bits),
+        sign_r=rr_bits[255],
+        s_bytes=b[64:96],
+        kneg_nibs=_dev_msb_nibbles(b[96:128]),
+    )
+
+
 # ------------------------------------------------------------------ cache
 # HBM-resident expanded-pubkey cache. The reference keeps a 4096-entry
 # LRU of expanded pubkeys because validators recur every round
@@ -260,6 +280,22 @@ def _cached_kernel(arena, arena_ok, idxs, buf):
     return ok & arena_ok[idxs]
 
 
+def _cached_kernel8(arena, arena_ok, idxs, buf):
+    import jax.numpy as jnp
+
+    b = buf.astype(jnp.int32)
+    rr_bits = _dev_le_bits(b[0:32])
+    table = arena[:, :, :, idxs]
+    ok = curve.verify_kernel8_cached(
+        table,
+        y_r=_dev_y_limbs(rr_bits),
+        sign_r=rr_bits[255],
+        s_bytes=b[32:64],
+        kneg_nibs=_dev_msb_nibbles(b[64:96]),
+    )
+    return ok & arena_ok[idxs]
+
+
 def _cached_kernel_pallas(arena, arena_ok, idxs, buf):
     from . import pallas_verify
 
@@ -284,6 +320,19 @@ def _scatter_kernel(arena, arena_ok, slots, tables, oks):
     return arena, arena_ok
 
 
+def _donatable(argnums: tuple[int, ...]) -> tuple[int, ...]:
+    """Donate per-launch input buffers on accelerator backends only.
+
+    Donation lets XLA reuse the wire buffer's HBM for ladder temporaries
+    (the buffer is dead after unpacking); on the CPU test backend
+    donation is unsupported and every call would warn, so gate it.
+    """
+    try:
+        return argnums if jax.default_backend() in ("tpu", "axon") else ()
+    except Exception:
+        return ()
+
+
 @lru_cache(maxsize=None)
 def _cached_jits():
     _enable_compilation_cache()
@@ -302,8 +351,12 @@ def _cached_jits():
 @lru_cache(maxsize=None)
 def _jitted_cached_kernel(which: str):
     _enable_compilation_cache()
-    fn = _cached_kernel_pallas if which == "pallas" else _cached_kernel
-    return jax.jit(fn)
+    fn = {
+        "pallas": _cached_kernel_pallas,
+        "xla8": _cached_kernel8,
+    }.get(which, _cached_kernel)
+    # donate the per-launch R|S|kneg wire rows (arg 3) — NEVER the arena
+    return jax.jit(fn, donate_argnums=_donatable((3,)))
 
 
 def _run_cached_kernel(arena, arena_ok, idxs, buf):
@@ -321,7 +374,10 @@ def _run_cached_kernel(arena, arena_ok, idxs, buf):
             )
         except Exception as e:
             _note_pallas_broken(e)
-    return _jitted_cached_kernel("xla")(arena, arena_ok, idxs, buf), False
+    return (
+        _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf),
+        False,
+    )
 
 
 class PubkeyTableCache:
@@ -345,6 +401,7 @@ class PubkeyTableCache:
         self._arena_ok = None
         self.hits = 0
         self.misses = 0
+        self.builds = 0  # builder launches (device round trips)
 
     def _ensure_arena(self):
         import jax.numpy as jnp
@@ -442,6 +499,7 @@ class PubkeyTableCache:
             for j, pk in enumerate(to_build):
                 if len(pk) == 32:
                     buf[:, j] = np.frombuffer(pk, np.uint8)
+            self.builds += 1
             tables, oks = builder(buf)
             import jax.numpy as jnp
 
@@ -455,6 +513,42 @@ class PubkeyTableCache:
 
 
 _PUBKEY_CACHE = PubkeyTableCache()
+
+
+def prestage_pubkeys(pubkeys) -> int:
+    """Warm the expanded-pubkey arena ahead of verification.
+
+    Called from the consensus FSM at enter-new-round (round-3 verdict
+    task 3): with the validator set's tables already HBM-resident, a
+    commit verify ships only R|S|k per lane and the steady-state path
+    performs ZERO builder launches. Returns the number of builder
+    launches this warm-up performed (0 = already staged).
+
+    COMETBFT_TPU_PRESTAGE: "auto" (default) warms only on accelerator
+    backends — on the CPU test mesh the production sub-threshold path is
+    the host verifier and an eager device build would only slow tests;
+    "1" forces (tests), "0" disables.
+    """
+    import os
+
+    mode = os.environ.get("COMETBFT_TPU_PRESTAGE", "auto")
+    if mode == "0" or not _cache_enabled():
+        return 0
+    if mode != "1":
+        try:
+            if jax.default_backend() not in ("tpu", "axon"):
+                return 0
+        except Exception:
+            return 0
+    keys = [bytes(pk) for pk in pubkeys][: _PUBKEY_CACHE.capacity]
+    if not keys:
+        return 0
+    before = _PUBKEY_CACHE.builds
+    try:
+        _PUBKEY_CACHE.lookup(keys)
+    except Exception:
+        return 0  # warm-up must never take down the FSM
+    return _PUBKEY_CACHE.builds - before
 
 
 def _kernel_from_bytes_pallas(buf):
@@ -487,28 +581,44 @@ def _enable_compilation_cache() -> None:
 @lru_cache(maxsize=None)
 def _jitted_kernel(which: str = "xla"):
     _enable_compilation_cache()
-    fn = _kernel_from_bytes_pallas if which == "pallas" else _kernel_from_bytes
-    return jax.jit(fn)
+    fn = {
+        "pallas": _kernel_from_bytes_pallas,
+        "xla8": _kernel_from_bytes8,
+    }.get(which, _kernel_from_bytes)
+    return jax.jit(fn, donate_argnums=_donatable((0,)))
 
 
 # Kernel selection: "auto" routes single-chip batches through the Pallas
 # kernel on TPU backends (VMEM-resident ladder, ~2x the XLA lowering) and
 # the XLA kernel elsewhere (CPU tests, virtual-device meshes — Pallas
 # interpret mode is far slower than the XLA program there). Overridable
-# for benchmarking via COMETBFT_TPU_KERNEL=pallas|xla.
+# for benchmarking via COMETBFT_TPU_KERNEL=pallas|xla|xla8 ("xla8" is
+# the 8-bit fixed-base-window prototype: MXU one-hot selects, -11%
+# field muls — see curve.fixed_base_sum8).
 _KERNEL_MODE = None
 _PALLAS_BROKEN = False
 
 
-def _pallas_wanted() -> bool:
+def _kernel_mode() -> str:
     global _KERNEL_MODE
     if _KERNEL_MODE is None:
         import os
 
         _KERNEL_MODE = os.environ.get("COMETBFT_TPU_KERNEL", "auto")
-    if _KERNEL_MODE == "pallas":
+    return _KERNEL_MODE
+
+
+def _xla_which() -> str:
+    """The non-Pallas lowering to use: the gated 8-bit prototype or the
+    default joint 4-bit ladder."""
+    return "xla8" if _kernel_mode() == "xla8" else "xla"
+
+
+def _pallas_wanted() -> bool:
+    mode = _kernel_mode()
+    if mode == "pallas":
         return True
-    if _KERNEL_MODE == "xla":
+    if mode in ("xla", "xla8"):
         return False
     try:
         return jax.default_backend() in ("tpu", "axon")
@@ -550,7 +660,7 @@ def _run_kernel(buf):
             return _jitted_kernel("pallas")(buf), True
         except Exception as e:  # synchronous trace/compile failure
             _note_pallas_broken(e)
-    return _jitted_kernel("xla")(buf), False
+    return _jitted_kernel(_xla_which())(buf), False
 
 
 def _materialize(out, used_pallas: bool, buf):
@@ -561,7 +671,7 @@ def _materialize(out, used_pallas: bool, buf):
         if not used_pallas:
             raise
         _note_pallas_broken(e)
-        return np.asarray(_jitted_kernel("xla")(buf))
+        return np.asarray(_jitted_kernel(_xla_which())(buf))
 
 
 # Measured sweet spot on a v5e: per-signature device time grows superlinearly
@@ -682,7 +792,7 @@ def verify_rsk_async(buf: np.ndarray, idxs: np.ndarray, arena, arena_ok,
                 raise
             _note_pallas_broken(e)
             return np.asarray(
-                _jitted_cached_kernel("xla")(arena, arena_ok, idxs, buf)
+                _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf)
             )[:n]
 
     return materialize
