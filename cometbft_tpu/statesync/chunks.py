@@ -1,49 +1,75 @@
-"""Chunk queue for one snapshot restore (statesync/chunks.go).
+"""Chunk queue for one snapshot restore (statesync/chunks.go:43-86).
 
-Chunks arrive out of order from multiple peers; the applier consumes them
-strictly in index order. Bounded in memory (chunks are app-defined blobs;
-the reference spools to a temp dir — here the queue holds at most
-``chunks`` entries of one snapshot, the kvstore-scale case, and can be
-swapped for file spooling transparently behind put/next)."""
+Chunks arrive out of order from multiple peers; the applier consumes
+them strictly in index order. Chunk BODIES are spooled to a per-restore
+temp dir (one file per index, like the reference's newChunkQueue) so an
+app snapshot larger than memory can restore: the queue holds only
+(path, peer) bookkeeping in RAM. The directory is removed on close.
+"""
 
 from __future__ import annotations
 
+import os
+import shutil
+import tempfile
 import threading
 
 
 class ChunkQueue:
-    def __init__(self, n_chunks: int):
+    def __init__(self, n_chunks: int, temp_dir: str | None = None):
         self.n_chunks = n_chunks
+        self._dir = tempfile.mkdtemp(
+            prefix="cometbft-tpu-statesync-", dir=temp_dir
+        )
         self._mtx = threading.Condition()
-        self._chunks: dict[int, tuple[bytes, str]] = {}  # index -> (blob, peer)
+        self._peers: dict[int, str] = {}  # index -> sender peer
         self._next = 0
         self._closed = False
         self._returned: set[int] = set()
 
+    def _path(self, index: int) -> str:
+        return os.path.join(self._dir, str(index))
+
     def put(self, index: int, chunk: bytes, peer_id: str) -> bool:
-        """Store a fetched chunk; True if newly added."""
+        """Spool a fetched chunk to disk; True if newly added."""
         with self._mtx:
             if self._closed or index >= self.n_chunks or index < self._next:
                 return False
-            if index in self._chunks:
+            if index in self._peers:
                 return False
-            self._chunks[index] = (chunk, peer_id)
+            tmp = self._path(index) + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(chunk)
+                os.replace(tmp, self._path(index))
+            except OSError:
+                return False
+            self._peers[index] = peer_id
             self._mtx.notify_all()
             return True
 
     def next(self, timeout: float | None = None):
         """Blocking in-order consume: (index, chunk, peer_id) or None on
-        close/timeout."""
+        close/timeout. The chunk file is deleted once loaded."""
         with self._mtx:
             if not self._mtx.wait_for(
-                lambda: self._closed or self._next in self._chunks,
+                lambda: self._closed or self._next in self._peers,
                 timeout=timeout,
             ):
                 return None
             if self._closed:
                 return None
             idx = self._next
-            chunk, peer = self._chunks.pop(idx)
+            peer = self._peers.pop(idx)
+            try:
+                with open(self._path(idx), "rb") as f:
+                    chunk = f.read()
+                os.remove(self._path(idx))
+            except OSError:
+                # spool file vanished (operator tampering / disk fault):
+                # treat as never received so the fetcher re-requests it
+                self._mtx.notify_all()
+                return None
             self._next += 1
             return idx, chunk, peer
 
@@ -52,9 +78,13 @@ class ChunkQueue:
         ApplySnapshotChunkResult.RETRY / refetch_chunks)."""
         with self._mtx:
             self._next = min(self._next, index)
-            for i in list(self._chunks):
+            for i in list(self._peers):
                 if i >= index:
-                    del self._chunks[i]
+                    del self._peers[i]
+                    try:
+                        os.remove(self._path(i))
+                    except OSError:
+                        pass
 
     def pending(self) -> list[int]:
         """Indexes not yet stored nor consumed (fetch targets)."""
@@ -62,7 +92,7 @@ class ChunkQueue:
             return [
                 i
                 for i in range(self._next, self.n_chunks)
-                if i not in self._chunks
+                if i not in self._peers
             ]
 
     def done(self) -> bool:
@@ -73,3 +103,4 @@ class ChunkQueue:
         with self._mtx:
             self._closed = True
             self._mtx.notify_all()
+            shutil.rmtree(self._dir, ignore_errors=True)
