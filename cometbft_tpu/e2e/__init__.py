@@ -8,10 +8,16 @@ with its own home dir, so SIGKILL/SIGSTOP give the same crash/pause
 semantics docker kill/pause give the reference.
 """
 
-from .load import LoadGenerator, LoadReport, load_report
+from .load import (
+    EventLoadMonitor,
+    LoadGenerator,
+    LoadReport,
+    load_report,
+)
 from .runner import ProcessNode, Testnet
 
 __all__ = [
+    "EventLoadMonitor",
     "LoadGenerator",
     "LoadReport",
     "load_report",

@@ -182,13 +182,87 @@ def block_interval_stats(
     }
 
 
+class EventLoadMonitor:
+    """Per-tx commit latency via a WebSocket Tx-event subscription.
+
+    The block-walk report (:func:`load_report`) measures latency against
+    BLOCK timestamps — the proposer's clock, quantized to commit times.
+    This monitor subscribes to ``tm.event = 'Tx'`` (the reference's
+    loadtime does the same through rpc/client Subscribe,
+    rpc/client/http/http.go:790) and records latency when the node
+    DELIVERS the commit event: send -> observed-committed on one clock,
+    including event-delivery lag, per tx rather than per block.
+
+    Use around a LoadGenerator run::
+
+        mon = EventLoadMonitor(endpoint, run_id)   # subscribes now
+        gen.run_for(8)
+        rep = mon.finish(drain_s=3.0)              # LoadReport
+    """
+
+    def __init__(self, endpoint: str, run_id: str):
+        from ..rpc.client import WSClient
+
+        self.run_id = run_id
+        self._ws = WSClient(endpoint)
+        self._sub = self._ws.subscribe("tm.event = 'Tx'")
+        self._report = LoadReport(run_id=run_id)
+        self._stop = threading.Event()
+        self._heights: set[int] = set()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            ev = self._sub.recv(timeout=0.3)
+            if ev is None:
+                continue
+            try:
+                txr = ev["data"]["value"]["TxResult"]
+                tx = base64.b64decode(txr["tx"])
+                height = int(txr["height"])
+            except (KeyError, ValueError):
+                continue
+            parsed = parse_tx(tx)
+            if parsed is None or parsed[0] != self.run_id:
+                continue
+            now_ns = time.time_ns()
+            rep = self._report
+            rep.txs += 1
+            rep.latencies_s.append((now_ns - parsed[2]) / 1e9)
+            if height not in self._heights:
+                self._heights.add(height)
+                rep.blocks += 1
+            rep.last_height = max(rep.last_height, height)
+            rep.first_height = (
+                height
+                if not rep.first_height
+                else min(rep.first_height, height)
+            )
+
+    def finish(self, drain_s: float = 3.0) -> LoadReport:
+        """Allow in-flight commits to surface, then close and report."""
+        time.sleep(drain_s)
+        self._stop.set()
+        self._thread.join(2.0)
+        try:
+            self._ws.close()
+        except Exception:
+            pass
+        return self._report
+
+
 def load_report(
     endpoint: str,
     run_id: str,
     from_height: int = 1,
     to_height: int | None = None,
 ) -> LoadReport:
-    """Walk committed blocks over RPC; latency = block time - send time."""
+    """Walk committed blocks over RPC; latency = block time - send time.
+
+    The offline/post-hoc method (works on a dead-but-queryable chain);
+    prefer :class:`EventLoadMonitor` for live runs — it measures real
+    per-tx commit latency on one clock via Tx events."""
     client = HTTPClient(endpoint)
     if to_height is None:
         to_height = int(

@@ -4,7 +4,7 @@ Reference: /root/reference/rpc/ (jsonrpc server, ~40 core routes, http and
 local clients).
 """
 
-from .client import HTTPClient, LocalClient
+from .client import HTTPClient, LocalClient, Subscription, WSClient
 from .core.env import Environment
 from .core.routes import ROUTES, RPCError
 from .jsonrpc.server import RPCServer
@@ -16,4 +16,6 @@ __all__ = [
     "ROUTES",
     "RPCError",
     "RPCServer",
+    "Subscription",
+    "WSClient",
 ]

@@ -1,17 +1,32 @@
 """RPC clients (reference: rpc/client/http/http.go, rpc/client/local).
 
-``HTTPClient``  — JSON-RPC 2.0 over HTTP POST (stdlib urllib; zero deps).
-``LocalClient`` — direct in-process dispatch against an Environment
-                  (rpc/client/local semantics: no network, same handlers).
+``HTTPClient``      — JSON-RPC 2.0 over HTTP POST (stdlib urllib).
+``WSClient``        — JSON-RPC over a WebSocket with event
+                      subscriptions (rpc/jsonrpc/client/ws_client.go:33,
+                      rpc/client/http/http.go:790): subscribe(query)
+                      yields a Subscription draining NewBlock/Tx/...
+                      events pushed by the server, with optional
+                      auto-reconnect + resubscribe.
+``LocalClient``     — direct in-process dispatch against an Environment
+                      (rpc/client/local semantics: same handlers, no
+                      network) including event-bus subscriptions.
 
-Both expose ``call(method, **params)`` plus pythonic helpers for the
+All expose ``call(method, **params)`` plus pythonic helpers for the
 common routes; results are the JSON dicts the server returns.
 """
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import itertools
 import json
+import os
+import queue
+import socket
+import struct
+import threading
+import time
 import urllib.request
 
 from .core.routes import ROUTES, RPCError
@@ -57,17 +72,392 @@ class HTTPClient:
         raise AttributeError(name)
 
 
+_WS_MAGIC = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class Subscription:
+    """Client-side event stream for one query.
+
+    ``recv(timeout)`` returns the next event dict
+    ({"query", "data", "events"}) or None on timeout/closed; iterate for
+    a blocking stream. Closed (and drained) when the client
+    unsubscribes, disconnects without reconnect, or is closed.
+    """
+
+    def __init__(self, query: str, capacity: int = 256):
+        self.query = query
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self.closed = threading.Event()
+
+    def _push(self, item) -> None:
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            # Slow consumer: drop oldest so the reader thread never
+            # blocks the demux loop (ws_client.go uses an unbounded
+            # queue by default; a bounded one with drop-oldest keeps
+            # memory flat under event storms).
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                pass
+
+    def recv(self, timeout: float | None = None):
+        if self.closed.is_set() and self._q.empty():
+            return None
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def __iter__(self):
+        while not (self.closed.is_set() and self._q.empty()):
+            item = self.recv(timeout=0.5)
+            if item is not None:
+                yield item
+
+
+class WSClient:
+    """WebSocket JSON-RPC client with event subscriptions.
+
+    Mirrors rpc/jsonrpc/client/ws_client.go: one socket, a reader
+    thread demuxing call responses (by id) from subscription events
+    (by result.query), masked client frames per RFC 6455, pong replies,
+    and optional reconnect-with-resubscribe on connection loss.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        timeout: float = 10.0,
+        reconnect: bool = True,
+        max_reconnect_attempts: int = 5,
+    ):
+        if addr.startswith(("tcp://", "ws://", "http://")):
+            addr = addr.split("://", 1)[1]
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.timeout = timeout
+        self.reconnect = reconnect
+        self.max_reconnect_attempts = max_reconnect_attempts
+        self._ids = itertools.count(1)
+        self._mtx = threading.Lock()  # socket write + state
+        self._pending: dict[int, queue.Queue] = {}
+        self._subs: dict[str, Subscription] = {}
+        self._closed = False
+        self._sock: socket.socket | None = None
+        self._connect()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # -- connection -------------------------------------------------------
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET /websocket HTTP/1.1\r\n"
+            f"Host: {self.host}:{self.port}\r\n"
+            f"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n"
+        )
+        sock.sendall(req.encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("ws handshake: connection closed")
+            buf += chunk
+        head = buf.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+        if "101" not in head.split("\r\n", 1)[0]:
+            raise ConnectionError(f"ws handshake refused: {head.splitlines()[0]}")
+        expect = base64.b64encode(
+            hashlib.sha1((key + _WS_MAGIC).encode()).digest()
+        ).decode()
+        if f"Sec-WebSocket-Accept: {expect}" not in head:
+            # header names are case-insensitive; re-scan tolerantly
+            ok = any(
+                line.split(":", 1)[1].strip() == expect
+                for line in head.splitlines()
+                if line.lower().startswith("sec-websocket-accept:")
+            )
+            if not ok:
+                raise ConnectionError("ws handshake: bad accept key")
+        sock.settimeout(None)
+        self._sock = sock
+
+    def _reconnect(self) -> bool:
+        """Redial with backoff and re-subscribe (ws_client.go reconnect)."""
+        for attempt in range(self.max_reconnect_attempts):
+            if self._closed:
+                return False
+            time.sleep(min(0.1 * (2**attempt), 2.0))
+            try:
+                with self._mtx:
+                    self._connect()
+                for q_str in list(self._subs):
+                    self._send(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": next(self._ids),
+                            "method": "subscribe",
+                            "params": {"query": q_str},
+                        }
+                    )
+                return True
+            except OSError:
+                continue
+        return False
+
+    # -- frame io (client frames are MASKED per RFC 6455) -----------------
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        head = bytes([0x80 | opcode])
+        ln = len(payload)
+        if ln < 126:
+            head += bytes([0x80 | ln])
+        elif ln < (1 << 16):
+            head += bytes([0x80 | 126]) + struct.pack(">H", ln)
+        else:
+            head += bytes([0x80 | 127]) + struct.pack(">Q", ln)
+        with self._mtx:
+            if self._sock is None:
+                raise ConnectionError("ws not connected")
+            self._sock.sendall(head + mask + masked)
+
+    def _send(self, payload: dict) -> None:
+        self._send_frame(0x1, json.dumps(payload).encode())
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf += chunk
+        return buf
+
+    def _read_frame(self) -> tuple[int, bytes]:
+        h = self._read_exact(2)
+        opcode = h[0] & 0x0F
+        masked = h[1] & 0x80
+        ln = h[1] & 0x7F
+        if ln == 126:
+            ln = struct.unpack(">H", self._read_exact(2))[0]
+        elif ln == 127:
+            ln = struct.unpack(">Q", self._read_exact(8))[0]
+        mask = self._read_exact(4) if masked else b""
+        payload = self._read_exact(ln)
+        if masked:
+            payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        return opcode, payload
+
+    # -- reader / demux ---------------------------------------------------
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                opcode, payload = self._read_frame()
+            except (OSError, ConnectionError, AttributeError):
+                if self._closed or not self.reconnect:
+                    break
+                if not self._reconnect():
+                    break
+                continue
+            if opcode == 0x9:  # ping -> pong
+                try:
+                    self._send_frame(0xA, payload)
+                except OSError:
+                    pass
+                continue
+            if opcode == 0x8:  # close
+                if self._closed or not self.reconnect:
+                    break
+                if not self._reconnect():
+                    break
+                continue
+            if opcode not in (0x1, 0x2):
+                continue
+            try:
+                msg = json.loads(payload)
+            except json.JSONDecodeError:
+                continue
+            self._demux(msg)
+        # terminal: fail pending calls, close subscriptions
+        self._closed = True
+        for q in self._pending.values():
+            q.put(None)
+        for sub in self._subs.values():
+            sub.closed.set()
+
+    def _demux(self, msg: dict) -> None:
+        result = msg.get("result")
+        if isinstance(result, dict) and "query" in result:
+            sub = self._subs.get(result["query"])
+            if sub is not None:
+                sub._push(result)
+                return
+        q = self._pending.pop(msg.get("id"), None)
+        if q is not None:
+            q.put(msg)
+
+    # -- public api -------------------------------------------------------
+
+    def call(self, method: str, **params):
+        id_ = next(self._ids)
+        waiter: queue.Queue = queue.Queue(maxsize=1)
+        self._pending[id_] = waiter
+        try:
+            self._send(
+                {
+                    "jsonrpc": "2.0",
+                    "id": id_,
+                    "method": method,
+                    "params": params,
+                }
+            )
+            msg = waiter.get(timeout=self.timeout)
+        except queue.Empty:
+            raise RPCError(f"ws call {method!r} timed out", code=-32603)
+        finally:
+            self._pending.pop(id_, None)
+        if msg is None:
+            raise RPCError("ws connection lost", code=-32603)
+        if "error" in msg:
+            err = msg["error"]
+            raise RPCError(
+                err.get("message", "rpc error"),
+                code=err.get("code", -32603),
+                data=err.get("data", ""),
+            )
+        return msg.get("result")
+
+    def subscribe(self, query: str, capacity: int = 256) -> Subscription:
+        """Subscribe to an event query; events stream into the returned
+        Subscription (rpc/client/http/http.go:790 Subscribe)."""
+        sub = Subscription(query, capacity)
+        self._subs[query] = sub
+        try:
+            self.call("subscribe", query=query)
+        except Exception:
+            self._subs.pop(query, None)
+            raise
+        return sub
+
+    def unsubscribe(self, query: str) -> None:
+        sub = self._subs.pop(query, None)
+        if sub is not None:
+            sub.closed.set()
+        self.call("unsubscribe", query=query)
+
+    def unsubscribe_all(self) -> None:
+        for sub in self._subs.values():
+            sub.closed.set()
+        self._subs.clear()
+        self.call("unsubscribe_all")
+
+    def close(self) -> None:
+        self._closed = True
+        with self._mtx:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for sub in self._subs.values():
+            sub.closed.set()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __getattr__(self, name: str):
+        if name in ROUTES:
+            return lambda **params: self.call(name, **params)
+        raise AttributeError(name)
+
+
 class LocalClient:
-    """In-process client over the same route handlers (rpc/client/local)."""
+    """In-process client over the same route handlers (rpc/client/local),
+    including event subscriptions straight off the node's EventBus."""
 
     def __init__(self, env):
         self.env = env
+        self._sub_id = f"local-client-{id(self):x}"
+        self._subs: dict[str, tuple[object, Subscription, object]] = {}
 
     def call(self, method: str, **params):
         fn = ROUTES.get(method)
         if fn is None:
             raise RPCError(f"method {method!r} not found", code=-32601)
         return fn(self.env, **params)
+
+    def subscribe(self, query: str, capacity: int = 256) -> Subscription:
+        """Event subscription without a network hop: the same
+        {"query","data","events"} items a WSClient subscription yields."""
+        from ..libs import pubsub
+        from .core.events import encode_event_data
+
+        if self.env.event_bus is None:
+            raise RPCError("event bus unavailable")
+        q = pubsub.Query.parse(query)
+        bus_sub = self.env.event_bus.subscribe(
+            self._sub_id, q, capacity=capacity
+        )
+        sub = Subscription(query, capacity)
+
+        def forward():
+            while not sub.closed.is_set() and not bus_sub.canceled.is_set():
+                try:
+                    msg = bus_sub.out.get(timeout=0.5)
+                except Exception:
+                    continue
+                sub._push(
+                    {
+                        "query": query,
+                        "data": encode_event_data(msg.data),
+                        "events": msg.events,
+                    }
+                )
+            sub.closed.set()
+
+        t = threading.Thread(target=forward, daemon=True)
+        t.start()
+        self._subs[query] = (q, sub, bus_sub)
+        return sub
+
+    def unsubscribe(self, query: str) -> None:
+        triple = self._subs.pop(query, None)
+        if triple is None:
+            raise RPCError(f"not subscribed to {query!r}")
+        q, sub, _bus_sub = triple
+        sub.closed.set()
+        self.env.event_bus.unsubscribe(self._sub_id, q)
+
+    def unsubscribe_all(self) -> None:
+        for _q, sub, _b in self._subs.values():
+            sub.closed.set()
+        if self._subs:
+            self.env.event_bus.unsubscribe_all(self._sub_id)
+        self._subs.clear()
+
+    def close(self) -> None:
+        try:
+            self.unsubscribe_all()
+        except Exception:
+            pass
 
     def __getattr__(self, name: str):
         if name in ROUTES:

@@ -453,3 +453,81 @@ class TestIndexerRoutes:
         res = client.block_search(query="block.height >= 1")
         assert int(res["total_count"]) >= 1
         assert res["blocks"][0]["block"]["header"]["height"]
+
+
+class TestWSClientAndLocalClient:
+    """The client-side subscription surface (ws_client.go:33,
+    http.go:790 Subscribe; rpc/client/local): calls + event streams."""
+
+    def test_ws_client_calls_and_subscription(self, node):
+        from cometbft_tpu.rpc import WSClient
+
+        with WSClient(node.rpc_server.bound_addr, timeout=10) as ws:
+            st = ws.call("status")
+            assert int(st["sync_info"]["latest_block_height"]) >= 1
+            # pythonic route helper
+            assert ws.health() == {}
+
+            sub = ws.subscribe("tm.event = 'NewBlock'")
+            ev = sub.recv(timeout=15)
+            assert ev is not None, "no NewBlock event within 15s"
+            assert ev["query"] == "tm.event = 'NewBlock'"
+            assert ev["data"]["type"] == "tendermint/event/NewBlock"
+            h1 = int(ev["data"]["value"]["block"]["header"]["height"])
+            ev2 = sub.recv(timeout=15)
+            assert ev2 is not None
+            h2 = int(ev2["data"]["value"]["block"]["header"]["height"])
+            assert h2 == h1 + 1, "NewBlock events must be consecutive"
+            ws.unsubscribe("tm.event = 'NewBlock'")
+
+    def test_ws_client_tx_commit_events(self, node):
+        """Per-tx commit latency source: a broadcast tx surfaces as a
+        Tx event carrying its height + result."""
+        import base64 as b64
+
+        from cometbft_tpu.rpc import WSClient
+
+        with WSClient(node.rpc_server.bound_addr, timeout=10) as ws:
+            sub = ws.subscribe("tm.event = 'Tx'")
+            tx = b"wsclient=1"
+            res = ws.call(
+                "broadcast_tx_sync", tx=b64.b64encode(tx).decode()
+            )
+            assert int(res["code"]) == 0
+            ev = sub.recv(timeout=15)
+            assert ev is not None, "no Tx event within 15s"
+            txr = ev["data"]["value"]["TxResult"]
+            assert b64.b64decode(txr["tx"]) == tx
+            assert int(txr["height"]) >= 1
+
+    def test_ws_client_reconnects_and_resubscribes(self, node):
+        from cometbft_tpu.rpc import WSClient
+
+        ws = WSClient(node.rpc_server.bound_addr, timeout=10,
+                      reconnect=True)
+        try:
+            sub = ws.subscribe("tm.event = 'NewBlock'")
+            assert sub.recv(timeout=15) is not None
+            # sever the socket out from under the client
+            ws._sock.close()
+            # after auto-reconnect + resubscribe, events flow again
+            ev = sub.recv(timeout=20)
+            assert ev is not None, "no event after reconnect"
+            assert ws.call("health") == {}
+        finally:
+            ws.close()
+
+    def test_local_client_subscription(self, node):
+        from cometbft_tpu.rpc import LocalClient
+
+        lc = LocalClient(node.rpc_env)
+        try:
+            st = lc.call("status")
+            assert int(st["sync_info"]["latest_block_height"]) >= 1
+            sub = lc.subscribe("tm.event = 'NewBlock'")
+            ev = sub.recv(timeout=15)
+            assert ev is not None
+            assert ev["data"]["type"] == "tendermint/event/NewBlock"
+            lc.unsubscribe("tm.event = 'NewBlock'")
+        finally:
+            lc.close()
