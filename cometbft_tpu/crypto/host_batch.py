@@ -70,6 +70,12 @@ def _load():
             lib.edb_decompress_ok.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
             ]
+            lib.edb_scalar_base_mult_xy.restype = None
+            lib.edb_scalar_base_mult_xy.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p
+            ]
+            lib.edb_keccak_f1600.restype = None
+            lib.edb_keccak_f1600.argtypes = [ctypes.c_void_p]
             _lib = lib
         except NativeBuildError:
             _lib_failed = True
@@ -88,6 +94,38 @@ def _decompress_ok(encs: bytes, m: int) -> np.ndarray:
     out = ctypes.create_string_buffer(m)
     _load().edb_decompress_ok(encs, m, out)
     return np.frombuffer(out.raw, np.uint8).astype(bool)
+
+
+def keccak_f1600_inplace(state: bytearray) -> bool:
+    """Native keccak-f[1600] on a 200-byte state; False if unavailable
+    (the merlin/STROBE layer falls back to its pure-Python permutation)."""
+    lib = _load()
+    if lib is None:
+        return False
+    buf = (ctypes.c_ubyte * 200).from_buffer(state)
+    lib.edb_keccak_f1600(ctypes.addressof(buf))
+    return True
+
+
+def scalar_base_mult(scalar: int):
+    """[s]B as an extended-coordinate point tuple, or None if the native
+    engine is unavailable.
+
+    The SIGNING primitive: the C side uses a constant-time window select
+    (no secret-indexed loads/branches), unlike the variable-time Python
+    oracle — sr25519 signing routes here (crypto/sr25519.py). ~50 us vs
+    ~5 ms pure Python.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(64)
+    lib.edb_scalar_base_mult_xy(
+        (scalar % L).to_bytes(32, "little"), out
+    )
+    x = int.from_bytes(out.raw[:32], "little")
+    y = int.from_bytes(out.raw[32:], "little")
+    return (x, y, 1, x * y % ref.P)
 
 
 class _Lane:

@@ -154,6 +154,18 @@ fe fe_frombytes(const uint8_t s[32]) {
     return r;
 }
 
+void fe_tobytes(fe a, uint8_t out[32]) {
+    fe_canon(a);
+    u64 w0 = a.v[0] | (a.v[1] << 51);
+    u64 w1 = (a.v[1] >> 13) | (a.v[2] << 38);
+    u64 w2 = (a.v[2] >> 26) | (a.v[3] << 25);
+    u64 w3 = (a.v[3] >> 39) | (a.v[4] << 12);
+    memcpy(out, &w0, 8);
+    memcpy(out + 8, &w1, 8);
+    memcpy(out + 16, &w2, 8);
+    memcpy(out + 24, &w3, 8);
+}
+
 fe fe_pow_2_252_m3(const fe& z) {
     // the classic curve25519 addition chain (ops/field.pow_2_252_m3)
     fe z2 = fe_sq(z);
@@ -334,6 +346,60 @@ pt msm(const std::vector<pt>& points, const uint8_t* coeffs, size_t m) {
     return acc;
 }
 
+// ------------------------------------------------------ base-point mult
+// Fixed-base scalar multiplication for the SIGNING path (sr25519 nonce
+// and public points ride this; verification stays on the MSM above).
+// 4-bit fixed windows MSB-first with a CONSTANT-TIME table select:
+// signing scalars are secrets, so the lookup touches all 16 entries
+// with arithmetic masks — no secret-indexed loads, no secret branches
+// (fe ops themselves are u64/u128 arithmetic, constant-time on this
+// target).
+
+fe fe_invert(const fe& z) {
+    // z^(p-2), p-2 = 8*(2^252 - 3) + 3
+    fe a = fe_pow_2_252_m3(z);
+    a = fe_sq(fe_sq(fe_sq(a)));
+    return fe_mul(a, fe_mul(fe_sq(z), z));
+}
+
+// canonical encoding of the ed25519 base point (y = 4/5, even x)
+const uint8_t B_BYTES[32] = {
+    0x58, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66};
+
+niels G_TABLE[16];  // [v]B in Niels form, v = 0..15 ([0]B = identity)
+
+inline void fe_cmov(fe& r, const fe& a, u64 mask) {
+    for (int i = 0; i < 5; i++) r.v[i] ^= mask & (r.v[i] ^ a.v[i]);
+}
+
+niels ct_select16(const niels table[16], unsigned v) {
+    niels r = table[0];
+    for (unsigned i = 1; i < 16; i++) {
+        // mask = all-ones iff i == v: diff-1 underflows to 2^64-1 only
+        // when diff == 0, so its top bit is the equality predicate
+        u64 diff = (u64)(i ^ v);
+        u64 mask = (u64)(((int64_t)(diff - 1)) >> 63);
+        fe_cmov(r.yplusx, table[i].yplusx, mask);
+        fe_cmov(r.yminusx, table[i].yminusx, mask);
+        fe_cmov(r.t2d, table[i].t2d, mask);
+    }
+    return r;
+}
+
+pt scalar_base_mult(const uint8_t scalar[32]) {
+    pt acc = pt_identity();
+    for (int w = 63; w >= 0; w--) {
+        if (w != 63)
+            for (int i = 0; i < 4; i++) acc = pt_double(acc);
+        unsigned byte = scalar[w / 2];
+        unsigned v = (w & 1) ? (byte >> 4) : (byte & 0x0F);
+        acc = pt_add_niels(acc, ct_select16(G_TABLE, v));
+    }
+    return acc;
+}
+
 bool g_init_done = false;
 
 void ensure_init() {
@@ -341,6 +407,20 @@ void ensure_init() {
     FE_D = fe_frombytes(D_BYTES);
     FE_D2 = fe_add(FE_D, FE_D);
     FE_SQRTM1 = fe_frombytes(SQRTM1_BYTES);
+    pt g;
+    pt_decompress(B_BYTES, g);
+    pt acc = pt_identity();
+    for (int v = 0; v < 16; v++) {
+        // to_niels requires z == 1: normalize each multiple
+        fe zi = fe_invert(acc.z);
+        pt aff;
+        aff.x = fe_mul(acc.x, zi);
+        aff.y = fe_mul(acc.y, zi);
+        aff.z = fe_one();
+        aff.t = fe_mul(aff.x, aff.y);
+        G_TABLE[v] = to_niels(aff);
+        acc = pt_add(acc, g);
+    }
     g_init_done = true;
 }
 
@@ -362,6 +442,69 @@ long edb_msm_is_identity_x8(const uint8_t* points_enc,
     pt res = msm(points, coeffs, m);
     res = pt_double(pt_double(pt_double(res)));
     return pt_is_identity(res) ? 1 : 0;
+}
+
+// keccak-f[1600] permutation over a 200-byte little-endian-lane state.
+// The merlin/STROBE transcript layer (crypto/sr25519.py) permutes ~6x
+// per signature and per verification-challenge; the pure-Python
+// permutation was ~1 ms — the whole remaining signing cost once the
+// scalar mult went native.
+void edb_keccak_f1600(uint8_t state[200]) {
+    static const u64 RC[24] = {
+        0x0000000000000001ULL, 0x0000000000008082ULL, 0x800000000000808AULL,
+        0x8000000080008000ULL, 0x000000000000808BULL, 0x0000000080000001ULL,
+        0x8000000080008081ULL, 0x8000000000008009ULL, 0x000000000000008AULL,
+        0x0000000000000088ULL, 0x0000000080008009ULL, 0x000000008000000AULL,
+        0x000000008000808BULL, 0x800000000000008BULL, 0x8000000000008089ULL,
+        0x8000000000008003ULL, 0x8000000000008002ULL, 0x8000000000000080ULL,
+        0x000000000000800AULL, 0x800000008000000AULL, 0x8000000080008081ULL,
+        0x8000000000008080ULL, 0x0000000080000001ULL, 0x8000000080008008ULL};
+    static const int ROTC[5][5] = {{0, 36, 3, 41, 18},
+                                   {1, 44, 10, 45, 2},
+                                   {62, 6, 43, 15, 61},
+                                   {28, 55, 25, 21, 56},
+                                   {27, 20, 39, 8, 14}};
+    u64 a[25];
+    memcpy(a, state, 200);
+    for (int round = 0; round < 24; round++) {
+        u64 c[5], d[5], b[25];
+        for (int x = 0; x < 5; x++)
+            c[x] = a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20];
+        for (int x = 0; x < 5; x++) {
+            u64 t = c[(x + 1) % 5];
+            d[x] = c[(x + 4) % 5] ^ ((t << 1) | (t >> 63));
+        }
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 5; y++) a[x + 5 * y] ^= d[x];
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 5; y++) {
+                int r = ROTC[x][y];
+                u64 v = a[x + 5 * y];
+                b[y + 5 * ((2 * x + 3 * y) % 5)] =
+                    r ? ((v << r) | (v >> (64 - r))) : v;
+            }
+        for (int x = 0; x < 5; x++)
+            for (int y = 0; y < 5; y++)
+                a[x + 5 * y] =
+                    b[x + 5 * y] ^ ((~b[(x + 1) % 5 + 5 * y]) &
+                                    b[(x + 2) % 5 + 5 * y]);
+        a[0] ^= RC[round];
+    }
+    memcpy(state, a, 200);
+}
+
+// [s]B for a 32-byte little-endian scalar (caller reduces mod L), out =
+// affine x || y, 64 bytes little-endian. Constant-time window select:
+// this is the SIGNING primitive (sr25519 public/nonce points) — the
+// scalar is secret.
+void edb_scalar_base_mult_xy(const uint8_t scalar[32], uint8_t out[64]) {
+    ensure_init();
+    pt p = scalar_base_mult(scalar);
+    fe zi = fe_invert(p.z);
+    fe x = fe_mul(p.x, zi);
+    fe y = fe_mul(p.y, zi);
+    fe_tobytes(x, out);
+    fe_tobytes(y, out + 32);
 }
 
 // Batched decompress-only check (ZIP-215): out[i] = 1 if points_enc[i]

@@ -163,3 +163,52 @@ class TestSecp256k1:
         sv = Sr25519PrivKey.from_seed(b"\x06" * 32)
         pk2 = keys.pubkey_from_type_and_bytes("sr25519", sv.pub_key().data)
         assert pk2 == sv.pub_key()
+
+
+def test_native_base_mult_matches_oracle():
+    """The constant-time native [s]B (signing primitive) is bit-equal to
+    the Python oracle across edge and random scalars."""
+    import random
+
+    from cometbft_tpu.crypto import ed25519_ref as ref
+    from cometbft_tpu.crypto import host_batch
+
+    if not host_batch.available():
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    rng = random.Random(99)
+    scalars = [0, 1, 2, ref.L - 1] + [
+        rng.randrange(ref.L) for _ in range(16)
+    ]
+    for s in scalars:
+        pt = host_batch.scalar_base_mult(s)
+        assert ref.point_equal(pt, ref.scalar_mult(s, ref.BASE)), s
+
+
+def test_native_keccak_matches_python():
+    """Native keccak-f[1600] produces the exact pure-Python permutation."""
+    import os as _os
+
+    from cometbft_tpu.crypto import host_batch
+
+    if not host_batch.available():
+        import pytest
+
+        pytest.skip("native engine unavailable")
+    rng_state = bytes(range(200))
+    a = bytearray(rng_state)
+    assert host_batch.keccak_f1600_inplace(a)
+    # pure-python reference on the same input (bypass the native route)
+    from cometbft_tpu.crypto import sr25519 as sr
+
+    b = bytearray(rng_state)
+    lib, host_batch._lib = host_batch._lib, None
+    failed = host_batch._lib_failed
+    host_batch._lib_failed = True
+    try:
+        sr.keccak_f1600(b)
+    finally:
+        host_batch._lib = lib
+        host_batch._lib_failed = failed
+    assert bytes(a) == bytes(b)
