@@ -103,8 +103,25 @@ def _load_last_chip_table():
 
 def _save_chip_table() -> None:
     try:
+        import jax
+
+        accel = jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        accel = False
+    try:
         with open("BENCH_CHIP_TABLE.json", "w") as f:
-            json.dump({"round": _ROUND, "table": _DETAILS}, f, indent=1)
+            json.dump(
+                {
+                    "round": _ROUND,
+                    # crypto/batch derives HOST_BATCH_THRESHOLD from the
+                    # 9_device_floor crossover ONLY when this is true —
+                    # a CPU dry run must not poison the production knob
+                    "measured_on_accelerator": accel,
+                    "table": _DETAILS,
+                },
+                f,
+                indent=1,
+            )
     except OSError:
         pass
 

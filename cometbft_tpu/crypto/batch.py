@@ -41,11 +41,45 @@ class BatchVerifier:
 # lanes on a v5e against the old sequential-OpenSSL host path. The host
 # path is now the native RLC batch verifier (crypto/host_batch.py,
 # ~1.5-3x sequential OpenSSL), which pushes the true crossover HIGHER;
-# re-derive against chip latency when the tunnel is reachable (the
-# device side also got faster via the expanded-pubkey arena). The
-# reference has the inverse constant (batchVerifyThreshold,
-# types/validation.go:13-17: below it batching isn't worth setup).
-HOST_BATCH_THRESHOLD = 768
+# the device side also got faster (expanded-pubkey arena, pre-staging,
+# donated buffers). The reference has the inverse constant
+# (batchVerifyThreshold, types/validation.go:13-17: below it batching
+# isn't worth setup).
+#
+# Derivation chain, most authoritative first:
+#   1. COMETBFT_TPU_HOST_THRESHOLD env (operator override / driver);
+#   2. the last chip-measured crossover recorded by bench.py's
+#      9_device_floor breakdown (BENCH_CHIP_TABLE.json, only trusted
+#      when measured on an accelerator backend);
+#   3. the static 768 fallback.
+_DEFAULT_HOST_BATCH_THRESHOLD = 768
+
+
+def _derive_host_threshold() -> int:
+    import json
+    import os
+
+    env = os.environ.get("COMETBFT_TPU_HOST_THRESHOLD")
+    if env:
+        try:
+            return max(2, int(env))
+        except ValueError:
+            pass
+    try:
+        with open("BENCH_CHIP_TABLE.json") as f:
+            table = json.load(f)
+        if table.get("measured_on_accelerator"):
+            for row in table.get("table", []):
+                if row.get("config") == "9_device_floor":
+                    xo = row.get("measured_crossover_lanes")
+                    if isinstance(xo, int) and xo >= 2:
+                        return xo
+    except (OSError, ValueError):
+        pass
+    return _DEFAULT_HOST_BATCH_THRESHOLD
+
+
+HOST_BATCH_THRESHOLD = _derive_host_threshold()
 
 
 class Ed25519BatchVerifier(BatchVerifier):
