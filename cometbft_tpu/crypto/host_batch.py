@@ -76,14 +76,80 @@ def _load():
             ]
             lib.edb_keccak_f1600.restype = None
             lib.edb_keccak_f1600.argtypes = [ctypes.c_void_p]
+            lib.edb_sha512_set_constants.restype = None
+            lib.edb_sha512_set_constants.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p
+            ]
+            lib.edb_pack_challenges.restype = ctypes.c_long
+            lib.edb_pack_challenges.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            _install_sha512_constants(lib)
             _lib = lib
         except NativeBuildError:
             _lib_failed = True
     return _lib
 
 
+def _install_sha512_constants(lib) -> None:
+    """Compute the FIPS 180-4 SHA-512 constants from their definition
+    (first 64 fractional bits of the cube/square roots of the first
+    primes, exact integer arithmetic — no hardcoded magic tables) and
+    install them in the native engine. hashlib parity is pinned by
+    tests/test_host_batch tests."""
+    primes = []
+    cand = 2
+    while len(primes) < 80:
+        if all(cand % p for p in primes):
+            primes.append(cand)
+        cand += 1
+
+    def iroot(x: int, k: int) -> int:
+        """Exact integer k-th root via Newton on Python ints."""
+        if x == 0:
+            return 0
+        r = 1 << ((x.bit_length() + k - 1) // k)
+        while True:
+            nr = ((k - 1) * r + x // r ** (k - 1)) // k
+            if nr >= r:
+                break
+            r = nr
+        return r
+
+    def frac_bits(p: int, k: int) -> int:
+        # floor(frac(p^(1/k)) * 2^64)
+        r = iroot(p << (64 * k), k)
+        return r - ((iroot(p, k)) << 64)
+
+    k80 = (ctypes.c_uint64 * 80)(*[frac_bits(p, 3) for p in primes])
+    h8 = (ctypes.c_uint64 * 8)(*[frac_bits(p, 2) for p in primes[:8]])
+    lib.edb_sha512_set_constants(k80, h8)
+
+
 def available() -> bool:
     return _load() is not None
+
+
+def pack_challenges(recs: bytes, msgs_blob: bytes, offs, n: int):
+    """Native per-lane challenge packing for ops/verify.pack_bytes.
+
+    ``recs``: n x 96 bytes (A|R|S); ``msgs_blob`` + ``offs`` (n+1 u64):
+    concatenated sign bytes. Returns (kneg_rows 32n bytes, s_ok (n,)
+    bool) or None when the native engine is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out_kneg = ctypes.create_string_buffer(32 * n)
+    out_ok = ctypes.create_string_buffer(n)
+    offs_arr = (ctypes.c_uint64 * (n + 1))(*offs)
+    rc = lib.edb_pack_challenges(
+        recs, msgs_blob, offs_arr, n, out_kneg, out_ok
+    )
+    if rc != 0:
+        return None
+    return out_kneg.raw, np.frombuffer(out_ok.raw, np.uint8).astype(bool)
 
 
 def _msm_identity(points: bytes, coeffs: bytes, m: int) -> int:

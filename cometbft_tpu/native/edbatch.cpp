@@ -400,6 +400,205 @@ pt scalar_base_mult(const uint8_t scalar[32]) {
     return acc;
 }
 
+// ------------------------------------------------- host packing engine
+// The per-lane host work of ops/verify.pack_bytes — the SHA-512
+// challenge k = H(R||A||M), its reduction mod L, kneg = (L - k) mod L,
+// and the S < L canonicality check — moved to C: the Python loop was
+// ~9 us/lane (~36 ms of a 4096-lane pack), a material share of the
+// device round trip's host side.
+//
+// SHA-512 round/init constants are NOT hardcoded: Python computes them
+// from the FIPS definition (frac bits of cube/square roots of primes,
+// exact integer arithmetic) and installs them once via
+// edb_sha512_set_constants; parity with hashlib is pinned by tests.
+
+u64 SHA_K[80];
+u64 SHA_H0[8];
+bool g_sha_ready = false;
+
+inline u64 rotr64(u64 x, int n) { return (x >> n) | (x << (64 - n)); }
+
+struct Sha512Ctx {
+    u64 h[8];
+    uint8_t block[128];
+    size_t fill;
+    u64 total;
+};
+
+void sha_init_ctx(Sha512Ctx& c) {
+    memcpy(c.h, SHA_H0, sizeof c.h);
+    c.fill = 0;
+    c.total = 0;
+}
+
+void sha_compress(u64 h[8], const uint8_t* p) {
+    u64 w[80];
+    for (int i = 0; i < 16; i++) {
+        u64 v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | p[8 * i + j];
+        w[i] = v;
+    }
+    for (int i = 16; i < 80; i++) {
+        u64 s0 = rotr64(w[i - 15], 1) ^ rotr64(w[i - 15], 8) ^
+                 (w[i - 15] >> 7);
+        u64 s1 = rotr64(w[i - 2], 19) ^ rotr64(w[i - 2], 61) ^
+                 (w[i - 2] >> 6);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    u64 a = h[0], b = h[1], c = h[2], d = h[3];
+    u64 e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 80; i++) {
+        u64 S1 = rotr64(e, 14) ^ rotr64(e, 18) ^ rotr64(e, 41);
+        u64 ch = (e & f) ^ ((~e) & g);
+        u64 t1 = hh + S1 + ch + SHA_K[i] + w[i];
+        u64 S0 = rotr64(a, 28) ^ rotr64(a, 34) ^ rotr64(a, 39);
+        u64 maj = (a & b) ^ (a & c) ^ (b & c);
+        u64 t2 = S0 + maj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+void sha_update(Sha512Ctx& c, const uint8_t* data, size_t len) {
+    c.total += len;
+    while (len) {
+        size_t take = 128 - c.fill;
+        if (take > len) take = len;
+        memcpy(c.block + c.fill, data, take);
+        c.fill += take;
+        data += take;
+        len -= take;
+        if (c.fill == 128) {
+            sha_compress(c.h, c.block);
+            c.fill = 0;
+        }
+    }
+}
+
+void sha_final(Sha512Ctx& c, uint8_t out[64]) {
+    u64 bits = c.total * 8;
+    uint8_t pad = 0x80;
+    sha_update(c, &pad, 1);
+    uint8_t zero = 0;
+    while (c.fill != 112) sha_update(c, &zero, 1);
+    uint8_t lenb[16] = {0};
+    for (int i = 0; i < 8; i++) lenb[15 - i] = (uint8_t)(bits >> (8 * i));
+    sha_update(c, lenb, 16);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 8; j++)
+            out[8 * i + j] = (uint8_t)(c.h[i] >> (56 - 8 * j));
+}
+
+// 4-limb (u64 LE) scalar arithmetic mod L = 2^252 + c.
+const u64 L_LIMBS[4] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL,
+                        0ULL, 0x1000000000000000ULL};
+// c = L - 2^252, two limbs
+const u64 C_LIMBS[2] = {0x5812631a5cf5d3edULL, 0x14def9dea2f79cd6ULL};
+u64 POW64_MOD_L[4][4];  // 2^(64k) mod L for k = 4..7
+
+bool sc_geq(const u64 a[4], const u64 b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] != b[i]) return a[i] > b[i];
+    }
+    return true;
+}
+
+void sc_sub_inplace(u64 a[4], const u64 b[4]) {
+    u64 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 t = (u128)a[i] - b[i] - borrow;
+        a[i] = (u64)t;
+        borrow = (u64)(t >> 64) ? 1 : 0;  // wraps to all-ones on underflow
+    }
+}
+
+void sc_init_pow64() {
+    u64 x[4] = {1, 0, 0, 0};
+    int idx = 0;
+    for (int bit = 1; bit <= 448; bit++) {
+        u64 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            u64 nv = (x[i] << 1) | carry;
+            carry = x[i] >> 63;
+            x[i] = nv;
+        }
+        if (sc_geq(x, L_LIMBS)) sc_sub_inplace(x, L_LIMBS);
+        if (bit % 64 == 0 && bit >= 256)
+            memcpy(POW64_MOD_L[idx++], x, 32);
+    }
+}
+
+// x (64 bytes LE) mod L -> out 4 limbs canonical
+void sc_reduce512(const uint8_t in[64], u64 out[4]) {
+    u64 x[8];
+    memcpy(x, in, 64);
+    // fold limbs 7..4: acc = x[0..3] + sum x[k] * (2^(64k) mod L)
+    u128 a0 = x[0], a1 = x[1], a2 = x[2], a3 = x[3], a4 = 0;
+    for (int k = 4; k < 8; k++) {
+        const u64* m = POW64_MOD_L[k - 4];
+        u128 p0 = (u128)x[k] * m[0];
+        u128 p1 = (u128)x[k] * m[1];
+        u128 p2 = (u128)x[k] * m[2];
+        u128 p3 = (u128)x[k] * m[3];
+        // add carries and lows SEPARATELY: u64 + u64 wraps before the
+        // u128 accumulator would widen it
+        a0 += (u64)p0;
+        a1 += (p0 >> 64);
+        a1 += (u64)p1;
+        a2 += (p1 >> 64);
+        a2 += (u64)p2;
+        a3 += (p2 >> 64);
+        a3 += (u64)p3;
+        a4 += (p3 >> 64);
+    }
+    // carry-normalize into 5 limbs (value < 2^320)
+    u64 y[5];
+    u128 c = a0;
+    y[0] = (u64)c; c = (c >> 64) + a1;
+    y[1] = (u64)c; c = (c >> 64) + a2;
+    y[2] = (u64)c; c = (c >> 64) + a3;
+    y[3] = (u64)c; c = (c >> 64) + a4;
+    y[4] = (u64)c;
+    // x = hi*2^252 + lo, 2^252 = -c (mod L)  =>  x = lo - hi*c (mod L)
+    u64 hi[2];  // < 2^68
+    hi[0] = (y[3] >> 60) | (y[4] << 4);
+    hi[1] = y[4] >> 60;
+    u64 lo[4] = {y[0], y[1], y[2], y[3] & 0x0FFFFFFFFFFFFFFFULL};
+    // d = hi * c  (< 2^(68+125) = 2^193, 4 limbs)
+    u128 q0 = (u128)hi[0] * C_LIMBS[0];
+    u128 q1 = (u128)hi[0] * C_LIMBS[1];
+    u128 q2 = (u128)hi[1] * C_LIMBS[0];
+    u128 q3 = (u128)hi[1] * C_LIMBS[1];
+    u64 d[4];
+    c = (u64)q0;
+    d[0] = (u64)c; c = (c >> 64) + (u64)(q0 >> 64) + (u64)q1 + (u64)q2;
+    d[1] = (u64)c;
+    c = (c >> 64) + (u64)(q1 >> 64) + (u64)(q2 >> 64) + (u64)q3;
+    d[2] = (u64)c; c = (c >> 64) + (u64)(q3 >> 64);
+    d[3] = (u64)c;
+    // r = lo - d, + L on underflow (d < 2^193 << L so one add suffices)
+    u64 r[4];
+    u64 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u64 di = d[i] + borrow;
+        u64 nb = (di < borrow) || (lo[i] < di) ? 1 : 0;
+        r[i] = lo[i] - di;
+        borrow = nb;
+    }
+    if (borrow) {
+        u128 cc = 0;
+        for (int i = 0; i < 4; i++) {
+            cc += (u128)r[i] + L_LIMBS[i];
+            r[i] = (u64)cc;
+            cc >>= 64;
+        }
+    }
+    while (sc_geq(r, L_LIMBS)) sc_sub_inplace(r, L_LIMBS);
+    memcpy(out, r, 32);
+}
+
 bool g_init_done = false;
 
 void ensure_init() {
@@ -407,6 +606,7 @@ void ensure_init() {
     FE_D = fe_frombytes(D_BYTES);
     FE_D2 = fe_add(FE_D, FE_D);
     FE_SQRTM1 = fe_frombytes(SQRTM1_BYTES);
+    sc_init_pow64();
     pt g;
     pt_decompress(B_BYTES, g);
     pt acc = pt_identity();
@@ -505,6 +705,51 @@ void edb_scalar_base_mult_xy(const uint8_t scalar[32], uint8_t out[64]) {
     fe y = fe_mul(p.y, zi);
     fe_tobytes(x, out);
     fe_tobytes(y, out + 32);
+}
+
+// Install SHA-512 constants (80 round + 8 init words, big-endian u64
+// values) computed by the Python side from the FIPS definition.
+void edb_sha512_set_constants(const uint64_t* k80, const uint64_t* h8) {
+    memcpy(SHA_K, k80, sizeof SHA_K);
+    memcpy(SHA_H0, h8, sizeof SHA_H0);
+    g_sha_ready = true;
+}
+
+// Batched challenge packing: per lane i, recs holds A(32) | R(32) | S(32)
+// and msgs[offs[i]:offs[i+1]] the sign bytes. Computes
+// k = SHA512(R || A || M) mod L, writes (L - k) mod L little-endian to
+// out_kneg, and out_ok[i] = (S < L). Returns 0, or -1 if constants were
+// never installed.
+long edb_pack_challenges(const uint8_t* recs, const uint8_t* msgs,
+                         const uint64_t* offs, size_t n,
+                         uint8_t* out_kneg, uint8_t* out_ok) {
+    if (!g_sha_ready) return -1;
+    ensure_init();
+    for (size_t i = 0; i < n; i++) {
+        const uint8_t* a = recs + 96 * i;
+        const uint8_t* r = a + 32;
+        const uint8_t* s = a + 64;
+        Sha512Ctx c;
+        sha_init_ctx(c);
+        sha_update(c, r, 32);
+        sha_update(c, a, 32);
+        sha_update(c, msgs + offs[i], (size_t)(offs[i + 1] - offs[i]));
+        uint8_t digest[64];
+        sha_final(c, digest);
+        u64 k[4];
+        sc_reduce512(digest, k);
+        // kneg = (L - k) mod L
+        u64 kneg[4] = {0, 0, 0, 0};
+        if (k[0] | k[1] | k[2] | k[3]) {
+            memcpy(kneg, L_LIMBS, 32);
+            sc_sub_inplace(kneg, k);
+        }
+        memcpy(out_kneg + 32 * i, kneg, 32);
+        u64 sv[4];
+        memcpy(sv, s, 32);
+        out_ok[i] = sc_geq(sv, L_LIMBS) ? 0 : 1;
+    }
+    return 0;
 }
 
 // Batched decompress-only check (ZIP-215): out[i] = 1 if points_enc[i]

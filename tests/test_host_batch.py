@@ -109,3 +109,125 @@ def test_single_lane_and_empty():
     assert host_batch.verify_many([], [], []) == []
     sigs[0] = bytes(64)
     assert host_batch.verify_many(pks, msgs, sigs) == [False]
+
+
+class TestNativePackChallenges:
+    """The native packing engine (edb_pack_challenges: C SHA-512 with
+    definition-computed constants + 4-limb mod-L reduction) must be
+    byte-identical to the Python pack path."""
+
+    def _batch(self, n):
+        from cometbft_tpu.crypto import ed25519_ref as ref
+
+        pks, msgs, sigs = [], [], []
+        for i in range(n):
+            seed = (3000 + i).to_bytes(32, "big")
+            pks.append(ref.pubkey_from_seed(seed))
+            msgs.append(b"np %d " % i + b"x" * (i % 190))
+            sigs.append(ref.sign(seed, msgs[-1]))
+        return pks, msgs, sigs
+
+    def test_sha512_constants_match_hashlib(self):
+        """One C-SHA512 digest equals hashlib's, across block boundaries
+        (the constants are derived, not vendored — this pins them)."""
+        from cometbft_tpu.crypto import host_batch
+        from cometbft_tpu.ops import verify as ov
+
+        if not host_batch.available():
+            import pytest
+
+            pytest.skip("native engine unavailable")
+        # messages of many lengths exercise padding edges (112/128)
+        pks, msgs, sigs = [], [], []
+        from cometbft_tpu.crypto import ed25519_ref as ref
+
+        for ln in list(range(0, 6)) + [47, 48, 49, 63, 64, 65, 111,
+                                       112, 113, 127, 128, 129, 255]:
+            seed = (5000 + ln).to_bytes(32, "big")
+            m = bytes(range(256))[:ln]
+            pks.append(ref.pubkey_from_seed(seed))
+            msgs.append(m)
+            sigs.append(ref.sign(seed, m))
+        native = ov._pack_bytes_native(pks, msgs, sigs, len(pks))
+        assert native is not None
+        buf_n, ok_n = native
+        # Python path, forced
+        lib, host_batch._lib = host_batch._lib, None
+        failed = host_batch._lib_failed
+        host_batch._lib_failed = True
+        try:
+            buf_p, ok_p = ov.pack_bytes(pks, msgs, sigs)
+        finally:
+            host_batch._lib = lib
+            host_batch._lib_failed = failed
+        import numpy as np
+
+        assert np.array_equal(ok_n, ok_p)
+        assert np.array_equal(buf_n, buf_p)
+
+    def test_native_pack_matches_python_with_malformed_lanes(self):
+        import numpy as np
+
+        from cometbft_tpu.crypto import host_batch
+        from cometbft_tpu.ops import verify as ov
+
+        if not host_batch.available():
+            import pytest
+
+            pytest.skip("native engine unavailable")
+        pks, msgs, sigs = self._batch(24)
+        pks[3] = b"\x01" * 31  # short pubkey
+        sigs[5] = b"\x02" * 63  # short sig
+        # non-canonical S >= L
+        s_big = (ov.L + 5).to_bytes(32, "little")
+        sigs[7] = sigs[7][:32] + s_big
+        native = ov._pack_bytes_native(pks, msgs, sigs, 24)
+        assert native is not None
+        buf_n, ok_n = native
+        lib, host_batch._lib = host_batch._lib, None
+        failed = host_batch._lib_failed
+        host_batch._lib_failed = True
+        try:
+            buf_p, ok_p = ov.pack_bytes(pks, msgs, sigs)
+        finally:
+            host_batch._lib = lib
+            host_batch._lib_failed = failed
+        assert np.array_equal(ok_n, ok_p)
+        assert not ok_n[3] and not ok_n[5] and not ok_n[7]
+        assert np.array_equal(buf_n, buf_p)
+
+    def test_sc_reduce_random_hashes(self):
+        """sc_reduce512 vs Python bigints on random 64-byte values,
+        via the pack entry (kneg rows)."""
+        import random
+
+        import numpy as np
+
+        from cometbft_tpu.crypto import ed25519_ref as ref
+        from cometbft_tpu.crypto import host_batch
+
+        if not host_batch.available():
+            import pytest
+
+            pytest.skip("native engine unavailable")
+        rng = random.Random(31337)
+        n = 64
+        # craft lanes whose digests we recompute in python
+        pks, msgs, sigs = self._batch(n)
+        recs = b"".join(
+            bytes(p) + bytes(s) for p, s in zip(pks, sigs)
+        )
+        blob = b"".join(msgs)
+        offs = [0]
+        for m in msgs:
+            offs.append(offs[-1] + len(m))
+        out = host_batch.pack_challenges(recs, blob, offs, n)
+        assert out is not None
+        kneg_blob, s_ok = out
+        assert s_ok.all()
+        for i in range(n):
+            k = ref.challenge_scalar(sigs[i][:32], pks[i], msgs[i])
+            expect = ((ref.L - k) % ref.L).to_bytes(32, "little")
+            got = kneg_blob[32 * i : 32 * i + 32]
+            assert got == expect, i
+        del rng
