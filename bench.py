@@ -474,7 +474,9 @@ def bench_kernel_ab():
         buf = np.pad(buf, [(0, 0), (0, size - n)])
     on_accel = jax.default_backend() in ("tpu", "axon")
     out = {"lanes": n}
-    lowerings = ["xla", "xla8"] + (["pallas"] if on_accel else [])
+    lowerings = ["xla", "xla8"] + (
+        ["pallas", "pallas8"] if on_accel else []
+    )
     for which in lowerings:
         try:
             fn = ov._jitted_kernel(which)

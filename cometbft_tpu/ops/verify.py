@@ -363,6 +363,24 @@ def _cached_kernel_pallas(arena, arena_ok, idxs, buf):
     )
 
 
+def _cached_kernel_pallas8(arena, arena_ok, idxs, buf):
+    import jax.numpy as jnp
+
+    from . import pallas_verify
+
+    b = buf.astype(jnp.int32)
+    rr_bits = _dev_le_bits(b[0:32])
+    table = arena[:, :, :, idxs]
+    return pallas_verify.verify_kernel8_cached(
+        table,
+        arena_ok[idxs],
+        y_r=_dev_y_limbs(rr_bits),
+        sign_r=rr_bits[255],
+        s_bytes=b[32:64],
+        kneg_nibs=_dev_msb_nibbles(b[64:96]),
+    )
+
+
 def _builder_kernel(buf):
     """(32, M) uint8 pubkey bytes -> (table, ok) for the arena."""
     import jax.numpy as jnp
@@ -410,6 +428,7 @@ def _jitted_cached_kernel(which: str):
     _enable_compilation_cache()
     fn = {
         "pallas": _cached_kernel_pallas,
+        "pallas8": _cached_kernel_pallas8,
         "xla8": _cached_kernel8,
     }.get(which, _cached_kernel)
     # donate the per-launch R|S|kneg wire rows (arg 3) — NEVER the arena
@@ -426,7 +445,7 @@ def _run_cached_kernel(arena, arena_ok, idxs, buf):
     ):
         try:
             return (
-                _jitted_cached_kernel("pallas")(arena, arena_ok, idxs, buf),
+                _jitted_cached_kernel(_pallas_which())(arena, arena_ok, idxs, buf),
                 True,
             )
         except Exception as e:
@@ -614,6 +633,24 @@ def _kernel_from_bytes_pallas(buf):
     return pallas_verify.verify_kernel(**unpack_on_device(buf))
 
 
+def _kernel_from_bytes_pallas8(buf):
+    import jax.numpy as jnp
+
+    from . import pallas_verify
+
+    b = buf.astype(jnp.int32)
+    pk_bits = _dev_le_bits(b[0:32])
+    rr_bits = _dev_le_bits(b[32:64])
+    return pallas_verify.verify_kernel8(
+        y_a=_dev_y_limbs(pk_bits),
+        sign_a=pk_bits[255],
+        y_r=_dev_y_limbs(rr_bits),
+        sign_r=rr_bits[255],
+        s_bytes=b[64:96],
+        kneg_nibs=_dev_msb_nibbles(b[96:128]),
+    )
+
+
 @lru_cache(maxsize=None)
 def _enable_compilation_cache() -> None:
     """Persistent XLA compilation cache: the verify kernel compiles once
@@ -640,6 +677,7 @@ def _jitted_kernel(which: str = "xla"):
     _enable_compilation_cache()
     fn = {
         "pallas": _kernel_from_bytes_pallas,
+        "pallas8": _kernel_from_bytes_pallas8,
         "xla8": _kernel_from_bytes8,
     }.get(which, _kernel_from_bytes)
     return jax.jit(fn, donate_argnums=_donatable((0,)))
@@ -667,13 +705,18 @@ def _kernel_mode() -> str:
 
 def _xla_which() -> str:
     """The non-Pallas lowering to use: the gated 8-bit prototype or the
-    default joint 4-bit ladder."""
-    return "xla8" if _kernel_mode() == "xla8" else "xla"
+    default joint 4-bit ladder. pallas8 falls back to xla8 (same window
+    scheme) when Mosaic balks."""
+    return "xla8" if _kernel_mode() in ("xla8", "pallas8") else "xla"
+
+
+def _pallas_which() -> str:
+    return "pallas8" if _kernel_mode() == "pallas8" else "pallas"
 
 
 def _pallas_wanted() -> bool:
     mode = _kernel_mode()
-    if mode == "pallas":
+    if mode in ("pallas", "pallas8"):
         return True
     if mode in ("xla", "xla8"):
         return False
@@ -714,7 +757,7 @@ def _run_kernel(buf):
         and not _PALLAS_BROKEN
     ):
         try:
-            return _jitted_kernel("pallas")(buf), True
+            return _jitted_kernel(_pallas_which())(buf), True
         except Exception as e:  # synchronous trace/compile failure
             _note_pallas_broken(e)
     return _jitted_kernel(_xla_which())(buf), False

@@ -97,3 +97,83 @@ def test_production_dispatch_xla8_cached_and_uncached(xla8_mode):
     ok2, bitmap2 = verify.verify_batch(pks, msgs, sigs)  # warm: cached
     assert bitmap2.tolist() == expect
     assert verify._PUBKEY_CACHE.hits >= len(pks)
+
+
+@pytest.mark.slow
+def test_pallas8_matches_corpus_interpret():
+    """The Pallas 8-bit-window lowering (COMETBFT_TPU_KERNEL=pallas8)
+    agrees with the ZIP-215 corpus in interpret mode — the same jaxpr
+    Mosaic compiles on hardware."""
+    from cometbft_tpu.ops import pallas_verify
+
+    pks, msgs, sigs, expect = _split(CORPUS)
+    buf, host_ok = verify.pack_bytes(pks, msgs, sigs)
+    n = buf.shape[1]
+    size = verify.bucket_size(n)
+    if size != n:
+        buf = np.pad(buf, [(0, 0), (0, size - n)])
+    import jax.numpy as jnp
+
+    b = jnp.asarray(buf).astype(jnp.int32)
+    pk_bits = verify._dev_le_bits(b[0:32])
+    rr_bits = verify._dev_le_bits(b[32:64])
+    got = (
+        np.asarray(
+            pallas_verify.verify_kernel8(
+                y_a=verify._dev_y_limbs(pk_bits),
+                sign_a=pk_bits[255],
+                y_r=verify._dev_y_limbs(rr_bits),
+                sign_r=rr_bits[255],
+                s_bytes=b[64:96],
+                kneg_nibs=verify._dev_msb_nibbles(b[96:128]),
+                interpret=True,
+            )
+        )[:n]
+        & host_ok
+    )
+    bad = [
+        (name, e, bool(g))
+        for (name, *_), e, g in zip(CORPUS, expect, got)
+        if e != bool(g)
+    ]
+    assert not bad, f"pallas8 kernel diverges: {bad}"
+
+
+@pytest.mark.slow
+def test_pallas8_cached_matches_oracle_interpret(xla8_mode):
+    """Cached-arena pallas8 path, one interpret invocation."""
+    from cometbft_tpu.ops import pallas_verify
+
+    pks, msgs, sigs = [], [], []
+    for i in range(8):
+        seed = (9000 + i).to_bytes(32, "big")
+        pks.append(ref.pubkey_from_seed(seed))
+        msgs.append(b"p8c %d" % i)
+        sigs.append(ref.sign(seed, msgs[-1]))
+    sigs[1] = bytes([sigs[1][0] ^ 1]) + sigs[1][1:]
+    expect = [ref.verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+
+    hit = verify._PUBKEY_CACHE.lookup(pks)
+    assert hit is not None
+    idxs, arena, arena_ok = hit
+    buf, host_ok = verify.pack_bytes(pks, msgs, sigs)
+    import jax.numpy as jnp
+
+    b = jnp.asarray(buf[32:]).astype(jnp.int32)
+    rr_bits = verify._dev_le_bits(b[0:32])
+    table = jnp.asarray(arena)[:, :, :, jnp.asarray(idxs)]
+    got = (
+        np.asarray(
+            pallas_verify.verify_kernel8_cached(
+                table,
+                jnp.asarray(arena_ok)[jnp.asarray(idxs)],
+                y_r=verify._dev_y_limbs(rr_bits),
+                sign_r=rr_bits[255],
+                s_bytes=b[32:64],
+                kneg_nibs=verify._dev_msb_nibbles(b[64:96]),
+                interpret=True,
+            )
+        )
+        & host_ok
+    )
+    assert got.tolist() == expect
