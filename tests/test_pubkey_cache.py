@@ -155,3 +155,41 @@ def test_pallas_cached_kernel_matches_xla():
     )
     assert np.array_equal(xla & host_ok, pal & host_ok)
     assert list(pal & host_ok) == expect
+
+
+def test_eviction_churn_with_out_of_lock_builds(monkeypatch):
+    """Round-4 lock refactor: builder launches run OUTSIDE the cache
+    lock, with a re-check loop when another thread evicts mid-build.
+    Force that window: a tiny arena (capacity 8) + 3 threads churning
+    overlapping 6-key sets (18 distinct keys > capacity), so every
+    lookup both evicts and rebuilds while the others are mid-flight.
+    Correctness bar: every bitmap still matches the oracle, and the
+    in_use pinning holds (a thread's own keys are never redirected)."""
+    cache = verify.PubkeyTableCache(capacity=8)
+    monkeypatch.setattr(verify, "_PUBKEY_CACHE", cache)
+    pks, msgs, sigs = make_batch(18)
+    expect = [True] * 18
+    errs = []
+
+    def worker(base):
+        idx = [(base * 5 + j) % 18 for j in range(6)]
+        p = [pks[i] for i in idx]
+        m = [msgs[i] for i in idx]
+        s = [sigs[i] for i in idx]
+        try:
+            for _ in range(4):
+                ok, bm = verify.verify_batch(p, m, s)
+                assert ok and bm.all(), bm
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(b,)) for b in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    assert cache.builds >= 1
+    # arena never exceeds capacity (evictions kept up under churn)
+    assert len(cache._slots) <= cache.capacity
+    del expect
