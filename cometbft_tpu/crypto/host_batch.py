@@ -201,8 +201,13 @@ class _Lane:
         self.a, self.r, self.s, self.k, self.z = a, r, s, k, z
 
 
-def _check_lanes(lanes) -> bool:
-    """One RLC MSM over the given lanes; True iff all valid."""
+def _check_lanes_res(lanes) -> int:
+    """One RLC MSM over the given lanes.
+
+    Returns the raw engine verdict: 1 all-valid, 0 equation fails,
+    -(2+i) when MSM input point i fails ZIP-215 decoding (the engine
+    decompresses before any bucket work, so a decode failure costs
+    only the decompression prefix, not an MSM)."""
     m = 2 * len(lanes) + 1
     points = bytearray()
     coeffs = bytearray()
@@ -219,9 +224,14 @@ def _check_lanes(lanes) -> bool:
         coeffs += ln.z.to_bytes(32, "little")
     points += _B_ENC
     coeffs += b.to_bytes(32, "little")
-    res = _msm_identity(bytes(points), bytes(coeffs), m)
-    # decompress failures were pre-filtered; a residual -n is a bug, not
-    # an invalid signature — surface it
+    return _msm_identity(bytes(points), bytes(coeffs), m)
+
+
+def _check_lanes(lanes) -> bool:
+    """True iff all lanes valid; callers guarantee decodable points."""
+    res = _check_lanes_res(lanes)
+    # decompress failures were filtered upstream; a residual -n is a
+    # bug, not an invalid signature — surface it
     if res < 0:
         raise RuntimeError(f"unexpected decompress failure at {-res - 2}")
     return res == 1
@@ -254,8 +264,6 @@ def verify_many(pubkeys, msgs, sigs) -> list[bool]:
     n = len(pubkeys)
     out = [False] * n
     lanes, idx_map = [], []
-    enc_blob = bytearray()
-    pend = []
     for i in range(n):
         p, m, s = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
         if len(p) != 32 or len(s) != 64:
@@ -267,20 +275,34 @@ def verify_many(pubkeys, msgs, sigs) -> list[bool]:
         z = 0
         while z == 0:
             z = int.from_bytes(secrets.token_bytes(16), "little")
-        pend.append((i, _Lane(p, s[:32], s_int, k, z)))
-        enc_blob += p
-        enc_blob += s[:32]
-    if pend:
-        # pre-filter undecodable A/R so the MSM can't fail on decode
-        ok = _decompress_ok(bytes(enc_blob), 2 * len(pend))
-        for j, (i, ln) in enumerate(pend):
+        lanes.append(_Lane(p, s[:32], s_int, k, z))
+        idx_map.append(i)
+    if not lanes:
+        return out
+    # Optimistic first MSM: honest batches (the overwhelming case) skip
+    # the decompress pre-filter entirely — the engine decompresses once,
+    # inside the MSM. Only a decode FAILURE (res < 0) pays the filter,
+    # and that failure surfaces during the engine's cheap decompression
+    # prefix, before any Pippenger work.
+    res = _check_lanes_res(lanes)
+    if res == 1:
+        for i in idx_map:
+            out[i] = True
+        return out
+    if res < 0:
+        enc = b"".join(ln.a + ln.r for ln in lanes)
+        ok = _decompress_ok(enc, 2 * len(lanes))
+        good, gmap = [], []
+        for j, (ln, i) in enumerate(zip(lanes, idx_map)):
             if ok[2 * j] and ok[2 * j + 1]:
-                lanes.append(ln)
-                idx_map.append(i)
-    if lanes:
+                good.append(ln)
+                gmap.append(i)
+        lanes, idx_map = good, gmap
+        if not lanes:
+            return out
         if _check_lanes(lanes):
             for i in idx_map:
                 out[i] = True
-        else:
-            _attribute(lanes, out, idx_map)
+            return out
+    _attribute(lanes, out, idx_map)
     return out
