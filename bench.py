@@ -11,12 +11,11 @@ trips including packing — what a consensus round actually pays.
 
 Baseline honesty: the reference's hot path is curve25519-voi *batch*
 verification (crypto/ed25519/ed25519.go:196-228), not single verifies.
-No Go toolchain exists in this image, so the baseline is measured
-OpenSSL single-verify throughput on one core times 2.0 — a documented,
-deliberately generous stand-in for voi's batch speedup over its single
-verify (random-linear-combination batching roughly halves per-sig cost
-at these batch sizes). vs_baseline therefore UNDERSTATES the advantage
-vs OpenSSL and approximates it vs voi-batch.
+No Go toolchain exists in this image, so the baseline is the MEASURED
+native RLC/Pippenger batch verifier (crypto/host_batch.py over
+native/edbatch.cpp — the voi algorithm itself) on one core of this
+machine; OpenSSL single-verify is reported alongside for context. The
+former "OpenSSL x 2.0" stand-in was retired in round 3.
 
 Configs (BASELINE.md "North-star target", crypto/ed25519/bench_test.go:31-68):
   1. 64-sig batch            (CPU-parity bucket)

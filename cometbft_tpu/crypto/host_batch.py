@@ -264,6 +264,7 @@ def verify_many(pubkeys, msgs, sigs) -> list[bool]:
     n = len(pubkeys)
     out = [False] * n
     lanes, idx_map = [], []
+    zs = secrets.token_bytes(16 * n)  # one syscall, not one per lane
     for i in range(n):
         p, m, s = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
         if len(p) != 32 or len(s) != 64:
@@ -272,8 +273,8 @@ def verify_many(pubkeys, msgs, sigs) -> list[bool]:
         if s_int >= L:  # S must be canonical even under ZIP-215
             continue
         k = ref.challenge_scalar(s[:32], p, m)
-        z = 0
-        while z == 0:
+        z = int.from_bytes(zs[16 * i : 16 * i + 16], "little")
+        while z == 0:  # vanishing probability; fresh draw
             z = int.from_bytes(secrets.token_bytes(16), "little")
         lanes.append(_Lane(p, s[:32], s_int, k, z))
         idx_map.append(i)
