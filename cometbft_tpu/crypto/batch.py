@@ -65,8 +65,15 @@ def _derive_host_threshold() -> int:
             return max(2, int(env))
         except ValueError:
             pass
+    # repo-root anchored (bench.py writes it there): a CWD-relative open
+    # would silently miss the table for any process not started in the
+    # repo root — and trust an unrelated same-named file that is.
+    table_path = os.environ.get("COMETBFT_TPU_CHIP_TABLE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "BENCH_CHIP_TABLE.json",
+    )
     try:
-        with open("BENCH_CHIP_TABLE.json") as f:
+        with open(table_path) as f:
             table = json.load(f)
         if table.get("measured_on_accelerator"):
             for row in table.get("table", []):

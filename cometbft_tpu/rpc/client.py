@@ -106,13 +106,29 @@ class Subscription:
             except queue.Full:
                 pass
 
+    def _close(self) -> None:
+        """Close and WAKE blocked receivers: closed.set() alone cannot
+        interrupt a queue.get, so a None sentinel rides the queue."""
+        self.closed.set()
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # queue non-empty: the receiver drains to items first
+
     def recv(self, timeout: float | None = None):
         if self.closed.is_set() and self._q.empty():
             return None
         try:
-            return self._q.get(timeout=timeout)
+            item = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+        if item is None:  # close sentinel — re-arm for other receivers
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                pass
+            return None
+        return item
 
     def __iter__(self):
         while not (self.closed.is_set() and self._q.empty()):
@@ -292,12 +308,15 @@ class WSClient:
             except json.JSONDecodeError:
                 continue
             self._demux(msg)
-        # terminal: fail pending calls, close subscriptions
+        # terminal: fail pending calls, close subscriptions. Snapshot —
+        # other threads insert into these dicts concurrently, and a
+        # mid-iteration resize would kill this thread before it wakes
+        # the remaining waiters.
         self._closed = True
-        for q in self._pending.values():
+        for q in list(self._pending.values()):
             q.put(None)
-        for sub in self._subs.values():
-            sub.closed.set()
+        for sub in list(self._subs.values()):
+            sub._close()
 
     def _demux(self, msg: dict) -> None:
         result = msg.get("result")
@@ -356,12 +375,12 @@ class WSClient:
     def unsubscribe(self, query: str) -> None:
         sub = self._subs.pop(query, None)
         if sub is not None:
-            sub.closed.set()
+            sub._close()
         self.call("unsubscribe", query=query)
 
     def unsubscribe_all(self) -> None:
-        for sub in self._subs.values():
-            sub.closed.set()
+        for sub in list(self._subs.values()):
+            sub._close()
         self._subs.clear()
         self.call("unsubscribe_all")
 
@@ -374,8 +393,8 @@ class WSClient:
                 sock.close()
             except OSError:
                 pass
-        for sub in self._subs.values():
-            sub.closed.set()
+        for sub in list(self._subs.values()):
+            sub._close()
 
     def __enter__(self):
         return self
@@ -431,7 +450,7 @@ class LocalClient:
                         "events": msg.events,
                     }
                 )
-            sub.closed.set()
+            sub._close()
 
         t = threading.Thread(target=forward, daemon=True)
         t.start()
@@ -443,12 +462,12 @@ class LocalClient:
         if triple is None:
             raise RPCError(f"not subscribed to {query!r}")
         q, sub, _bus_sub = triple
-        sub.closed.set()
+        sub._close()
         self.env.event_bus.unsubscribe(self._sub_id, q)
 
     def unsubscribe_all(self) -> None:
-        for _q, sub, _b in self._subs.values():
-            sub.closed.set()
+        for _q, sub, _b in list(self._subs.values()):
+            sub._close()
         if self._subs:
             self.env.event_bus.unsubscribe_all(self._sub_id)
         self._subs.clear()

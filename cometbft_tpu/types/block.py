@@ -362,9 +362,9 @@ class Block:
         # Cross-check the evidence section against the committed header
         # hash (types/block.go:98) — without this, a relay could strip or
         # alter evidence while the header still content-verifies.
-        if self.header.evidence_hash != merkle.hash_from_byte_slices(
-            [ev.hash() for ev in self.evidence]
-        ):
+        from .evidence import evidence_list_hash
+
+        if self.header.evidence_hash != evidence_list_hash(self.evidence):
             raise ValueError("evidence hash mismatch")
 
 
@@ -387,6 +387,8 @@ def make_block(
 ) -> Block:
     """Assemble a block and fill derived hashes (types/block.go MakeBlock +
     fillHeader)."""
+    from .evidence import evidence_list_hash
+
     data = Data(txs=list(txs))
     header = Header(
         height=height,
@@ -396,9 +398,7 @@ def make_block(
             if last_commit is not None
             else merkle.hash_from_byte_slices([])
         ),
-        evidence_hash=merkle.hash_from_byte_slices(
-            [ev.hash() for ev in evidence]
-        ),
+        evidence_hash=evidence_list_hash(evidence),
         **header_fields,
     )
     return Block(

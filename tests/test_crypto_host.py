@@ -144,7 +144,10 @@ class TestHostThresholdDerivation:
         from cometbft_tpu.crypto import batch
 
         monkeypatch.delenv("COMETBFT_TPU_HOST_THRESHOLD", raising=False)
-        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv(
+            "COMETBFT_TPU_CHIP_TABLE",
+            str(tmp_path / "BENCH_CHIP_TABLE.json"),
+        )
         (tmp_path / "BENCH_CHIP_TABLE.json").write_text(
             json.dumps(
                 {
@@ -181,7 +184,10 @@ class TestHostThresholdDerivation:
         from cometbft_tpu.crypto import batch
 
         monkeypatch.delenv("COMETBFT_TPU_HOST_THRESHOLD", raising=False)
-        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv(
+            "COMETBFT_TPU_CHIP_TABLE",
+            str(tmp_path / "missing.json"),
+        )
         assert batch._derive_host_threshold() == (
             batch._DEFAULT_HOST_BATCH_THRESHOLD
         )

@@ -531,3 +531,28 @@ class TestWSClientAndLocalClient:
             lc.unsubscribe("tm.event = 'NewBlock'")
         finally:
             lc.close()
+
+
+def test_subscription_close_wakes_blocked_recv():
+    """A recv() with no timeout must not hang forever when the
+    connection is lost: _close() pushes a wake sentinel."""
+    import threading as _threading
+
+    from cometbft_tpu.rpc import Subscription
+
+    sub = Subscription("q")
+    got = []
+
+    def receiver():
+        got.append(sub.recv())  # timeout=None: blocks until close
+
+    t = _threading.Thread(target=receiver, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive(), "receiver should be blocked"
+    sub._close()
+    t.join(2.0)
+    assert not t.is_alive(), "close did not wake the blocked recv"
+    assert got == [None]
+    # subsequent receivers see closed immediately (sentinel re-armed)
+    assert sub.recv(timeout=0.1) is None
