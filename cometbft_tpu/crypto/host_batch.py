@@ -62,34 +62,56 @@ def _load():
             return _lib
         try:
             lib = build_and_load(_SRC, _SO)
-            lib.edb_msm_is_identity_x8.restype = ctypes.c_long
-            lib.edb_msm_is_identity_x8.argtypes = [
-                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
-            ]
-            lib.edb_decompress_ok.restype = None
-            lib.edb_decompress_ok.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
-            ]
-            lib.edb_scalar_base_mult_xy.restype = None
-            lib.edb_scalar_base_mult_xy.argtypes = [
-                ctypes.c_char_p, ctypes.c_char_p
-            ]
-            lib.edb_keccak_f1600.restype = None
-            lib.edb_keccak_f1600.argtypes = [ctypes.c_void_p]
-            lib.edb_sha512_set_constants.restype = None
-            lib.edb_sha512_set_constants.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p
-            ]
-            lib.edb_pack_challenges.restype = ctypes.c_long
-            lib.edb_pack_challenges.argtypes = [
-                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
-                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
-            ]
+            try:
+                _bind(lib)
+            except AttributeError:
+                # a pre-existing .so from OLDER source (deploy that
+                # preserved mtimes) lacks newer symbols: force a clean
+                # rebuild from the current source once
+                try:
+                    os.remove(_SO)
+                except OSError:
+                    pass
+                lib = build_and_load(_SRC, _SO)
+                _bind(lib)
             _install_sha512_constants(lib)
             _lib = lib
-        except NativeBuildError:
+        except (NativeBuildError, AttributeError):
             _lib_failed = True
     return _lib
+
+
+def _bind(lib) -> None:
+    """ctypes signatures for every engine symbol; raises AttributeError
+    when the loaded .so predates one (callers force a rebuild)."""
+    lib.edb_msm_is_identity_x8.restype = ctypes.c_long
+    lib.edb_msm_is_identity_x8.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
+    ]
+    lib.edb_decompress_ok.restype = None
+    lib.edb_decompress_ok.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
+    ]
+    lib.edb_scalar_base_mult_xy.restype = None
+    lib.edb_scalar_base_mult_xy.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p
+    ]
+    lib.edb_keccak_f1600.restype = None
+    lib.edb_keccak_f1600.argtypes = [ctypes.c_void_p]
+    lib.edb_sha512_set_constants.restype = None
+    lib.edb_sha512_set_constants.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p
+    ]
+    lib.edb_pack_challenges.restype = ctypes.c_long
+    lib.edb_pack_challenges.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.edb_verify_batch.restype = ctypes.c_long
+    lib.edb_verify_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
 
 
 def _install_sha512_constants(lib) -> None:
@@ -263,33 +285,51 @@ def verify_many(pubkeys, msgs, sigs) -> list[bool]:
         return fast25519.verify_many(pubkeys, msgs, sigs)
     n = len(pubkeys)
     out = [False] * n
-    lanes, idx_map = [], []
-    zs = secrets.token_bytes(16 * n)  # one syscall, not one per lane
+    # Happy path: ONE fused native call — SHA-512 challenges, mod-L
+    # coefficient math, the basepoint scalar, and the MSM all in C. The
+    # only per-lane Python left is the length/S<L admission filter.
+    well = []  # (index, pubkey, sig, msg) of well-formed lanes
     for i in range(n):
         p, m, s = bytes(pubkeys[i]), bytes(msgs[i]), bytes(sigs[i])
         if len(p) != 32 or len(s) != 64:
             continue
-        s_int = int.from_bytes(s[32:], "little")
-        if s_int >= L:  # S must be canonical even under ZIP-215
-            continue
-        k = ref.challenge_scalar(s[:32], p, m)
-        z = int.from_bytes(zs[16 * i : 16 * i + 16], "little")
-        while z == 0:  # vanishing probability; fresh draw
-            z = int.from_bytes(secrets.token_bytes(16), "little")
-        lanes.append(_Lane(p, s[:32], s_int, k, z))
-        idx_map.append(i)
-    if not lanes:
+        if int.from_bytes(s[32:], "little") >= L:
+            continue  # S must be canonical even under ZIP-215
+        well.append((i, p, s, m))
+    if not well:
         return out
-    # Optimistic first MSM: honest batches (the overwhelming case) skip
-    # the decompress pre-filter entirely — the engine decompresses once,
-    # inside the MSM. Only a decode FAILURE (res < 0) pays the filter,
-    # and that failure surfaces during the engine's cheap decompression
-    # prefix, before any Pippenger work.
-    res = _check_lanes_res(lanes)
+    zs = bytearray(secrets.token_bytes(16 * len(well)))
+    zero16 = bytes(16)
+    for j in range(len(well)):  # z == 0 voids the RLC: redraw (p=2^-128)
+        while zs[16 * j : 16 * j + 16] == zero16:
+            zs[16 * j : 16 * j + 16] = secrets.token_bytes(16)
+    recs = b"".join(p + s for _i, p, s, _m in well)
+    msgs_blob = b"".join(m for *_x, m in well)
+    offs = [0]
+    for *_x, m in well:
+        offs.append(offs[-1] + len(m))
+    offs_arr = (ctypes.c_uint64 * len(offs))(*offs)
+    res = _load().edb_verify_batch(
+        recs, msgs_blob, offs_arr, bytes(zs), len(well)
+    )
     if res == 1:
-        for i in idx_map:
+        for i, *_x in well:
             out[i] = True
         return out
+    # Sad path (invalid signature or undecodable point in the batch):
+    # rebuild Python lanes for attribution, REUSING the drawn
+    # coefficients (they were never revealed, so they stay sound — and
+    # the splits then re-check exactly the committed linear
+    # combination). Paying the challenge twice here is fine — this path
+    # only runs under attack/corruption.
+    lanes, idx_map = [], []
+    for j, (i, p, s, m) in enumerate(well):
+        k = ref.challenge_scalar(s[:32], p, m)
+        z = int.from_bytes(zs[16 * j : 16 * j + 16], "little")
+        lanes.append(
+            _Lane(p, s[:32], int.from_bytes(s[32:], "little"), k, z)
+        )
+        idx_map.append(i)
     if res < 0:
         enc = b"".join(ln.a + ln.r for ln in lanes)
         ok = _decompress_ok(enc, 2 * len(lanes))

@@ -599,6 +599,42 @@ void sc_reduce512(const uint8_t in[64], u64 out[4]) {
     memcpy(out, r, 32);
 }
 
+// (z * x) mod L for a 128-bit z and canonical 4-limb x: the product is
+// < 2^381, so padding it to 512 bits reuses sc_reduce512.
+void sc_mul_z_mod_L(const u64 z[2], const u64 x[4], u64 out[4]) {
+    u128 acc[6] = {0, 0, 0, 0, 0, 0};
+    for (int zi = 0; zi < 2; zi++)
+        for (int xi = 0; xi < 4; xi++) {
+            u128 p = (u128)z[zi] * x[xi];
+            acc[zi + xi] += (u64)p;
+            acc[zi + xi + 1] += (u64)(p >> 64);
+        }
+    u64 pl[8] = {0};
+    u128 carry = 0;
+    for (int w = 0; w < 6; w++) {
+        carry += acc[w];
+        pl[w] = (u64)carry;
+        carry >>= 64;
+    }
+    pl[6] = (u64)carry;
+    uint8_t prod[64];
+    memcpy(prod, pl, 64);
+    sc_reduce512(prod, out);
+}
+
+// Decompress-all + cofactored-MSM verdict shared by the two batch
+// entries: 1 identity, 0 not, -(2+i) when point i fails to decode.
+long msm_verdict(const uint8_t* points_enc, const uint8_t* coeffs,
+                 size_t m) {
+    std::vector<pt> pts(m);
+    for (size_t i = 0; i < m; i++)
+        if (!pt_decompress(points_enc + 32 * i, pts[i]))
+            return -(long)(2 + i);
+    pt res = msm(pts, coeffs, m);
+    res = pt_double(pt_double(pt_double(res)));
+    return pt_is_identity(res) ? 1 : 0;
+}
+
 bool g_init_done = false;
 
 void ensure_init() {
@@ -635,13 +671,7 @@ extern "C" {
 long edb_msm_is_identity_x8(const uint8_t* points_enc,
                             const uint8_t* coeffs, size_t m) {
     ensure_init();
-    std::vector<pt> points(m);
-    for (size_t i = 0; i < m; i++)
-        if (!pt_decompress(points_enc + 32 * i, points[i]))
-            return -(long)(2 + i);
-    pt res = msm(points, coeffs, m);
-    res = pt_double(pt_double(pt_double(res)));
-    return pt_is_identity(res) ? 1 : 0;
+    return msm_verdict(points_enc, coeffs, m);
 }
 
 // keccak-f[1600] permutation over a 200-byte little-endian-lane state.
@@ -750,6 +780,73 @@ long edb_pack_challenges(const uint8_t* recs, const uint8_t* msgs,
         out_ok[i] = sc_geq(sv, L_LIMBS) ? 0 : 1;
     }
     return 0;
+}
+
+// Fused happy-path batch verification: per lane i, recs holds
+// A(32) | R(32) | S(32), msgs[offs[i]:offs[i+1]] the sign bytes, and
+// zs 16 random bytes (the RLC coefficient, drawn by the caller from a
+// CSPRNG). Computes k_i = SHA512(R||A||M) mod L, the coefficients
+// -(z_i*k_i) mod L for A_i and +z_i for -R_i, the basepoint scalar
+// b = sum z_i*s_i mod L, and runs the cofactored MSM — the entire
+// per-lane preparation that used to be Python bigints. Returns the MSM
+// verdict (1 valid, 0 fail, -(2+i) decode failure at MSM point i), or
+// -1 if SHA constants were never installed. Rejecting S >= L stays the
+// CALLER's job (it filters those lanes out before building recs).
+long edb_verify_batch(const uint8_t* recs, const uint8_t* msgs,
+                      const uint64_t* offs, const uint8_t* zs, size_t n) {
+    if (!g_sha_ready) return -1;
+    ensure_init();
+    std::vector<uint8_t> points(32 * (2 * n + 1));
+    std::vector<uint8_t> coeffs(32 * (2 * n + 1));
+    u64 b[4] = {0, 0, 0, 0};
+    for (size_t i = 0; i < n; i++) {
+        const uint8_t* a = recs + 96 * i;
+        const uint8_t* r = a + 32;
+        const uint8_t* s = a + 64;
+        Sha512Ctx c;
+        sha_init_ctx(c);
+        sha_update(c, r, 32);
+        sha_update(c, a, 32);
+        sha_update(c, msgs + offs[i], (size_t)(offs[i + 1] - offs[i]));
+        uint8_t digest[64];
+        sha_final(c, digest);
+        u64 k[4];
+        sc_reduce512(digest, k);
+        u64 z[2];
+        memcpy(z, zs + 16 * i, 16);
+        u64 zk[4];
+        sc_mul_z_mod_L(z, k, zk);
+        // coeff for A_i: (L - zk) mod L
+        u64 czk[4] = {0, 0, 0, 0};
+        if (zk[0] | zk[1] | zk[2] | zk[3]) {
+            memcpy(czk, L_LIMBS, 32);
+            sc_sub_inplace(czk, zk);
+        }
+        memcpy(&points[32 * (2 * i)], a, 32);
+        memcpy(&coeffs[32 * (2 * i)], czk, 32);
+        // -R_i with coefficient +z (sign-bit flip; short coeff keeps
+        // half the Pippenger windows idle — same trick as the caller)
+        memcpy(&points[32 * (2 * i + 1)], r, 32);
+        points[32 * (2 * i + 1) + 31] ^= 0x80;
+        memcpy(&coeffs[32 * (2 * i + 1)], z, 16);
+        memset(&coeffs[32 * (2 * i + 1)] + 16, 0, 16);
+        // b += (z * s) mod L
+        u64 sv[4];
+        memcpy(sv, s, 32);
+        u64 zsv[4];
+        sc_mul_z_mod_L(z, sv, zsv);
+        u128 cc = 0;
+        for (int w = 0; w < 4; w++) {
+            cc += (u128)b[w] + zsv[w];
+            b[w] = (u64)cc;
+            cc >>= 64;
+        }
+        // b < 2L after the add (both operands canonical): one subtract
+        if (cc || sc_geq(b, L_LIMBS)) sc_sub_inplace(b, L_LIMBS);
+    }
+    memcpy(&points[32 * 2 * n], B_BYTES, 32);
+    memcpy(&coeffs[32 * 2 * n], b, 32);
+    return msm_verdict(points.data(), coeffs.data(), 2 * n + 1);
 }
 
 // Batched decompress-only check (ZIP-215): out[i] = 1 if points_enc[i]
