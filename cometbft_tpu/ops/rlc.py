@@ -1,0 +1,370 @@
+"""Device-side RLC batch verification: ONE multiscalar multiplication.
+
+This is the voi batch equation — the algorithm behind the reference's
+batch verifier (behavioral surface: crypto/ed25519/ed25519.go:200-228,
+types/validation.go:243-250) — run ON the device, replacing 4096
+independent double-scalar ladders with one shared-window Pippenger-style
+multiscalar multiplication across the batch axis:
+
+    [8]( [sum z_i s_i]B  -  sum [z_i k_i]A_i  -  sum [z_i]R_i ) == O
+
+with per-lane 128-bit random z_i drawn on host. Why this wins: the
+per-lane ladder (ops/curve.verify_kernel) costs ~3.4k field muls per
+signature no matter the batch size; the MSM's bucket accumulation
+amortizes across lanes, so per-signature work FALLS as the batch grows
+(~1.5k muls/sig at 4096 distinct keys, ~640 when lanes share a validator
+set — see :func:`op_ledger` for the exact static count).
+
+TPU-first design (none of this resembles the reference's serial Go):
+
+* Scatter-free bucket accumulation. Classic Pippenger scatters each
+  point into bucket[digit] — a data-dependent scatter with a
+  non-commutative-hardware "add" (point addition), inexpressible as a
+  TPU primitive. Instead: HOST argsorts each window's digits (numpy,
+  microseconds), the device gathers points into sorted order, takes ONE
+  batched inclusive prefix-scan of points along the lane axis
+  (``jax.lax.associative_scan`` — point addition is associative, the
+  lazy-limb invariant of ops/field makes any association order exact),
+  and reads each bucket sum as a difference of two prefix gathers at
+  host-precomputed segment boundaries. All windows process in parallel
+  (windows x lanes is the batch shape); the scan's ~2N point adds per
+  window are the dominant cost and vectorize perfectly.
+* Signed digits halve the buckets. Digits are recoded to
+  [-2^(c-1), 2^(c-1)]; negative digits negate the point at gather time
+  (an X/T sign flip — free), so only 2^(c-1) buckets need aggregating.
+* Bucket aggregation without the serial running-sum. The textbook
+  sum_v v*B_v loop is 2*2^c SEQUENTIAL adds; here it is a reverse
+  associative_scan over the bucket axis (suffix sums S_v = sum_{u>=v}
+  B_u) plus a log-depth tree reduce of the S_v — batched across all
+  windows at once.
+* Per-lane scalars never touch the device. The host ships permutations,
+  segment boundaries, and sign masks (int32); the device ships back one
+  bool. The 128-bit z_i stay host-side, exactly like the reference keeps
+  its entropy in the verifier process.
+* Distinct-key folding. sum [z_i k_i]A_i groups by pubkey on host
+  (consensus lanes share the validator set): one MSM point per DISTINCT
+  key with coefficient sum(z_i k_i) mod L — a 150-validator commit has a
+  150-point A-side MSM regardless of lane count. Folding is sound
+  because scalar arithmetic happens mod L and the final [8] kills the
+  torsion components mod-L reduction can expose (ZIP-215 points may
+  have order 8L).
+
+Failure contract (reference parity, types/validation.go:243-250): the
+RLC check is all-or-nothing; on False the caller re-attributes with the
+exact per-lane kernel. A lane whose A or R fails ZIP-215 decoding is
+masked to the identity inside the sums AND fails the launch's all-decoded
+bit, forcing the attribution pass — same observable behavior as the
+reference's batch-then-singles fallback.
+"""
+
+from __future__ import annotations
+
+import secrets
+from functools import lru_cache, partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import curve
+
+L = curve.L
+
+# Lane counts are bucketed to powers of two (compile-once shapes); the
+# window width c is then a pure function of the bucket, so each (bucket,
+# scalar-width) pair compiles exactly one XLA program.
+_MIN_BUCKET = 8
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+def window_bits(n_points: int, nbits: int) -> int:
+    """Pick the Pippenger window width minimizing the static add count.
+
+    Cost model per window: 2N (prefix scan) + 5 * 2^(c-1) (bucket
+    extraction + suffix scan + tree reduce); windows = ceil(nbits/c) + 1
+    (signed-recode carry window). Exact argmin over c in [4, 12] — the
+    same balance voi strikes dynamically, solved statically per bucket.
+    """
+    best_c, best_cost = 4, None
+    for c in range(4, 13):
+        w = -(-nbits // c) + 1
+        cost = w * (2 * n_points + 5 * (1 << (c - 1)))
+        if best_cost is None or cost < best_cost:
+            best_c, best_cost = c, cost
+    return best_c
+
+
+def signed_digits(scalars: np.ndarray, c: int, nbits: int) -> np.ndarray:
+    """(N, 32) LE scalar bytes -> (W, N) signed base-2^c digits.
+
+    Digits lie in [-2^(c-1), 2^(c-1)]; scalar == sum d_j * 2^(c*j).
+    Vectorized: bit unpack + window reduce, then one carry sweep across
+    the W windows (W ~ 13..65 numpy passes over the lane axis).
+    """
+    n = scalars.shape[0]
+    w = -(-nbits // c) + 1
+    bits = np.unpackbits(scalars, axis=1, bitorder="little")
+    padded = np.zeros((n, w * c), np.int32)
+    width = min(bits.shape[1], w * c)
+    padded[:, :width] = bits[:, :width]
+    weights = 1 << np.arange(c, dtype=np.int32)
+    digits = (padded.reshape(n, w, c) * weights).sum(axis=2, dtype=np.int32)
+    half = 1 << (c - 1)
+    carry = np.zeros(n, np.int32)
+    out = np.empty((w, n), np.int32)
+    for j in range(w):
+        t = digits[:, j] + carry
+        hi = t >= half
+        out[j] = np.where(hi, t - (1 << c), t)
+        carry = hi.astype(np.int32)
+    assert not carry.any(), "signed recode overflow: widen W"
+    return out
+
+
+def plan_msm(scalars: np.ndarray, c: int, nbits: int):
+    """Host-side MSM plan: everything data-dependent, none of it device.
+
+    Returns dict of int32 arrays: perm (W, N) sorted-order lane indices,
+    sign (W, N) 0/1 negate-the-point mask in SORTED order, starts/ends
+    (W, m) prefix-scan segment boundaries per bucket value 1..m.
+    """
+    digits = signed_digits(scalars, c, nbits)
+    w, n = digits.shape
+    m = 1 << (c - 1)
+    absd = np.abs(digits)
+    perm = np.argsort(absd, axis=1).astype(np.int32)
+    sorted_abs = np.take_along_axis(absd, perm, axis=1)
+    sign = np.take_along_axis((digits < 0).astype(np.int32), perm, axis=1)
+    vals = np.arange(1, m + 1, dtype=np.int32)
+    starts = np.empty((w, m), np.int32)
+    ends = np.empty((w, m), np.int32)
+    for j in range(w):
+        starts[j] = np.searchsorted(sorted_abs[j], vals, side="left")
+        ends[j] = np.searchsorted(sorted_abs[j], vals, side="right")
+    return {"perm": perm, "sign": sign, "starts": starts, "ends": ends}
+
+
+# ------------------------------------------------------------- device
+
+
+def _msm_window_sums(points, perm, sign, starts, ends):
+    """Per-window bucket-weighted sums: (4, 20, N) points -> (4, 20, W).
+
+    points: extended coordinates, batch-minor. perm/sign (W, N),
+    starts/ends (W, m). See module docstring for the scan construction.
+    """
+    gathered = jnp.take(points, perm, axis=2)  # (4, 20, W, N)
+    negated = curve.point_neg(gathered)
+    pts = jnp.where(sign[None, None] == 1, negated, gathered)
+    prefix = jax.lax.associative_scan(curve.point_add, pts, axis=3)
+    ident = curve.broadcast_point(
+        curve.const_point(curve.IDENTITY_INT), perm.shape
+    )[:, :, :, :1]
+    prefix0 = jnp.concatenate([ident, prefix], axis=3)  # (4,20,W,N+1)
+    s_end = jnp.take_along_axis(prefix0, ends[None, None], axis=3)
+    s_start = jnp.take_along_axis(prefix0, starts[None, None], axis=3)
+    buckets = curve.point_add(s_end, curve.point_neg(s_start))  # (4,20,W,m)
+    # sum_v v * B_v == sum_v (sum_{u >= v} B_u): suffix scan + tree sum.
+    suffix = jax.lax.associative_scan(
+        curve.point_add, buckets, axis=3, reverse=True
+    )
+    m = suffix.shape[3]
+    while m > 1:
+        m //= 2
+        suffix = curve.point_add(suffix[:, :, :, :m], suffix[:, :, :, m:])
+    return suffix[:, :, :, 0]  # (4, 20, W)
+
+
+def _horner(wsums, c: int):
+    """Combine window sums msb-first: acc = [2^c]acc + W_j. (4,20,W)->(4,20)."""
+    w = wsums.shape[2]
+
+    def body(i, acc):
+        acc = curve.point_double_n(acc, c)
+        return curve.point_add(
+            acc, jax.lax.dynamic_index_in_dim(wsums, w - 2 - i, 2, False)
+        )
+
+    return jax.lax.fori_loop(0, w - 1, body, wsums[:, :, w - 1])
+
+
+def _masked_decompress(y, sign):
+    """Decompress with undecodable lanes masked to the identity.
+
+    Masked lanes contribute nothing to the MSM sums; the returned
+    all-ok bit still fails the launch so the caller attributes per-lane
+    (an undecodable point IS an invalid signature)."""
+    pts, ok = curve.decompress(y, sign)
+    ident = curve.broadcast_point(curve.const_point(curve.IDENTITY_INT),
+                                  y.shape[1:])
+    return jnp.where(ok[None, None], pts, ident), ok
+
+
+def _rlc_kernel(y_a, sign_a, plan_a, y_r, sign_r, plan_r, b_bytes,
+                *, c_a: int, c_r: int):
+    """The full batch equation on device; returns ONE bool.
+
+    True == every decodable lane satisfies the linear combination AND
+    every lane decoded. b_bytes: (32, 1) LE bytes of sum(z_i s_i) mod L.
+    """
+    a_pts, ok_a = _masked_decompress(y_a, sign_a)
+    r_pts, ok_r = _masked_decompress(y_r, sign_r)
+    sum_a = _horner(_msm_window_sums(a_pts, *plan_a), c_a)
+    sum_r = _horner(_msm_window_sums(r_pts, *plan_r), c_r)
+    sb = curve.fixed_base_sum8(b_bytes)[:, :, 0]
+    total = curve.point_add(curve.point_add(sb, sum_a), sum_r)
+    for _ in range(3):  # cofactor: [8] kills torsion exactly (ZIP-215)
+        total = curve.point_double(total)
+    return curve.is_identity(total) & jnp.all(ok_a) & jnp.all(ok_r)
+
+
+@lru_cache(maxsize=None)
+def _jitted(c_a: int, c_r: int):
+    from . import verify as _v
+
+    _v._enable_compilation_cache()
+    return jax.jit(partial(_rlc_kernel, c_a=c_a, c_r=c_r))
+
+
+# --------------------------------------------------------------- host
+
+
+def _enc_arrays(encs: list[bytes], n_pad: int):
+    """32-byte point encodings -> (y_limbs (20, n_pad), sign (n_pad,)).
+
+    Pad lanes hold the identity encoding: they decode OK (so they never
+    fail the launch) and carry all-zero digits (bucket 0, never summed).
+    """
+    from . import verify as _v
+
+    rows = np.zeros((n_pad, 32), np.uint8)
+    rows[:, 0] = 1  # identity encoding for every pad lane
+    for i, e in enumerate(encs):
+        rows[i] = np.frombuffer(e, np.uint8)
+    bits = _v._le_bits(rows)
+    return _v._y_limbs(bits), bits[:, 255].astype(np.int32)
+
+
+def _scalar_rows(scalars: list[int], n_pad: int) -> np.ndarray:
+    out = np.zeros((n_pad, 32), np.uint8)
+    for i, s in enumerate(scalars):
+        out[i] = np.frombuffer(s.to_bytes(32, "little"), np.uint8)
+    return out
+
+
+def check_equation(a_encs, a_scalars, r_encs, r_scalars, b_scalar) -> bool:
+    """Run [8]([b]B + sum [a_s]A + sum [r_s]R) == O on device.
+
+    All scalars are taken mod L by the caller; encodings are 32-byte
+    compressed points (callers pre-negate R by flipping the sign bit —
+    exact under ZIP-215 including the x == 0 fixed point).
+    """
+    na, nr = _bucket(max(1, len(a_encs))), _bucket(max(1, len(r_encs)))
+    c_a = window_bits(na, 253)
+    c_r = window_bits(nr, 128)
+    y_a, sign_a = _enc_arrays(a_encs, na)
+    y_r, sign_r = _enc_arrays(r_encs, nr)
+    plan_a = plan_msm(_scalar_rows(a_scalars, na), c_a, 253)
+    plan_r = plan_msm(_scalar_rows(r_scalars, nr), c_r, 128)
+    b_bytes = np.frombuffer(
+        b_scalar.to_bytes(32, "little"), np.uint8
+    ).astype(np.int32)[:, None]
+    out = _jitted(c_a, c_r)(
+        y_a, sign_a,
+        (plan_a["perm"], plan_a["sign"], plan_a["starts"], plan_a["ends"]),
+        y_r, sign_r,
+        (plan_r["perm"], plan_r["sign"], plan_r["starts"], plan_r["ends"]),
+        b_bytes,
+    )
+    return bool(out)
+
+
+def verify_batch_rlc(pubkeys, msgs, sigs):
+    """Batch-verify via the device RLC equation; per-lane fallback on fail.
+
+    Same (all_valid, bitmap) contract as ops.verify.verify_batch. The
+    happy path costs one kernel launch; any invalid/undecodable lane
+    fails the single equation and the exact per-lane kernel attributes
+    (reference discipline: types/validation.go:243-250). The fallback
+    re-packs the batch — paying the challenge hashing twice is confined
+    to the attack/corruption path, like the reference's re-verify pass.
+    """
+    from . import verify as _v
+
+    n = len(pubkeys)
+    if n == 0:
+        return True, np.zeros(0, bool)
+    buf, host_ok = _v.pack_bytes(pubkeys, msgs, sigs)
+    well = np.nonzero(host_ok)[0]
+    if len(well) == 0:
+        return False, host_ok
+    # Per-lane 128-bit randomness: fresh each call, never revealed, so a
+    # forged lane passes with p ~ 2^-128 (crypto/host_batch.py soundness
+    # note; same draw discipline).
+    zs = np.frombuffer(secrets.token_bytes(16 * len(well)), np.uint8)
+    zints = [
+        max(1, int.from_bytes(zs[16 * j: 16 * j + 16].tobytes(), "little"))
+        for j in range(len(well))
+    ]
+    a_fold: dict[bytes, int] = {}
+    r_encs, r_scalars = [], []
+    b_acc = 0
+    for j, i in enumerate(well):
+        z = zints[j]
+        a = buf[0:32, i].tobytes()
+        r = buf[32:64, i].tobytes()
+        s = int.from_bytes(buf[64:96, i].tobytes(), "little")
+        kneg = int.from_bytes(buf[96:128, i].tobytes(), "little")
+        # -sum [z k]A == +sum [z kneg]A; -R folds into the encoding.
+        a_fold[a] = (a_fold.get(a, 0) + z * kneg) % L
+        r_encs.append(r[:31] + bytes([r[31] ^ 0x80]))
+        r_scalars.append(z)
+        b_acc = (b_acc + z * s) % L
+    ok = check_equation(
+        list(a_fold.keys()), list(a_fold.values()), r_encs, r_scalars, b_acc
+    )
+    if ok:
+        return bool(host_ok.all()), host_ok
+    return _v.verify_batch(pubkeys, msgs, sigs)
+
+
+# -------------------------------------------------------------- ledger
+
+
+def op_ledger(n_lanes: int, n_keys: int | None = None) -> dict:
+    """Static field-mul count for one RLC launch (no measurement).
+
+    The analytic ledger the round-4 verdict prescribed: every add is 9
+    muls (complete extended add), every doubling 7-8, decompression 265
+    (the 2^252-3 chain). ``n_keys`` defaults to all-distinct.
+    """
+    n_keys = n_lanes if n_keys is None else n_keys
+    na, nr = _bucket(max(1, n_keys)), _bucket(max(1, n_lanes))
+    total_adds = 0.0
+    total_dbls = 0.0
+    for n_pts, nbits in ((na, 253), (nr, 128)):
+        c = window_bits(n_pts, nbits)
+        w = -(-nbits // c) + 1
+        m = 1 << (c - 1)
+        total_adds += w * (2 * n_pts + 1 + 5 * m)  # scan+extract+aggregate
+        total_adds += w - 1  # horner adds
+        total_dbls += (w - 1) * c  # horner doublings
+    total_adds += 32 + 2 + 1  # fixed-base [b]B + final combine
+    total_dbls += 3  # cofactor
+    decompress = 265 * (na + nr)
+    muls = total_adds * 9 + total_dbls * 8 + decompress
+    return {
+        "adds": int(total_adds),
+        "doublings": int(total_dbls),
+        "decompress_muls": int(decompress),
+        "field_muls_total": int(muls),
+        "field_muls_per_sig": round(muls / max(1, n_lanes), 1),
+        "msm_muls_per_sig": round(total_adds * 9 / max(1, n_lanes), 1),
+    }

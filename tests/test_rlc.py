@@ -1,0 +1,202 @@
+"""Device RLC batch verification (ops/rlc.py) against the Python oracle.
+
+Covers: signed-digit recoding, the MSM plan, equation-level parity with
+ed25519_ref on valid/invalid/undecodable batches, distinct-key folding,
+and the static op-count ledger the round-4 verdict prescribed.
+"""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519_ref as ref
+from cometbft_tpu.ops import rlc
+
+L = ref.L
+
+
+def _keypairs(n, seed=0):
+    out = []
+    for i in range(n):
+        s = bytes([seed]) + i.to_bytes(4, "little") + bytes(27)
+        out.append((s, ref.pubkey_from_seed(s)))
+    return out
+
+
+def _signed_batch(n, seed=0, n_keys=None):
+    pairs = _keypairs(n_keys or n, seed)
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        sd, pk = pairs[i % len(pairs)]
+        m = b"msg-%d-%d" % (seed, i)
+        pks.append(pk)
+        msgs.append(m)
+        sigs.append(ref.sign(sd, m))
+    return pks, msgs, sigs
+
+
+def _digits_value(digits, c):
+    return sum(int(d) << (c * j) for j, d in enumerate(digits))
+
+
+class TestSignedDigits:
+    def test_roundtrip_random(self):
+        rng = np.random.default_rng(7)
+        for c in (4, 7, 10, 12):
+            vals = [
+                int.from_bytes(rng.bytes(32), "little") % (1 << 253)
+                for _ in range(16)
+            ]
+            rows = np.stack(
+                [
+                    np.frombuffer(v.to_bytes(32, "little"), np.uint8)
+                    for v in vals
+                ]
+            )
+            digs = rlc.signed_digits(rows, c, 253)
+            half = 1 << (c - 1)
+            assert digs.max() <= half and digs.min() >= -half
+            for i, v in enumerate(vals):
+                assert _digits_value(digs[:, i], c) == v
+
+    def test_plan_boundaries(self):
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, 256, (32, 32), np.uint8).astype(np.uint8)
+        c = 8
+        plan = rlc.plan_msm(rows, c, 253)
+        digs = rlc.signed_digits(rows, c, 253)
+        absd = np.abs(digs)
+        srt = np.take_along_axis(absd, plan["perm"], axis=1)
+        assert (np.diff(srt, axis=1) >= 0).all()
+        # each bucket segment [start, end) holds exactly the |d| == v lanes
+        for j in range(digs.shape[0]):
+            for v in (1, 2, 120, 128):
+                seg = srt[j, plan["starts"][j, v - 1]: plan["ends"][j, v - 1]]
+                assert (seg == v).all()
+                assert (srt[j] == v).sum() == len(seg)
+
+
+class TestEquation:
+    def test_all_valid(self):
+        pks, msgs, sigs = _signed_batch(13, seed=1)
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert ok and bitmap.all() and len(bitmap) == 13
+
+    def test_shared_keys_fold(self):
+        # 3 distinct keys across 20 lanes: A-side MSM folds to 3 points
+        pks, msgs, sigs = _signed_batch(20, seed=2, n_keys=3)
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert ok and bitmap.all()
+
+    def test_single_invalid_attributed(self):
+        pks, msgs, sigs = _signed_batch(9, seed=3)
+        bad = bytearray(sigs[4])
+        bad[2] ^= 0x40
+        sigs[4] = bytes(bad)
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert not ok
+        assert not bitmap[4] and bitmap.sum() == 8
+
+    def test_wrong_message(self):
+        pks, msgs, sigs = _signed_batch(8, seed=4)
+        msgs[0] = b"tampered"
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert not ok and not bitmap[0] and bitmap[1:].all()
+
+    def test_undecodable_r(self):
+        pks, msgs, sigs = _signed_batch(8, seed=5)
+        # y = p is > field modulus with x-sign tricks exhausted: use an
+        # encoding whose y has no square root; 2 is a known non-point y
+        # for many encodings — brute force one that fails decompression
+        bad_y = None
+        for y in range(2, 300):
+            if ref.decompress(y.to_bytes(32, "little")) is None:
+                bad_y = y.to_bytes(32, "little")
+                break
+        assert bad_y is not None
+        sigs[3] = bad_y + sigs[3][32:]
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert not ok and not bitmap[3] and bitmap.sum() == 7
+
+    def test_malformed_lane(self):
+        pks, msgs, sigs = _signed_batch(8, seed=6)
+        sigs[2] = b"short"
+        pks2 = list(pks)
+        ok, bitmap = rlc.verify_batch_rlc(pks2, msgs, sigs)
+        assert not ok and not bitmap[2] and bitmap.sum() == 7
+
+    def test_noncanonical_s_rejected(self):
+        pks, msgs, sigs = _signed_batch(8, seed=7)
+        s = int.from_bytes(sigs[1][32:], "little") + L
+        sigs[1] = sigs[1][:32] + s.to_bytes(32, "little")
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert not ok and not bitmap[1] and bitmap.sum() == 7
+
+    def test_empty(self):
+        ok, bitmap = rlc.verify_batch_rlc([], [], [])
+        assert ok and len(bitmap) == 0
+
+    def test_single_lane(self):
+        pks, msgs, sigs = _signed_batch(1, seed=8)
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert ok and bitmap.all()
+
+    def test_large_batch_mixed_validity(self):
+        pks, msgs, sigs = _signed_batch(40, seed=9, n_keys=5)
+        for i in (7, 31):
+            b = bytearray(sigs[i])
+            b[40] ^= 1
+            sigs[i] = bytes(b)
+        ok, bitmap = rlc.verify_batch_rlc(pks, msgs, sigs)
+        assert not ok
+        assert bitmap.sum() == 38 and not bitmap[7] and not bitmap[31]
+
+
+class TestCheckEquation:
+    def test_trivial_identity(self):
+        # 0*B + no points == O
+        assert rlc.check_equation([], [], [], [], 0)
+
+    def test_base_times_one_fails(self):
+        # [1]B + nothing != O
+        assert not rlc.check_equation([], [], [], [], 1)
+
+    def test_cancellation(self):
+        # [z]P with P == -B folds against [z]B
+        z = 12345678901234567890
+        bx = rlc.curve.BASE_INT[0]
+        by = rlc.curve.BASE_INT[1]
+        enc = bytearray(by.to_bytes(32, "little"))
+        enc[31] |= 0x80 if (ref.P - bx) & 1 else 0
+        assert rlc.check_equation([bytes(enc)], [z], [], [], z)
+
+
+class TestLedger:
+    def test_amortized_target(self):
+        # the round-4 verdict's done-bar: <500 field muls/sig amortized
+        # at 4096 lanes in the shared-validator-set (consensus) regime
+        led = rlc.op_ledger(4096, n_keys=150)
+        assert led["msm_muls_per_sig"] < 500
+        assert led["field_muls_per_sig"] < 1000
+
+    def test_all_distinct_still_beats_ladder(self):
+        led = rlc.op_ledger(4096)
+        assert led["field_muls_per_sig"] < 2400  # ladder is ~3.4k
+
+    def test_monotone_amortization(self):
+        a = rlc.op_ledger(256)["field_muls_per_sig"]
+        b = rlc.op_ledger(4096)["field_muls_per_sig"]
+        assert b < a
+
+
+class TestSpeccheckParity:
+    def test_corpus_agreement(self):
+        """RLC single-lane verdicts match the oracle on the ZIP-215
+        equivalence-class corpus (4-way agreement extended to 5)."""
+        from tests.test_zip215_conformance import build_corpus
+
+        corpus = build_corpus()
+        for name, pk, msg, sig, expect in corpus:
+            ok, bitmap = rlc.verify_batch_rlc([pk], [msg], [sig])
+            assert ok == expect, name
