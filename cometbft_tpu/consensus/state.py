@@ -270,6 +270,11 @@ class ConsensusState(BaseService):
         rt = getattr(self, "_receive_thread", None)
         if rt is not None and rt is not threading.current_thread():
             rt.join(timeout=5)
+        # An in-flight prestage build dying mid-device-call at interpreter
+        # teardown can abort the process; give it a bounded drain.
+        pt = getattr(self, "_prestage_thread", None)
+        if pt is not None:
+            pt.join(timeout=2)
         self.wal.flush_and_sync()
 
     def _tock_forwarder(self) -> None:
@@ -653,12 +658,33 @@ class ConsensusState(BaseService):
         # so this round's vote/commit verifies ship only R|S|k (zero
         # builder launches in steady state). Fingerprinted by valset hash:
         # rounds without churn are a dict no-op.
+        # Off the FSM thread: on accelerator backends a valset change
+        # costs a full builder device round trip, which must not delay
+        # publish_new_round. Tables are a pure function of the key and
+        # the cache is thread-safe, so a racing verify at worst builds
+        # the same tables itself.
         vhash = validators.hash()
-        if vhash != getattr(self, "_prestaged_valset", None):
-            from ..crypto import batch as crypto_batch
+        if vhash != getattr(self, "_prestaged_valset", None) and vhash != getattr(
+            self, "_prestage_inflight", None
+        ):
+            # Mark staged only when the warm-up RETURNS (a thread that
+            # dies must not permanently skip this valset); the inflight
+            # marker stops churn rounds spawning duplicate warm-ups.
+            # Both attributes are touched only on the FSM thread except
+            # the success store, which is idempotent.
+            self._prestage_inflight = vhash
 
-            crypto_batch.prestage_validators(validators)
-            self._prestaged_valset = vhash
+            def _warm(vs=validators, h=vhash):
+                try:
+                    crypto_batch.prestage_validators(vs)
+                    self._prestaged_valset = h
+                finally:
+                    self._prestage_inflight = None
+
+            self._prestage_thread = threading.Thread(
+                target=_warm, name="prestage-valset", daemon=True
+            )
+            self._prestage_thread.start()
         self.event_bus.publish_new_round(
             EventDataNewRound(
                 height=height,

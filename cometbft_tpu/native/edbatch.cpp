@@ -25,8 +25,10 @@
 // output is carried, so limbs stay below 2^52 and every product column
 // fits u128 with a wide margin.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <vector>
 
 typedef unsigned __int128 u128;
@@ -414,7 +416,7 @@ pt scalar_base_mult(const uint8_t scalar[32]) {
 
 u64 SHA_K[80];
 u64 SHA_H0[8];
-bool g_sha_ready = false;
+std::atomic<bool> g_sha_ready{false};
 
 inline u64 rotr64(u64 x, int n) { return (x >> n) | (x << (64 - n)); }
 
@@ -635,10 +637,12 @@ long msm_verdict(const uint8_t* points_enc, const uint8_t* coeffs,
     return pt_is_identity(res) ? 1 : 0;
 }
 
-bool g_init_done = false;
+// ctypes releases the GIL during calls, so first-use init can race
+// across threads (consensus verify vs RPC verify): call_once makes the
+// table/constant build happen exactly once with a proper barrier.
+std::once_flag g_init_once;
 
-void ensure_init() {
-    if (g_init_done) return;
+void init_tables() {
     FE_D = fe_frombytes(D_BYTES);
     FE_D2 = fe_add(FE_D, FE_D);
     FE_SQRTM1 = fe_frombytes(SQRTM1_BYTES);
@@ -657,8 +661,9 @@ void ensure_init() {
         G_TABLE[v] = to_niels(aff);
         acc = pt_add(acc, g);
     }
-    g_init_done = true;
 }
+
+void ensure_init() { std::call_once(g_init_once, init_tables); }
 
 }  // namespace
 

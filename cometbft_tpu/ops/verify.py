@@ -516,7 +516,12 @@ class PubkeyTableCache:
             return None
         built: list[tuple[list[bytes], object, object]] = []
         built_keys: set[bytes] = set()
-        while True:
+        # Bounded retries: under sustained eviction churn (concurrent
+        # callers with disjoint key sets larger than capacity) a thread
+        # could otherwise rebuild evicted keys forever. Three builder
+        # launches is already pathological; give up to the uncached
+        # kernel path rather than spin.
+        for _attempt in range(4):
             with self._lock:
                 self._ensure_arena()
                 to_build = [
@@ -563,6 +568,8 @@ class PubkeyTableCache:
                         else:
                             self.hits += 1
                     return idxs, self._arena, self._arena_ok
+            if _attempt == 3:
+                break  # 3 builds done and keys STILL missing: stop
             # Outside the lock: one bucketed builder launch for the keys
             # still missing. A key evicted between iterations (another
             # thread filling the arena mid-build) sends us around again;
@@ -586,6 +593,7 @@ class PubkeyTableCache:
             oks = jnp.logical_and(oks, jnp.asarray(host_wellformed))
             built.append((to_build, tables, oks))
             built_keys.update(to_build)
+        return None  # churn won the race 3x: uncached kernel fallback
 
 
 _PUBKEY_CACHE = PubkeyTableCache()

@@ -162,7 +162,9 @@ class WSClient:
         self.max_reconnect_attempts = max_reconnect_attempts
         self._ids = itertools.count(1)
         self._mtx = threading.Lock()  # socket write + state
+        self._subs_mtx = threading.Lock()  # subscribe check+insert
         self._pending: dict[int, queue.Queue] = {}
+        self._inflight: set[int] = set()  # ids actually written to the wire
         self._subs: dict[str, Subscription] = {}
         self._closed = False
         self._sock: socket.socket | None = None
@@ -286,6 +288,17 @@ class WSClient:
             except (OSError, ConnectionError, AttributeError):
                 if self._closed or not self.reconnect:
                     break
+                # Replies to in-flight calls died with the connection:
+                # fail their waiters NOW instead of letting each wait
+                # out its full timeout while we redial. Only ids whose
+                # request actually went out on the wire — a call that
+                # registered its waiter but hasn't sent yet will send on
+                # the NEW socket and must keep its waiter.
+                for id_ in list(self._inflight):
+                    self._inflight.discard(id_)
+                    q = self._pending.pop(id_, None)
+                    if q is not None:
+                        q.put(None)
                 if not self._reconnect():
                     break
                 continue
@@ -336,19 +349,26 @@ class WSClient:
         waiter: queue.Queue = queue.Queue(maxsize=1)
         self._pending[id_] = waiter
         try:
-            self._send(
-                {
-                    "jsonrpc": "2.0",
-                    "id": id_,
-                    "method": method,
-                    "params": params,
-                }
-            )
+            try:
+                self._send(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": id_,
+                        "method": method,
+                        "params": params,
+                    }
+                )
+            except OSError as e:  # incl. mid-reconnect "ws not connected"
+                raise RPCError(
+                    f"ws send for {method!r} failed: {e}", code=-32603
+                ) from e
+            self._inflight.add(id_)
             msg = waiter.get(timeout=self.timeout)
         except queue.Empty:
             raise RPCError(f"ws call {method!r} timed out", code=-32603)
         finally:
             self._pending.pop(id_, None)
+            self._inflight.discard(id_)
         if msg is None:
             raise RPCError("ws connection lost", code=-32603)
         if "error" in msg:
@@ -362,13 +382,23 @@ class WSClient:
 
     def subscribe(self, query: str, capacity: int = 256) -> Subscription:
         """Subscribe to an event query; events stream into the returned
-        Subscription (rpc/client/http/http.go:790 Subscribe)."""
+        Subscription (rpc/client/http/http.go:790 Subscribe).
+
+        Duplicate queries error (ws_client discipline): silently
+        replacing the existing Subscription would orphan its readers."""
         sub = Subscription(query, capacity)
-        self._subs[query] = sub
+        with self._subs_mtx:  # check+insert atomically: two racing
+            if query in self._subs:  # subscribers must not orphan one
+                raise RPCError(
+                    f"already subscribed to query {query!r}", code=-32603
+                )
+            self._subs[query] = sub
         try:
             self.call("subscribe", query=query)
         except Exception:
-            self._subs.pop(query, None)
+            with self._subs_mtx:
+                if self._subs.get(query) is sub:
+                    self._subs.pop(query, None)
             raise
         return sub
 

@@ -8,6 +8,8 @@ layer (a staged batch performs zero builder launches) and at the FSM
 layer (a running node stages its validator keys).
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -77,7 +79,13 @@ def test_fsm_stages_validator_set(fresh_cache):
         wait_for_height(parts, 2)
     finally:
         stop_node(cs, parts)
-    staged = set(fresh_cache._slots.keys())
-    for pv in pvs:
-        assert bytes(pv.get_pub_key().data) in staged
+    # staging runs on a background thread off the FSM (round-4 advisor
+    # finding): poll briefly instead of asserting synchronously
+    want = {bytes(pv.get_pub_key().data) for pv in pvs}
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if want <= set(fresh_cache._slots.keys()):
+            break
+        time.sleep(0.05)
+    assert want <= set(fresh_cache._slots.keys())
     assert fresh_cache.builds >= 1
