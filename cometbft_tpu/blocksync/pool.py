@@ -13,7 +13,11 @@ import time
 
 REQUEST_WINDOW = 20  # max heights in flight (pool.go maxPendingRequests≈)
 REQUEST_TIMEOUT = 15.0  # per-height peer response timeout
-MIN_RECV_RATE = 0  # rate eviction disabled by default (pool.go:133)
+# Minimum bytes/sec a peer with pending requests must deliver, else it is
+# evicted (pool.go:133-160 minRecvRate, 7680 B/s there). A peer trickling
+# bytes under the request timeout would otherwise never be caught.
+MIN_RECV_RATE = 7680
+RATE_GRACE = 2.0  # monitor must run this long before a verdict
 
 
 class _Peer:
@@ -23,6 +27,16 @@ class _Peer:
         self.height = height
         self.num_pending = 0
         self.timeout_count = 0
+        self.recv_monitor = None  # armed while requests are pending
+        self.monitor_start = 0.0
+
+    def arm_monitor(self) -> None:
+        """(Re)start rate tracking when pending goes 0 -> 1
+        (pool.go resetMonitor)."""
+        from ..libs.flowrate import Monitor
+
+        self.recv_monitor = Monitor(window=5.0)
+        self.monitor_start = time.monotonic()
 
 
 class _Requester:
@@ -36,13 +50,19 @@ class _Requester:
 
 
 class BlockPool:
-    def __init__(self, start_height: int, send_request, on_peer_error=None):
+    def __init__(self, start_height: int, send_request, on_peer_error=None,
+                 min_recv_rate: int | None = None):
         """``send_request(height, peer_id)`` dispatches a BlockRequest;
-        ``on_peer_error(peer_id, reason)`` reports misbehaving peers."""
+        ``on_peer_error(peer_id, reason)`` reports misbehaving peers.
+        ``min_recv_rate``: B/s floor for peers with pending requests
+        (0 disables; default MIN_RECV_RATE)."""
         self._mtx = threading.RLock()
         self.height = start_height  # next height to apply
         self.send_request = send_request
         self.on_peer_error = on_peer_error or (lambda pid, r: None)
+        self.min_recv_rate = (
+            MIN_RECV_RATE if min_recv_rate is None else min_recv_rate
+        )
         self.peers: dict[str, _Peer] = {}
         self.requesters: dict[int, _Requester] = {}
         self.max_peer_height = 0
@@ -85,10 +105,36 @@ class BlockPool:
 
     # -- scheduling (call periodically from the reactor loop) --------------
 
+    def _evict_slow_peers(self, now: float) -> None:
+        """Evict peers trickling below min_recv_rate while owing blocks
+        (pool.go removeTimedoutPeers' rate branch)."""
+        if self.min_recv_rate <= 0:
+            return
+        for peer in list(self.peers.values()):
+            if peer.num_pending <= 0 or peer.recv_monitor is None:
+                continue
+            if now - peer.monitor_start < RATE_GRACE:
+                continue
+            rate = peer.recv_monitor.rate()
+            # rate == 0 means nothing measured YET (the monitor is fed on
+            # block receipt, and a first large block can legitimately
+            # take longer than the grace period): only judge peers that
+            # have delivered something slowly — pool.go's "curRate can
+            # be 0 on start" guard. Fully silent peers fall to the
+            # REQUEST_TIMEOUT path instead.
+            if rate > 0 and rate < self.min_recv_rate:
+                self.on_peer_error(
+                    peer.id,
+                    f"slow peer: {rate:.0f} B/s < {self.min_recv_rate} B/s "
+                    f"with {peer.num_pending} pending",
+                )
+                self.remove_peer(peer.id)
+
     def make_requests(self) -> None:
         with self._mtx:
             if not self._running:
                 return
+            self._evict_slow_peers(time.monotonic())
             for h in range(self.height, self.height + REQUEST_WINDOW):
                 if self.max_peer_height and h > self.max_peer_height:
                     break
@@ -118,12 +164,18 @@ class BlockPool:
                 r.peer_id = peer.id
                 r.request_time = now
                 peer.num_pending += 1
+                if peer.num_pending == 1:
+                    peer.arm_monitor()
                 self.send_request(h, peer.id)
 
     # -- block ingest ------------------------------------------------------
 
-    def add_block(self, peer_id: str, block, ext_commit=None) -> bool:
+    def add_block(self, peer_id: str, block, ext_commit=None,
+                  size: int = 0) -> bool:
         with self._mtx:
+            peer = self.peers.get(peer_id)
+            if peer is not None and peer.recv_monitor is not None and size:
+                peer.recv_monitor.update(size)
             r = self.requesters.get(block.header.height)
             if r is None or r.peer_id != peer_id:
                 # unsolicited — could be a late response; ignore

@@ -36,6 +36,7 @@ class BlocksyncReactor(Reactor):
         block_store,
         block_sync: bool,
         consensus_reactor=None,  # for switch_to_consensus
+        min_recv_rate: int | None = None,
     ):
         super().__init__("blocksync-reactor")
         self.initial_state = state
@@ -44,10 +45,12 @@ class BlocksyncReactor(Reactor):
         self.block_store = block_store
         self.block_sync = block_sync
         self.consensus_reactor = consensus_reactor
+        self.min_recv_rate = min_recv_rate
         self.pool = BlockPool(
             block_store.height() + 1,
             send_request=self._send_block_request,
             on_peer_error=self._on_pool_peer_error,
+            min_recv_rate=min_recv_rate,
         )
         self.synced = threading.Event()
         self._n_synced = 0
@@ -81,6 +84,7 @@ class BlocksyncReactor(Reactor):
             state.last_block_height + 1,
             send_request=self._send_block_request,
             on_peer_error=self._on_pool_peer_error,
+            min_recv_rate=self.min_recv_rate,
         )
         # re-announce status so peers learn we now need blocks
         self._broadcast_status_request()
@@ -134,7 +138,9 @@ class BlocksyncReactor(Reactor):
                 ser.dumps(BlockResponseMessage(block=block, ext_commit=ext)),
             )
         elif isinstance(msg, BlockResponseMessage):
-            self.pool.add_block(peer.id, msg.block, msg.ext_commit)
+            self.pool.add_block(
+                peer.id, msg.block, msg.ext_commit, size=len(msg_bytes)
+            )
         elif isinstance(msg, NoBlockResponseMessage):
             pass  # the requester will time out and re-pick
 

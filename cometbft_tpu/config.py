@@ -99,6 +99,10 @@ class StateSyncConfig:
 @dataclass(slots=True)
 class BlockSyncConfig:
     version: str = "v0"
+    # bytes/sec floor for peers with pending block requests; peers
+    # trickling below it are evicted (blocksync/pool.go:133 minRecvRate).
+    # 0 disables rate eviction.
+    min_recv_rate: int = 7680
 
 
 @dataclass(slots=True)

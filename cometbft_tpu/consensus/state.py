@@ -270,10 +270,9 @@ class ConsensusState(BaseService):
         rt = getattr(self, "_receive_thread", None)
         if rt is not None and rt is not threading.current_thread():
             rt.join(timeout=5)
-        # An in-flight prestage build dying mid-device-call at interpreter
-        # teardown can abort the process; give it a bounded drain.
-        pt = getattr(self, "_prestage_thread", None)
-        if pt is not None:
+        # In-flight prestage builds dying mid-device-call at interpreter
+        # teardown can abort the process; give each a bounded drain.
+        for pt in getattr(self, "_prestage_threads", []):
             pt.join(timeout=2)
         self.wal.flush_and_sync()
 
@@ -679,12 +678,22 @@ class ConsensusState(BaseService):
                     crypto_batch.prestage_validators(vs)
                     self._prestaged_valset = h
                 finally:
-                    self._prestage_inflight = None
+                    # only clear OUR marker: a newer valset's warm-up may
+                    # have replaced it while we ran
+                    if getattr(self, "_prestage_inflight", None) == h:
+                        self._prestage_inflight = None
 
-            self._prestage_thread = threading.Thread(
+            threads = [
+                t
+                for t in getattr(self, "_prestage_threads", [])
+                if t.is_alive()
+            ]
+            t = threading.Thread(
                 target=_warm, name="prestage-valset", daemon=True
             )
-            self._prestage_thread.start()
+            t.start()
+            threads.append(t)
+            self._prestage_threads = threads
         self.event_bus.publish_new_round(
             EventDataNewRound(
                 height=height,

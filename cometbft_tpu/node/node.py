@@ -244,6 +244,7 @@ class Node(BaseService):
             # restore hands it a state (switch_to_block_sync)
             run_blocksync and not self.statesync_enabled,
             consensus_reactor=self.consensus_reactor,
+            min_recv_rate=config.blocksync.min_recv_rate,
         )
         if self.statesync_enabled:
             # parked-for-statesync is NOT synced: the constructor pre-sets

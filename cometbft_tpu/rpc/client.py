@@ -236,7 +236,8 @@ class WSClient:
 
     # -- frame io (client frames are MASKED per RFC 6455) -----------------
 
-    def _send_frame(self, opcode: int, payload: bytes) -> None:
+    def _send_frame(self, opcode: int, payload: bytes,
+                    mark_inflight: int | None = None) -> None:
         mask = os.urandom(4)
         masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
         head = bytes([0x80 | opcode])
@@ -251,9 +252,16 @@ class WSClient:
             if self._sock is None:
                 raise ConnectionError("ws not connected")
             self._sock.sendall(head + mask + masked)
+            if mark_inflight is not None:
+                # registered under the SAME lock hold as the write, so
+                # the reader's disconnect sweep (also under _mtx) either
+                # sees this id or serializes before the write
+                self._inflight.add(mark_inflight)
 
-    def _send(self, payload: dict) -> None:
-        self._send_frame(0x1, json.dumps(payload).encode())
+    def _send(self, payload: dict, mark_inflight: int | None = None) -> None:
+        self._send_frame(
+            0x1, json.dumps(payload).encode(), mark_inflight=mark_inflight
+        )
 
     def _read_exact(self, n: int) -> bytes:
         buf = b""
@@ -293,9 +301,12 @@ class WSClient:
                 # out its full timeout while we redial. Only ids whose
                 # request actually went out on the wire — a call that
                 # registered its waiter but hasn't sent yet will send on
-                # the NEW socket and must keep its waiter.
-                for id_ in list(self._inflight):
-                    self._inflight.discard(id_)
+                # the NEW socket and must keep its waiter. Under _mtx so
+                # the sweep serializes against send+register.
+                with self._mtx:
+                    swept = list(self._inflight)
+                    self._inflight.clear()
+                for id_ in swept:
                     q = self._pending.pop(id_, None)
                     if q is not None:
                         q.put(None)
@@ -356,13 +367,13 @@ class WSClient:
                         "id": id_,
                         "method": method,
                         "params": params,
-                    }
+                    },
+                    mark_inflight=id_,
                 )
             except OSError as e:  # incl. mid-reconnect "ws not connected"
                 raise RPCError(
                     f"ws send for {method!r} failed: {e}", code=-32603
                 ) from e
-            self._inflight.add(id_)
             msg = waiter.get(timeout=self.timeout)
         except queue.Empty:
             raise RPCError(f"ws call {method!r} timed out", code=-32603)

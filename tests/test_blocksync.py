@@ -185,3 +185,68 @@ def test_blocksync_end_to_end_catchup(tmp_path):
         if node_b is not None:
             node_b.stop()
         node_a.stop()
+
+
+def test_pool_evicts_trickling_peer_and_rerequests():
+    """A peer delivering below min_recv_rate while owing blocks is
+    evicted and its heights go to another peer (pool.go:133-160)."""
+    sent, errs = [], []
+    pool = BlockPool(
+        1,
+        send_request=lambda h, p: sent.append((h, p)),
+        on_peer_error=lambda p, r: errs.append((p, r)),
+        min_recv_rate=10_000,
+    )
+    pool.set_peer_range("slow", 1, 5)
+    pool.make_requests()
+    assert {p for _, p in sent} == {"slow"}
+    slow = pool.peers["slow"]
+    assert slow.recv_monitor is not None  # armed on first pending
+    # trickle: a few bytes, then age the monitor past the grace period
+    slow.recv_monitor.update(100)
+    slow.monitor_start -= 10.0
+    slow.recv_monitor._last_sample -= 10.0
+    slow.recv_monitor.update(1)  # fold the trickle into the EMA
+    pool.set_peer_range("fast", 1, 5)
+    pool.make_requests()
+    assert errs and errs[0][0] == "slow" and "slow peer" in errs[0][1]
+    assert "slow" not in pool.peers
+    # every height re-requested from the surviving peer
+    pool.make_requests()
+    rerequested = {h for h, p in sent if p == "fast"}
+    assert rerequested == {1, 2, 3, 4, 5}
+
+
+def test_pool_healthy_peer_not_evicted():
+    sent, errs = [], []
+    pool = BlockPool(
+        1,
+        send_request=lambda h, p: sent.append((h, p)),
+        on_peer_error=lambda p, r: errs.append((p, r)),
+        min_recv_rate=10_000,
+    )
+    pool.set_peer_range("good", 1, 3)
+    pool.make_requests()
+    good = pool.peers["good"]
+    good.monitor_start -= 10.0
+    good.recv_monitor._last_sample -= 1.0
+    good.recv_monitor.update(500_000)  # healthy: ~500 KB/s
+    pool.make_requests()
+    assert not errs and "good" in pool.peers
+
+
+def test_pool_rate_eviction_disabled_by_zero():
+    errs = []
+    pool = BlockPool(
+        1,
+        send_request=lambda h, p: None,
+        on_peer_error=lambda p, r: errs.append((p, r)),
+        min_recv_rate=0,
+    )
+    pool.set_peer_range("slow", 1, 3)
+    pool.make_requests()
+    slow = pool.peers["slow"]
+    if slow.recv_monitor is not None:
+        slow.monitor_start -= 10.0
+    pool.make_requests()
+    assert not errs and "slow" in pool.peers
