@@ -81,6 +81,19 @@ def _derive_host_threshold() -> int:
                     xo = row.get("measured_crossover_lanes")
                     if isinstance(xo, int) and xo >= 2:
                         return xo
+                    rows = row.get("rows") or []
+                    max_n = max(
+                        (r.get("n", 0) for r in rows), default=0
+                    )
+                    if xo is None and max_n >= 2048:
+                        # The chip WAS measured, the sweep covered real
+                        # production sizes, and the device never beat
+                        # the host: route everything host rather than
+                        # trusting the static guess (round-4 verdict
+                        # task 4 — 768 can be wrong both ways). A tiny
+                        # or truncated sweep (max n < 2048) must NOT
+                        # poison the knob.
+                        return 1 << 30
     except (OSError, ValueError):
         pass
     return _DEFAULT_HOST_BATCH_THRESHOLD
@@ -166,9 +179,17 @@ class Sr25519BatchVerifier(BatchVerifier):
         from . import ed25519_ref as ref
         from . import sr25519 as sr
 
+        import os as _os
+
         t0 = _time.perf_counter()
         n = len(self._pubkeys)
-        if n < self.HOST_THRESHOLD:
+        # The ed25519 host-always sentinel does NOT redirect sr25519:
+        # its host fallback is sequential pure Python (~30 ms/sig), so
+        # the ed25519 measurement says nothing about this tradeoff.
+        # COMETBFT_TPU_SR_HOST=1 is the explicit dead-tunnel escape.
+        if n < self.HOST_THRESHOLD or _os.environ.get(
+            "COMETBFT_TPU_SR_HOST"
+        ) == "1":
             bitmap = [
                 sr.verify(p, m, s)
                 for p, m, s in zip(self._pubkeys, self._msgs, self._sigs)

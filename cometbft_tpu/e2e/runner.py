@@ -8,9 +8,15 @@ Containers are replaced by child processes of ``cometbft-tpu start``:
   pause   -> SIGSTOP ... SIGCONT        (docker pause / unpause)
   restart -> SIGTERM + restart          (docker restart)
 
-Disconnect-style network faults belong to the in-process tier
-(FuzzedConnection, tests/test_fault_injection.py) where the transport is
-reachable; an OS process's TCP stack isn't, without root.
+The ``disconnect`` perturbation (perturb.go's docker network
+disconnect) is realized WITHOUT root: a relayed testnet routes every
+inter-node TCP link through an in-runner :class:`LinkRelay` the runner
+can sever (drop live connections, refuse new ones) and heal. PEX is
+disabled in relayed nets so nodes only ever dial the configured
+(relayed) addresses — a learned direct address would tunnel under the
+partition. Finer link faults (drop/duplicate/reorder of individual
+messages) remain in the in-process tier (FuzzedConnection,
+tests/test_fault_injection.py).
 
 Invariant checks after perturbations mirror test/e2e/tests/block_test.go:
 all nodes agree on the app hash at every common height, and heights
@@ -21,11 +27,110 @@ from __future__ import annotations
 
 import os
 import signal
+import socket
 import subprocess
 import sys
+import threading
 import time
 
 from ..rpc.client import HTTPClient
+
+
+class LinkRelay:
+    """Severable TCP forwarder for ONE directed peer link.
+
+    The process-tier analog of `docker network disconnect`
+    (test/e2e/runner/perturb.go:16-31): while severed, established
+    connections are torn down and new dials are accepted-then-closed, so
+    the dialer sees a live listener with a dead peer — the same
+    observable as a dropped container link, without root.
+    """
+
+    def __init__(self, target_host: str, target_port: int):
+        self._target = (target_host, target_port)
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(16)
+        self.port = self._lsock.getsockname()[1]
+        self._severed = threading.Event()
+        self._closed = False
+        self._conns: set[socket.socket] = set()
+        self._mtx = threading.Lock()
+        threading.Thread(
+            target=self._accept_loop, name=f"relay-{self.port}", daemon=True
+        ).start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                client, _ = self._lsock.accept()
+            except OSError:
+                return  # listener closed
+            if self._severed.is_set():
+                client.close()
+                continue
+            try:
+                upstream = socket.create_connection(self._target, timeout=5)
+            except OSError:
+                client.close()
+                continue
+            with self._mtx:
+                # re-check under the same lock sever() snapshots with: a
+                # dial that raced past the first check must not survive
+                # the partition
+                if self._severed.is_set():
+                    client.close()
+                    upstream.close()
+                    continue
+                self._conns.update((client, upstream))
+            for a, b in ((client, upstream), (upstream, client)):
+                threading.Thread(
+                    target=self._pump, args=(a, b), daemon=True
+                ).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            with self._mtx:
+                self._conns.discard(src)
+                self._conns.discard(dst)
+
+    def sever(self) -> None:
+        self._severed.set()
+        with self._mtx:
+            conns = list(self._conns)
+            self._conns.clear()
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def heal(self) -> None:
+        self._severed.clear()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.sever()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
 
 
 class ProcessNode:
@@ -173,6 +278,8 @@ class Testnet:
 
     def __init__(self, out_dir: str, n_vals: int, starting_port: int):
         self.out_dir = out_dir
+        self.starting_port = starting_port
+        self.relays: dict[tuple[int, int], LinkRelay] = {}
         self.nodes = [
             ProcessNode(
                 home=os.path.join(out_dir, f"node{i}"),
@@ -257,6 +364,56 @@ class Testnet:
         net.manifest = manifest
         return net
 
+    @classmethod
+    def generate_relayed(
+        cls, out_dir: str, n_vals: int, starting_port: int
+    ) -> "Testnet":
+        """A testnet whose every inter-node p2p link runs through a
+        severable :class:`LinkRelay` — the `disconnect` perturbation's
+        substrate. One relay per DIRECTED pair (i dials j), so a single
+        node can be partitioned without touching third-party links. PEX
+        is disabled: learned direct addresses would bypass the relays.
+        """
+        from ..config_file import load_toml, save_toml
+
+        net = cls.generate(out_dir, n_vals, starting_port)
+        port_to_idx = {
+            starting_port + 2 * j: j for j in range(n_vals)
+        }
+        for i, node in enumerate(net.nodes):
+            path = os.path.join(node.home, "config", "config.toml")
+            cfg = load_toml(path)
+            cfg.base.home = node.home
+            cfg.p2p.pex = False
+            rewritten = []
+            for entry in cfg.p2p.persistent_peers.split(","):
+                if not entry:
+                    continue
+                pid, addr = entry.split("@", 1)
+                host, port_s = addr.rsplit(":", 1)
+                j = port_to_idx[int(port_s)]
+                relay = net.relays.get((i, j))
+                if relay is None:
+                    relay = LinkRelay(host, int(port_s))
+                    net.relays[(i, j)] = relay
+                rewritten.append(f"{pid}@127.0.0.1:{relay.port}")
+            cfg.p2p.persistent_peers = ",".join(rewritten)
+            save_toml(cfg, path)
+        return net
+
+    def partition(self, idx: int) -> None:
+        """Sever every link to/from node ``idx`` (perturb.go disconnect)."""
+        for (i, j), relay in self.relays.items():
+            if idx in (i, j):
+                relay.sever()
+
+    def heal(self, idx: int) -> None:
+        """Re-enable node ``idx``'s links (the reference reconnects after
+        10 s; healing is the caller's schedule here)."""
+        for (i, j), relay in self.relays.items():
+            if idx in (i, j):
+                relay.heal()
+
     def start(self) -> None:
         for n in self.nodes:
             n.start()
@@ -267,6 +424,8 @@ class Testnet:
                 n.stop()
             except Exception:
                 pass
+        for relay in self.relays.values():
+            relay.close()
 
     def live_nodes(self) -> list[ProcessNode]:
         return [

@@ -191,3 +191,47 @@ class TestHostThresholdDerivation:
         assert batch._derive_host_threshold() == (
             batch._DEFAULT_HOST_BATCH_THRESHOLD
         )
+
+    def test_threshold_tracks_recorded_numbers(self, monkeypatch, tmp_path):
+        """The knob MOVES when the recorded measurement moves, and a
+        measured-but-never-winning device routes everything host
+        (round-4 verdict task 4)."""
+        import json
+
+        from cometbft_tpu.crypto import batch
+
+        monkeypatch.delenv("COMETBFT_TPU_HOST_THRESHOLD", raising=False)
+        path = tmp_path / "BENCH_CHIP_TABLE.json"
+        monkeypatch.setenv("COMETBFT_TPU_CHIP_TABLE", str(path))
+
+        def table(xo, rows=({"n": 64}, {"n": 4096})):
+            return json.dumps(
+                {
+                    "measured_on_accelerator": True,
+                    "table": [
+                        {
+                            "config": "9_device_floor",
+                            "measured_crossover_lanes": xo,
+                            "rows": list(rows),
+                        }
+                    ],
+                }
+            )
+
+        path.write_text(table(512))
+        assert batch._derive_host_threshold() == 512
+        path.write_text(table(2048))
+        assert batch._derive_host_threshold() == 2048  # moved with data
+        # measured on chip, full sweep, device never won -> host always
+        path.write_text(table(None))
+        assert batch._derive_host_threshold() == 1 << 30
+        # no rows at all (probe died mid-run): static fallback, not host-always
+        path.write_text(table(None, rows=()))
+        assert batch._derive_host_threshold() == (
+            batch._DEFAULT_HOST_BATCH_THRESHOLD
+        )
+        # tiny/truncated sweep (max n < 2048) must NOT poison the knob
+        path.write_text(table(None, rows=({"n": 64}, {"n": 150})))
+        assert batch._derive_host_threshold() == (
+            batch._DEFAULT_HOST_BATCH_THRESHOLD
+        )
