@@ -152,8 +152,11 @@ class Sr25519BatchVerifier(BatchVerifier):
     surface: crypto/sr25519/batch.go:14-46.
     """
 
-    # pure-Python host verify costs ~30 ms/sig (6 scalar mults): the
-    # device wins from a handful of lanes
+    # Without the native engine the host fallback is sequential pure
+    # Python (~30 ms/sig, 6 scalar mults): the device wins from a
+    # handful of lanes. WITH it, the host runs the same one-MSM RLC
+    # path as ed25519 (native merlin challenges + verify_quads), so the
+    # ed25519 crossover applies.
     HOST_THRESHOLD = 4
 
     def __init__(self) -> None:
@@ -174,40 +177,44 @@ class Sr25519BatchVerifier(BatchVerifier):
         return len(self._pubkeys)
 
     def verify(self) -> tuple[bool, list[bool]]:
+        import os as _os
         import time as _time
 
-        from . import ed25519_ref as ref
+        from . import host_batch
         from . import sr25519 as sr
-
-        import os as _os
 
         t0 = _time.perf_counter()
         n = len(self._pubkeys)
-        # The ed25519 host-always sentinel does NOT redirect sr25519:
-        # its host fallback is sequential pure Python (~30 ms/sig), so
-        # the ed25519 measurement says nothing about this tradeoff.
+        # Routing: with the native engine, the host path is the same
+        # one-MSM RLC pipeline as ed25519 (merlin challenges batched in
+        # C, then verify_quads), so the ed25519 host/device crossover
+        # applies. Without it the host is sequential pure Python
+        # (~30 ms/sig) and the device wins from a handful of lanes.
         # COMETBFT_TPU_SR_HOST=1 is the explicit dead-tunnel escape.
-        if n < self.HOST_THRESHOLD or _os.environ.get(
-            "COMETBFT_TPU_SR_HOST"
-        ) == "1":
-            bitmap = [
-                sr.verify(p, m, s)
-                for p, m, s in zip(self._pubkeys, self._msgs, self._sigs)
-            ]
+        native = host_batch.available()
+        host_cut = HOST_BATCH_THRESHOLD if native else self.HOST_THRESHOLD
+        if n < host_cut or _os.environ.get("COMETBFT_TPU_SR_HOST") == "1":
+            bitmap = None
+            if native:
+                bitmap = host_batch.verify_quads(
+                    sr.verification_encs_batch(
+                        self._pubkeys, self._msgs, self._sigs
+                    )
+                )
+            if bitmap is None:
+                bitmap = [
+                    sr.verify(p, m, s)
+                    for p, m, s in zip(
+                        self._pubkeys, self._msgs, self._sigs
+                    )
+                ]
             _observe("sr25519-host", t0, n)
             return all(bitmap), bitmap
         from ..ops import verify as ov
 
-        parts = []
-        for p, m, s in zip(self._pubkeys, self._msgs, self._sigs):
-            quad = sr.verification_parts(p, m, s)
-            if quad is None:
-                parts.append(None)
-                continue
-            a_pt, r_pt, s_int, k_int = quad
-            parts.append(
-                (ref.compress(a_pt), ref.compress(r_pt), s_int, k_int)
-            )
+        parts = sr.verification_encs_batch(
+            self._pubkeys, self._msgs, self._sigs
+        )
         buf, host_ok = ov.pack_parts(parts)
         # The expanded-point cache is keyed by the edwards A encoding, so
         # sr25519 validators (converted ristretto points) share the same

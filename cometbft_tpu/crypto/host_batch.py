@@ -112,6 +112,16 @@ def _bind(lib) -> None:
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
         ctypes.c_char_p, ctypes.c_size_t,
     ]
+    lib.edb_sr_challenge_batch.restype = ctypes.c_long
+    lib.edb_sr_challenge_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.edb_ristretto_to_edwards.restype = None
+    lib.edb_ristretto_to_edwards.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
 
 
 def _install_sha512_constants(lib) -> None:
@@ -172,6 +182,49 @@ def pack_challenges(recs: bytes, msgs_blob: bytes, offs, n: int):
     if rc != 0:
         return None
     return out_kneg.raw, np.frombuffer(out_ok.raw, np.uint8).astype(bool)
+
+
+def sr_challenge_batch(
+    ctx_state: bytes, recs: bytes, msgs_blob: bytes, offs, n: int
+):
+    """Batched sr25519 (schnorrkel) verification challenges.
+
+    ``ctx_state``: 203-byte serialized STROBE state of the merlin
+    transcript prefix Transcript("SigningContext") + append("", ctx)
+    (crypto/sr25519._context_prefix — pure function of the signing
+    context, cached). ``recs``: n x 64 bytes (pk | R); ``msgs_blob`` +
+    ``offs`` (n+1 u64): concatenated sign bytes. Returns n x 32 bytes of
+    little-endian challenges k_i mod L, or None when the native engine
+    is unavailable. Reference surface: crypto/sr25519/batch.go:14-46.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out_k = ctypes.create_string_buffer(32 * n)
+    offs_arr = (ctypes.c_uint64 * (n + 1))(*offs)
+    rc = lib.edb_sr_challenge_batch(
+        ctx_state, recs, msgs_blob, offs_arr, n, out_k
+    )
+    if rc != 0:
+        return None
+    return out_k.raw
+
+
+def ristretto_to_edwards_batch(encs: bytes, m: int):
+    """Decode m ristretto255 encodings (RFC 9496) to compressed edwards.
+
+    Returns (enc_rows: 32*m bytes, ok: (m,) bool) or None when the
+    native engine is unavailable. Both sr25519 batch consumers — the
+    host MSM and the TPU kernel — take compressed edwards points, so
+    the decode and re-compression never touch Python bigints.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out_enc = ctypes.create_string_buffer(32 * m)
+    out_ok = ctypes.create_string_buffer(m)
+    lib.edb_ristretto_to_edwards(encs, m, out_enc, out_ok)
+    return out_enc.raw, np.frombuffer(out_ok.raw, np.uint8).astype(bool)
 
 
 def _msm_identity(points: bytes, coeffs: bytes, m: int) -> int:
@@ -259,6 +312,41 @@ def _check_lanes(lanes) -> bool:
     return res == 1
 
 
+def _verdict_lanes(lanes, out, idx_map, res=None) -> None:
+    """Full RLC verdict over built lanes: one MSM; on an undecodable
+    point, filter it and re-check; on equation failure, binary-split
+    attribution. Shared by verify_many's sad path and verify_quads so
+    the ed25519 and sr25519 host paths can't diverge.
+
+    ``res``: a verdict already obtained for exactly these lanes and
+    coefficients (verify_many's fused edb_verify_batch call) — skips
+    the redundant opening MSM."""
+    if not lanes:
+        return
+    if res is None:
+        res = _check_lanes_res(lanes)
+    if res == 1:
+        for i in idx_map:
+            out[i] = True
+        return
+    if res < 0:
+        enc = b"".join(ln.a + ln.r for ln in lanes)
+        ok = _decompress_ok(enc, 2 * len(lanes))
+        good, gmap = [], []
+        for j, (ln, i) in enumerate(zip(lanes, idx_map)):
+            if ok[2 * j] and ok[2 * j + 1]:
+                good.append(ln)
+                gmap.append(i)
+        lanes, idx_map = good, gmap
+        if not lanes:
+            return
+        if _check_lanes(lanes):
+            for i in idx_map:
+                out[i] = True
+            return
+    _attribute(lanes, out, idx_map)
+
+
 def _attribute(lanes, out, idx_map) -> None:
     """Binary-split attribution of a failing batch (voi-style)."""
     if len(lanes) == 1:
@@ -271,6 +359,36 @@ def _attribute(lanes, out, idx_map) -> None:
     mid = len(lanes) // 2
     _attribute(lanes[:mid], out, idx_map[:mid])
     _attribute(lanes[mid:], out, idx_map[mid:])
+
+
+def verify_quads(quads) -> list[bool] | None:
+    """RLC batch verdict over precomputed (A_enc, R_enc, s, k) quads.
+
+    The sr25519 HOST path: challenges come from the native merlin engine
+    (sr_challenge_batch) and the points are ristretto decodes
+    re-compressed as edwards encodings — the curve equation, one
+    Pippenger MSM, and the binary-split attribution are exactly the
+    ed25519 machinery (reference: crypto/sr25519/batch.go:48-61 feeds
+    the same curve25519-voi verifier core its ed25519 batch uses).
+    Entries may be None (malformed lane -> False). Returns None when the
+    native engine is unavailable.
+    """
+    if _load() is None:
+        return None
+    n = len(quads)
+    out = [False] * n
+    lanes, idx_map = [], []
+    for i, q in enumerate(quads):
+        if q is None:
+            continue
+        a_enc, r_enc, s, k = q
+        z = 0
+        while z == 0:  # z == 0 voids the RLC: redraw (p = 2^-128)
+            z = int.from_bytes(secrets.token_bytes(16), "little")
+        lanes.append(_Lane(bytes(a_enc), bytes(r_enc), s, k, z))
+        idx_map.append(i)
+    _verdict_lanes(lanes, out, idx_map)
+    return out
 
 
 def verify_many(pubkeys, msgs, sigs) -> list[bool]:
@@ -330,20 +448,5 @@ def verify_many(pubkeys, msgs, sigs) -> list[bool]:
             _Lane(p, s[:32], int.from_bytes(s[32:], "little"), k, z)
         )
         idx_map.append(i)
-    if res < 0:
-        enc = b"".join(ln.a + ln.r for ln in lanes)
-        ok = _decompress_ok(enc, 2 * len(lanes))
-        good, gmap = [], []
-        for j, (ln, i) in enumerate(zip(lanes, idx_map)):
-            if ok[2 * j] and ok[2 * j + 1]:
-                good.append(ln)
-                gmap.append(i)
-        lanes, idx_map = good, gmap
-        if not lanes:
-            return out
-        if _check_lanes(lanes):
-            for i in idx_map:
-                out[i] = True
-            return out
-    _attribute(lanes, out, idx_map)
+    _verdict_lanes(lanes, out, idx_map, res=res)
     return out

@@ -388,6 +388,102 @@ def sign(mini: bytes, msg: bytes, context: bytes = SIGNING_CTX) -> bytes:
     return r_enc + bytes(s_bytes)
 
 
+# ---------------------------------------------------------------------------
+# verification challenges — native batched transcript engine
+# ---------------------------------------------------------------------------
+
+# Serialized STROBE states of Transcript("SigningContext") +
+# append_message(b"", context): a pure function of the signing context,
+# shared by every challenge under it. Bounded — contexts are a small
+# static set (conventionally just b"substrate").
+_CTX_PREFIX_CACHE: dict[bytes, bytes] = {}
+
+
+def _context_prefix(context: bytes) -> bytes:
+    """203-byte serialized STROBE state (sponge || pos || pos_begin ||
+    cur_flags) of the per-context transcript prefix, for the native
+    engine (native/edbatch.cpp edb_sr_challenge_batch)."""
+    st = _CTX_PREFIX_CACHE.get(context)
+    if st is None:
+        t = Transcript(b"SigningContext")
+        t.append_message(b"", context)
+        s = t.strobe
+        st = bytes(s.state) + bytes([s.pos, s.pos_begin, s.cur_flags])
+        if len(_CTX_PREFIX_CACHE) < 64:
+            _CTX_PREFIX_CACHE[context] = st
+    return st
+
+
+def _challenge_py(context: bytes, msg: bytes, pubkey: bytes,
+                  r_enc: bytes) -> int:
+    """Pure-Python transcript challenge (the native engine's oracle)."""
+    t = _signing_transcript(context, msg)
+    t.append_message(b"proto-name", b"Schnorr-sig")
+    t.append_message(b"sign:pk", pubkey)
+    t.append_message(b"sign:R", r_enc)
+    return t.challenge_scalar(b"sign:c")
+
+
+def challenge_scalars_batch(
+    pubkeys, msgs, sigs, context: bytes = SIGNING_CTX
+) -> list[int]:
+    """k_i for each (pubkey, msg, R=sig[:32]) lane in ONE native call.
+
+    The sr25519 batch hot path (reference crypto/sr25519/batch.go:14-46
+    computes these transcript challenges per entry): the whole STROBE
+    absorb/permute/squeeze sequence runs in C against the cached
+    per-context prefix state; the per-lane Python transcript is the
+    toolchain-less fallback."""
+    from . import host_batch
+
+    n = len(pubkeys)
+    recs = b"".join(p + s[:32] for p, s in zip(pubkeys, sigs))
+    offs = [0]
+    for m in msgs:
+        offs.append(offs[-1] + len(m))
+    raw = host_batch.sr_challenge_batch(
+        _context_prefix(context), recs, b"".join(msgs), offs, n
+    )
+    if raw is None:
+        return [
+            _challenge_py(context, m, p, s[:32])
+            for p, m, s in zip(pubkeys, msgs, sigs)
+        ]
+    return [
+        int.from_bytes(raw[32 * i : 32 * i + 32], "little")
+        for i in range(n)
+    ]
+
+
+def _admit(pubkey: bytes, sig: bytes):
+    """Structural admission shared by every verify path: lengths, the
+    schnorrkel v1 marker bit, s < L. Returns the unmasked scalar s, or
+    None if malformed."""
+    if len(sig) != SIGNATURE_SIZE or len(pubkey) != PUBKEY_SIZE:
+        return None
+    if not (sig[63] & 0x80):
+        return None  # not a schnorrkel v1 signature
+    s_bytes = bytearray(sig[32:])
+    s_bytes[31] &= 0x7F
+    s = int.from_bytes(bytes(s_bytes), "little")
+    if s >= L:
+        return None
+    return s
+
+
+def _precheck(pubkey: bytes, sig: bytes):
+    """Structural admission + ristretto decode: (A_pt, R_pt, s) or None
+    if malformed."""
+    s = _admit(pubkey, sig)
+    if s is None:
+        return None
+    a_pt = ristretto_decode(pubkey)
+    r_pt = ristretto_decode(sig[:32])
+    if a_pt is None or r_pt is None:
+        return None
+    return a_pt, r_pt, s
+
+
 def verification_parts(
     pubkey: bytes, msg: bytes, sig: bytes, context: bytes = SIGNING_CTX
 ):
@@ -398,31 +494,110 @@ def verification_parts(
     TPU verifier consumes; sr25519 rides the ed25519 kernel because
     ristretto equality is Edwards equality modulo torsion, which the
     cofactored check decides."""
-    if len(sig) != SIGNATURE_SIZE or len(pubkey) != PUBKEY_SIZE:
+    pre = _precheck(pubkey, sig)
+    if pre is None:
         return None
-    if not (sig[63] & 0x80):
-        return None  # not a schnorrkel v1 signature
-    s_bytes = bytearray(sig[32:])
-    s_bytes[31] &= 0x7F
-    s = int.from_bytes(bytes(s_bytes), "little")
-    if s >= L:
-        return None
-    a_pt = ristretto_decode(pubkey)
-    r_pt = ristretto_decode(sig[:32])
-    if a_pt is None or r_pt is None:
-        return None
-    t = _signing_transcript(context, msg)
-    t.append_message(b"proto-name", b"Schnorr-sig")
-    t.append_message(b"sign:pk", pubkey)
-    t.append_message(b"sign:R", sig[:32])
-    k = t.challenge_scalar(b"sign:c")
+    a_pt, r_pt, s = pre
+    k = challenge_scalars_batch([pubkey], [msg], [sig], context)[0]
     return a_pt, r_pt, s, k
+
+
+def verification_parts_batch(
+    pubkeys, msgs, sigs, context: bytes = SIGNING_CTX
+) -> list:
+    """Per-lane (A, R, s, k) quads — None for malformed lanes — with one
+    native challenge pass over the structurally valid lanes."""
+    n = len(pubkeys)
+    parts: list = [None] * n
+    pre = [_precheck(pubkeys[i], sigs[i]) for i in range(n)]
+    live = [i for i in range(n) if pre[i] is not None]
+    if not live:
+        return parts
+    ks = challenge_scalars_batch(
+        [pubkeys[i] for i in live],
+        [msgs[i] for i in live],
+        [sigs[i] for i in live],
+        context,
+    )
+    for j, i in enumerate(live):
+        a_pt, r_pt, s = pre[i]
+        parts[i] = (a_pt, r_pt, s, ks[j])
+    return parts
+
+
+def verification_encs_batch(
+    pubkeys, msgs, sigs, context: bytes = SIGNING_CTX
+) -> list:
+    """Per-lane (A_edwards_enc, R_edwards_enc, s, k) — None for
+    malformed lanes — with the ristretto decodes AND transcript
+    challenges batched through the native engine.
+
+    This is the form both batch consumers want (host MSM and TPU kernel
+    take compressed edwards points), so no Python bigint touches the
+    per-lane path. Falls back to the pure-Python decode + compress when
+    the toolchain is absent."""
+    from . import host_batch
+
+    n = len(pubkeys)
+    parts: list = [None] * n
+    # structural admission (cheap Python): lengths, marker bit, s < L
+    svals = [_admit(pubkeys[i], sigs[i]) for i in range(n)]
+    cand = [i for i in range(n) if svals[i] is not None]
+    if not cand:
+        return parts
+    conv = host_batch.ristretto_to_edwards_batch(
+        b"".join(bytes(pubkeys[i]) + bytes(sigs[i][:32]) for i in cand),
+        2 * len(cand),
+    )
+    if conv is None:
+        quads = verification_parts_batch(pubkeys, msgs, sigs, context)
+        return [
+            (ref.compress(q[0]), ref.compress(q[1]), q[2], q[3])
+            if q is not None
+            else None
+            for q in quads
+        ]
+    enc_rows, ok = conv
+    live = [i for j, i in enumerate(cand) if ok[2 * j] and ok[2 * j + 1]]
+    encs = {
+        i: (enc_rows[64 * j : 64 * j + 32],
+            enc_rows[64 * j + 32 : 64 * j + 64])
+        for j, i in enumerate(cand)
+    }
+    if not live:
+        return parts
+    ks = challenge_scalars_batch(
+        [pubkeys[i] for i in live],
+        [msgs[i] for i in live],
+        [sigs[i] for i in live],
+        context,
+    )
+    for j, i in enumerate(live):
+        a_enc, r_enc = encs[i]
+        parts[i] = (a_enc, r_enc, svals[i], ks[j])
+    return parts
 
 
 def verify(
     pubkey: bytes, msg: bytes, sig: bytes, context: bytes = SIGNING_CTX
 ) -> bool:
-    """Host-side verification: s*B - k*A == R in ristretto."""
+    """Host-side verification: s*B - k*A == R in ristretto.
+
+    Routed through the native engine when available: one 3-point
+    cofactored MSM. For ristretto-decoded inputs the cofactored check
+    [8](sB - kA - R) == O decides exactly ristretto equality — decoded
+    points lie in the even subgroup 2E, whose full torsion is E[4], the
+    kernel of the ristretto quotient. Pure-Python scalar mults remain
+    the toolchain-less fallback."""
+    from . import host_batch
+
+    if host_batch.available():
+        quad = verification_encs_batch([pubkey], [msg], [sig], context)[0]
+        if quad is None:
+            return False
+        res = host_batch.verify_quads([quad])
+        if res is not None:
+            return bool(res[0])
     parts = verification_parts(pubkey, msg, sig, context)
     if parts is None:
         return False

@@ -854,6 +854,193 @@ long edb_verify_batch(const uint8_t* recs, const uint8_t* msgs,
     return msm_verdict(points.data(), coeffs.data(), 2 * n + 1);
 }
 
+// ---------------------------------------------------------------------
+// STROBE-128 / merlin — the schnorrkel transcript layer.
+//
+// Mirrors crypto/sr25519.py's Strobe128/Transcript subset byte-for-byte
+// (parity pinned by tests against the Python state machine, which is
+// itself pinned to merlin's published protocol vector). Verify-side
+// challenges are the sr25519 batch hot path (reference:
+// crypto/sr25519/batch.go:14-46): each lane permutes the sponge ~6
+// times, and before this the absorb/squeeze byte pushing ran in Python.
+// ---------------------------------------------------------------------
+
+namespace {
+
+constexpr int STROBE_R = 166;  // security level 128 -> rate 166
+
+struct Strobe {
+    uint8_t st[200];
+    uint8_t pos, pos_begin, flags;
+};
+
+void strobe_f(Strobe& s) {
+    s.st[s.pos] ^= s.pos_begin;
+    s.st[s.pos + 1] ^= 0x04;
+    s.st[STROBE_R + 1] ^= 0x80;
+    edb_keccak_f1600(s.st);
+    s.pos = 0;
+    s.pos_begin = 0;
+}
+
+void strobe_absorb(Strobe& s, const uint8_t* d, size_t n) {
+    for (size_t i = 0; i < n; i++) {
+        s.st[s.pos++] ^= d[i];
+        if (s.pos == STROBE_R) strobe_f(s);
+    }
+}
+
+void strobe_begin(Strobe& s, uint8_t flags) {
+    // header absorbs the OLD pos_begin, then records the new one
+    uint8_t hdr[2] = {s.pos_begin, flags};
+    s.pos_begin = (uint8_t)(s.pos + 1);
+    s.flags = flags;
+    strobe_absorb(s, hdr, 2);
+    if ((flags & 0x24) && s.pos != 0) strobe_f(s);  // C|K force a round
+}
+
+void strobe_meta_ad(Strobe& s, const uint8_t* d, size_t n) {
+    strobe_begin(s, 0x12);  // M|A
+    strobe_absorb(s, d, n);
+}
+
+void strobe_ad(Strobe& s, const uint8_t* d, size_t n) {
+    strobe_begin(s, 0x02);  // A
+    strobe_absorb(s, d, n);
+}
+
+void strobe_prf(Strobe& s, uint8_t* out, size_t n) {
+    strobe_begin(s, 0x07);  // I|A|C
+    for (size_t i = 0; i < n; i++) {
+        out[i] = s.st[s.pos];
+        s.st[s.pos++] = 0;
+        if (s.pos == STROBE_R) strobe_f(s);
+    }
+}
+
+// ---- ristretto255 (RFC 9496) decode -> compressed edwards ----
+// sr25519 feeds the SAME curve machinery as ed25519 (host MSM and TPU
+// kernel both take compressed edwards points); this is the per-lane
+// ristretto_decode + edwards compression that was 4 Python modexps.
+
+bool fe_isneg(const fe& a) {
+    uint8_t b[32];
+    fe_tobytes(a, b);
+    return b[0] & 1;
+}
+
+fe fe_abs(const fe& a) { return fe_isneg(a) ? fe_neg(a) : a; }
+
+// sqrt_ratio_m1 specialized to u == 1 (RFC 9496 §4.2): out = 1/sqrt(v)
+// (or 1/sqrt(i*v)); returns was_square.
+bool fe_invsqrt(const fe& v, fe& out) {
+    fe v3 = fe_mul(fe_sq(v), v);
+    fe v7 = fe_mul(fe_sq(v3), v);
+    fe r = fe_mul(v3, fe_pow_2_252_m3(v7));
+    fe check = fe_mul(v, fe_sq(r));
+    fe one = fe_one();
+    bool correct = fe_eq(check, one);
+    bool flipped = fe_eq(check, fe_neg(one));
+    bool flipped_i = fe_eq(check, fe_neg(FE_SQRTM1));
+    if (flipped || flipped_i) r = fe_mul(r, FE_SQRTM1);
+    out = fe_abs(r);
+    return correct || flipped;
+}
+
+// RFC 9496 §4.3.1 decode; writes the compressed edwards encoding of
+// the decoded (affine) point. False for non-canonical/negative/invalid.
+bool ristretto_to_edwards(const uint8_t enc[32], uint8_t out[32]) {
+    fe s = fe_frombytes(enc);
+    uint8_t canon[32];
+    fe_tobytes(s, canon);
+    if (memcmp(canon, enc, 32) != 0) return false;  // s >= P
+    if (enc[0] & 1) return false;                   // s negative
+    fe ss = fe_sq(s);
+    fe u1 = fe_sub(fe_one(), ss);
+    fe u2 = fe_add(fe_one(), ss);
+    fe u2s = fe_sq(u2);
+    fe v = fe_sub(fe_neg(fe_mul(FE_D, fe_sq(u1))), u2s);
+    fe invsqrt;
+    bool ws = fe_invsqrt(fe_mul(v, u2s), invsqrt);
+    fe den_x = fe_mul(invsqrt, u2);
+    fe den_y = fe_mul(fe_mul(invsqrt, den_x), v);
+    fe x = fe_abs(fe_mul(fe_add(s, s), den_x));
+    fe y = fe_mul(u1, den_y);
+    fe t = fe_mul(x, y);
+    if (!ws || fe_isneg(t) || fe_is_zero(y)) return false;
+    uint8_t xb[32];
+    fe_tobytes(x, xb);
+    fe_tobytes(y, out);
+    out[31] |= (uint8_t)((xb[0] & 1) << 7);
+    return true;
+}
+
+// merlin append_message: meta_AD(label || LE32(len)); AD(message)
+void merlin_append(Strobe& s, const char* label, size_t label_len,
+                   const uint8_t* msg, size_t msg_len) {
+    uint8_t hdr[20];
+    memcpy(hdr, label, label_len);
+    hdr[label_len + 0] = (uint8_t)(msg_len);
+    hdr[label_len + 1] = (uint8_t)(msg_len >> 8);
+    hdr[label_len + 2] = (uint8_t)(msg_len >> 16);
+    hdr[label_len + 3] = (uint8_t)(msg_len >> 24);
+    strobe_meta_ad(s, hdr, label_len + 4);
+    strobe_ad(s, msg, msg_len);
+}
+
+}  // namespace
+
+// Batched schnorrkel verification challenges. ``ctx`` is the 203-byte
+// serialized STROBE state (200-byte sponge || pos || pos_begin ||
+// cur_flags) of a merlin transcript already carrying
+// Transcript("SigningContext") + append_message("", signing_context) —
+// a pure function of the signing context, built once by the caller and
+// cached. Per lane i, recs holds pk(32) | R(32) and
+// msgs[offs[i]:offs[i+1]] the sign bytes; writes
+// k_i = PRF64("sign:c") mod L (32 bytes little-endian) to out_k.
+long edb_sr_challenge_batch(const uint8_t* ctx, const uint8_t* recs,
+                            const uint8_t* msgs, const uint64_t* offs,
+                            size_t n, uint8_t* out_k) {
+    ensure_init();  // sc_reduce512 needs POW64_MOD_L
+    Strobe base;
+    memcpy(base.st, ctx, 200);
+    base.pos = ctx[200];
+    base.pos_begin = ctx[201];
+    base.flags = ctx[202];
+    for (size_t i = 0; i < n; i++) {
+        Strobe s = base;
+        merlin_append(s, "sign-bytes", 10, msgs + offs[i],
+                      (size_t)(offs[i + 1] - offs[i]));
+        merlin_append(s, "proto-name", 10,
+                      (const uint8_t*)"Schnorr-sig", 11);
+        merlin_append(s, "sign:pk", 7, recs + 64 * i, 32);
+        merlin_append(s, "sign:R", 6, recs + 64 * i + 32, 32);
+        // challenge_bytes("sign:c", 64): meta_AD(label||LE32(64)); PRF
+        static const uint8_t clbl[10] = {'s', 'i', 'g', 'n', ':', 'c',
+                                         64,  0,   0,   0};
+        strobe_meta_ad(s, clbl, 10);
+        uint8_t prf[64];
+        strobe_prf(s, prf, 64);
+        u64 k[4];
+        sc_reduce512(prf, k);
+        memcpy(out_k + 32 * i, k, 32);
+    }
+    return 0;
+}
+
+// Batched ristretto255 -> compressed-edwards conversion (RFC 9496
+// decode + edwards compression): out_enc[i] gets the 32-byte edwards
+// encoding, out_ok[i] = 1 iff encs[i] is a valid canonical ristretto
+// encoding. Feeds both sr25519 batch paths (host MSM and TPU kernel
+// take compressed edwards points).
+void edb_ristretto_to_edwards(const uint8_t* encs, size_t m,
+                              uint8_t* out_enc, uint8_t* out_ok) {
+    ensure_init();
+    for (size_t i = 0; i < m; i++)
+        out_ok[i] =
+            ristretto_to_edwards(encs + 32 * i, out_enc + 32 * i) ? 1 : 0;
+}
+
 // Batched decompress-only check (ZIP-215): out[i] = 1 if points_enc[i]
 // decodes. Used for fast per-lane attribution of decode failures.
 void edb_decompress_ok(const uint8_t* points_enc, size_t m, uint8_t* out) {

@@ -128,12 +128,18 @@ class TestHostThresholdDerivation:
     """HOST_BATCH_THRESHOLD derives from env > chip-measured crossover >
     static fallback (round-3 verdict weak #4: the 768 was an assertion)."""
 
-    def test_env_override_wins(self, monkeypatch):
+    def test_env_override_wins(self, monkeypatch, tmp_path):
         from cometbft_tpu.crypto import batch
 
         monkeypatch.setenv("COMETBFT_TPU_HOST_THRESHOLD", "96")
         assert batch._derive_host_threshold() == 96
+        # garbage env falls through to the next tier; isolate from the
+        # repo's real chip table (round 5 recorded an accelerator-
+        # measured crossover there) so this checks the STATIC fallback
         monkeypatch.setenv("COMETBFT_TPU_HOST_THRESHOLD", "garbage")
+        monkeypatch.setenv(
+            "COMETBFT_TPU_CHIP_TABLE", str(tmp_path / "absent.json")
+        )
         assert batch._derive_host_threshold() == (
             batch._DEFAULT_HOST_BATCH_THRESHOLD
         )

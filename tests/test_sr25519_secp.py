@@ -212,3 +212,113 @@ def test_native_keccak_matches_python():
         host_batch._lib = lib
         host_batch._lib_failed = failed
     assert bytes(a) == bytes(b)
+
+
+def test_native_challenge_matches_python_transcript():
+    """The native STROBE/merlin engine (edb_sr_challenge_batch) equals the
+    pure-Python transcript challenge across message lengths that cross the
+    STROBE rate boundary (166) and across signing contexts."""
+    import secrets
+
+    from cometbft_tpu.crypto import host_batch
+
+    if not host_batch.available():
+        pytest.skip("native engine unavailable")
+    for ctx in (sr.SIGNING_CTX, b"", b"another-context"):
+        lanes = []
+        for mlen in (0, 1, 37, 150, 165, 166, 167, 331, 332, 333, 1000):
+            mini = secrets.token_bytes(32)
+            msg = secrets.token_bytes(mlen)
+            sig = sr.sign(mini, msg, context=ctx)
+            lanes.append((sr.public_from_mini(mini), msg, sig))
+        pks, msgs, sigs = map(list, zip(*lanes))
+        ks = sr.challenge_scalars_batch(pks, msgs, sigs, context=ctx)
+        expect = [
+            sr._challenge_py(ctx, m, p, s[:32]) for p, m, s in lanes
+        ]
+        assert ks == expect
+
+
+def test_native_ristretto_to_edwards_matches_python():
+    """Native RFC 9496 decode + edwards compression agrees with the
+    Python ristretto_decode + compress, including rejects."""
+    import secrets
+
+    from cometbft_tpu.crypto import host_batch
+
+    if not host_batch.available():
+        pytest.skip("native engine unavailable")
+    encs = []
+    # valid points: generator multiples + random public keys
+    acc = ref.BASE
+    for _ in range(8):
+        encs.append(sr.ristretto_encode(acc))
+        acc = ref.point_add(acc, ref.BASE)
+    for _ in range(8):
+        encs.append(sr.public_from_mini(secrets.token_bytes(32)))
+    # rejects: negative s, s >= p, random junk, the torsion-y edge 1 || 0*31
+    encs.append(bytes([0x01]) + bytes(31))
+    encs.append(b"\xff" * 32)
+    encs.append(bytes([0xed]) + bytes(30) + bytes([0x7f]))  # s == p
+    encs.append(secrets.token_bytes(31) + b"\x40")
+    blob = b"".join(encs)
+    out = host_batch.ristretto_to_edwards_batch(blob, len(encs))
+    assert out is not None
+    rows, ok = out
+    for i, e in enumerate(encs):
+        pt = sr.ristretto_decode(e)
+        if pt is None:
+            assert not ok[i], i
+        else:
+            assert ok[i], i
+            assert rows[32 * i : 32 * i + 32] == ref.compress(pt), i
+
+
+def test_verify_quads_matches_per_lane_verify():
+    """host_batch.verify_quads (one RLC MSM over precomputed quads) gives
+    the same verdicts as per-lane sr25519 verification."""
+    import secrets
+
+    from cometbft_tpu.crypto import host_batch
+
+    if not host_batch.available():
+        pytest.skip("native engine unavailable")
+    lanes = []
+    for i in range(10):
+        mini = secrets.token_bytes(32)
+        msg = b"lane-%d" % i
+        lanes.append((sr.public_from_mini(mini), msg, sr.sign(mini, msg)))
+    # corrupt lanes 3 (scalar bits) and 6 (message binding)
+    pks, msgs, sigs = map(list, zip(*lanes))
+    sigs[3] = sigs[3][:40] + bytes([sigs[3][40] ^ 4]) + sigs[3][41:]
+    msgs[6] = msgs[6] + b"!"
+    quads = sr.verification_encs_batch(pks, msgs, sigs)
+    bitmap = host_batch.verify_quads(quads)
+    assert bitmap == [True, True, True, False, True, True, False,
+                      True, True, True]
+
+
+def test_verification_encs_batch_flags_malformed_lanes():
+    """Structurally invalid lanes surface as None quads: wrong lengths,
+    missing schnorrkel marker bit, non-canonical scalar, bad ristretto."""
+    import secrets
+
+    mini = secrets.token_bytes(32)
+    msg = b"ok"
+    good = sr.sign(mini, msg)
+    pk = sr.public_from_mini(mini)
+    no_marker = good[:63] + bytes([good[63] & 0x7F])
+    big_s = good[:32] + (ref.L).to_bytes(32, "little")
+    big_s = big_s[:63] + bytes([big_s[63] | 0x80])
+    bad_r = bytes([0x01]) + bytes(31) + good[32:]
+    quads = sr.verification_encs_batch(
+        [pk, pk, pk, pk, pk, b"\x00"],
+        [msg] * 6,
+        [good, no_marker, big_s, bad_r, good[:40], good],
+    )
+    assert quads[0] is not None
+    assert quads[1] is None  # marker bit
+    assert quads[2] is None  # s >= L
+    assert quads[3] is None  # undecodable R
+    assert quads[4] is None  # truncated signature
+    assert quads[5] is None  # short pubkey
