@@ -391,6 +391,13 @@ def _est_vpu_util(muls_per_sig: float, n: int, compute_s: float) -> float:
     return round(ops / max(compute_s, 1e-9) / _VPU_INT32_PEAK, 4)
 
 
+# One grid for BOTH halves of the 9_device_floor table (device sweep and
+# the dead-tunnel host analog): diverging grids would make host-vs-device
+# comparison impossible at exactly the sizes being tuned.
+_FLOOR_SIZES_FULL = (64, 150, 256, 512, 768, 1024, 2048, 4096, 8192, 16384)
+_FLOOR_SIZES_TINY = (64, 150)
+
+
 def _host_floor_rows():
     """Host-only analog of the device-floor table for dead-tunnel rounds:
     pack + native-RLC latency per size, NO jax (a dead tunnel hangs the
@@ -399,7 +406,7 @@ def _host_floor_rows():
     from cometbft_tpu.ops import verify as ov
 
     rows = []
-    for n in ((64, 150) if _TINY else (64, 150, 256, 512, 1024, 2048, 4096)):
+    for n in (_FLOOR_SIZES_TINY if _TINY else _FLOOR_SIZES_FULL):
         pubkeys, msgs, sigs = _make_ed_batch(n, seed=n)
         host_batch.verify_many(pubkeys, msgs, sigs)  # warm
         t0 = time.perf_counter()
@@ -437,9 +444,7 @@ def bench_device_floor():
     from cometbft_tpu.ops import verify as ov
 
     rows = []
-    sizes = (
-        (64, 150) if _TINY else (64, 150, 256, 512, 768, 1024, 2048, 4096)
-    )
+    sizes = _FLOOR_SIZES_TINY if _TINY else _FLOOR_SIZES_FULL
     for n in sizes:
         pubkeys, msgs, sigs = _make_ed_batch(n, seed=n)
         # warm both paths (compile + cache build)

@@ -540,12 +540,14 @@ def _point_add_full(p, q, batch):
 def _fixed_base_sum8_pl(tab8_ref, s_ref, batch):
     """[S]B from 8-bit windows: 32 MXU one-hot dots + 32 affine adds.
 
-    ``tab8_ref``: (32*60, 256) f32 — per-window constant affine-Niels
-    tables T_j[v] = [v*2^(8j)]B, coordinate rows j*60 + c*20 + limb,
-    entry axis on lanes so each window's select is one
-    (60, 256) @ (256, B) matmul (exact in f32: limbs < 2^13, one-hot
-    has a single nonzero per column). ``s_ref``: (32, B) S bytes,
-    little-endian — byte j IS the window of weight 2^(8j).
+    ``tab8_ref``: (32*64, 256) f32 — per-window constant affine-Niels
+    tables T_j[v] = [v*2^(8j)]B, coordinate rows j*64 + c*20 + limb
+    (rows 60-63 of each window zero-padded: Mosaic requires the dynamic
+    window offset to be provably 8-aligned, and 60 is not), entry axis
+    on lanes so each window's select is one (64, 256) @ (256, B) matmul
+    (exact in f32: limbs < 2^13, one-hot has a single nonzero per
+    column). ``s_ref``: (32, B) S bytes, little-endian — byte j IS the
+    window of weight 2^(8j).
 
     vs the joint ladder's per-window select_b: the 64 affine B-adds
     drop to 32 and the select work leaves the VPU entirely
@@ -563,15 +565,19 @@ def _fixed_base_sum8_pl(tab8_ref, s_ref, batch):
     def body(j, acc):
         sj = s_ref[pl.ds(j, 1), :]  # (1, B)
         oh = (iota == sj).astype(jnp.float32)  # (256, B)
-        tj = tab8_ref[pl.ds(j * 60, 60), :]  # (60, 256)
+        tj = tab8_ref[pl.ds(j * 64, 64), :]  # (64, 256), 8-aligned start
         sel = jax.lax.dot_general(
             tj,
             oh,
             (((1,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)  # (60, B)
-        n3 = (sel[0:NLIMB], sel[NLIMB : 2 * NLIMB], sel[2 * NLIMB :])
+        ).astype(jnp.int32)  # (64, B); rows 60+ are the zero padding
+        n3 = (
+            sel[0:NLIMB],
+            sel[NLIMB : 2 * NLIMB],
+            sel[2 * NLIMB : 3 * NLIMB],
+        )
         return _affine_niels_add(acc, n3)
 
     return jax.lax.fori_loop(0, 32, body, ident)
@@ -710,13 +716,18 @@ _TAB8_PL_CACHE: list = []
 
 
 def _tab8_pl() -> np.ndarray:
-    """(32*60, 256) f32 layout of curve's per-window base tables."""
+    """(32*64, 256) f32 layout of curve's per-window base tables.
+
+    Each window's 60 coordinate rows are padded to a 64-row block so
+    the kernel's dynamic window offset (j*64) is provably 8-aligned
+    (Mosaic rejects j*60)."""
     if not _TAB8_PL_CACHE:
         t8 = curve._base_table8_host()  # (32, 256, 3, 20)
+        rows = t8.transpose(0, 2, 3, 1).reshape(32, 60, 256)
+        padded = np.zeros((32, 64, 256), np.float32)
+        padded[:, :60] = rows
         _TAB8_PL_CACHE.append(
-            np.ascontiguousarray(
-                t8.transpose(0, 2, 3, 1).reshape(32 * 60, 256)
-            ).astype(np.float32)
+            np.ascontiguousarray(padded.reshape(32 * 64, 256))
         )
     return _TAB8_PL_CACHE[0]
 
@@ -728,7 +739,7 @@ def _compiled8(n: int, block: int, interpret: bool):
         (rows, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     tab_spec = pl.BlockSpec(
-        (32 * 60, 256), lambda i: (0, 0), memory_space=pltpu.VMEM
+        (32 * 64, 256), lambda i: (0, 0), memory_space=pltpu.VMEM
     )
     call = pl.pallas_call(
         _verify_block_kernel8,
@@ -784,7 +795,7 @@ def _compiled8_cached(n: int, block: int, interpret: bool):
         (rows, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     tab_spec = pl.BlockSpec(
-        (32 * 60, 256), lambda i: (0, 0), memory_space=pltpu.VMEM
+        (32 * 64, 256), lambda i: (0, 0), memory_space=pltpu.VMEM
     )
     call = pl.pallas_call(
         _verify_block_kernel8_cached,

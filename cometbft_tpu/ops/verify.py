@@ -467,7 +467,12 @@ class PubkeyTableCache:
     live buffer and gathers never race an eviction.
     """
 
-    CAPACITY = 4096  # matches the reference LRU; ~21 MB of HBM
+    # One full _CHUNK of distinct signers stays cacheable (a 10k-lane
+    # light-client batch must not bail to the uncached path just because
+    # it exceeds the arena). 4x the reference's 4096-entry LRU
+    # (crypto/ed25519/ed25519.go:31) — theirs sizes a CPU heap, this
+    # sizes HBM: ~84 MB of a v5e's 16 GB.
+    CAPACITY = 16384
 
     def __init__(self, capacity: int = CAPACITY):
         self.capacity = capacity
@@ -782,15 +787,18 @@ def _materialize(out, used_pallas: bool, buf):
         return np.asarray(_jitted_kernel(_xla_which())(buf))
 
 
-# Measured sweet spot on a v5e: per-signature device time grows superlinearly
-# past 4096 lanes (HBM-resident select tables), while launch overhead
-# dominates below ~2048. Large batches are split into pipelined 4096-lane
-# launches instead of one giant one.
-_CHUNK = 4096
+# Measured on a v5e (round 5, Pallas kernel): the launch has a ~40-50 ms
+# floor nearly independent of lane count up to 4096, then scales gently —
+# 4096 lanes 40 ms, 8192 66 ms, 16384 120 ms (137k sigs/s). Chunking at
+# 2048 therefore DOUBLED 4096-lane cost (two floor payments); one big
+# launch wins everywhere measured. Batches past _CHUNK still split so a
+# single dispatch stays bounded (compile shape, VMEM head-room).
+_CHUNK = 16384
 
-# verify_batch pipelines pack->dispatch at this granularity (half _CHUNK:
-# two in-flight launches hide one chunk's packing time).
-_PIPE_CHUNK = 2048
+# verify_batch pipelines pack->dispatch at this granularity. Device time
+# dominates host packing ~10:1, so the pipeline grain equals _CHUNK:
+# splitting finer pays the launch floor again without hiding anything.
+_PIPE_CHUNK = 16384
 
 
 def verify_bytes_async(buf: np.ndarray, n: int):
@@ -807,8 +815,12 @@ def verify_bytes_async(buf: np.ndarray, n: int):
         for lo in range(0, n, _CHUNK):
             hi = min(lo + _CHUNK, n)
             piece = buf[:, lo:hi]
-            if hi - lo < _CHUNK:
-                piece = np.pad(piece, [(0, 0), (0, _CHUNK - (hi - lo))])
+            # The tail chunk pads to its own pow-2 bucket, not a full
+            # _CHUNK: a 64-lane remainder costs the ~40 ms launch floor
+            # instead of a full 16384-lane launch (~120 ms).
+            size = bucket_size(hi - lo)
+            if hi - lo < size:
+                piece = np.pad(piece, [(0, 0), (0, size - (hi - lo))])
             out, used_pallas = _run_kernel(piece)
             outs.append((out, used_pallas, piece, hi - lo))
         return lambda: np.concatenate(
