@@ -429,15 +429,17 @@ class ConsensusState(BaseService):
         if len(triples) < 2:
             return None
         try:
-            verifier = crypto_batch.create_batch_verifier(triples[0][0])
+            # Keyed off the SET: a heterogeneous ed25519+sr25519 valset
+            # pre-verifies through MixedBatchVerifier (one launch)
+            # instead of losing batching to a foreign-key TypeError.
+            verifier = crypto_batch.create_commit_batch_verifier(val_set)
             for pub_key, sign_bytes, sig in triples:
                 verifier.add(pub_key, sign_bytes, sig)
             _, bits = verifier.verify()
         except (ValueError, TypeError):
-            # no batch backend for this key type, or a MIXED-key validator
-            # set (add rejects foreign keys): skip pre-verification —
-            # admission falls back to per-vote verify, never crashes the
-            # receive loop
+            # no batch backend for some key type (e.g. secp256k1):
+            # skip pre-verification — admission falls back to per-vote
+            # verify, never crashes the receive loop
             return None
         for (pub_key, sign_bytes, sig), ok in zip(triples, bits):
             memo[(pub_key.bytes(), sign_bytes, sig)] = bool(ok)

@@ -226,10 +226,256 @@ class Sr25519BatchVerifier(BatchVerifier):
         return bool(valid.all()), list(np.asarray(valid, bool))
 
 
+class MixedBatchVerifier(BatchVerifier):
+    """One verifier for a heterogeneous (ed25519 + sr25519) lane set.
+
+    Both schemes decompose to the same quadruple (A_edwards, R_edwards,
+    s, k) and differ only in challenge derivation (SHA-512 vs merlin
+    STROBE — both computed off-device), so a mixed batch is ONE
+    cofactored device launch, or ONE host RLC MSM. The reference cannot
+    batch mixed sets at all: CreateBatchVerifier keys off a single type
+    and verifyCommitBatch falls back to per-signature verification
+    (types/validation.go:170-176); here a mixed commit stays batched.
+    """
+
+    def __init__(self) -> None:
+        self._types: list[str] = []
+        self._pubkeys: list[bytes] = []
+        self._msgs: list[bytes] = []
+        self._sigs: list[bytes] = []
+
+    def add(self, pub_key, msg: bytes, signature: bytes) -> None:
+        t = getattr(pub_key, "type", None)
+        if t not in _BATCH_BACKENDS:
+            raise TypeError(f"unsupported key type for batching: {t!r}")
+        self._types.append(t)
+        self._pubkeys.append(pub_key.data)
+        self._msgs.append(bytes(msg))
+        self._sigs.append(bytes(signature))
+
+    def __len__(self) -> int:
+        return len(self._pubkeys)
+
+    def _ed_lane_idxs(self) -> list[int]:
+        """ed25519 lanes passing the length admission; S-canonicity and
+        A/R decodability are decided downstream (native packer / MSM
+        engine / device kernel), exactly like the pure ed25519 paths."""
+        return [
+            i
+            for i, t in enumerate(self._types)
+            if t == keys.ED25519_KEY_TYPE
+            and len(self._pubkeys[i]) == 32
+            and len(self._sigs[i]) == 64
+        ]
+
+    def _ed_knegs(self, ed_idx: list[int]):
+        """(kneg_rows bytes, s_ok) from the native fused SHA-512 packer,
+        or None when the toolchain is absent."""
+        from . import host_batch
+
+        recs = b"".join(self._pubkeys[i] + self._sigs[i] for i in ed_idx)
+        offs = [0]
+        for i in ed_idx:
+            offs.append(offs[-1] + len(self._msgs[i]))
+        return host_batch.pack_challenges(
+            recs, b"".join(self._msgs[i] for i in ed_idx), offs,
+            len(ed_idx),
+        )
+
+    def _sr_quads(self, out: list) -> list[int]:
+        """Scatter sr25519 lane quads into ``out``; returns the sr lane
+        indices. The ONE home of sr admission + scatter, shared by the
+        host (_quads) and device (_pack_rows) paths."""
+        from . import sr25519 as sr
+
+        sr_idx = [i for i, t in enumerate(self._types) if t == "sr25519"]
+        if sr_idx:
+            sq = sr.verification_encs_batch(
+                [self._pubkeys[i] for i in sr_idx],
+                [self._msgs[i] for i in sr_idx],
+                [self._sigs[i] for i in sr_idx],
+            )
+            for j, i in enumerate(sr_idx):
+                out[i] = sq[j]
+        return sr_idx
+
+    def _quads(self) -> list:
+        """Per-lane (A_enc, R_enc, s, k), challenges batched per scheme
+        through the native engine (merlin STROBE for sr25519, fused
+        SHA-512 for ed25519); None marks a structurally invalid lane."""
+        from . import ed25519_ref as ref
+
+        n = len(self._pubkeys)
+        quads: list = [None] * n
+        self._sr_quads(quads)
+        ed_idx = self._ed_lane_idxs()
+        if not ed_idx:
+            return quads
+        L = ref.L
+        packed = self._ed_knegs(ed_idx)
+        if packed is not None:
+            kneg_rows, s_ok = packed
+            for j, i in enumerate(ed_idx):
+                if not s_ok[j]:
+                    continue
+                sig = self._sigs[i]
+                kneg = int.from_bytes(
+                    kneg_rows[32 * j : 32 * j + 32], "little"
+                )
+                quads[i] = (
+                    self._pubkeys[i],
+                    sig[:32],
+                    int.from_bytes(sig[32:], "little"),
+                    (L - kneg) % L,
+                )
+            return quads
+        for i in ed_idx:  # toolchain-less: per-lane Python challenge
+            pk, sig = self._pubkeys[i], self._sigs[i]
+            s = int.from_bytes(sig[32:], "little")
+            if s >= L:
+                continue  # S must be canonical even under ZIP-215
+            k = ref.challenge_scalar(sig[:32], pk, self._msgs[i])
+            quads[i] = (pk, sig[:32], s, k)
+        return quads
+
+    _ZERO_ROW = bytes(128)
+
+    def _pack_rows(self) -> tuple[np.ndarray, np.ndarray, list]:
+        """(buf (128, n), host_ok, a_keys): the device wire rows
+        A|R|S|kneg, challenges batched per scheme through the native
+        engine (fused SHA-512 packer for ed25519, STROBE for sr25519) —
+        no per-lane Python bigints on the happy path. Row layout lives
+        in ops/verify.pack_part_row / pack_challenges."""
+        from ..ops import verify as ov
+        from . import host_batch
+
+        if not host_batch.available():
+            # toolchain-less: build everything through the shared quad
+            # packer (one Python challenge loop lives in _quads) —
+            # checked FIRST so the ed record/message blobs aren't joined
+            # just to learn pack_challenges must return None
+            quads = self._quads()
+            buf, host_ok = ov.pack_parts(quads)
+            a_keys = [q[0] if q is not None else b"" for q in quads]
+            return buf, host_ok, a_keys
+        n = len(self._pubkeys)
+        rows: list = [None] * n
+        a_keys: list = [b""] * n
+        sq: list = [None] * n
+        for i in self._sr_quads(sq):
+            q = sq[i]
+            if q is None:
+                continue
+            rows[i] = ov.pack_part_row(*q)
+            a_keys[i] = bytes(q[0])
+        ed_idx = self._ed_lane_idxs()
+        packed = self._ed_knegs(ed_idx) if ed_idx else None
+        if ed_idx and packed is None:  # engine vanished mid-flight
+            quads = self._quads()
+            buf, host_ok = ov.pack_parts(quads)
+            return buf, host_ok, [
+                q[0] if q is not None else b"" for q in quads
+            ]
+        if ed_idx:
+            kneg_rows, s_ok = packed
+            for j, i in enumerate(ed_idx):
+                if not s_ok[j]:
+                    continue
+                # raw-bytes row pk|R|S|kneg: byte-identical to
+                # pack_part_row's layout (sig is R||S on the wire, kneg
+                # from the native packer) — pinned by
+                # test_mixed_row_assembly_matches_pack_part_row
+                rows[i] = (
+                    self._pubkeys[i]
+                    + self._sigs[i]
+                    + kneg_rows[32 * j : 32 * j + 32]
+                )
+                a_keys[i] = self._pubkeys[i]
+        host_ok = np.array([r is not None for r in rows], bool)
+        blob = b"".join(
+            r if r is not None else self._ZERO_ROW for r in rows
+        )
+        buf = np.ascontiguousarray(
+            np.frombuffer(blob, np.uint8).reshape(n, 128).T
+        )
+        return buf, host_ok, a_keys
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        import os as _os
+        import time as _time
+
+        from . import host_batch
+
+        t0 = _time.perf_counter()
+        n = len(self._pubkeys)
+        native = host_batch.available()
+        host_cut = (
+            HOST_BATCH_THRESHOLD
+            if native
+            else Sr25519BatchVerifier.HOST_THRESHOLD
+        )
+        if n < host_cut or _os.environ.get("COMETBFT_TPU_SR_HOST") == "1":
+            bitmap = host_batch.verify_quads(self._quads()) if native \
+                else None
+            if bitmap is None:
+                from .sr25519 import verify as sr_verify
+
+                bitmap = [
+                    (
+                        keys.Ed25519PubKey(pk).verify_signature(m, s)
+                        if t == keys.ED25519_KEY_TYPE
+                        else sr_verify(pk, m, s)
+                    )
+                    for t, pk, m, s in zip(
+                        self._types, self._pubkeys, self._msgs, self._sigs
+                    )
+                ]
+            _observe("mixed-host", t0, n)
+            return all(bitmap), list(bitmap)
+        from ..ops import verify as ov
+
+        buf, host_ok, a_keys = self._pack_rows()
+        device_ok = ov.verify_prepacked(buf, a_keys, n)()
+        valid = device_ok & host_ok
+        _observe("mixed-tpu", t0, n)
+        return bool(valid.all()), list(np.asarray(valid, bool))
+
+
 _BATCH_BACKENDS: dict[str, type] = {
     keys.ED25519_KEY_TYPE: Ed25519BatchVerifier,
     "sr25519": Sr25519BatchVerifier,
 }
+
+
+def supports_commit_batch(validator_set) -> bool:
+    """True when every key type in the set has a batch backend (a mixed
+    set rides MixedBatchVerifier)."""
+    vals = getattr(validator_set, "validators", [])
+    return bool(vals) and all(
+        getattr(v.pub_key, "type", None) in _BATCH_BACKENDS for v in vals
+    )
+
+
+def create_commit_batch_verifier(validator_set) -> BatchVerifier:
+    """Batch verifier for a (possibly heterogeneous) validator set.
+
+    Homogeneous sets get their scheme's dedicated backend (ed25519 keeps
+    the fused native happy path); mixed sets get MixedBatchVerifier —
+    one launch where the reference falls back to per-signature verifies.
+    """
+    types = {
+        getattr(v.pub_key, "type", None)
+        for v in getattr(validator_set, "validators", [])
+    }
+    if len(types) == 1:
+        backend = _BATCH_BACKENDS.get(next(iter(types)))
+        if backend is not None:
+            return backend()
+    if types and all(t in _BATCH_BACKENDS for t in types):
+        return MixedBatchVerifier()
+    raise ValueError(
+        f"batch verification unsupported for key types {sorted(types)!r}"
+    )
 
 
 def _observe(backend: str, t0: float, n: int) -> None:

@@ -54,10 +54,13 @@ DEFAULT_TRUST_LEVEL = Fraction(1, 3)
 
 
 def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
-    return len(
-        commit.signatures
-    ) >= BATCH_VERIFY_THRESHOLD and crypto_batch.supports_batch_verifier(
-        vals.get_proposer().pub_key
+    # Unlike the reference (which keys off one type and bails to single
+    # verifies when a mixed set trips Add, types/validation.go:170-176),
+    # a heterogeneous set batches too: every key type just needs a
+    # backend (crypto_batch.MixedBatchVerifier — one device launch).
+    return (
+        len(commit.signatures) >= BATCH_VERIFY_THRESHOLD
+        and crypto_batch.supports_commit_batch(vals)
     )
 
 
@@ -158,7 +161,7 @@ def _verify_batch(
     chain_id, vals, commit, needed, ignore, count, count_all, by_index
 ) -> None:
     """Mirror of verifyCommitBatch (types/validation.go:153-257)."""
-    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    bv = crypto_batch.create_commit_batch_verifier(vals)
     seen: dict[int, int] = {}
     batch_sig_idxs: list[int] = []
     tallied = 0

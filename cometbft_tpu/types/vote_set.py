@@ -192,24 +192,54 @@ class VoteSet:
             val = self.val_set.get_by_index(vote.validator_index)
             screened.append((vote, val))
 
-        verifier = crypto_batch.create_batch_verifier(
-            self.val_set.get_proposer().pub_key
-        )
+        # Keyed off the SET, not the proposer: a heterogeneous
+        # ed25519+sr25519 valset gets MixedBatchVerifier (one launch)
+        # instead of a TypeError from add() on the first foreign key. A
+        # set with a type no backend supports (e.g. secp256k1) verifies
+        # per-vote instead of crashing reconstruction.
+        try:
+            verifier = crypto_batch.create_commit_batch_verifier(
+                self.val_set
+            )
+        except ValueError:
+            verifier = None
         lanes: list[int] = []
         for i, (vote, val) in enumerate(screened):
             if val is None:
                 continue
-            verifier.add(
-                val.pub_key, vote.sign_bytes(self.chain_id), vote.signature
-            )
-            lanes.append(i)
-            if self._needs_extension(vote):
+            if verifier is not None:
                 verifier.add(
-                    val.pub_key,
+                    val.pub_key, vote.sign_bytes(self.chain_id),
+                    vote.signature,
+                )
+                lanes.append(i)
+                if self._needs_extension(vote):
+                    verifier.add(
+                        val.pub_key,
+                        vote.extension_sign_bytes(self.chain_id),
+                        vote.extension_signature,
+                    )
+                    lanes.append(i)  # second lane for the same vote
+                continue
+            # per-vote fallback path
+            ok = val.pub_key.verify_signature(
+                vote.sign_bytes(self.chain_id), vote.signature
+            )
+            if ok and self._needs_extension(vote):
+                ok = val.pub_key.verify_signature(
                     vote.extension_sign_bytes(self.chain_id),
                     vote.extension_signature,
                 )
-                lanes.append(i)  # second lane for the same vote
+            if not ok:
+                errors[i] = VoteError(
+                    f"invalid signature from validator "
+                    f"{vote.validator_address.hex()}"
+                )
+                continue
+            try:
+                added[i] = self._admit(vote, val)
+            except ConflictingVoteError as e:
+                errors[i] = e
 
         if lanes:
             _, bits = verifier.verify()

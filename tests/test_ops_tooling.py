@@ -219,3 +219,24 @@ class TestDebugCLI:
             assert "consensus" in dump or "thread" in dump
         finally:
             n.stop()
+
+
+def test_bucket_size_grid():
+    """Compile buckets: powers of two plus the 3*2^k midpoints that are
+    512-block multiples (the Pallas wrappers require n % 512 == 0 at or
+    above one block). Mid buckets bound padding waste by 1.5x where the
+    kernel is lane-proportional."""
+    from cometbft_tpu.ops.verify import _CHUNK, bucket_size
+
+    table = {
+        1: 8, 8: 8, 9: 16, 12: 16, 100: 128, 513: 1024, 1000: 1024,
+        1025: 1536, 1536: 1536, 1537: 2048, 2049: 3072, 3073: 4096,
+        4097: 6144, 6145: 8192, 8193: 12288, 10000: 12288,
+        12289: 16384, 16384: 16384,
+    }
+    for n, want in table.items():
+        got = bucket_size(n)
+        assert got == want, (n, got, want)
+        assert n <= got <= _CHUNK
+        # every bucket at/above one Pallas block divides into blocks
+        assert got < 512 or got % 512 == 0

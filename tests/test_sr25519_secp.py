@@ -322,3 +322,123 @@ def test_verification_encs_batch_flags_malformed_lanes():
     assert quads[3] is None  # undecodable R
     assert quads[4] is None  # truncated signature
     assert quads[5] is None  # short pubkey
+
+
+class TestMixedBatchVerifier:
+    """One launch / one MSM across heterogeneous key types — the path
+    types/validation.py routes mixed validator sets through (the
+    reference falls back to per-signature verifies there,
+    types/validation.go:170-176)."""
+
+    def _lanes(self):
+        import secrets
+
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+        lanes = []
+        for i in range(4):
+            k = Ed25519PrivKey.generate()
+            m = b"ed-%d" % i
+            lanes.append((k.pub_key(), m, k.sign(m)))
+        for i in range(4):
+            k = Sr25519PrivKey(secrets.token_bytes(32))
+            m = b"sr-%d" % i
+            lanes.append((k.pub_key(), m, k.sign(m)))
+        return lanes
+
+    def test_interleaved_types_one_verifier(self):
+        bv = crypto_batch.MixedBatchVerifier()
+        lanes = self._lanes()
+        # interleave so per-scheme grouping must preserve lane order
+        order = [0, 4, 1, 5, 2, 6, 3, 7]
+        for i in order:
+            p, m, s = lanes[i]
+            bv.add(p, m, s)
+        ok, bm = bv.verify()
+        assert ok and all(bm) and len(bm) == 8
+
+    def test_mixed_failure_attribution(self):
+        bv = crypto_batch.MixedBatchVerifier()
+        lanes = self._lanes()
+        for j, (p, m, s) in enumerate(lanes):
+            if j == 1:  # corrupt an ed25519 lane
+                s = s[:6] + bytes([s[6] ^ 1]) + s[7:]
+            if j == 6:  # corrupt an sr25519 lane
+                m = m + b"!"
+            bv.add(p, m, s)
+        ok, bm = bv.verify()
+        assert not ok
+        assert [int(b) for b in bm] == [1, 0, 1, 1, 1, 1, 0, 1]
+
+    def test_rejects_unbatchable_type(self):
+        bv = crypto_batch.MixedBatchVerifier()
+        k = Secp256k1PrivKey.generate()
+        with pytest.raises(TypeError):
+            bv.add(k.pub_key(), b"m", k.sign(b"m"))
+
+    def test_commit_factory_picks_backend(self):
+        import secrets
+
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+        from cometbft_tpu.types.validator_set import (
+            Validator,
+            ValidatorSet,
+        )
+
+        ed = [Ed25519PrivKey.generate().pub_key() for _ in range(2)]
+        srk = [
+            Sr25519PrivKey(secrets.token_bytes(32)).pub_key()
+            for _ in range(2)
+        ]
+        homo = ValidatorSet([Validator(p, voting_power=1) for p in ed])
+        assert isinstance(
+            crypto_batch.create_commit_batch_verifier(homo),
+            crypto_batch.Ed25519BatchVerifier,
+        )
+        mixed = ValidatorSet(
+            [Validator(p, voting_power=1) for p in ed + srk]
+        )
+        assert isinstance(
+            crypto_batch.create_commit_batch_verifier(mixed),
+            crypto_batch.MixedBatchVerifier,
+        )
+        assert crypto_batch.supports_commit_batch(mixed)
+
+
+def test_mixed_row_assembly_matches_pack_part_row():
+    """The mixed verifier's fused ed25519 row (raw pk|sig|native-kneg)
+    is byte-identical to pack_part_row on the same quad — the two
+    assemblies of the device wire layout must never diverge."""
+    from cometbft_tpu.crypto import host_batch
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.ops import verify as ov
+
+    if not host_batch.available():
+        pytest.skip("native engine unavailable")
+    k = Ed25519PrivKey.from_seed(b"\x33" * 32)
+    msg = b"row-equality"
+    sig = k.sign(msg)
+    pk = k.pub_key().data
+    bv = crypto_batch.MixedBatchVerifier()
+    bv.add(k.pub_key(), msg, sig)
+    buf, host_ok, a_keys = bv._pack_rows()
+    assert host_ok[0] and a_keys[0] == pk
+    fused_row = buf[:, 0].tobytes()
+    k_int = ref.challenge_scalar(sig[:32], pk, msg)
+    s_int = int.from_bytes(sig[32:], "little")
+    assert fused_row == ov.pack_part_row(pk, sig[:32], s_int, k_int)
+
+
+def test_bucket_midpoints_match_pallas_block():
+    """bucket_size's midpoint admission hard-codes the Pallas block
+    width; if _BLOCK is ever retuned, a mid-bucket launch would raise
+    inside _run_kernel and permanently pin the process to the XLA
+    kernel (_PALLAS_BROKEN) — this pins the two constants together."""
+    from cometbft_tpu.ops import pallas_verify
+    from cometbft_tpu.ops import verify as ov
+
+    assert pallas_verify._BLOCK == 512
+    assert ov._PALLAS_MIN_LANES == pallas_verify._BLOCK
+    for mid in (1536, 3072, 6144, 12288):
+        assert ov.bucket_size(mid) == mid
+        assert mid % pallas_verify._BLOCK == 0

@@ -451,3 +451,51 @@ class TestProposal:
         assert pv.get_pub_key().verify_signature(
             p.sign_bytes(CHAIN_ID), p.signature
         )
+
+
+class TestVerifyCommitMixedKeys:
+    """A heterogeneous (ed25519 + sr25519) validator set batches through
+    crypto_batch.MixedBatchVerifier — one launch — where the reference
+    falls back to per-signature verifies (types/validation.go:170-176)."""
+
+    def _mixed_pv_set(self, n_ed, n_sr, power=10):
+        from cometbft_tpu.crypto.sr25519 import Sr25519PrivKey
+
+        pvs = [
+            MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
+            for i in range(n_ed)
+        ] + [
+            MockPV(Sr25519PrivKey.from_seed(bytes([i + 101]) * 32))
+            for i in range(n_sr)
+        ]
+        vals = ValidatorSet(
+            [
+                Validator(pub_key=pv.get_pub_key(), voting_power=power)
+                for pv in pvs
+            ]
+        )
+        by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+        ordered = [by_addr[v.address] for v in vals.validators]
+        return ordered, vals
+
+    def test_mixed_commit_batches_and_verifies(self):
+        from cometbft_tpu.crypto import batch as crypto_batch
+        from cometbft_tpu.types import validation
+
+        pvs, vals = self._mixed_pv_set(3, 3)
+        assert crypto_batch.supports_commit_batch(vals)
+        assert validation._should_batch_verify(
+            vals, _make_commit(CHAIN_ID, 5, 0, _block_id(), pvs, vals)
+        )
+        bid = _block_id()
+        commit = _make_commit(CHAIN_ID, 5, 0, bid, pvs, vals)
+        verify_commit(CHAIN_ID, vals, bid, 5, commit)
+
+    def test_mixed_commit_bad_signature_attributed(self):
+        pvs, vals = self._mixed_pv_set(3, 3)
+        bid = _block_id()
+        commit = _make_commit(
+            CHAIN_ID, 5, 0, bid, pvs, vals, bad_sig_idx={4}
+        )
+        with pytest.raises(VerificationError, match="wrong signature"):
+            verify_commit(CHAIN_ID, vals, bid, 5, commit)
