@@ -409,11 +409,20 @@ class MixedBatchVerifier(BatchVerifier):
         t0 = _time.perf_counter()
         n = len(self._pubkeys)
         native = host_batch.available()
-        host_cut = (
-            HOST_BATCH_THRESHOLD
-            if native
-            else Sr25519BatchVerifier.HOST_THRESHOLD
-        )
+        if native:
+            host_cut = HOST_BATCH_THRESHOLD
+        else:
+            # Toolchain-less host cost is dominated by pure-Python
+            # sr25519 verifies (~30 ms/sig); ed25519 lanes verify via
+            # OpenSSL in ~50 us. The tiny sr cutoff applies only when
+            # sr lanes actually dominate — an ed-heavy mixed batch
+            # keeps the ed crossover.
+            n_sr = sum(1 for t in self._types if t == "sr25519")
+            host_cut = (
+                Sr25519BatchVerifier.HOST_THRESHOLD
+                if n_sr >= Sr25519BatchVerifier.HOST_THRESHOLD
+                else HOST_BATCH_THRESHOLD
+            )
         if n < host_cut or _os.environ.get("COMETBFT_TPU_SR_HOST") == "1":
             bitmap = host_batch.verify_quads(self._quads()) if native \
                 else None

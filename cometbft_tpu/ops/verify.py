@@ -460,21 +460,20 @@ def _jitted_cached_kernel(which: str):
 def _run_cached_kernel(arena, arena_ok, idxs, buf):
     """Cached-table launch with the same Pallas/XLA selection and Mosaic
     fallback discipline as :func:`_run_kernel`."""
-    if (
-        buf.shape[1] >= _PALLAS_MIN_LANES
-        and _pallas_wanted()
-        and not _PALLAS_BROKEN
-    ):
-        try:
-            return (
-                _jitted_cached_kernel(_pallas_which())(arena, arena_ok, idxs, buf),
-                True,
-            )
-        except Exception as e:
-            _note_pallas_broken(e)
+    if buf.shape[1] >= _PALLAS_MIN_LANES and _pallas_wanted():
+        for which in _pallas_candidates():
+            try:
+                return (
+                    _jitted_cached_kernel(which)(
+                        arena, arena_ok, idxs, buf
+                    ),
+                    which,
+                )
+            except Exception as e:
+                _note_pallas_broken(which, e)
     return (
         _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf),
-        False,
+        None,
     )
 
 
@@ -726,7 +725,7 @@ def _jitted_kernel(which: str = "xla"):
 # the 8-bit fixed-base-window prototype: MXU one-hot selects, -11%
 # field muls — see curve.fixed_base_sum8).
 _KERNEL_MODE = None
-_PALLAS_BROKEN = False
+_PALLAS_BROKEN: set = set()  # flavors that faulted in this process
 
 
 def _kernel_mode() -> str:
@@ -745,8 +744,71 @@ def _xla_which() -> str:
     return "xla8" if _kernel_mode() in ("xla8", "pallas8") else "xla"
 
 
-def _pallas_which() -> str:
-    return "pallas8" if _kernel_mode() == "pallas8" else "pallas"
+_UNSET = object()
+_MEASURED_FLAVOR = _UNSET
+
+
+def _measured_pallas_flavor():
+    """The pallas flavor that won the last accelerator-measured kernel
+    A/B (BENCH_CHIP_TABLE.json, config 10_kernel_ab; best of its
+    cached/uncached numbers per flavor), or None without chip data.
+    Same measured-knob discipline as crypto/batch._derive_host_threshold
+    — the default kernel follows what the chip actually ran fastest,
+    not a guess."""
+    global _MEASURED_FLAVOR
+    if _MEASURED_FLAVOR is not _UNSET:
+        return _MEASURED_FLAVOR
+    import json
+    import os
+
+    flavor = None
+    path = os.environ.get("COMETBFT_TPU_CHIP_TABLE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+        "BENCH_CHIP_TABLE.json",
+    )
+    try:
+        with open(path) as f:
+            table = json.load(f)
+        if table.get("measured_on_accelerator"):
+            for row in table.get("table", []):
+                if row.get("config") != "10_kernel_ab":
+                    continue
+                best = {}
+                for fl in ("pallas", "pallas8"):
+                    vals = [
+                        v
+                        for k, v in row.items()
+                        if k.startswith(fl + "_")
+                        and k.endswith("_sigs_per_sec")
+                        and isinstance(v, (int, float))
+                    ]
+                    if vals:
+                        best[fl] = max(vals)
+                if best:
+                    flavor = max(best, key=best.get)
+    except (OSError, ValueError):
+        pass
+    _MEASURED_FLAVOR = flavor
+    return flavor
+
+
+def _pallas_candidates() -> list[str]:
+    """Pallas flavors to try, best first, faulted flavors excluded.
+
+    Explicit COMETBFT_TPU_KERNEL=pallas|pallas8 pins a single flavor
+    (benchmarking wants THAT kernel, its XLA twin is the only
+    fallback); auto tries the chip-measured winner first, then the
+    sibling."""
+    mode = _kernel_mode()
+    if mode in ("pallas", "pallas8"):
+        order = [mode]
+    else:
+        m = _measured_pallas_flavor()
+        if m is None:
+            order = ["pallas", "pallas8"]
+        else:
+            order = [m, "pallas8" if m == "pallas" else "pallas"]
+    return [f for f in order if f not in _PALLAS_BROKEN]
 
 
 def _pallas_wanted() -> bool:
@@ -767,46 +829,48 @@ def _pallas_wanted() -> bool:
 _PALLAS_MIN_LANES = 512
 
 
-def _note_pallas_broken(e: Exception) -> None:
-    global _PALLAS_BROKEN
-    _PALLAS_BROKEN = True
+def _note_pallas_broken(which: str, e: Exception) -> None:
+    _PALLAS_BROKEN.add(which)
     from ..libs import log as _log
 
     _log.default_logger().with_module("ops.verify").error(
-        "pallas verify kernel failed; falling back to XLA kernel",
+        "pallas verify kernel failed; falling back",
+        flavor=which,
         err=repr(e)[:200],
     )
 
 
 def _run_kernel(buf):
-    """Dispatch one bucket launch, falling back to XLA if Mosaic balks.
+    """Dispatch one bucket launch, falling back through the remaining
+    pallas flavor and then XLA if Mosaic balks.
 
-    Returns (device_array, used_pallas). jit dispatch is asynchronous, so
-    a Mosaic *runtime* fault only surfaces when the result materializes —
-    callers resolve through :func:`_materialize`, which retries the
-    launch on the XLA kernel in that case.
+    Returns (device_array, flavor-or-None). jit dispatch is
+    asynchronous, so a Mosaic *runtime* fault only surfaces when the
+    result materializes — callers resolve through :func:`_materialize`,
+    which marks the flavor broken and re-dispatches.
     """
-    if (
-        buf.shape[1] >= _PALLAS_MIN_LANES
-        and _pallas_wanted()
-        and not _PALLAS_BROKEN
-    ):
-        try:
-            return _jitted_kernel(_pallas_which())(buf), True
-        except Exception as e:  # synchronous trace/compile failure
-            _note_pallas_broken(e)
-    return _jitted_kernel(_xla_which())(buf), False
+    if buf.shape[1] >= _PALLAS_MIN_LANES and _pallas_wanted():
+        for which in _pallas_candidates():
+            try:
+                return _jitted_kernel(which)(buf), which
+            except Exception as e:  # synchronous trace/compile failure
+                _note_pallas_broken(which, e)
+    return _jitted_kernel(_xla_which())(buf), None
 
 
-def _materialize(out, used_pallas: bool, buf):
-    """np.asarray(out) with device-side pallas faults rerouted to XLA."""
+def _materialize(out, used_pallas, buf):
+    """np.asarray(out) with device-side pallas faults rerouted: the
+    faulting flavor is retired and the launch retried through
+    :func:`_run_kernel` (sibling flavor, then XLA). Bounded — each
+    retry removes a flavor; the XLA launch (used_pallas None) raises."""
     try:
         return np.asarray(out)
     except Exception as e:
-        if not used_pallas:
+        if used_pallas is None:
             raise
-        _note_pallas_broken(e)
-        return np.asarray(_jitted_kernel(_xla_which())(buf))
+        _note_pallas_broken(used_pallas, e)
+        out2, which2 = _run_kernel(buf)
+        return _materialize(out2, which2, buf)
 
 
 # Measured on a v5e (round 5, Pallas kernel): the launch has a ~40-50 ms
@@ -927,15 +991,17 @@ def verify_rsk_async(buf: np.ndarray, idxs: np.ndarray, arena, arena_ok,
     out, used_pallas = _run_cached_kernel(arena, arena_ok, idxs, buf)
 
     def materialize():
-        try:
-            return np.asarray(out)[:n]
-        except Exception as e:
-            if not used_pallas:
-                raise
-            _note_pallas_broken(e)
-            return np.asarray(
-                _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf)
-            )[:n]
+        o, which = out, used_pallas
+        while True:
+            try:
+                return np.asarray(o)[:n]
+            except Exception as e:
+                if which is None:
+                    raise
+                # retire the faulting flavor; _run_cached_kernel then
+                # tries the sibling, bottoming out at XLA (which=None)
+                _note_pallas_broken(which, e)
+                o, which = _run_cached_kernel(arena, arena_ok, idxs, buf)
 
     return materialize
 

@@ -203,6 +203,20 @@ class VoteSet:
             )
         except ValueError:
             verifier = None
+
+        def finish(i, vote, val, ok: bool) -> None:
+            """Shared verdict->admission tail for both verify paths."""
+            if not ok:
+                errors[i] = VoteError(
+                    f"invalid signature from validator "
+                    f"{vote.validator_address.hex()}"
+                )
+                return
+            try:
+                added[i] = self._admit(vote, val)
+            except ConflictingVoteError as e:
+                errors[i] = e
+
         lanes: list[int] = []
         for i, (vote, val) in enumerate(screened):
             if val is None:
@@ -230,16 +244,7 @@ class VoteSet:
                     vote.extension_sign_bytes(self.chain_id),
                     vote.extension_signature,
                 )
-            if not ok:
-                errors[i] = VoteError(
-                    f"invalid signature from validator "
-                    f"{vote.validator_address.hex()}"
-                )
-                continue
-            try:
-                added[i] = self._admit(vote, val)
-            except ConflictingVoteError as e:
-                errors[i] = e
+            finish(i, vote, val, ok)
 
         if lanes:
             _, bits = verifier.verify()
@@ -248,16 +253,7 @@ class VoteSet:
                 vote_ok[lane] = vote_ok.get(lane, True) and bool(ok)
             for i, ok in vote_ok.items():
                 vote, val = screened[i]
-                if not ok:
-                    errors[i] = VoteError(
-                        f"invalid signature from validator "
-                        f"{vote.validator_address.hex()}"
-                    )
-                    continue
-                try:
-                    added[i] = self._admit(vote, val)
-                except ConflictingVoteError as e:
-                    errors[i] = e
+                finish(i, vote, val, bool(ok))
         return added, errors
 
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:

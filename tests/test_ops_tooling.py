@@ -240,3 +240,48 @@ def test_bucket_size_grid():
         assert n <= got <= _CHUNK
         # every bucket at/above one Pallas block divides into blocks
         assert got < 512 or got % 512 == 0
+
+
+def test_pallas_flavor_selection(tmp_path, monkeypatch):
+    """Auto kernel mode picks the chip-measured A/B winner; explicit
+    modes pin one flavor; faulted flavors drop out of the candidate
+    order (per-flavor isolation — a pallas8 fault must not retire
+    pallas)."""
+    import json
+
+    from cometbft_tpu.ops import verify as ov
+
+    table = {
+        "measured_on_accelerator": True,
+        "table": [
+            {
+                "config": "10_kernel_ab",
+                "pallas_uncached_sigs_per_sec": 90000.0,
+                "pallas_cached_sigs_per_sec": 95000.0,
+                "pallas8_uncached_sigs_per_sec": 102000.0,
+                "pallas8_cached_sigs_per_sec": 103000.0,
+            }
+        ],
+    }
+    p = tmp_path / "chip.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("COMETBFT_TPU_CHIP_TABLE", str(p))
+    monkeypatch.setattr(ov, "_MEASURED_FLAVOR", ov._UNSET)
+    monkeypatch.setattr(ov, "_KERNEL_MODE", "auto")
+    monkeypatch.setattr(ov, "_PALLAS_BROKEN", set())
+    assert ov._measured_pallas_flavor() == "pallas8"
+    assert ov._pallas_candidates() == ["pallas8", "pallas"]
+    # a faulted winner falls back to the sibling, not to nothing
+    monkeypatch.setattr(ov, "_PALLAS_BROKEN", {"pallas8"})
+    assert ov._pallas_candidates() == ["pallas"]
+    # explicit mode pins a single flavor regardless of measurements
+    monkeypatch.setattr(ov, "_PALLAS_BROKEN", set())
+    monkeypatch.setattr(ov, "_KERNEL_MODE", "pallas")
+    assert ov._pallas_candidates() == ["pallas"]
+    # host-measured tables (dead-tunnel rounds) must not steer auto
+    table["measured_on_accelerator"] = False
+    p.write_text(json.dumps(table))
+    monkeypatch.setattr(ov, "_MEASURED_FLAVOR", ov._UNSET)
+    monkeypatch.setattr(ov, "_KERNEL_MODE", "auto")
+    assert ov._measured_pallas_flavor() is None
+    assert ov._pallas_candidates() == ["pallas", "pallas8"]
