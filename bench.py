@@ -381,6 +381,15 @@ _VPU_INT32_PEAK = 3.3e12
 # (29 dbl-chain + 8 niels + 7 affine) + tail ~31.
 _LADDER_MULS_CACHED = 265 + 64 * 44 + 31
 _LADDER_MULS_UNCACHED = _LADDER_MULS_CACHED + 265 + 121  # + A decomp/table
+# 8-bit fixed-base windows: -32 affine B-adds (-224 muls) + 1 complete
+# add (+9) = -215 muls/sig vs the joint ladder (docs/tpu-kernel.md);
+# the window selects move to the MXU and leave the VPU ledger.
+_MULS_UNCACHED_BY_KERNEL = {
+    "xla": _LADDER_MULS_UNCACHED,
+    "pallas": _LADDER_MULS_UNCACHED,
+    "xla8": _LADDER_MULS_UNCACHED - 215,
+    "pallas8": _LADDER_MULS_UNCACHED - 215,
+}
 
 
 def _est_vpu_util(muls_per_sig: float, n: int, compute_s: float) -> float:
@@ -497,11 +506,30 @@ def bench_device_floor():
             bufp = buf
             if size != n and n <= ov._CHUNK:
                 bufp = np.pad(buf, [(0, 0), (0, size - n)])
-            probe_kernel = ov._xla_which()
-            fn = ov._jitted_kernel(probe_kernel)
+            # Time the kernel production would actually pick for this
+            # bucket (auto: the measured-A/B pallas flavor on chip; XLA
+            # otherwise) so compute_ms/utilization describe the real
+            # path — falling back through the remaining candidates to
+            # XLA so one broken pallas flavor can't erase the whole
+            # decomposition this probe exists to capture.
+            cands = (
+                ov._pallas_candidates()
+                if ov._pallas_wanted() and size >= ov._PALLAS_MIN_LANES
+                else []
+            )
             dev_buf = jax.device_put(bufp[:, : min(size, ov._CHUNK)])
             dev_buf.block_until_ready()
-            fn(dev_buf).block_until_ready()  # warm
+            fn = None
+            for probe_try in [*cands, ov._xla_which()]:
+                try:
+                    fn = ov._jitted_kernel(probe_try)
+                    fn(dev_buf).block_until_ready()  # warm
+                    probe_kernel = probe_try
+                    break
+                except Exception:
+                    fn = None
+            if fn is None:
+                raise RuntimeError("no kernel probed")
             t_c = []
             for _ in range(reps):
                 dev_buf2 = jax.device_put(bufp[:, : min(size, ov._CHUNK)])
@@ -566,14 +594,16 @@ def bench_device_floor():
                     else None
                 ),
                 "probe_kernel": probe_kernel,
-                # The mul ledger counts the 4-bit joint ladder's ops:
-                # pairing it with an 8-bit-window kernel's time would
-                # report a utilization off by the window-scheme ratio.
+                # Ledger matched to the probed kernel's window scheme
+                # (both lowerings of a scheme run the same algorithm).
                 "est_vpu_util_uncached": (
                     _est_vpu_util(
-                        _LADDER_MULS_UNCACHED, probe_lanes, t_compute
+                        _MULS_UNCACHED_BY_KERNEL[probe_kernel],
+                        probe_lanes,
+                        t_compute,
                     )
-                    if t_compute and probe_kernel == "xla"
+                    if t_compute
+                    and probe_kernel in _MULS_UNCACHED_BY_KERNEL
                     else None
                 ),
                 "rlc_total_ms": round(t_rlc * 1e3, 2) if t_rlc else None,

@@ -56,8 +56,9 @@ _DEFAULT_HOST_BATCH_THRESHOLD = 768
 
 
 def _derive_host_threshold() -> int:
-    import json
     import os
+
+    from ..libs import chip_table
 
     env = os.environ.get("COMETBFT_TPU_HOST_THRESHOLD")
     if env:
@@ -65,37 +66,25 @@ def _derive_host_threshold() -> int:
             return max(2, int(env))
         except ValueError:
             pass
-    # repo-root anchored (bench.py writes it there): a CWD-relative open
-    # would silently miss the table for any process not started in the
-    # repo root — and trust an unrelated same-named file that is.
-    table_path = os.environ.get("COMETBFT_TPU_CHIP_TABLE") or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        "BENCH_CHIP_TABLE.json",
+    # load_chip_table anchors the path to the repo root (bench.py
+    # writes it there) and trusts only accelerator-measured captures.
+    row = chip_table.find_row(
+        chip_table.load_chip_table(), "9_device_floor"
     )
-    try:
-        with open(table_path) as f:
-            table = json.load(f)
-        if table.get("measured_on_accelerator"):
-            for row in table.get("table", []):
-                if row.get("config") == "9_device_floor":
-                    xo = row.get("measured_crossover_lanes")
-                    if isinstance(xo, int) and xo >= 2:
-                        return xo
-                    rows = row.get("rows") or []
-                    max_n = max(
-                        (r.get("n", 0) for r in rows), default=0
-                    )
-                    if xo is None and max_n >= 2048:
-                        # The chip WAS measured, the sweep covered real
-                        # production sizes, and the device never beat
-                        # the host: route everything host rather than
-                        # trusting the static guess (round-4 verdict
-                        # task 4 — 768 can be wrong both ways). A tiny
-                        # or truncated sweep (max n < 2048) must NOT
-                        # poison the knob.
-                        return 1 << 30
-    except (OSError, ValueError):
-        pass
+    if row is not None:
+        xo = row.get("measured_crossover_lanes")
+        if isinstance(xo, int) and xo >= 2:
+            return xo
+        rows = row.get("rows") or []
+        max_n = max((r.get("n", 0) for r in rows), default=0)
+        if xo is None and max_n >= 2048:
+            # The chip WAS measured, the sweep covered real production
+            # sizes, and the device never beat the host: route
+            # everything host rather than trusting the static guess
+            # (round-4 verdict task 4 — 768 can be wrong both ways). A
+            # tiny or truncated sweep (max n < 2048) must NOT poison
+            # the knob.
+            return 1 << 30
     return _DEFAULT_HOST_BATCH_THRESHOLD
 
 

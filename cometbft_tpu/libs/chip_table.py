@@ -1,0 +1,57 @@
+"""Accelerator-measured bench table loading — the one home for every
+measured knob's data source.
+
+bench.py persists each chip-measured capture to the repo-root
+``BENCH_CHIP_TABLE.json``; the knobs that steer production off it
+(crypto/batch.HOST_BATCH_THRESHOLD's crossover tier,
+ops/verify's auto pallas-flavor selection) load it through here so the
+resolution rules, the accelerator-trust gate, and the malformed-file
+robustness cannot drift between consumers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def load_chip_table():
+    """The last ACCELERATOR-measured bench table as a dict, or None.
+
+    Resolution: the ``COMETBFT_TPU_CHIP_TABLE`` env override, else the
+    repo-root ``BENCH_CHIP_TABLE.json`` (anchored — a CWD-relative open
+    would silently miss the table for any process not started in the
+    repo root, and trust an unrelated same-named file that is).
+    Host-fallback tables (``measured_on_accelerator`` false) return
+    None: they must never steer a measured knob. Malformed files (parse
+    errors, non-dict shapes) also return None rather than raise — the
+    knobs they feed sit on every verify dispatch path.
+    """
+    path = os.environ.get("COMETBFT_TPU_CHIP_TABLE") or os.path.join(
+        _REPO_ROOT, "BENCH_CHIP_TABLE.json"
+    )
+    try:
+        with open(path) as f:
+            table = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(table, dict) and table.get("measured_on_accelerator"):
+        return table
+    return None
+
+
+def find_row(table, config: str):
+    """The named config row of a loaded table, or None."""
+    if not isinstance(table, dict):
+        return None
+    rows = table.get("table")
+    if not isinstance(rows, list):
+        return None
+    for row in rows:
+        if isinstance(row, dict) and row.get("config") == config:
+            return row
+    return None

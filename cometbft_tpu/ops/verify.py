@@ -758,36 +758,24 @@ def _measured_pallas_flavor():
     global _MEASURED_FLAVOR
     if _MEASURED_FLAVOR is not _UNSET:
         return _MEASURED_FLAVOR
-    import json
-    import os
+    from ..libs import chip_table
 
     flavor = None
-    path = os.environ.get("COMETBFT_TPU_CHIP_TABLE") or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
-        "BENCH_CHIP_TABLE.json",
-    )
-    try:
-        with open(path) as f:
-            table = json.load(f)
-        if table.get("measured_on_accelerator"):
-            for row in table.get("table", []):
-                if row.get("config") != "10_kernel_ab":
-                    continue
-                best = {}
-                for fl in ("pallas", "pallas8"):
-                    vals = [
-                        v
-                        for k, v in row.items()
-                        if k.startswith(fl + "_")
-                        and k.endswith("_sigs_per_sec")
-                        and isinstance(v, (int, float))
-                    ]
-                    if vals:
-                        best[fl] = max(vals)
-                if best:
-                    flavor = max(best, key=best.get)
-    except (OSError, ValueError):
-        pass
+    row = chip_table.find_row(chip_table.load_chip_table(), "10_kernel_ab")
+    if row is not None:
+        best = {}
+        for fl in ("pallas", "pallas8"):
+            vals = [
+                v
+                for k, v in row.items()
+                if k.startswith(fl + "_")
+                and k.endswith("_sigs_per_sec")
+                and isinstance(v, (int, float))
+            ]
+            if vals:
+                best[fl] = max(vals)
+        if best:
+            flavor = max(best, key=best.get)
     _MEASURED_FLAVOR = flavor
     return flavor
 
