@@ -517,12 +517,19 @@ def bench_device_floor():
                 if ov._pallas_wanted() and size >= ov._PALLAS_MIN_LANES
                 else []
             )
-            dev_buf = jax.device_put(bufp[:, : min(size, ov._CHUNK)])
-            dev_buf.block_until_ready()
             fn = None
             for probe_try in [*cands, ov._xla_which()]:
                 try:
                     fn = ov._jitted_kernel(probe_try)
+                    # fresh device buffer per attempt: the kernels jit
+                    # with input donation on TPU, so a faulting
+                    # candidate consumes its warm buffer — reusing one
+                    # would fail every later candidate on a deleted
+                    # Array and defeat this fallback chain
+                    dev_buf = jax.device_put(
+                        bufp[:, : min(size, ov._CHUNK)]
+                    )
+                    dev_buf.block_until_ready()
                     fn(dev_buf).block_until_ready()  # warm
                     probe_kernel = probe_try
                     break

@@ -39,6 +39,17 @@ def canonical_block_id(block_id) -> bytes:
     )
 
 
+# One consensus round encodes O(validators) CanonicalVotes that differ
+# ONLY in the timestamp field: the constant prefix (type|height|round|
+# block-id) and suffix (chain-id) are cached per round context so the
+# batch-ingest hot path (types/vote_set.add_votes_batch) re-encodes just
+# the timestamp. Tiny working set (a handful of contexts per height);
+# cleared wholesale when it grows past the bound. Byte-equality with the
+# uncached encoding is pinned by tests.
+_SIGN_TEMPLATE_CACHE: dict = {}
+_SIGN_TEMPLATE_BOUND = 64
+
+
 def vote_sign_bytes(
     chain_id: str,
     msg_type: int,
@@ -48,14 +59,34 @@ def vote_sign_bytes(
     timestamp_ns: int,
 ) -> bytes:
     """CanonicalVote sign bytes (types/vote.go:139, canonical.proto:30-37)."""
-    cbid = canonical_block_id(block_id)
+    bid_key = (
+        None
+        if block_id is None or block_id.is_nil()
+        else (
+            bytes(block_id.hash),
+            block_id.part_set_header.total,
+            bytes(block_id.part_set_header.hash),
+        )
+    )
+    key = (chain_id, msg_type, height, round_, bid_key)
+    tpl = _SIGN_TEMPLATE_CACHE.get(key)
+    if tpl is None:
+        cbid = canonical_block_id(block_id)
+        tpl = (
+            proto.field_varint(1, msg_type)
+            + proto.field_sfixed64(2, height)
+            + proto.field_sfixed64(3, round_)
+            + proto.field_message(4, cbid),
+            proto.field_string(6, chain_id),
+        )
+        if len(_SIGN_TEMPLATE_CACHE) >= _SIGN_TEMPLATE_BOUND:
+            _SIGN_TEMPLATE_CACHE.clear()
+        _SIGN_TEMPLATE_CACHE[key] = tpl
+    prefix, suffix = tpl
     body = (
-        proto.field_varint(1, msg_type)
-        + proto.field_sfixed64(2, height)
-        + proto.field_sfixed64(3, round_)
-        + proto.field_message(4, cbid)
+        prefix
         + proto.field_message(5, proto.timestamp(timestamp_ns), always=True)
-        + proto.field_string(6, chain_id)
+        + suffix
     )
     return proto.delimited(body)
 

@@ -283,3 +283,45 @@ class TestPartSetHashInputs:
         ps = PartSet.from_data(b"", part_size=4)
         assert ps.header.total == 1
         assert ps.header.hash == hashlib.sha256(b"\x00").digest()
+
+
+def test_vote_sign_bytes_template_cache_byte_equality():
+    """The per-round template cache must emit the exact bytes of an
+    uncached encoding across every field variation (incl. nil block id,
+    negative rounds, zero time, cache eviction)."""
+    from cometbft_tpu.types import canonical, proto
+    from cometbft_tpu.types.block import BlockID, PartSetHeader
+
+    def fresh(chain_id, t, h, r, bid, ts):
+        cbid = canonical.canonical_block_id(bid)
+        body = (
+            proto.field_varint(1, t)
+            + proto.field_sfixed64(2, h)
+            + proto.field_sfixed64(3, r)
+            + proto.field_message(4, cbid)
+            + proto.field_message(5, proto.timestamp(ts), always=True)
+            + proto.field_string(6, chain_id)
+        )
+        return proto.delimited(body)
+
+    bid = BlockID(
+        hash=b"\xab" * 32,
+        part_set_header=PartSetHeader(total=3, hash=b"\xcd" * 32),
+    )
+    nil = BlockID(hash=b"", part_set_header=PartSetHeader(total=0, hash=b""))
+    cases = [
+        ("chain-a", 1, 5, 0, bid, 1_700_000_000_000_000_000),
+        ("chain-a", 2, 5, 0, bid, 1_700_000_000_000_000_001),
+        ("chain-a", 2, 5, 0, nil, 1_700_000_000_000_000_002),
+        ("chain-b", 1, 2**40, 7, bid, 0),
+        ("chain-a", 2, 5, -1, None, 999_999_999),
+    ]
+    canonical._SIGN_TEMPLATE_CACHE.clear()
+    for args in cases:
+        assert canonical.vote_sign_bytes(*args) == fresh(*args), args
+        # second call rides the template — still byte-identical
+        assert canonical.vote_sign_bytes(*args) == fresh(*args), args
+    # eviction path: overflow the bound, then re-encode correctly
+    for i in range(canonical._SIGN_TEMPLATE_BOUND + 3):
+        args = ("chain-%d" % i, 1, i, 0, bid, 123456789 + i)
+        assert canonical.vote_sign_bytes(*args) == fresh(*args)
