@@ -169,13 +169,58 @@ def _abci_misbehavior(evidence_list, state: State) -> list[abci.Misbehavior]:
     return out
 
 
+def validate_validator_updates(
+    updates: list[abci.ValidatorUpdate], validator_params
+) -> None:
+    """Reject app validator updates the consensus layer can't carry
+    (state/execution.go:515-535 validateValidatorUpdates): negative
+    power, key types outside ConsensusParams.validator.pub_key_types,
+    and — beyond the params check — types the tendermint.crypto
+    .PublicKey oneof cannot wire-encode at all (the valset hash would
+    otherwise crash the FSM at the next header; same gate as genesis,
+    types/genesis.py)."""
+    from ..types.validator_set import pubkey_proto_encode
+
+    allowed = tuple(validator_params.pub_key_types)
+    for vu in updates:
+        if vu.power < 0:
+            raise ValueError(f"voting power can't be negative: {vu!r}")
+        if vu.power == 0:
+            continue  # removal: no pubkey to admit
+        if vu.pub_key_type not in allowed:
+            raise ValueError(
+                f"validator update uses pubkey type {vu.pub_key_type!r},"
+                f" which is unsupported for consensus (allowed:"
+                f" {allowed})"
+            )
+        pk = crypto_keys.pubkey_from_type_and_bytes(
+            vu.pub_key_type, vu.pub_key_bytes
+        )
+        try:
+            pubkey_proto_encode(pk)
+        except ValueError as e:
+            raise ValueError(
+                f"validator update key not wire-encodable: {e}"
+            ) from e
+
+
 def validator_updates_to_validators(updates: list[abci.ValidatorUpdate]):
-    """ABCI ValidatorUpdate list → Validator list (power 0 = removal)."""
+    """ABCI ValidatorUpdate list → Validator list (power 0 = removal).
+
+    Rejects key types the tendermint.crypto.PublicKey oneof cannot
+    carry: the reference's converter fails identically inside
+    PubKeyFromProto (crypto/encoding/codec.go:41-63), which also guards
+    its InitChain/replay path — without this, a non-wire key admitted
+    here would crash the FSM at the next validator-set hash."""
+    from ..types.validator_set import pubkey_proto_encode
+
     out = []
     for vu in updates:
         pk = crypto_keys.pubkey_from_type_and_bytes(
             vu.pub_key_type, vu.pub_key_bytes
         )
+        if vu.power != 0:
+            pubkey_proto_encode(pk)  # ValueError for non-wire types
         out.append(Validator(pub_key=pk, voting_power=vu.power))
     return out
 
@@ -364,6 +409,11 @@ class BlockExecutor:
         next_vals = state.next_validators.copy()
         last_height_vals_changed = state.last_height_validators_changed
         if resp.validator_updates:
+            # validated against the params IN FORCE for this height
+            # (the reference passes state.ConsensusParams.Validator)
+            validate_validator_updates(
+                resp.validator_updates, state.consensus_params.validator
+            )
             changes = validator_updates_to_validators(resp.validator_updates)
             next_vals.update_with_change_set(changes)
             last_height_vals_changed = height + 1 + 1

@@ -80,6 +80,26 @@ codec.register_adapter(
     lambda raw: keys.Ed25519PubKey(raw),
 )
 
+# Every supported validator key type must round-trip through the codec:
+# validator sets carrying them appear in consensus WAL messages, state
+# snapshots, genesis docs, and light blocks (a mixed ed25519+sr25519 set
+# is a first-class consensus citizen here — crypto/batch.MixedBatchVerifier).
+from ..crypto.secp256k1 import Secp256k1PubKey  # noqa: E402
+from ..crypto.sr25519 import Sr25519PubKey  # noqa: E402
+
+codec.register_adapter(
+    Sr25519PubKey,
+    "sr25519.pub",
+    lambda pk: pk.bytes(),
+    lambda raw: Sr25519PubKey(raw),
+)
+codec.register_adapter(
+    Secp256k1PubKey,
+    "secp256k1.pub",
+    lambda pk: pk.bytes(),
+    lambda raw: Secp256k1PubKey(raw),
+)
+
 
 def _valset_enc(vs: ValidatorSet) -> dict:
     return {

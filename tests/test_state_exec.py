@@ -269,3 +269,48 @@ def test_finalize_block_response_persisted(rig):
     assert resp is not None
     assert len(resp.tx_results) == 2
     assert all(r.code == 0 for r in resp.tx_results)
+
+
+def test_validator_updates_rejected_outside_pub_key_types():
+    """App validator updates must pass the consensus-params key-type
+    gate and wire-encodability (state/execution.go:515-535): an
+    sr25519 update would otherwise crash the FSM at the next valset
+    hash."""
+    import pytest
+
+    from cometbft_tpu.abci.types import ValidatorUpdate
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.crypto.sr25519 import Sr25519PrivKey
+    from cometbft_tpu.state.execution import validate_validator_updates
+    from cometbft_tpu.types.params import ValidatorParams
+
+    params = ValidatorParams()  # default: ed25519 only
+    ed = Ed25519PrivKey.from_seed(b"\x21" * 32).pub_key()
+    ok = ValidatorUpdate(
+        pub_key_type="ed25519", pub_key_bytes=ed.data, power=5
+    )
+    validate_validator_updates([ok], params)
+    # removal of any type is fine (no pubkey to admit)
+    validate_validator_updates(
+        [ValidatorUpdate(pub_key_type="sr25519", pub_key_bytes=b"",
+                         power=0)], params
+    )
+    with pytest.raises(ValueError, match="negative"):
+        validate_validator_updates(
+            [ValidatorUpdate(pub_key_type="ed25519",
+                             pub_key_bytes=ed.data, power=-1)], params
+        )
+    sr = Sr25519PrivKey.from_seed(b"\x22" * 32).pub_key()
+    with pytest.raises(ValueError, match="unsupported for consensus"):
+        validate_validator_updates(
+            [ValidatorUpdate(pub_key_type="sr25519",
+                             pub_key_bytes=sr.data, power=5)], params
+        )
+    # params naming a non-wire type still can't smuggle it past the
+    # proto gate
+    loose = ValidatorParams(pub_key_types=("ed25519", "sr25519"))
+    with pytest.raises(ValueError, match="not wire-encodable"):
+        validate_validator_updates(
+            [ValidatorUpdate(pub_key_type="sr25519",
+                             pub_key_bytes=sr.data, power=5)], loose
+        )

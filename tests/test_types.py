@@ -499,3 +499,56 @@ class TestVerifyCommitMixedKeys:
         )
         with pytest.raises(VerificationError, match="wrong signature"):
             verify_commit(CHAIN_ID, vals, bid, 5, commit)
+
+
+class TestValidatorKeyWireScope:
+    """The tendermint.crypto.PublicKey oneof carries only ed25519 and
+    secp256k1 (keys.proto; the reference's PubKeyToProto errors for
+    anything else, crypto/encoding/codec.go:20-38): sr25519 stays a
+    crypto/batch citizen but cannot be a wire-encodable validator key,
+    and genesis must say so clearly instead of crashing the FSM at the
+    first validator-set hash."""
+
+    def test_valset_hash_rejects_sr25519(self):
+        from cometbft_tpu.crypto.sr25519 import Sr25519PrivKey
+
+        pk = Sr25519PrivKey.from_seed(b"\x09" * 32).pub_key()
+        vs = ValidatorSet([Validator(pub_key=pk, voting_power=1)])
+        with pytest.raises(ValueError, match="unsupported key type"):
+            vs.hash()
+
+    def test_genesis_rejects_sr25519_validator_early(self):
+        from cometbft_tpu.crypto.sr25519 import Sr25519PrivKey
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc,
+            GenesisValidator,
+        )
+
+        pv = Sr25519PrivKey.from_seed(b"\x0a" * 32)
+        doc = GenesisDoc(
+            chain_id="wire-scope",
+            genesis_time_ns=1,
+            validators=[
+                GenesisValidator(pub_key=pv.pub_key(), power=10)
+            ],
+        )
+        with pytest.raises(ValueError, match="not wire-encodable"):
+            doc.validate_and_complete()
+
+    def test_genesis_accepts_secp256k1_validator(self):
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc,
+            GenesisValidator,
+        )
+
+        pv = Secp256k1PrivKey.from_seed(b"\x0b" * 32)
+        doc = GenesisDoc(
+            chain_id="wire-scope",
+            genesis_time_ns=1,
+            validators=[
+                GenesisValidator(pub_key=pv.pub_key(), power=10)
+            ],
+        )
+        doc.validate_and_complete()  # proto-encodable: accepted
+        assert doc.validator_set().hash()
