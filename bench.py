@@ -191,11 +191,13 @@ def _cpu_batch_baseline(n: int = 4096) -> float:
 
 
 def _steady(fn, reps: int = 3) -> float:
+    """Warm once, then MIN over reps (since round 5; previously the
+    mean). One statistic everywhere: every vs_batch_baseline divides a
+    min-of-reps row by the min-of-reps baseline — mixing mean rows with
+    a min baseline would bias the ratios downward on any transient, and
+    the tunnel/host both have multi-second ones."""
     fn()  # warm-up: compile + caches
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        fn()
-    return (time.perf_counter() - t0) / reps
+    return _best(fn, reps)
 
 
 def _best(fn, reps: int) -> float:
@@ -1091,7 +1093,8 @@ def main() -> None:
             "openssl_single_sigs_per_sec": round(single, 1),
             "native_rlc_batch_sigs_per_sec": round(batch_baseline, 1),
             "note": "baseline MEASURED: native RLC multiscalar batch "
-            "(the voi algorithm), crypto/host_batch.py",
+            "(the voi algorithm), crypto/host_batch.py; all rows and "
+            "this baseline are min-of-reps since round 5",
         }
     )
 

@@ -23,7 +23,11 @@ from ..types.event_bus import (
     EventDataValidatorSetUpdates,
     NopEventBus,
 )
-from ..types.validator_set import Validator, ValidatorSet
+from ..types.validator_set import (
+    Validator,
+    ValidatorSet,
+    pubkey_proto_encode,
+)
 from ..crypto import keys as crypto_keys
 from .state import State, results_hash
 from .validation import BlockValidationError, validate_block
@@ -179,23 +183,27 @@ def validate_validator_updates(
     .PublicKey oneof cannot wire-encode at all (the valset hash would
     otherwise crash the FSM at the next header; same gate as genesis,
     types/genesis.py)."""
-    from ..types.validator_set import pubkey_proto_encode
-
     allowed = tuple(validator_params.pub_key_types)
     for vu in updates:
         if vu.power < 0:
             raise ValueError(f"voting power can't be negative: {vu!r}")
+        # Decode the key for removals too (the reference's converter
+        # does, PB2TM.ValidatorUpdates): a malformed removal must fail
+        # HERE with a validation error, not deep inside apply_block.
+        try:
+            pk = crypto_keys.pubkey_from_type_and_bytes(
+                vu.pub_key_type, vu.pub_key_bytes
+            )
+        except ValueError as e:
+            raise ValueError(f"invalid validator update key: {e}") from e
         if vu.power == 0:
-            continue  # removal: no pubkey to admit
+            continue  # removal: decoded, but no type admission needed
         if vu.pub_key_type not in allowed:
             raise ValueError(
                 f"validator update uses pubkey type {vu.pub_key_type!r},"
                 f" which is unsupported for consensus (allowed:"
                 f" {allowed})"
             )
-        pk = crypto_keys.pubkey_from_type_and_bytes(
-            vu.pub_key_type, vu.pub_key_bytes
-        )
         try:
             pubkey_proto_encode(pk)
         except ValueError as e:
@@ -212,8 +220,6 @@ def validator_updates_to_validators(updates: list[abci.ValidatorUpdate]):
     PubKeyFromProto (crypto/encoding/codec.go:41-63), which also guards
     its InitChain/replay path — without this, a non-wire key admitted
     here would crash the FSM at the next validator-set hash."""
-    from ..types.validator_set import pubkey_proto_encode
-
     out = []
     for vu in updates:
         pk = crypto_keys.pubkey_from_type_and_bytes(

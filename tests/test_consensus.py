@@ -686,3 +686,32 @@ class TestBatchedVoteIngest:
             voteset._verify_vote_signature(
                 spoofed, vs.validators[1].pub_key
             )
+
+
+def test_secp256k1_validator_produces_blocks():
+    """A secp256k1 validator (wire-encodable but with NO batch backend,
+    crypto/secp256k1.go) drives consensus through the per-vote verify
+    fallback in vote_set.add_votes_batch and _verify_single — the
+    non-batchable key path the mixed-batch work must keep working."""
+    from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+    from helpers import CHAIN_ID
+
+    pv = MockPV(Secp256k1PrivKey.from_seed(bytes([9]) * 32))
+    genesis = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pub_key=pv.get_pub_key(), power=10)],
+    )
+    genesis.validate_and_complete()
+    cs, parts = make_consensus_node(genesis, pv)
+    cs.start()
+    try:
+        assert wait_for_height(parts, 2, timeout=60), (
+            f"secp validator stalled at {parts['block_store'].height()}"
+        )
+        commit = parts["block_store"].load_block_commit(1)
+        assert commit is not None and len(commit.signatures) == 1
+    finally:
+        stop_node(cs, parts)

@@ -290,11 +290,24 @@ def test_validator_updates_rejected_outside_pub_key_types():
         pub_key_type="ed25519", pub_key_bytes=ed.data, power=5
     )
     validate_validator_updates([ok], params)
-    # removal of any type is fine (no pubkey to admit)
+    # removal of any decodable key is fine (no type admission needed)
+    sr_rm = Sr25519PrivKey.from_seed(b"\x23" * 32).pub_key()
     validate_validator_updates(
-        [ValidatorUpdate(pub_key_type="sr25519", pub_key_bytes=b"",
-                         power=0)], params
+        [ValidatorUpdate(pub_key_type="sr25519",
+                         pub_key_bytes=sr_rm.data, power=0)], params
     )
+    # ...but a malformed removal fails HERE, not deep inside apply
+    with pytest.raises(ValueError, match="invalid validator update key"):
+        validate_validator_updates(
+            [ValidatorUpdate(pub_key_type="sr25519", pub_key_bytes=b"",
+                             power=0)], params
+        )
+    with pytest.raises(ValueError, match="invalid validator update key"):
+        validate_validator_updates(
+            [ValidatorUpdate(pub_key_type="bls12381",
+                             pub_key_bytes=b"\x00" * 48, power=0)],
+            params,
+        )
     with pytest.raises(ValueError, match="negative"):
         validate_validator_updates(
             [ValidatorUpdate(pub_key_type="ed25519",
