@@ -76,6 +76,96 @@ def _sharded_verify(mesh: Mesh):
     )
 
 
+# One synchronous pallas-under-shard_map failure retires the path for the
+# process (per-mesh compile caches make retrying per call pointless).
+_SHARDED_PALLAS_BROKEN = False
+
+
+@lru_cache(maxsize=None)
+def _sharded_verify_pallas(mesh: Mesh):
+    """Sharded verify with the PALLAS kernel per shard (accelerators).
+
+    Mosaic custom calls are not SPMD-auto-partitionable, so the kernel
+    runs inside ``shard_map``: each device gets its (C_l, V_l) block,
+    flattens the commit axis into lanes, pads to the kernel's 512-lane
+    block constraint (static shapes — padding targets are computed at
+    trace time), and runs the VMEM-resident ladder. The per-commit
+    verdict's ``jnp.all`` stays OUTSIDE the shard_map, so XLA still
+    lowers it to the one-byte-per-commit ICI all-reduce. ~2.5x the XLA
+    program per chip (round-5 A/B) — this is the multi-chip projection
+    of that measured single-chip win.
+    """
+    from ..ops import pallas_verify
+    from jax.experimental.shard_map import shard_map
+
+    lead = P(None, AXIS_COMMIT, AXIS_SIG)
+    flat = P(AXIS_COMMIT, AXIS_SIG)
+
+    def local(y_a, sign_a, y_r, sign_r, s_nibs, kneg_nibs):
+        c_l, v_l = y_a.shape[-2], y_a.shape[-1]
+        n = c_l * v_l
+        target = n if n <= 512 else pad_to(n, 512)
+
+        def lanes(x):
+            x = x.reshape(*x.shape[:-2], n)
+            if target != n:
+                pad = [(0, 0)] * (x.ndim - 1) + [(0, target - n)]
+                x = jnp.pad(x, pad)
+            return x
+
+        ok = pallas_verify.verify_kernel(
+            lanes(y_a), lanes(sign_a), lanes(y_r), lanes(sign_r),
+            lanes(s_nibs), lanes(kneg_nibs), interpret=False,
+        )
+        return ok[:n].reshape(c_l, v_l)
+
+    sm = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(lead, flat, lead, flat, lead, lead),
+        out_specs=flat,
+        check_rep=False,
+    )
+
+    def step(y_a, sign_a, y_r, sign_r, s_nibs, kneg_nibs):
+        ok = sm(y_a, sign_a, y_r, sign_r, s_nibs, kneg_nibs)
+        return ok, jnp.all(ok, axis=-1)
+
+    return jax.jit(step)
+
+
+def _dispatch_sharded(mesh: Mesh, args, lanes_per_shard: int):
+    """Pallas-per-shard on accelerator backends, the portable XLA
+    program otherwise (CPU virtual meshes: interpret mode is far too
+    slow). Returns MATERIALIZED (ok, verdict) ndarrays: jit dispatch is
+    asynchronous, so a Mosaic runtime fault only surfaces at
+    np.asarray — materializing inside the try is what lets it retire
+    the path and fall back (the multi-chip analog of
+    ops/verify._materialize). Honors the COMETBFT_TPU_KERNEL knob and
+    the 512-lane Mosaic floor via the single-chip selection helpers."""
+    global _SHARDED_PALLAS_BROKEN
+    from ..ops import verify as ov
+
+    if (
+        lanes_per_shard >= ov._PALLAS_MIN_LANES
+        and ov._pallas_wanted()
+        and not _SHARDED_PALLAS_BROKEN
+    ):
+        try:
+            ok, verdict = _sharded_verify_pallas(mesh)(*args)
+            return np.asarray(ok), np.asarray(verdict)
+        except Exception as e:
+            _SHARDED_PALLAS_BROKEN = True
+            from ..libs import log as _log
+
+            _log.default_logger().with_module("parallel.mesh").error(
+                "sharded pallas kernel failed; falling back to XLA",
+                err=repr(e)[:200],
+            )
+    ok, verdict = _sharded_verify(mesh)(*args)
+    return np.asarray(ok), np.asarray(verdict)
+
+
 def pad_to(n: int, multiple: int) -> int:
     return (n + multiple - 1) // multiple * multiple
 
@@ -118,13 +208,17 @@ def verify_sharded(
         pad = [(0, 0)] * (v.ndim - 2) + [(0, cp - n_commits), (0, vp - n_sigs)]
         shaped[k] = np.pad(v, pad)
     # pjit with in_shardings requires positional args.
-    ok, _ = _sharded_verify(mesh)(
-        shaped["y_a"],
-        shaped["sign_a"],
-        shaped["y_r"],
-        shaped["sign_r"],
-        shaped["s_nibs"],
-        shaped["kneg_nibs"],
+    ok, _ = _dispatch_sharded(
+        mesh,
+        (
+            shaped["y_a"],
+            shaped["sign_a"],
+            shaped["y_r"],
+            shaped["sign_r"],
+            shaped["s_nibs"],
+            shaped["kneg_nibs"],
+        ),
+        lanes_per_shard=(cp // c_dev) * (vp // v_dev),
     )
-    device_ok = np.asarray(ok)[:n_commits, :n_sigs]
+    device_ok = ok[:n_commits, :n_sigs]
     return device_ok & np.asarray(host_ok, bool).reshape(n_commits, n_sigs)

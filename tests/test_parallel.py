@@ -129,3 +129,65 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert np.asarray(out).all()
     ge.dryrun_multichip(min(8, len(jax.devices())))
+
+
+def test_sharded_dispatch_backend_selection(monkeypatch):
+    """_dispatch_sharded routes accelerators to the pallas-per-shard
+    path and everything else (CPU virtual meshes, COMETBFT_TPU_KERNEL
+    overrides, sub-512-lane shards) to the portable XLA program; a
+    pallas failure — including one surfacing at materialization —
+    retires the path and falls back instead of sinking the verify."""
+    import numpy as np
+
+    from cometbft_tpu.ops import verify as ov
+    from cometbft_tpu.parallel import mesh as pmesh
+
+    calls = []
+    pair = (np.ones((1, 2), bool), np.ones((1,), bool))
+
+    class FakeCallable:
+        def __init__(self, tag, fail=False):
+            self.tag, self.fail = tag, fail
+
+        def __call__(self, *args):
+            calls.append(self.tag)
+            if self.fail:
+                raise RuntimeError("mosaic balked")
+            return pair
+
+    def reset(pallas_wanted, fail=False):
+        calls.clear()
+        monkeypatch.setattr(ov, "_pallas_wanted", lambda: pallas_wanted)
+        monkeypatch.setattr(
+            pmesh, "_sharded_verify", lambda m: FakeCallable("xla")
+        )
+        monkeypatch.setattr(
+            pmesh,
+            "_sharded_verify_pallas",
+            lambda m: FakeCallable("pallas", fail=fail),
+        )
+        monkeypatch.setattr(pmesh, "_SHARDED_PALLAS_BROKEN", False)
+
+    # CPU / kernel-knob override: straight to XLA
+    reset(pallas_wanted=False)
+    pmesh._dispatch_sharded("mesh", (), lanes_per_shard=2048)
+    assert calls == ["xla"]
+
+    # accelerator: pallas first
+    reset(pallas_wanted=True)
+    pmesh._dispatch_sharded("mesh", (), lanes_per_shard=2048)
+    assert calls == ["pallas"]
+
+    # tiny per-shard lane counts stay off Mosaic (512-lane floor)
+    reset(pallas_wanted=True)
+    pmesh._dispatch_sharded("mesh", (), lanes_per_shard=8)
+    assert calls == ["xla"]
+
+    # pallas failure: falls back to XLA and retires the path
+    reset(pallas_wanted=True, fail=True)
+    pmesh._dispatch_sharded("mesh", (), lanes_per_shard=2048)
+    assert calls == ["pallas", "xla"]
+    assert pmesh._SHARDED_PALLAS_BROKEN
+    calls.clear()
+    pmesh._dispatch_sharded("mesh", (), lanes_per_shard=2048)
+    assert calls == ["xla"]  # retired: no pallas retry
