@@ -141,13 +141,26 @@ def _dispatch_sharded(mesh: Mesh, args, lanes_per_shard: int):
     asynchronous, so a Mosaic runtime fault only surfaces at
     np.asarray — materializing inside the try is what lets it retire
     the path and fall back (the multi-chip analog of
-    ops/verify._materialize). Honors the COMETBFT_TPU_KERNEL knob and
-    the 512-lane Mosaic floor via the single-chip selection helpers."""
+    ops/verify._materialize).
+
+    Knob semantics here: COMETBFT_TPU_KERNEL=xla|xla8 disables the
+    pallas branch (via _pallas_wanted); a pallas/pallas8 pin or auto
+    runs the 4-bit pallas LADDER per shard — the 8-bit-window kernels
+    take a different wire layout (s_bytes) than pack_inputs ships
+    (s_nibs), so flavor pins to them apply to the single-chip path
+    only. The backend gate is explicit: an off-accelerator pallas pin
+    must route to XLA, not attempt a Mosaic compile that retires the
+    path."""
     global _SHARDED_PALLAS_BROKEN
     from ..ops import verify as ov
 
+    try:
+        on_accel = jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        on_accel = False
     if (
-        lanes_per_shard >= ov._PALLAS_MIN_LANES
+        on_accel
+        and lanes_per_shard >= ov._PALLAS_MIN_LANES
         and ov._pallas_wanted()
         and not _SHARDED_PALLAS_BROKEN
     ):

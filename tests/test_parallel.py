@@ -155,9 +155,10 @@ def test_sharded_dispatch_backend_selection(monkeypatch):
                 raise RuntimeError("mosaic balked")
             return pair
 
-    def reset(pallas_wanted, fail=False):
+    def reset(pallas_wanted, fail=False, backend="tpu"):
         calls.clear()
         monkeypatch.setattr(ov, "_pallas_wanted", lambda: pallas_wanted)
+        monkeypatch.setattr(pmesh.jax, "default_backend", lambda: backend)
         monkeypatch.setattr(
             pmesh, "_sharded_verify", lambda m: FakeCallable("xla")
         )
@@ -168,10 +169,15 @@ def test_sharded_dispatch_backend_selection(monkeypatch):
         )
         monkeypatch.setattr(pmesh, "_SHARDED_PALLAS_BROKEN", False)
 
-    # CPU / kernel-knob override: straight to XLA
+    # kernel-knob override (xla/xla8): straight to XLA
     reset(pallas_wanted=False)
     pmesh._dispatch_sharded("mesh", (), lanes_per_shard=2048)
     assert calls == ["xla"]
+
+    # off-accelerator pallas pin: no Mosaic attempt, no retirement
+    reset(pallas_wanted=True, backend="cpu")
+    pmesh._dispatch_sharded("mesh", (), lanes_per_shard=2048)
+    assert calls == ["xla"] and not pmesh._SHARDED_PALLAS_BROKEN
 
     # accelerator: pallas first
     reset(pallas_wanted=True)

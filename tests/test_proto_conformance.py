@@ -325,3 +325,83 @@ def test_vote_sign_bytes_template_cache_byte_equality():
     for i in range(canonical._SIGN_TEMPLATE_BOUND + 3):
         args = ("chain-%d" % i, 1, i, 0, bid, 123456789 + i)
         assert canonical.vote_sign_bytes(*args) == fresh(*args)
+
+
+class TestSimpleValidatorEncoding:
+    """SimpleValidator leaves of the validator-set hash (validator.go:
+    117-133) and the tendermint.crypto.PublicKey oneof (keys.proto:
+    ed25519=1, secp256k1=2) — golden bytes hand-derived per the proto3
+    rules in this module's header. Consensus-critical: these leaves
+    feed Header.validators_hash."""
+
+    def test_ed25519_validator_leaf(self):
+        from cometbft_tpu.crypto.keys import Ed25519PubKey
+        from cometbft_tpu.types.validator_set import (
+            Validator,
+            pubkey_proto_encode,
+        )
+
+        pk = bytes(range(32))
+        # PublicKey oneof: field 1 (ed25519), wire 2 -> 0x0a, len 0x20
+        expect_pk = bytes([0x0A, 0x20]) + pk
+        assert pubkey_proto_encode(Ed25519PubKey(pk)) == expect_pk
+        # SimpleValidator: field 1 message (pubkey, len 34) +
+        # field 2 varint power. tag(1,2)=0x0a len=0x22; tag(2,0)=0x10,
+        # power 10 -> 0x0a.
+        v = Validator(pub_key=Ed25519PubKey(pk), voting_power=10)
+        assert v.bytes() == bytes([0x0A, 0x22]) + expect_pk + bytes(
+            [0x10, 0x0A]
+        )
+
+    def test_secp256k1_validator_leaf(self):
+        from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+        from cometbft_tpu.types.validator_set import (
+            Validator,
+            pubkey_proto_encode,
+        )
+
+        pub = Secp256k1PrivKey.from_seed(b"\x0c" * 32).pub_key()
+        data = pub.data
+        assert len(data) == 33  # compressed SEC1
+        # oneof field 2 (secp256k1), wire 2 -> tag 0x12, len 0x21
+        expect_pk = bytes([0x12, 0x21]) + data
+        assert pubkey_proto_encode(pub) == expect_pk
+        # power 300 varint = 0xAC 0x02; pubkey msg len = 35 = 0x23
+        v = Validator(pub_key=pub, voting_power=300)
+        assert v.bytes() == bytes([0x0A, 0x23]) + expect_pk + bytes(
+            [0x10, 0xAC, 0x02]
+        )
+
+    def test_valset_hash_is_merkle_of_leaves(self):
+        """validators_hash == RFC-6962 root over SimpleValidator leaves
+        in set order — independent hashlib oracle, like the commit-hash
+        fixture above."""
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+        from cometbft_tpu.types.validator_set import (
+            Validator,
+            ValidatorSet,
+        )
+
+        vals = ValidatorSet(
+            [
+                Validator(
+                    pub_key=Ed25519PrivKey.from_seed(
+                        bytes([i]) * 32
+                    ).pub_key(),
+                    voting_power=i,
+                )
+                for i in (1, 2, 3)
+            ]
+        )
+        leaves = [v.bytes() for v in vals.validators]
+
+        def leaf(b):
+            return hashlib.sha256(b"\x00" + b).digest()
+
+        def inner(l, r):
+            return hashlib.sha256(b"\x01" + l + r).digest()
+
+        # RFC 6962 for n=3: split at largest power of two < n -> (2, 1)
+        expect = inner(inner(leaf(leaves[0]), leaf(leaves[1])),
+                       leaf(leaves[2]))
+        assert vals.hash() == expect
