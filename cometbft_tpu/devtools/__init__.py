@@ -1,0 +1,6 @@
+"""Developer tooling that ships with the tree (lint, future codegen).
+
+Nothing here is imported by production modules — the package exists so
+invariant-enforcement tools version together with the code whose
+invariants they check.
+"""

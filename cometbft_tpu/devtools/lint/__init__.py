@@ -1,0 +1,40 @@
+"""cometlint: project-native static analysis for TPU hot-path and
+concurrency invariants.
+
+Run it over the tree::
+
+    python -m cometbft_tpu.devtools.lint
+
+Checkers (one CLNT code family each; docs/static-analysis.md has the
+full table and the suppression/baseline contract):
+
+==========  ==================  ==========================================
+code        checker             invariant
+==========  ==================  ==========================================
+CLNT001     lock-discipline     mutexes route through libs/sync so the
+                                deadlock tier can instrument them
+CLNT002     host-sync           no accidental device->host syncs in
+                                ops/ and parallel/
+CLNT003     dtype-discipline    no 64-bit dtypes in kernel modules
+CLNT004     jit-hygiene         no jax.jit in plain function bodies
+CLNT005     jit-hygiene         shape-like scalar args need static_argnames
+CLNT006     exception-hygiene   no swallowed failures in reactors/servers
+CLNT007     env-knob-registry   COMETBFT_* reads declared in config.py
+==========  ==================  ==========================================
+"""
+
+from .engine import (  # noqa: F401
+    Checker,
+    FileContext,
+    Finding,
+    declared_knobs_from_config,
+    iter_py_files,
+    lint_root,
+)
+from .baseline import (  # noqa: F401
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+    unjustified,
+)
+from .checkers import ALL_CHECKERS  # noqa: F401

@@ -1,0 +1,151 @@
+"""CLI runner: ``python -m cometbft_tpu.devtools.lint [roots...]``.
+
+Exit codes: 0 clean (or fully baselined), 1 findings, 2 usage/baseline
+errors. Default root is the installed ``cometbft_tpu`` package; default
+baseline is ``.cometlint-baseline.json`` next to the package (the repo
+root) when it exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    ALL_CHECKERS,
+    apply_baseline,
+    lint_root,
+    load_baseline,
+    save_baseline,
+    unjustified,
+)
+from .baseline import BaselineError
+
+
+def _default_root() -> str:
+    import cometbft_tpu
+
+    return os.path.dirname(os.path.abspath(cometbft_tpu.__file__))
+
+
+def _default_baseline(root: str, for_write: bool = False) -> str | None:
+    p = os.path.join(os.path.dirname(root), ".cometlint-baseline.json")
+    # read mode wants an EXISTING baseline; write mode is how the file
+    # gets bootstrapped, so the default path always applies there
+    return p if for_write or os.path.exists(p) else None
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cometbft_tpu.devtools.lint",
+        description="TPU hot-path / concurrency invariant linter",
+    )
+    ap.add_argument(
+        "roots",
+        nargs="*",
+        help="package roots to lint (default: the cometbft_tpu package)",
+    )
+    ap.add_argument(
+        "--baseline",
+        help="baseline JSON (default: <repo>/.cometlint-baseline.json "
+        "when linting the default root)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline file",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0 "
+        "(existing justifications are preserved; new entries get a "
+        "FIXME placeholder that the tier-1 gate rejects)",
+    )
+    ap.add_argument(
+        "--list-checkers", action="store_true", help="list checkers and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for c in ALL_CHECKERS:
+            print(f"{'/'.join(c.codes):18s} {c.name}: {c.description}")
+        return 0
+
+    roots = args.roots or [_default_root()]
+    baseline_path = args.baseline
+    if baseline_path is None and not args.roots:
+        baseline_path = _default_baseline(
+            roots[0], for_write=args.write_baseline
+        )
+    if args.no_baseline:
+        baseline_path = None
+
+    findings, errors = [], []
+    for root in roots:
+        if not os.path.isdir(root):
+            print(f"error: not a directory: {root}", file=sys.stderr)
+            return 2
+        f, e = lint_root(root, ALL_CHECKERS)
+        findings.extend(f)
+        errors.extend(e)
+
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        if baseline_path is None:
+            print("error: --write-baseline needs --baseline", file=sys.stderr)
+            return 2
+        save_baseline(baseline_path, findings)
+        print(f"wrote {len(findings)} entr(ies) to {baseline_path}")
+        return 0
+
+    stale: list[dict] = []
+    bad_justifications: list[dict] = []
+    if baseline_path is not None:
+        try:
+            bl = load_baseline(baseline_path)
+        except (OSError, BaselineError, json.JSONDecodeError) as e:
+            print(f"error: baseline: {e}", file=sys.stderr)
+            return 2
+        findings, matched, stale = apply_baseline(findings, bl)
+        bad_justifications = unjustified(matched)
+
+    for f in findings:
+        print(f.render())
+    for e in stale:
+        print(
+            f"warning: stale baseline entry {e['path']}:{e['line']}: "
+            f"{e['code']} (fixed? delete it)",
+            file=sys.stderr,
+        )
+    for e in bad_justifications:
+        print(
+            f"error: baseline entry {e['path']}:{e['line']}: {e['code']} "
+            "has no written justification",
+            file=sys.stderr,
+        )
+
+    if findings or errors or bad_justifications:
+        n = len(findings)
+        print(
+            f"cometlint: {n} finding(s)"
+            + (f", {len(errors)} file error(s)" if errors else "")
+            + (
+                f", {len(bad_justifications)} unjustified baseline "
+                "entr(ies)"
+                if bad_justifications
+                else ""
+            ),
+            file=sys.stderr,
+        )
+        return 1
+    print("cometlint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Baseline (grandfather) file for cometlint.
+
+The baseline is the escape hatch for findings that are KNOWN, justified
+and deliberately not fixed — each entry must carry a written
+``justification`` (the tier-1 gate rejects placeholder text). Matching
+is exact on (path, line, code): when the code around a baselined
+finding moves, the entry goes stale and the CLI reports it so the file
+shrinks instead of rotting.
+
+Format (JSON, stable key order for reviewable diffs)::
+
+    {"version": 1,
+     "entries": [{"path": "...", "line": 12, "code": "CLNT002",
+                  "message": "...", "justification": "..."}]}
+"""
+
+from __future__ import annotations
+
+import json
+
+from .engine import Finding
+
+PLACEHOLDER = "FIXME: add justification"
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path: str) -> dict[tuple[str, int, str], dict]:
+    """Load entries keyed by (path, line, code). Raises BaselineError on
+    structural problems; missing justifications load fine (the CLI and
+    the tier-1 gate decide how strict to be)."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("version") != 1:
+        raise BaselineError(f"{path}: unsupported baseline format")
+    out: dict[tuple[str, int, str], dict] = {}
+    for e in data.get("entries", []):
+        try:
+            key = (str(e["path"]), int(e["line"]), str(e["code"]))
+        except (KeyError, TypeError, ValueError) as exc:
+            raise BaselineError(f"{path}: malformed entry {e!r}") from exc
+        out[key] = e
+    return out
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    """Write ``findings`` as a fresh baseline, preserving justifications
+    of entries that already exist in the file."""
+    try:
+        old = load_baseline(path)
+    except (OSError, BaselineError, json.JSONDecodeError):
+        old = {}
+    entries = []
+    for f in sorted(findings, key=lambda f: f.key()):
+        prev = old.get(f.key(), {})
+        entries.append(
+            {
+                "path": f.path,
+                "line": f.line,
+                "code": f.code,
+                "message": f.message,
+                "justification": prev.get("justification", PLACEHOLDER),
+            }
+        )
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[tuple[str, int, str], dict]
+) -> tuple[list[Finding], list[dict], list[dict]]:
+    """Split findings against the baseline.
+
+    Returns (new_findings, matched_entries, stale_entries): new findings
+    fail the run, matched entries are the baseline earning its keep,
+    stale entries no longer correspond to any finding and should be
+    deleted from the file.
+    """
+    new: list[Finding] = []
+    matched: list[dict] = []
+    used: set[tuple[str, int, str]] = set()
+    for f in findings:
+        e = baseline.get(f.key())
+        if e is None:
+            new.append(f)
+        else:
+            matched.append(e)
+            used.add(f.key())
+    stale = [e for k, e in baseline.items() if k not in used]
+    return new, matched, stale
+
+
+def unjustified(entries) -> list[dict]:
+    """Baseline entries whose justification is missing or placeholder."""
+    return [
+        e
+        for e in entries
+        if not str(e.get("justification", "")).strip()
+        or e.get("justification") == PLACEHOLDER
+    ]

@@ -1,0 +1,19 @@
+"""Checker registry. A new checker = one module here + one list entry
+(+ a row in docs/static-analysis.md and fixtures in tests/test_lint.py).
+"""
+
+from .locks import LockDisciplineChecker
+from .hostsync import HostSyncChecker
+from .dtypes import DtypeDisciplineChecker
+from .jit import JitHygieneChecker
+from .excepts import ExceptionHygieneChecker
+from .envknobs import EnvKnobChecker
+
+ALL_CHECKERS = (
+    LockDisciplineChecker(),
+    HostSyncChecker(),
+    DtypeDisciplineChecker(),
+    JitHygieneChecker(),
+    ExceptionHygieneChecker(),
+    EnvKnobChecker(),
+)

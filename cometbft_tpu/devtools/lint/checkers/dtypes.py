@@ -1,0 +1,60 @@
+"""CLNT003 dtype-discipline: no 64-bit dtypes in kernel modules.
+
+The field arithmetic (ops/field.py) is built on 13-bit limbs in int32
+precisely so the TPU VPU never needs int64 emulation, and jax on TPU
+silently truncates 64-bit dtypes unless ``jax_enable_x64`` is set —
+either way an ``int64``/``uint64``/``float64`` reaching a kernel module
+is a correctness or performance landmine. Host-side staging arrays
+(numpy buffers that never ship to the device, e.g. ops/verify.py's
+message byte offsets) are allowlisted with a ``# host-staging: reason``
+marker on the statement.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Checker, FileContext, Finding
+
+_KERNEL_PREFIXES = ("ops/", "parallel/")
+_DTYPES = {"int64", "uint64", "float64"}
+
+
+class DtypeDisciplineChecker(Checker):
+    codes = ("CLNT003",)
+    name = "dtype-discipline"
+    description = (
+        "int64/uint64/float64 forbidden in Pallas/XLA kernel modules; "
+        "host-side staging arrays need a '# host-staging:' marker"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath.startswith(_KERNEL_PREFIXES)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            hit = None
+            if isinstance(node, ast.Attribute) and node.attr in _DTYPES:
+                hit = node.attr
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in _DTYPES
+            ):
+                hit = node.value
+            if hit is None:
+                continue
+            if ctx.host_staged(node) or ctx.suppressed(node, "CLNT003"):
+                continue
+            findings.append(
+                ctx.finding(
+                    node,
+                    "CLNT003",
+                    f"64-bit dtype '{hit}' in a kernel module — the "
+                    "limb schedule is int32-only (no int64 emulation "
+                    "on the VPU); mark genuine host buffers with "
+                    "'# host-staging: <reason>'",
+                )
+            )
+        return findings

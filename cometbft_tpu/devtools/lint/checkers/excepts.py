@@ -1,0 +1,79 @@
+"""CLNT006 exception-hygiene: swallowed failures in reactors/servers.
+
+Reactors and the ABCI/RPC servers are long-running message loops: a
+``bare except:`` or ``except Exception: pass`` there turns a real fault
+(a peer crashing the codec, an application handler raising) into a
+silently dead or wedged service — the engine keeps looking alive while
+a reactor thread has stopped doing its job. Failures must at minimum be
+logged; intentional swallows carry an inline suppression saying why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Checker, FileContext, Finding
+
+# long-running message-loop modules: every */reactor.py plus the servers
+_SERVER_FILES = {
+    "p2p/base_reactor.py",
+    "abci/server.py",
+    "abci/grpc.py",
+    "abci/socket_client.py",
+    "rpc/jsonrpc/server.py",
+    "rpc/grpc_api.py",
+}
+_BROAD = {"Exception", "BaseException"}
+
+
+class ExceptionHygieneChecker(Checker):
+    codes = ("CLNT006",)
+    name = "exception-hygiene"
+    description = (
+        "bare except / except Exception: pass in reactors and the "
+        "ABCI/RPC servers (silently dead message loops)"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return (
+            ctx.relpath.endswith("/reactor.py")
+            or ctx.relpath in _SERVER_FILES
+        )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            msg = None
+            if node.type is None:
+                msg = (
+                    "bare 'except:' in a reactor/server catches "
+                    "KeyboardInterrupt and SystemExit too — name the "
+                    "exception and log it"
+                )
+            elif self._broad(node.type) and self._body_is_pass(node):
+                msg = (
+                    "'except Exception: pass' swallows reactor/server "
+                    "failures — log the error (or suppress with a "
+                    "reason if dropping it is the contract)"
+                )
+            if msg is None or ctx.suppressed(node, "CLNT006"):
+                continue
+            findings.append(ctx.finding(node, "CLNT006", msg))
+        return findings
+
+    @staticmethod
+    def _broad(t: ast.expr) -> bool:
+        names = []
+        if isinstance(t, ast.Tuple):
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+        elif isinstance(t, ast.Name):
+            names = [t.id]
+        return any(n in _BROAD for n in names)
+
+    @staticmethod
+    def _body_is_pass(handler: ast.ExceptHandler) -> bool:
+        return len(handler.body) == 1 and isinstance(
+            handler.body[0], ast.Pass
+        )

@@ -1,0 +1,103 @@
+"""CLNT002 host-sync-in-hot-path: accidental device→host syncs in the
+per-vote verification path.
+
+``ops/`` and ``parallel/`` are the consensus hot path: their contract is
+async dispatch with exactly one sanctioned readback per launch
+(``ops.verify._materialize``). Anything that forces an early
+device→host transfer — ``block_until_ready()``, ``.item()``,
+``jax.device_get``, ``np.asarray`` on a device value, ``int()``/
+``float()`` of a device expression — serializes the pipeline and
+silently erases the overlap the bench trajectory depends on (the FPGA
+ECDSA-engine lesson: throughput holds only while the host never stalls
+the pipeline). Deliberate sync points carry an inline suppression
+naming themselves as such.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Checker, FileContext, Finding
+
+_HOT_PREFIXES = ("ops/", "parallel/")
+_SYNC_METHODS = {"block_until_ready", "item"}
+_NUMPY_ALIASES_DEFAULT = {"np", "numpy"}
+# host metadata attributes: subscripts of these never touch device data
+_META_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+class HostSyncChecker(Checker):
+    codes = ("CLNT002",)
+    name = "host-sync-in-hot-path"
+    description = (
+        "device->host syncs (block_until_ready, .item(), np.asarray, "
+        "jax.device_get, int()/float() of device expressions) flagged "
+        "inside ops/ and parallel/"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath.startswith(_HOT_PREFIXES)
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        np_aliases = set(_NUMPY_ALIASES_DEFAULT)
+        jax_aliases = {"jax"}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy" and a.asname:
+                        np_aliases.add(a.asname)
+                    if a.name == "jax" and a.asname:
+                        jax_aliases.add(a.asname)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = self._classify(node, np_aliases, jax_aliases)
+            if msg is None or ctx.suppressed(node, "CLNT002"):
+                continue
+            findings.append(ctx.finding(node, "CLNT002", msg))
+        return findings
+
+    def _classify(self, node: ast.Call, np_aliases, jax_aliases):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SYNC_METHODS and not node.args:
+                return (
+                    f".{fn.attr}() forces a device->host sync in the "
+                    "hot path — keep dispatch async and materialize "
+                    "through the sanctioned readback"
+                )
+            if isinstance(fn.value, ast.Name):
+                if fn.value.id in np_aliases and fn.attr == "asarray":
+                    return (
+                        "np.asarray on a device value blocks until the "
+                        "launch completes — hot-path code must "
+                        "materialize only at the sanctioned sync point"
+                    )
+                if fn.value.id in jax_aliases and fn.attr == "device_get":
+                    return (
+                        "jax.device_get forces a device->host transfer "
+                        "in the hot path"
+                    )
+        elif isinstance(fn, ast.Name) and fn.id in ("int", "float"):
+            if len(node.args) == 1 and self._devicey(node.args[0]):
+                return (
+                    f"{fn.id}() of a device expression synchronizes the "
+                    "stream — hoist the scalar to host once, outside "
+                    "the per-vote path"
+                )
+        return None
+
+    def _devicey(self, arg: ast.expr) -> bool:
+        """Heuristic: int()/float() of a call result or an array
+        subscript is treated as a potential device readback; names,
+        constants and arithmetic are host scalars. Subscripts of host
+        metadata (``x.shape[-1]``) are exempt."""
+        if isinstance(arg, ast.Call):
+            return True
+        if isinstance(arg, ast.Subscript):
+            base = arg.value
+            if isinstance(base, ast.Attribute) and base.attr in _META_ATTRS:
+                return False
+            return True
+        return False

@@ -1,0 +1,142 @@
+"""CLNT004/CLNT005 jit-hygiene: retrace-per-call and shape-arg traps.
+
+CLNT004 — ``jax.jit`` invoked inside a plain function body creates a
+fresh jitted callable (and a fresh trace cache) per call: every
+invocation retraces and recompiles. The sanctioned pattern in this tree
+is a module-level jit or an ``@lru_cache`` factory (ops/verify.py
+``_jitted_kernel``), which this checker recognizes and allows.
+
+CLNT005 — a jitted function taking a Python-scalar shape-like argument
+(``n``, ``size``, an ``int``-annotated parameter...) without declaring
+it in ``static_argnums``/``static_argnames`` traces the scalar as a
+dynamic value: shape-dependent control flow fails at trace time, or
+worse, every distinct value retraces.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Checker, FileContext, Finding
+
+_CACHE_DECORATORS = {"lru_cache", "cache", "cached_property"}
+_SHAPE_NAMES = {
+    "n", "m", "size", "count", "length", "width", "height", "depth",
+    "dim", "dims", "ndim", "shape", "batch", "bucket", "lanes", "chunk",
+}
+_SHAPE_PREFIXES = ("n_", "num_")
+
+
+def _decorator_is_cache(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    if isinstance(dec, ast.Attribute):
+        return dec.attr in _CACHE_DECORATORS
+    return isinstance(dec, ast.Name) and dec.id in _CACHE_DECORATORS
+
+
+class JitHygieneChecker(Checker):
+    codes = ("CLNT004", "CLNT005")
+    name = "jit-hygiene"
+    description = (
+        "jax.jit inside a plain function body (retrace per call) and "
+        "jitted functions taking scalar shape args without "
+        "static_argnames"
+    )
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        jax_aliases = {"jax"}
+        jit_names: set[str] = set()
+        funcdefs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" and a.asname:
+                        jax_aliases.add(a.asname)
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "jit":
+                        jit_names.add(a.asname or "jit")
+            elif isinstance(node, ast.FunctionDef):
+                funcdefs.setdefault(node.name, node)
+
+        def is_jit_call(call: ast.Call) -> bool:
+            fn = call.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "jit"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in jax_aliases
+            ):
+                return True
+            return isinstance(fn, ast.Name) and fn.id in jit_names
+
+        def visit(node: ast.AST, in_plain_function: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_in_plain = in_plain_function
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    memoized = any(
+                        _decorator_is_cache(d) for d in child.decorator_list
+                    )
+                    # an @lru_cache factory runs its body once per key —
+                    # a jit built there is cached, not per-call
+                    child_in_plain = not memoized
+                if isinstance(child, ast.Call) and is_jit_call(child):
+                    self._report(
+                        child, ctx, findings, funcdefs, in_plain_function
+                    )
+                visit(child, child_in_plain)
+
+        visit(ctx.tree, in_plain_function=False)
+        return findings
+
+    def _report(self, call, ctx, findings, funcdefs, inside_plain_fn):
+        if inside_plain_fn and not ctx.suppressed(call, "CLNT004"):
+            findings.append(
+                ctx.finding(
+                    call,
+                    "CLNT004",
+                    "jax.jit inside a function body retraces and "
+                    "recompiles per call — hoist to module level or an "
+                    "@lru_cache factory",
+                )
+            )
+        # CLNT005: jit(fn) where fn is a same-module def with shape-like
+        # scalar params and no static_arg* declaration
+        has_static = any(
+            kw.arg in ("static_argnums", "static_argnames")
+            for kw in call.keywords
+        )
+        if has_static or not call.args:
+            return
+        target = call.args[0]
+        if not isinstance(target, ast.Name):
+            return
+        fd = funcdefs.get(target.id)
+        if fd is None:
+            return
+        shapey = [
+            a.arg
+            for a in list(fd.args.args) + list(fd.args.kwonlyargs)
+            if self._shape_like(a)
+        ]
+        if shapey and not ctx.suppressed(call, "CLNT005"):
+            findings.append(
+                ctx.finding(
+                    call,
+                    "CLNT005",
+                    f"jitted function '{target.id}' takes scalar "
+                    f"shape-like arg(s) {shapey} without "
+                    "static_argnames — each distinct value retraces",
+                )
+            )
+
+    @staticmethod
+    def _shape_like(arg: ast.arg) -> bool:
+        if isinstance(arg.annotation, ast.Name) and arg.annotation.id == "int":
+            return True
+        name = arg.arg
+        return name in _SHAPE_NAMES or name.startswith(_SHAPE_PREFIXES)

@@ -1,0 +1,81 @@
+"""CLNT001 lock-discipline: raw ``threading`` primitives bypass the
+deadlock-detection tier.
+
+``libs/sync`` is the Python analog of CometBFT's ``go-deadlock`` build
+tag: every mutex constructed through ``libsync.Mutex``/``RLock``/
+``Condition`` flips to an instrumented lock under
+``COMETBFT_TPU_DEADLOCK=1`` and costs nothing otherwise. A raw
+``threading.Lock()`` is invisible to that tier — a wedged reactor
+holding one never shows up in the deadlock dump.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Checker, FileContext, Finding
+
+_PRIMITIVES = {"Lock", "RLock", "Condition"}
+_REPLACEMENT = {
+    "Lock": "Mutex",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+# The tier's own implementation is the one legitimate construction site.
+_EXEMPT = ("libs/sync.py",)
+
+
+class LockDisciplineChecker(Checker):
+    codes = ("CLNT001",)
+    name = "lock-discipline"
+    description = (
+        "threading.Lock/RLock/Condition outside libs/sync must be "
+        "constructed via cometbft_tpu.libs.sync so the deadlock tier "
+        "can instrument them"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.relpath not in _EXEMPT
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        threading_aliases = {"threading"}
+        direct_names: dict[str, str] = {}  # local name -> primitive
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        threading_aliases.add(a.asname or "threading")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "threading":
+                    for a in node.names:
+                        if a.name in _PRIMITIVES:
+                            direct_names[a.asname or a.name] = a.name
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            prim = None
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in threading_aliases
+                and fn.attr in _PRIMITIVES
+            ):
+                prim = fn.attr
+            elif isinstance(fn, ast.Name) and fn.id in direct_names:
+                prim = direct_names[fn.id]
+            if prim is None or ctx.suppressed(node, "CLNT001"):
+                continue
+            findings.append(
+                ctx.finding(
+                    node,
+                    "CLNT001",
+                    f"raw threading.{prim}() bypasses the deadlock tier"
+                    f" — use cometbft_tpu.libs.sync."
+                    f"{_REPLACEMENT[prim]}() (COMETBFT_TPU_DEADLOCK=1 "
+                    f"instrumentation)",
+                )
+            )
+        return findings

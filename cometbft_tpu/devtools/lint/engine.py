@@ -1,0 +1,217 @@
+"""cometlint engine: file walking, AST parsing, suppressions, reporting.
+
+A checker is a small class with a ``check(ctx)`` method returning
+:class:`Finding` objects; the engine owns everything else — one AST
+parse per file, inline-suppression bookkeeping, and the shared
+``file:line: CLNT0xx message`` report format — so adding a checker in a
+later PR is ~40 lines of visitor (docs/static-analysis.md has the
+recipe).
+
+Inline suppression (reason after ``--`` is REQUIRED; a bare disable is
+ignored so unexplained carve-outs cannot accumulate)::
+
+    self._raw = threading.Lock()  # cometlint: disable=CLNT001 -- why
+
+Host-staging marker (CLNT003 only — brands a 64-bit array as host-side
+staging that never ships to the device)::
+
+    offs = np.zeros(n + 1, np.uint64)  # host-staging: byte offsets
+
+Both markers cover the physical lines of the flagged statement plus a
+comment-only line directly above it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+
+SUPPRESS_RE = re.compile(
+    r"#\s*cometlint:\s*disable=([A-Z0-9,\s]+?)\s*--\s*(\S.*)$"
+)
+HOST_STAGING_RE = re.compile(r"#\s*host-staging:\s*(\S.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit. ``path`` is root-relative with forward slashes."""
+
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def key(self) -> tuple[str, int, str]:
+        return (self.path, self.line, self.code)
+
+
+class Checker:
+    """Base checker. Subclasses set ``codes`` (the CLNT ids they emit),
+    ``name`` and ``description``, and implement :meth:`check`.
+
+    ``applies`` gates on the file's root-relative path so scoped
+    invariants (hot path, reactors) never fire on unrelated modules.
+    """
+
+    codes: tuple[str, ...] = ()
+    name: str = ""
+    description: str = ""
+
+    def applies(self, ctx: "FileContext") -> bool:
+        return True
+
+    def check(self, ctx: "FileContext") -> list[Finding]:
+        raise NotImplementedError
+
+
+class FileContext:
+    """Parsed source + suppression maps for one file, shared by checkers."""
+
+    def __init__(
+        self,
+        relpath: str,
+        source: str,
+        declared_knobs: frozenset[str] | None = None,
+    ):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.relpath)
+        # env-knob registry (config.py ENV_KNOBS keys); None when the
+        # scanned root has no config.py — the envknobs checker treats
+        # that as an empty registry.
+        self.declared_knobs = declared_knobs
+        self._suppressed: dict[int, set[str]] = {}
+        self._host_staged: set[int] = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(text)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                self._suppressed.setdefault(i, set()).update(codes)
+            if HOST_STAGING_RE.search(text):
+                self._host_staged.add(i)
+
+    # -- marker queries ----------------------------------------------------
+
+    def _node_lines(self, node: ast.AST) -> range:
+        start = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", None) or start
+        # a contiguous block of comment-only lines directly above the
+        # statement also counts (multi-line justifications)
+        above = start - 1
+        while 1 <= above <= len(self.lines) and self.lines[
+            above - 1
+        ].lstrip().startswith("#"):
+            start = above
+            above -= 1
+        return range(start, end + 1)
+
+    def suppressed(self, node: ast.AST, code: str) -> bool:
+        return any(
+            code in self._suppressed.get(ln, ()) for ln in self._node_lines(node)
+        )
+
+    def host_staged(self, node: ast.AST) -> bool:
+        return any(ln in self._host_staged for ln in self._node_lines(node))
+
+    def finding(self, node: ast.AST, code: str, message: str) -> Finding:
+        return Finding(self.relpath, getattr(node, "lineno", 1), code, message)
+
+
+# ---------------------------------------------------------------- walking
+
+
+def iter_py_files(root: str):
+    """Yield (abspath, relpath) for every .py under root, sorted."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d
+            for d in dirnames
+            if not d.startswith(".") and d != "__pycache__"
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                ap = os.path.join(dirpath, fn)
+                yield ap, os.path.relpath(ap, root)
+
+
+def declared_knobs_from_config(config_path: str) -> frozenset[str] | None:
+    """Parse ``ENV_KNOBS = {...}`` keys out of a config.py, without
+    importing it. None when the file or the registry is absent."""
+    try:
+        with open(config_path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=config_path)
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "ENV_KNOBS":
+                if isinstance(value, ast.Dict):
+                    return frozenset(
+                        k.value
+                        for k in value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    )
+    return None
+
+
+def lint_root(
+    root: str,
+    checkers,
+    declared_knobs: frozenset[str] | None = None,
+) -> tuple[list[Finding], list[str]]:
+    """Run ``checkers`` over every .py under ``root``.
+
+    Returns (findings, errors) — errors are human-readable strings for
+    files that failed to parse (a syntax error in the tree is itself a
+    finding-worthy event, but not one attributable to a checker).
+    """
+    if declared_knobs is None:
+        declared_knobs = declared_knobs_from_config(
+            os.path.join(root, "config.py")
+        )
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for abspath, relpath in iter_py_files(root):
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+            ctx = FileContext(relpath, source, declared_knobs)
+        except (OSError, SyntaxError, UnicodeDecodeError) as e:
+            errors.append(f"{relpath}: unparseable: {e}")
+            continue
+        for checker in checkers:
+            if not checker.applies(ctx):
+                continue
+            for fnd in checker.check(ctx):
+                if not ctx.suppressed(
+                    _line_probe(fnd.line), fnd.code
+                ) and fnd not in findings:
+                    findings.append(fnd)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, errors
+
+
+class _line_probe:
+    """Minimal node stand-in so suppression checks work on a bare line
+    number (checkers already skip suppressed nodes themselves; this is
+    the engine-level backstop for checkers that forget)."""
+
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.end_lineno = lineno
