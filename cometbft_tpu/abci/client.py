@@ -11,6 +11,7 @@ behind one mutex — the default for a single-binary node.
 from __future__ import annotations
 
 import threading
+from ..libs import sync as libsync
 from typing import Callable
 
 from ..libs.service import BaseService
@@ -28,7 +29,7 @@ class ReqRes:
         self.error: Exception | None = None
         self._done = threading.Event()
         self._cb: Callable | None = None
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("abci.client._mtx")
 
     def set_callback(self, cb: Callable) -> None:
         """Fires on successful completion only; error completions surface
@@ -147,7 +148,7 @@ class LocalClient(Client):
     def __init__(self, app: Application, mtx: threading.RLock | None = None):
         super().__init__("local-abci-client")
         self.app = app
-        self.mtx = mtx or threading.RLock()
+        self.mtx = mtx or libsync.RLock("abci.client")
 
     def echo(self, msg: str) -> str:
         return msg

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import json
 import struct
-import threading
+from ..libs import sync as libsync
 
 from ..libs import db as dbm
 from . import types as abci
@@ -27,7 +27,7 @@ VALIDATOR_TX_PREFIX = b"val:"
 class KVStoreApplication(BaseApplication):
     def __init__(self, db: dbm.DB | None = None, snapshot_interval: int = 5):
         self.db = db if db is not None else dbm.MemDB()
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("abci.kvstore._mtx")
         self._staged: dict[bytes, bytes] = {}
         self._val_updates: list[abci.ValidatorUpdate] = []
         self._validators: dict[str, int] = {}  # pubkey hex -> power

@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+from ..libs import sync as libsync
 
 from ..libs.service import BaseService
 from . import codec
@@ -33,7 +34,7 @@ class SocketServer(BaseService):
         super().__init__("abci-socket-server")
         self.addr = addr
         self.app = app
-        self._app_mtx = threading.Lock()
+        self._app_mtx = libsync.Mutex("abci.server._app_mtx")
         self._listener: socket.socket | None = None
         self._conns: list[socket.socket] = []
         self._threads: list[threading.Thread] = []

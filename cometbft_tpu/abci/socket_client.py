@@ -12,7 +12,9 @@ from __future__ import annotations
 import queue
 import socket
 import threading
+from ..libs import sync as libsync
 
+from ..libs import log as _log
 from . import codec
 from . import types as abci
 from .client import Client, ReqRes
@@ -35,7 +37,7 @@ class SocketClient(Client):
         self._inflight: queue.Queue[ReqRes] = queue.Queue()
         # Guards the (_inflight, _send_q) enqueue pair: both queues must see
         # requests in the same order or FIFO response matching breaks.
-        self._queue_mtx = threading.Lock()
+        self._queue_mtx = libsync.Mutex("abci.socket_client._queue_mtx")
 
     def on_start(self) -> None:
         family, target = _parse_addr(self.addr)
@@ -135,8 +137,12 @@ class SocketClient(Client):
         if self.is_running():
             try:
                 self.stop()
-            except Exception:
-                pass
+            except Exception as e:  # CLNT006: teardown is best-effort,
+                # but a stop() failure during error handling is worth a
+                # line — it usually means a wedged reader thread
+                _log.default_logger().with_module("abci.socket_client").debug(
+                    "stop during error teardown failed", err=repr(e)[:120]
+                )
         if self._on_error is not None:
             self._on_error(err)
 

@@ -8,7 +8,7 @@ on timeout or bad data. The reactor consumes blocks strictly in order via
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 import time
 
 REQUEST_WINDOW = 20  # max heights in flight (pool.go maxPendingRequests≈)
@@ -56,7 +56,7 @@ class BlockPool:
         ``on_peer_error(peer_id, reason)`` reports misbehaving peers.
         ``min_recv_rate``: B/s floor for peers with pending requests
         (0 disables; default MIN_RECV_RATE)."""
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("blocksync.pool._mtx")
         self.height = start_height  # next height to apply
         self.send_request = send_request
         self.on_peer_error = on_peer_error or (lambda pid, r: None)

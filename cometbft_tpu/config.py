@@ -13,6 +13,62 @@ from dataclasses import dataclass, field, replace
 
 _MS = 1_000_000  # ns per ms
 
+# Registry of every COMETBFT_* environment knob the engine reads.
+# cometlint (CLNT007, devtools/lint) fails the build when code reads a
+# knob that is not declared here, so this dict IS the operator-facing
+# catalog — adding an env read and documenting it are one change. Keys
+# are knob names, values are one-line operator docs.
+ENV_KNOBS: dict[str, str] = {
+    "COMETBFT_TPU_KERNEL": (
+        "verify-kernel lowering: auto (default) | pallas | pallas8 | "
+        "xla | xla8; pins a flavor for benchmarking (ops/verify.py)"
+    ),
+    "COMETBFT_TPU_PUBKEY_CACHE": (
+        "expanded-pubkey device arena: 1 (default) | 0 to disable "
+        "(ops/verify.py)"
+    ),
+    "COMETBFT_TPU_PRESTAGE": (
+        "warm the pubkey arena at enter-new-round: auto (default, "
+        "accelerator-only) | 1 force | 0 off (ops/verify.py)"
+    ),
+    "COMETBFT_TPU_SHARD": (
+        "multi-chip signature-axis sharding: auto (default, "
+        "accelerator-only) | 1 force | 0 off (ops/verify.py)"
+    ),
+    "COMETBFT_TPU_XLA_CACHE": (
+        "persistent XLA compilation-cache directory (default "
+        "~/.cache/cometbft_tpu_xla; ops/verify.py)"
+    ),
+    "COMETBFT_TPU_HOST_THRESHOLD": (
+        "batch size below which verification stays on host; overrides "
+        "the chip-table-derived crossover (crypto/batch.py)"
+    ),
+    "COMETBFT_TPU_SR_HOST": (
+        "1 routes sr25519 batches to the host verifier — the explicit "
+        "dead-tunnel escape (crypto/batch.py)"
+    ),
+    "COMETBFT_TPU_CHIP_TABLE": (
+        "path override for the accelerator-measured bench table "
+        "(default <repo>/BENCH_CHIP_TABLE.json; libs/chip_table.py)"
+    ),
+    "COMETBFT_TPU_DEADLOCK": (
+        "1 swaps every libs/sync mutex for a deadlock-detecting "
+        "instrumented lock (the go-deadlock build-tag analog)"
+    ),
+    "COMETBFT_TPU_DEADLOCK_TIMEOUT": (
+        "seconds a waiter stalls before the deadlock tier dumps all "
+        "thread stacks (default 30; libs/sync.py)"
+    ),
+    "COMETBFT_TPU_FAIL": (
+        "named crash point for fault-injection tests — the process "
+        "dies hard when execution reaches it (libs/fail.py)"
+    ),
+    "COMETBFT_TPU_SOFTWARE_VERSION": (
+        "node software version advertised in p2p NodeInfo/RPC status "
+        "(node/node.py; set per-node by the e2e harness)"
+    ),
+}
+
 
 @dataclass(slots=True)
 class BaseConfig:

@@ -8,7 +8,7 @@ POL (proof-of-lock) queries.
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 
 from ..types import canonical
 from ..types.validator_set import ValidatorSet
@@ -30,7 +30,7 @@ class HeightVoteSet:
         self.height = height
         self.val_set = validators
         self.extensions_enabled = extensions_enabled
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("consensus.height_vote_set._mtx")
         self._round = 0
         self._round_vote_sets: dict[int, tuple[VoteSet, VoteSet]] = {}
         self._peer_catchup_rounds: dict[str, list[int]] = {}

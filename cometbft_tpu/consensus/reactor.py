@@ -12,13 +12,14 @@ from __future__ import annotations
 
 import random
 import threading
+from ..libs import sync as libsync
 import time
 
+from ..libs import log as _log
 from ..libs.bits import BitArray
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
-from ..types import BlockID, canonical
+from ..types import canonical
 from ..types import serialization as ser
-from ..types.part_set import PartSet
 from .messages import (
     BlockPartMessage,
     HasVoteMessage,
@@ -37,6 +38,12 @@ from .state import (
     EVENT_VOTE,
 )
 
+def _gossip_log():
+    """Logger for the per-peer gossip/query routines (lazy: honors
+    whatever default logger the node configured after import)."""
+    return _log.default_logger().with_module("consensus.reactor")
+
+
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
@@ -47,7 +54,7 @@ class PeerState:
     """Mirror of a peer's round state (reactor.go PeerState)."""
 
     def __init__(self):
-        self.mtx = threading.RLock()
+        self.mtx = libsync.RLock("consensus.reactor.mtx")
         self.height = 0
         self.round = -1
         self.step = RoundStep.NEW_HEIGHT
@@ -433,8 +440,12 @@ class ConsensusReactor(Reactor):
             try:
                 if self._gossip_data_once(peer, ps, rs):
                     continue
-            except Exception:
-                pass
+            except Exception as e:  # CLNT006: keep gossiping, but say why
+                _gossip_log().debug(
+                    "gossip data failed; retrying after sleep",
+                    peer=str(getattr(peer, "id", "?"))[:16],
+                    err=repr(e)[:120],
+                )
             time.sleep(self._gossip_sleep)
 
     def _gossip_data_once(self, peer, ps: PeerState, rs) -> bool:
@@ -518,8 +529,12 @@ class ConsensusReactor(Reactor):
             try:
                 if self._gossip_votes_once(peer, ps, rs):
                     continue
-            except Exception:
-                pass
+            except Exception as e:  # CLNT006: keep gossiping, but say why
+                _gossip_log().debug(
+                    "gossip votes failed; retrying after sleep",
+                    peer=str(getattr(peer, "id", "?"))[:16],
+                    err=repr(e)[:120],
+                )
             time.sleep(self._gossip_sleep)
 
     def _gossip_votes_once(self, peer, ps: PeerState, rs) -> bool:
@@ -647,6 +662,10 @@ class ConsensusReactor(Reactor):
                                 )
                             ),
                         )
-            except Exception:
-                pass
+            except Exception as e:  # CLNT006: keep querying, but say why
+                _gossip_log().debug(
+                    "maj23 query failed; retrying after sleep",
+                    peer=str(getattr(peer, "id", "?"))[:16],
+                    err=repr(e)[:120],
+                )
             time.sleep(self._maj23_sleep)

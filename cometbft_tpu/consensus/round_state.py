@@ -8,9 +8,8 @@ single-writer consensus loop owns for the current height.
 from __future__ import annotations
 
 import enum
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
-from ..types import BlockID
 from ..types.block import Block, Commit
 from ..types.part_set import PartSet
 from ..types.validator_set import ValidatorSet

@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import struct
-import threading
+from ..libs import sync as libsync
 import zlib
 
 from ..libs import autofile
@@ -66,7 +66,7 @@ class WAL:
         if head_size_limit is not None:
             kwargs["head_size_limit"] = head_size_limit
         self.group = autofile.Group(path, **kwargs)
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("consensus.wal._mtx")
         self._msgs_since_sync = 0
         # Seed a brand-new WAL with #ENDHEIGHT 0 so replay can always find
         # a marker (wal.go OnStart); absence later = corruption.

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
+from ..libs import sync as libsync
 import secrets
 
 import numpy as np
@@ -46,7 +46,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "edbatch.cpp"))
 _SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_edbatch.so"))
 
-_build_lock = threading.Lock()
+_build_lock = libsync.Mutex("crypto.host_batch._build_lock")
 _lib = None
 _lib_failed = False
 

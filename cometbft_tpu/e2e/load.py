@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import base64
 import threading
+from ..libs import sync as libsync
 import time
 from dataclasses import dataclass, field
 
@@ -60,7 +61,7 @@ class LoadGenerator:
         self.sent = 0
         self.errors = 0
         self._seq = 0
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("e2e.load._mtx")
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
 

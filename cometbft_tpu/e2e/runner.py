@@ -31,6 +31,7 @@ import socket
 import subprocess
 import sys
 import threading
+from ..libs import sync as libsync
 import time
 
 from ..rpc.client import HTTPClient
@@ -56,7 +57,7 @@ class LinkRelay:
         self._severed = threading.Event()
         self._closed = False
         self._conns: set[socket.socket] = set()
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("e2e.runner._mtx")
         threading.Thread(
             target=self._accept_loop, name=f"relay-{self.port}", daemon=True
         ).start()

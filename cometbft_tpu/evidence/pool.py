@@ -8,7 +8,7 @@ reports conflicting votes here (``report_conflicting_votes``, pool.go:180
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 
 from ..libs import db as dbm
 from ..libs.clist import CList
@@ -29,7 +29,7 @@ class EvidencePool:
         self.db = db
         self.state_store = state_store
         self.block_store = block_store
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("evidence.pool._mtx")
         self.evidence_list = CList()  # gossip tail
         self._in_list: dict[bytes, object] = {}  # hash -> CElement
         # load persisted pending evidence into the gossip list

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import os
 import re
-import threading
+from . import sync as libsync
 
 DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # 10MB (group.go:27)
 DEFAULT_GROUP_SIZE_LIMIT = 1024 * 1024 * 1024  # 1GB (group.go:28)
@@ -30,7 +30,7 @@ class Group:
         self.head_path = head_path
         self.head_size_limit = head_size_limit
         self.group_size_limit = group_size_limit
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("libs.autofile._mtx")
         os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
         self._head = open(head_path, "ab")
 

@@ -7,7 +7,7 @@ tracking; gossip messages exchange these to decide what to send a peer.
 from __future__ import annotations
 
 import random
-import threading
+from . import sync as libsync
 
 
 class BitArray:
@@ -16,7 +16,7 @@ class BitArray:
             raise ValueError("negative bit count")
         self.bits = bits
         self._elems = bytearray((bits + 7) // 8)
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("libs.bits._mtx")
 
     @classmethod
     def from_indices(cls, bits: int, indices) -> "BitArray":

@@ -8,7 +8,7 @@ pool without polling).
 
 from __future__ import annotations
 
-import threading
+from . import sync as libsync
 from typing import Any
 
 MAX_LENGTH = 1 << 30
@@ -23,7 +23,7 @@ class CElement:
         self._next: CElement | None = None
         self._removed = False
         self._list = list_
-        self._cv = threading.Condition()
+        self._cv = libsync.Condition()
 
     def next(self) -> "CElement | None":
         with self._cv:
@@ -64,12 +64,12 @@ class CElement:
 
 class CList:
     def __init__(self, max_length: int = MAX_LENGTH):
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("libs.clist._mtx")
         self._head: CElement | None = None
         self._tail: CElement | None = None
         self._len = 0
         self._max_length = max_length
-        self._wait_cv = threading.Condition(self._mtx)
+        self._wait_cv = libsync.Condition(self._mtx)
 
     def __len__(self) -> int:
         with self._mtx:

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
+from . import sync as libsync
 from bisect import bisect_left, insort
 from typing import Iterator
 
@@ -83,7 +83,7 @@ class Batch:
 
 class MemDB(DB):
     def __init__(self) -> None:
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("libs.db._mtx")
         self._data: dict[bytes, bytes] = {}
         self._keys: list[bytes] = []  # sorted view for iteration
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import threading
+from . import sync as libsync
 
 from .db import DB, prefix_end  # noqa: F401  (prefix_end re-export parity)
 from .native_build import NativeBuildError, build_and_load  # noqa: F401
@@ -27,7 +27,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "nkv.cpp"))
 _SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_nkv.so"))
 
-_load_lock = threading.Lock()
+_load_lock = libsync.Mutex("libs.db_native._load_lock")
 _lib = None
 
 
@@ -91,7 +91,7 @@ class NativeDB(DB):
                 f"foreign-format file — FileDB files start with b'FKV1\\n', "
                 f"native files with b'NKV1\\n'; was db_backend changed?)"
             )
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("libs.db_native._mtx")
         self._closed = False
 
     def _live(self):

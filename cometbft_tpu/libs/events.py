@@ -8,7 +8,7 @@ registration order.
 
 from __future__ import annotations
 
-import threading
+from . import sync as libsync
 from typing import Any, Callable
 
 EventCallback = Callable[[Any], None]
@@ -16,7 +16,7 @@ EventCallback = Callable[[Any], None]
 
 class EventSwitch:
     def __init__(self) -> None:
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("libs.events._mtx")
         # event -> {listener_id: callback}
         self._cells: dict[str, dict[str, EventCallback]] = {}
 

@@ -7,13 +7,13 @@ reference's blocking mode when the budget is exhausted.
 
 from __future__ import annotations
 
-import threading
+from . import sync as libsync
 import time
 
 
 class Monitor:
     def __init__(self, sample_period: float = 0.1, window: float = 1.0):
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("libs.flowrate._mtx")
         self._start = time.monotonic()
         self._total = 0
         self._rate_ema = 0.0

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import sys
 import threading
+from . import sync as libsync
 import time
 
 DEBUG, INFO, ERROR, NONE = 0, 1, 2, 3
@@ -53,7 +54,7 @@ class Logger:
         self._module_levels = (
             module_levels if module_levels is not None else {}
         )
-        self._lock = _lock if _lock is not None else threading.Lock()
+        self._lock = _lock if _lock is not None else libsync.Mutex("libs.log")
 
     # -- derivation --------------------------------------------------------
 

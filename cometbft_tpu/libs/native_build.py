@@ -13,9 +13,9 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
+from . import sync as libsync
 
-_lock = threading.Lock()
+_lock = libsync.Mutex("libs.native_build._lock")
 
 
 class NativeBuildError(RuntimeError):

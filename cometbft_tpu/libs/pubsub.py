@@ -24,6 +24,7 @@ from __future__ import annotations
 import queue
 import re
 import threading
+from . import sync as libsync
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -258,7 +259,7 @@ class Server:
     """
 
     def __init__(self, capacity: int | None = None):
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("libs.pubsub._mtx")
         self._subs: dict[str, dict[Any, Subscription]] = {}
         self._capacity = capacity
 

@@ -10,6 +10,7 @@ becomes a ``threading.Event`` that Python code can ``wait()`` on.
 from __future__ import annotations
 
 import threading
+from . import sync as libsync
 
 
 class ServiceError(Exception):
@@ -38,7 +39,7 @@ class BaseService:
 
     def __init__(self, name: str | None = None, logger=None):
         self._name = name or type(self).__name__
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("libs.service._mtx")
         self._started = False
         self._stopped = False
         self._quit = threading.Event()

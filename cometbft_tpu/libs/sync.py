@@ -167,3 +167,21 @@ def Mutex(name: str = ""):
 def RLock(name: str = ""):
     """A reentrant lock; instrumented when deadlock detection is on."""
     return _InstrumentedRLock(name) if _enabled else threading.RLock()
+
+
+def Condition(lock=None, name: str = ""):
+    """A condition variable routed through the sync tier.
+
+    Conditions are not themselves instrumented: ``wait()`` must release
+    and re-acquire the underlying primitive with the stdlib's exact
+    save/restore protocol, which the instrumented wrappers deliberately
+    don't implement (their non-reentrant self-deadlock check would
+    misfire inside ``Condition._is_owned``). When handed an
+    instrumented Mutex/RLock the raw lock is unwrapped, so waiters
+    remain visible to the deadlock tier through every ordinary
+    ``acquire`` on the associated mutex; only the wait/notify edge
+    itself is uninstrumented.
+    """
+    if isinstance(lock, _InstrumentedMutex):
+        lock = lock._lock
+    return threading.Condition(lock)

@@ -7,7 +7,7 @@ key tracks the pair count for O(1) Size().
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 
 from ..libs import db as dbm
 from ..libs.db import prefix_end
@@ -28,7 +28,7 @@ class Store:
 
     def __init__(self, db: dbm.DB | None = None):
         self._db = db if db is not None else dbm.MemDB()
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("light.store._mtx")
 
     # -- writes ------------------------------------------------------------
 

@@ -2,14 +2,14 @@
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 from collections import OrderedDict
 
 
 class LRUTxCache:
     def __init__(self, size: int):
         self._size = size
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("mempool.cache._mtx")
         self._map: OrderedDict[bytes, None] = OrderedDict()
 
     def push(self, tx_key: bytes) -> bool:

@@ -29,7 +29,6 @@ from ..p2p import MultiplexTransport, NodeInfo, NodeKey, Switch
 from ..p2p.conn.connection import MConnConfig
 from ..privval import FilePV
 from ..state import BlockExecutor, Store, make_genesis_state
-from ..state.execution import NopEvidencePool
 from ..store import BlockStore
 from ..types import GenesisDoc
 from ..types.event_bus import EventBus

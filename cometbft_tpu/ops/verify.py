@@ -22,7 +22,7 @@ nibbles) — see ops/field.py for why batch-minor wins on TPU.
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 from collections import OrderedDict
 from functools import lru_cache
 
@@ -195,7 +195,8 @@ def _pack_bytes_native(pubkeys, msgs, sigs, n: int):
     host_ok = np.ones(n, bool)
     recs = []
     msg_parts = []
-    lens = np.zeros(n, np.uint64)
+    lens = np.zeros(n, np.uint64)  # host-staging: message byte lengths
+    # for the C packer's offset table; never shipped to the device
     for i in range(n):
         p_i, s_i = pubkeys[i], sigs[i]
         if len(p_i) != 32 or len(s_i) != 64:
@@ -209,7 +210,8 @@ def _pack_bytes_native(pubkeys, msgs, sigs, n: int):
         lens[i] = len(m)
     recs_blob = b"".join(recs)
     msgs_blob = b"".join(msg_parts)
-    offs = np.zeros(n + 1, np.uint64)
+    offs = np.zeros(n + 1, np.uint64)  # host-staging: byte offsets into
+    # msgs_blob for native/edbatch.cpp (size_t ABI); never device-bound
     np.cumsum(lens, out=offs[1:])
     out = host_batch.pack_challenges(recs_blob, msgs_blob, offs, n)
     if out is None:
@@ -497,7 +499,7 @@ class PubkeyTableCache:
 
     def __init__(self, capacity: int = CAPACITY):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = libsync.Mutex("ops.verify._lock")
         self._slots: OrderedDict[bytes, int] = OrderedDict()
         self._arena = None
         self._arena_ok = None
@@ -852,6 +854,8 @@ def _materialize(out, used_pallas, buf):
     :func:`_run_kernel` (sibling flavor, then XLA). Bounded — each
     retry removes a flavor; the XLA launch (used_pallas None) raises."""
     try:
+        # cometlint: disable=CLNT002 -- THE sanctioned per-launch readback:
+        # every async dispatch materializes exactly once, here
         return np.asarray(out)
     except Exception as e:
         if used_pallas is None:
@@ -982,6 +986,8 @@ def verify_rsk_async(buf: np.ndarray, idxs: np.ndarray, arena, arena_ok,
         o, which = out, used_pallas
         while True:
             try:
+                # cometlint: disable=CLNT002 -- sanctioned readback of the
+                # cached-table launch (the _materialize analog)
                 return np.asarray(o)[:n]
             except Exception as e:
                 if which is None:

@@ -17,6 +17,7 @@ import struct
 
 from ...libs import metrics as libmetrics
 import threading
+from ...libs import sync as libsync
 import time
 from dataclasses import dataclass
 
@@ -58,9 +59,9 @@ class ChannelDescriptor:
 class _Channel:
     def __init__(self, desc: ChannelDescriptor):
         self.desc = desc
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("p2p.conn.connection._mtx")
         self._queue: list[bytes] = []
-        self._not_full = threading.Condition(self._mtx)
+        self._not_full = libsync.Condition(self._mtx)
         self.sending: bytes | None = None
         self.sent_pos = 0
         self.recently_sent = 0  # exponentially decayed
@@ -136,7 +137,7 @@ class MConnection(BaseService):
         self._send_signal = threading.Event()
         self._pong_pending = threading.Event()
         self._last_pong = time.monotonic()
-        self._write_mtx = threading.Lock()
+        self._write_mtx = libsync.Mutex("p2p.conn.connection._write_mtx")
 
     # -- API ---------------------------------------------------------------
 

@@ -6,7 +6,7 @@ store reactors use for per-peer state (p2p/peer.go Set/Get).
 
 from __future__ import annotations
 
-import threading
+from ..libs import sync as libsync
 
 from ..libs.service import BaseService
 from .conn.connection import MConnection
@@ -32,7 +32,7 @@ class Peer(BaseService):
         self.persistent = persistent
         self.socket_addr = socket_addr
         self._data: dict[str, object] = {}
-        self._data_mtx = threading.Lock()
+        self._data_mtx = libsync.Mutex("p2p.peer._data_mtx")
         self.mconn = MConnection(
             secret_conn,
             channels,

@@ -20,9 +20,9 @@ import hashlib
 import json
 import os
 import random
-import threading
+from ...libs import sync as libsync
 import time
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass
 
 NEW_BUCKET_COUNT = 256
 OLD_BUCKET_COUNT = 64
@@ -72,7 +72,7 @@ class AddrBook:
     def __init__(self, file_path: str | None = None, key: bytes | None = None):
         self.file_path = file_path
         self._key = key if key is not None else os.urandom(8)
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("p2p.pex.addrbook._mtx")
         self._addrs: dict[str, KnownAddress] = {}  # node_id -> ka
         self._new: list[set[str]] = [set() for _ in range(NEW_BUCKET_COUNT)]
         self._old: list[set[str]] = [set() for _ in range(OLD_BUCKET_COUNT)]

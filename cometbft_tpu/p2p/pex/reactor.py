@@ -13,9 +13,11 @@ Reference: p2p/pex/pex_reactor.go:764. Responsibilities:
 from __future__ import annotations
 
 import threading
+from ...libs import sync as libsync
 import time
 from dataclasses import dataclass, field
 
+from ...libs import log as _log
 from ...types import serialization as ser
 from ..base_reactor import ChannelDescriptor, Reactor
 from .addrbook import AddrBook
@@ -56,7 +58,7 @@ class PexReactor(Reactor):
         self._last_request: dict[str, float] = {}
         self._requested: set[str] = set()  # peers we asked (expect a reply)
         self._dialing: set[str] = set()
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("p2p.pex.reactor._mtx")
         self._stop = threading.Event()
 
     def get_channels(self):
@@ -154,8 +156,11 @@ class PexReactor(Reactor):
         while not self._stop.is_set():
             try:
                 self._ensure_peers()
-            except Exception:
-                pass
+            except Exception as e:  # CLNT006: keep the loop alive, but a
+                # failing ensure-peers pass starves the dial schedule
+                _log.default_logger().with_module("pex").error(
+                    "ensure-peers pass failed", err=repr(e)[:120]
+                )
             self._stop.wait(self.ensure_interval)
 
     def _ensure_peers(self) -> None:
@@ -194,8 +199,11 @@ class PexReactor(Reactor):
         try:
             # non-persistent dial: single attempt, no backoff loop
             self.switch._dial_with_backoff(ka.addr)
-        except Exception:
-            pass
+        except Exception as e:  # CLNT006: dial failures are routine
+            # (mark_attempt already recorded it) — log at debug only
+            _log.default_logger().with_module("pex").debug(
+                "dial failed", addr=str(ka.addr), err=repr(e)[:120]
+            )
         finally:
             with self._mtx:
                 self._dialing.discard(ka.node_id)

@@ -11,7 +11,6 @@ import random
 import threading
 
 from ..libs import sync as libsync
-import time
 
 from ..libs.service import BaseService
 from .base_reactor import Reactor

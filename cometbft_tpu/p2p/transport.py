@@ -14,7 +14,7 @@ import threading
 
 from .conn.secret_connection import SecretConnection
 from .key import NodeKey, node_id_from_pubkey
-from .node_info import MAX_NODE_INFO_SIZE, NodeInfo, NodeInfoError
+from .node_info import MAX_NODE_INFO_SIZE, NodeInfo
 
 
 class TransportError(Exception):

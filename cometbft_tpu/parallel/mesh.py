@@ -166,6 +166,9 @@ def _dispatch_sharded(mesh: Mesh, args, lanes_per_shard: int):
     ):
         try:
             ok, verdict = _sharded_verify_pallas(mesh)(*args)
+            # cometlint: disable=CLNT002 -- sanctioned sharded readback:
+            # materializing INSIDE the try is what lets a Mosaic runtime
+            # fault retire the pallas path and fall through to XLA
             return np.asarray(ok), np.asarray(verdict)
         except Exception as e:
             _SHARDED_PALLAS_BROKEN = True
@@ -176,6 +179,8 @@ def _dispatch_sharded(mesh: Mesh, args, lanes_per_shard: int):
                 err=repr(e)[:200],
             )
     ok, verdict = _sharded_verify(mesh)(*args)
+    # cometlint: disable=CLNT002 -- sanctioned readback of the XLA
+    # sharded launch (single sync point of the multi-chip path)
     return np.asarray(ok), np.asarray(verdict)
 
 

@@ -20,6 +20,7 @@ import dataclasses
 import json
 import socket
 import threading
+from ..libs import sync as libsync
 import time
 from dataclasses import dataclass
 
@@ -206,7 +207,7 @@ class SignerListenerEndpoint(BaseService):
         self._listener = None
         self._conn: _Conn | None = None
         self._conn_ready = threading.Event()
-        self._req_mtx = threading.Lock()
+        self._req_mtx = libsync.Mutex("privval.signer._req_mtx")
         self._accept_thread = None
         self._ping_thread = None
 

@@ -11,7 +11,7 @@ kills the node — fail-stop, multi_app_conn.go:129).
 
 from __future__ import annotations
 
-import threading
+from .libs import sync as libsync
 from typing import Callable
 
 from .abci.application import Application
@@ -23,7 +23,7 @@ ClientCreator = Callable[[], Client]
 
 def local_client_creator(app: Application) -> ClientCreator:
     """All four connections share one mutex around one in-process app."""
-    mtx = threading.RLock()
+    mtx = libsync.RLock("proxy.mtx")
     return lambda: LocalClient(app, mtx)
 
 

@@ -26,6 +26,7 @@ import queue
 import socket
 import struct
 import threading
+from ..libs import sync as libsync
 import time
 import urllib.request
 
@@ -161,8 +162,8 @@ class WSClient:
         self.reconnect = reconnect
         self.max_reconnect_attempts = max_reconnect_attempts
         self._ids = itertools.count(1)
-        self._mtx = threading.Lock()  # socket write + state
-        self._subs_mtx = threading.Lock()  # subscribe check+insert
+        self._mtx = libsync.Mutex("rpc.client._mtx")  # socket write + state
+        self._subs_mtx = libsync.Mutex("rpc.client._subs_mtx")  # subscribe check+insert
         self._pending: dict[int, queue.Queue] = {}
         self._inflight: set[int] = set()  # ids actually written to the wire
         self._subs: dict[str, Subscription] = {}

@@ -8,7 +8,6 @@ Errors raise RPCError with reference-style messages.
 
 from __future__ import annotations
 
-import time
 
 from ...abci import types as abci
 from ...mempool.clist_mempool import MempoolFullError, TxInCacheError

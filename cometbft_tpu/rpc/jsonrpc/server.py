@@ -19,9 +19,9 @@ from __future__ import annotations
 import base64
 import hashlib
 import json
-import socketserver
 import struct
 import threading
+from ...libs import sync as libsync
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -177,8 +177,13 @@ class _Handler(BaseHTTPRequestHandler):
         if refresh is not None:
             try:
                 refresh()
-            except Exception:
-                pass
+            except Exception as e:  # CLNT006: serve stale metrics rather
+                # than failing the scrape, but record the refresh fault
+                logger = getattr(self.server, "logger", None)
+                if logger is not None:
+                    logger.debug(
+                        "metrics refresh failed", err=repr(e)[:120]
+                    )
         body = metrics.registry.render().encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -218,7 +223,7 @@ class _WSConn:
         self.sock = sock
         self.env = env
         self.id = f"ws-{id(self):x}"
-        self._write_mtx = threading.Lock()
+        self._write_mtx = libsync.Mutex("rpc.jsonrpc.server._write_mtx")
         self._subs: dict[str, tuple[object, object]] = {}  # query -> (q, sub)
         self._alive = True
 
@@ -366,7 +371,9 @@ class _WSConn:
         if self._subs:
             try:
                 self.env.event_bus.unsubscribe_all(self.id)
-            except Exception:
+            except Exception:  # cometlint: disable=CLNT006 -- cleanup of a
+                # dying websocket: the subscriber may already be gone from
+                # the bus (unsubscribed server-side); nothing to report
                 pass
             self._subs.clear()
 

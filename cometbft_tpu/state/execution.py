@@ -30,7 +30,7 @@ from ..types.validator_set import (
 )
 from ..crypto import keys as crypto_keys
 from .state import State, results_hash
-from .validation import BlockValidationError, validate_block
+from .validation import validate_block
 
 
 class NopMempool:

@@ -22,7 +22,8 @@ condition is an equality.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field as dc_field
+from ..libs import sync as libsync
+from dataclasses import dataclass
 
 from ..crypto import tmhash
 from ..libs import db as dbm
@@ -67,7 +68,7 @@ class KVTxIndexer:
 
     def __init__(self, db: dbm.DB | None = None):
         self.db = db if db is not None else dbm.MemDB()
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("state.indexer._mtx")
 
     def index(self, rec: TxRecord, events) -> None:
         """Index one tx: by hash plus every (event key, value) pair."""
@@ -131,7 +132,7 @@ class KVBlockIndexer:
 
     def __init__(self, db: dbm.DB | None = None):
         self.db = db if db is not None else dbm.MemDB()
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("state.indexer.KVBlockIndexer._mtx")
 
     def index(self, height: int, events) -> None:
         with self._mtx:

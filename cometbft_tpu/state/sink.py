@@ -26,7 +26,7 @@ generated chain (tests/test_sink.py).
 from __future__ import annotations
 
 import sqlite3
-import threading
+from ..libs import sync as libsync
 
 from ..crypto import tmhash
 from ..libs.pubsub import Query
@@ -82,7 +82,7 @@ class SQLiteEventSink:
         # one connection, serialized by a lock: the indexer service feeds
         # from two consumer threads, searches come from RPC threads
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("state.sink._mtx")
         with self._mtx:
             self._conn.executescript(_SCHEMA)
             self._conn.commit()

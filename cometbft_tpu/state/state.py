@@ -16,7 +16,6 @@ from ..types import (
     BlockID,
     Commit,
     ConsensusParams,
-    Data,
     GenesisDoc,
     Header,
     NIL_BLOCK_ID,

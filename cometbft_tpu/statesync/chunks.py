@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import shutil
 import tempfile
-import threading
+from ..libs import sync as libsync
 
 
 class ChunkQueue:
@@ -21,7 +21,7 @@ class ChunkQueue:
         self._dir = tempfile.mkdtemp(
             prefix="cometbft-tpu-statesync-", dir=temp_dir
         )
-        self._mtx = threading.Condition()
+        self._mtx = libsync.Condition()
         self._peers: dict[int, str] = {}  # index -> sender peer
         self._next = 0
         self._closed = False

@@ -3,7 +3,7 @@ proto/tendermint/statesync)."""
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..types import serialization as ser
 

@@ -7,8 +7,8 @@ chunk fetches spread across providers and peer failures prune cleanly.
 
 from __future__ import annotations
 
-import threading
-from dataclasses import dataclass, field
+from ..libs import sync as libsync
+from dataclasses import dataclass
 
 
 @dataclass(frozen=True)
@@ -25,7 +25,7 @@ class Snapshot:
 
 class SnapshotPool:
     def __init__(self):
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("statesync.snapshots._mtx")
         self._snapshots: dict[tuple, Snapshot] = {}
         self._peers: dict[tuple, set[str]] = {}
         self._rejected: set[tuple] = set()

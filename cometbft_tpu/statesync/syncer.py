@@ -12,6 +12,7 @@ Reference: statesync/syncer.go:145-516. Flow per snapshot (best first):
 from __future__ import annotations
 
 import threading
+from ..libs import sync as libsync
 import time
 
 from ..abci import types as abci
@@ -66,7 +67,7 @@ class Syncer:
         self.pool = SnapshotPool()
         self._chunk_queue: ChunkQueue | None = None
         self._current: Snapshot | None = None
-        self._mtx = threading.Lock()
+        self._mtx = libsync.Mutex("statesync.syncer._mtx")
         # Once ANY chunk has been applied the app's state is no longer
         # genesis: callers must not fall back to blocksync-from-genesis
         # (the reference fail-stops post-restore errors for this reason).

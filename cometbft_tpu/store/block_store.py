@@ -13,7 +13,7 @@ commit | ``EC:<h>`` extended commit | ``BH:<hash>`` height | ``BS`` state.
 from __future__ import annotations
 
 import json
-import threading
+from ..libs import sync as libsync
 
 from ..libs import db as dbm
 from ..types import serialization as ser
@@ -28,7 +28,7 @@ def _h(prefix: bytes, height: int) -> bytes:
 class BlockStore:
     def __init__(self, db: dbm.DB):
         self.db = db
-        self._mtx = threading.RLock()
+        self._mtx = libsync.RLock("store.block_store._mtx")
         raw = db.get(b"BS")
         if raw:
             st = json.loads(raw)

@@ -16,7 +16,7 @@ Clipping arithmetic (safeAddClip/safeSubClip) saturates at int64 bounds.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 
 from ..crypto import merkle
 from . import proto

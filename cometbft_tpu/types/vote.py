@@ -8,7 +8,7 @@ Sign bytes are the canonical length-delimited protobuf of CanonicalVote
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 
 from . import canonical
 from .block import (

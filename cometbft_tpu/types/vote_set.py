@@ -27,11 +27,9 @@ from ..libs import sync as libsync
 from ..libs.bits import BitArray
 from . import canonical
 from .block import (
-    BLOCK_ID_FLAG_ABSENT,
     BLOCK_ID_FLAG_COMMIT,
     BlockID,
     Commit,
-    NIL_BLOCK_ID,
 )
 from .validator_set import ValidatorSet
 from .vote import Vote, VoteError
