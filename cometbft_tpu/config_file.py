@@ -12,9 +12,95 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: fall back to the minimal
+    tomllib = None  # reader below, which covers exactly what we render
 
 from .config import Config, default_config
+
+
+def _scan_value(s: str, i: int):
+    """Parse one TOML value of the subset ``render_toml`` emits
+    (strings with backslash escapes, ints, floats, bools, flat lists).
+    Returns (value, index after the value)."""
+    while i < len(s) and s[i] in " \t":
+        i += 1
+    if i >= len(s):
+        raise ValueError("missing value")
+    c = s[i]
+    if c == '"':
+        out = []
+        i += 1
+        esc = {"n": "\n", "r": "\r", "t": "\t", "\\": "\\", '"': '"'}
+        while i < len(s) and s[i] != '"':
+            if s[i] == "\\":
+                i += 1
+                if i >= len(s) or s[i] not in esc:
+                    raise ValueError("bad string escape")
+                out.append(esc[s[i]])
+            else:
+                out.append(s[i])
+            i += 1
+        if i >= len(s):
+            raise ValueError("unterminated string")
+        return "".join(out), i + 1
+    if c == "[":
+        vals = []
+        i += 1
+        while True:
+            while i < len(s) and s[i] in " \t,":
+                i += 1
+            if i >= len(s):
+                raise ValueError("unterminated array")
+            if s[i] == "]":
+                return vals, i + 1
+            v, i = _scan_value(s, i)
+            vals.append(v)
+    j = i
+    while j < len(s) and s[j] not in " \t#,]":
+        j += 1
+    tok = s[i:j]
+    if tok == "true":
+        return True, j
+    if tok == "false":
+        return False, j
+    try:
+        return int(tok), j
+    except ValueError:
+        return float(tok), j
+
+
+def _parse_toml_minimal(text: str) -> dict:
+    """Line-oriented reader for the flat ``[section]`` / ``key = value``
+    subset this module writes. Loud on anything outside it — a config
+    this code didn't render should be read with real tomllib."""
+    root: dict = {}
+    table = root
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            if not line.endswith("]"):
+                raise ValueError(f"line {lineno}: malformed section header")
+            name = line[1:-1].strip()
+            if not name or "." in name:
+                raise ValueError(
+                    f"line {lineno}: unsupported section {name!r}"
+                )
+            table = root.setdefault(name, {})
+            continue
+        key, sep, rest = line.partition("=")
+        if not sep:
+            raise ValueError(f"line {lineno}: expected key = value")
+        value, end = _scan_value(rest, 0)
+        tail = rest[end:].strip()
+        if tail and not tail.startswith("#"):
+            raise ValueError(f"line {lineno}: trailing junk {tail!r}")
+        table[key.strip()] = value
+    return root
 
 _SECTION_ORDER = (
     ("base", ""),  # base fields live at the top level, like the reference
@@ -79,7 +165,11 @@ def load_toml(path: str, base: Config | None = None) -> Config:
     misconfigurations ship)."""
     cfg = base if base is not None else default_config()
     with open(path, "rb") as fh:
-        data = tomllib.load(fh)
+        raw = fh.read()
+    if tomllib is not None:
+        data = tomllib.loads(raw.decode())
+    else:
+        data = _parse_toml_minimal(raw.decode())
     known_sections = {s for _, s in _SECTION_ORDER if s}
     for key, value in data.items():
         if isinstance(value, dict) and key not in known_sections:

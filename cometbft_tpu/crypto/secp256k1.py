@@ -18,12 +18,21 @@ import hashlib
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:  # the cryptography wheel is baked into prod images; degrade
+    # explicitly on slim containers instead of breaking package import
+    # (secp256k1 is off the consensus hot path — ed25519 stays fully
+    # functional without the wheel).
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # pragma: no cover
+    hashes = ec = decode_dss_signature = encode_dss_signature = None
+    _HAVE_OPENSSL = False
 
 SECP256K1_KEY_TYPE = "secp256k1"
 PUBKEY_SIZE = 33
@@ -32,6 +41,23 @@ SIGNATURE_SIZE = 64
 
 # curve order (for low-s normalization)
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+
+_degraded_warned = False
+
+
+def _warn_degraded_once() -> None:
+    global _degraded_warned
+    if _degraded_warned:
+        return
+    _degraded_warned = True
+    from ..libs import log as _log
+
+    _log.default_logger().with_module("crypto.secp256k1").error(
+        "secp256k1 verification UNAVAILABLE (no 'cryptography' wheel): "
+        "all secp256k1 signatures verify False — this node will diverge "
+        "from wheel-backed peers on chains with secp256k1 validators"
+    )
 
 
 def _address(pubkey33: bytes) -> bytes:
@@ -61,6 +87,13 @@ class Secp256k1PubKey:
         return self.data
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if not _HAVE_OPENSSL:
+            # Reject-only degradation: never accept unchecked. This IS a
+            # consensus divergence on chains with secp256k1 validators —
+            # say so loudly (once), don't let the operator discover it
+            # as a silent stall.
+            _warn_degraded_once()
+            return False
         if len(sig) != SIGNATURE_SIZE:
             return False
         try:
@@ -117,7 +150,11 @@ class Secp256k1PrivKey:
     def bytes(self) -> bytes:
         return self.data
 
-    def _key(self) -> ec.EllipticCurvePrivateKey:
+    def _key(self):
+        if not _HAVE_OPENSSL:
+            raise RuntimeError(
+                "secp256k1 signing requires the 'cryptography' wheel"
+            )
         return ec.derive_private_key(
             int.from_bytes(self.data, "big"), ec.SECP256K1()
         )

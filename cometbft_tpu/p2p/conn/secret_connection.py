@@ -17,16 +17,24 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
+from ...libs import sync as libsync
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:  # the cryptography wheel (OpenSSL) is preferred; slim containers
+    # fall back to the project's pure-Python X25519/HKDF/ChaCha20-
+    # Poly1305 below — same RFCs, interoperable across the two paths.
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover
+    _HAVE_CRYPTOGRAPHY = False
+
+from ...crypto import x25519 as x25519_fallback
+from ...crypto.aead import new_chacha20poly1305
 from ...crypto.keys import Ed25519PubKey
 
 DATA_LEN_SIZE = 4
@@ -41,6 +49,59 @@ CHALLENGE_CONTEXT = b"TENDERMINT_SECRET_CONNECTION_KEY_CHALLENGE"
 
 class SecretConnectionError(Exception):
     pass
+
+
+def _x25519_keypair():
+    """(opaque private handle, 32-byte public key)."""
+    if _HAVE_CRYPTOGRAPHY:
+        priv = X25519PrivateKey.generate()
+        return priv, priv.public_key().public_bytes_raw()
+    seed = os.urandom(32)
+    return seed, x25519_fallback.x25519_base(seed)
+
+
+def _x25519_exchange(priv, remote_pub: bytes) -> bytes:
+    if _HAVE_CRYPTOGRAPHY:
+        return priv.exchange(X25519PublicKey.from_public_bytes(remote_pub))
+    shared = x25519_fallback.x25519(priv, remote_pub)
+    if shared == bytes(32):
+        # low-order remote point: the whole "shared" secret is attacker-
+        # known. OpenSSL's exchange() raises here; match it exactly so
+        # wheel-less nodes reject the same peers wheel-backed ones do.
+        raise SecretConnectionError("x25519: low-order remote ephemeral key")
+    return shared
+
+
+def hkdf_sha256(
+    ikm: bytes, info: bytes, length: int, salt: bytes = b"\x00" * 32
+) -> bytes:
+    """RFC 5869 HKDF-SHA256 (pure, stdlib hmac). Default salt is the
+    RFC's not-provided case (HashLen zeros). Pinned against the RFC 5869
+    A.1/A.3 vectors in tests/test_crypto_host.py."""
+    import hashlib
+    import hmac
+
+    prk = hmac.new(salt, ikm, hashlib.sha256).digest()
+    okm = b""
+    t = b""
+    i = 1
+    while len(okm) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        okm += t
+        i += 1
+    return okm[:length]
+
+
+def _hkdf_sha256_96(shared: bytes) -> bytes:
+    """HKDF-SHA256(salt=None, info=HKDF_INFO) -> 96 bytes."""
+    if _HAVE_CRYPTOGRAPHY:
+        return HKDF(
+            algorithm=hashes.SHA256(),
+            length=96,
+            salt=None,
+            info=HKDF_INFO,
+        ).derive(shared)
+    return hkdf_sha256(shared, HKDF_INFO, 96)
 
 
 class _Nonce:
@@ -65,24 +126,18 @@ class SecretConnection:
     def __init__(self, sock, priv_key):
         """priv_key: our persistent ed25519 key (node key)."""
         self._sock = sock
-        self._send_mtx = threading.Lock()
-        self._recv_mtx = threading.Lock()
+        self._send_mtx = libsync.Mutex("p2p.conn.secret_connection._send_mtx")
+        self._recv_mtx = libsync.Mutex("p2p.conn.secret_connection._recv_mtx")
         self._recv_buf = b""
 
         # 1. ephemeral key exchange
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes_raw()
+        eph_priv, eph_pub = _x25519_keypair()
         self._write_all(eph_pub)
         remote_eph = self._read_exact(32)
 
         # 2. shared secret → keys + challenge
-        shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(remote_eph))
-        okm = HKDF(
-            algorithm=hashes.SHA256(),
-            length=96,
-            salt=None,
-            info=HKDF_INFO,
-        ).derive(shared)
+        shared = _x25519_exchange(eph_priv, remote_eph)
+        okm = _hkdf_sha256_96(shared)
         # Key order: the side with the smaller ephemeral pubkey uses okm[:32]
         # to receive (secret_connection.go:312-333).
         loc_is_least = eph_pub < remote_eph
@@ -91,8 +146,8 @@ class SecretConnection:
         else:
             send_key, recv_key = okm[:32], okm[32:64]
         challenge = okm[64:96]
-        self._send_aead = ChaCha20Poly1305(send_key)
-        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_aead = new_chacha20poly1305(send_key)
+        self._recv_aead = new_chacha20poly1305(recv_key)
         self._send_nonce = _Nonce()
         self._recv_nonce = _Nonce()
 
