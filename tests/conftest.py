@@ -30,6 +30,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache for the WHOLE suite (the ops paths
+# already opt in via ops/verify._enable_compilation_cache): kernel
+# compiles are disk-cached across processes, so repeated tier runs and
+# test-local jax.jit calls don't re-pay CPU XLA compile time.
+from cometbft_tpu.ops.verify import _enable_compilation_cache  # noqa: E402
+
+_enable_compilation_cache()
+
 import pytest  # noqa: E402
 
 # The quick tier (`pytest -m quick`, < 60 s): suites with no JAX kernel

@@ -20,6 +20,14 @@ from cometbft_tpu.state import make_genesis_state
 
 CHAIN_ID = "test-chain-tpu"
 
+try:  # the OpenSSL-backed key types need the `cryptography` wheel;
+    # slim containers run ed25519 on the native/pure fallbacks instead
+    import cryptography  # noqa: F401
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    HAVE_CRYPTOGRAPHY = False
+
 
 def make_genesis(n_vals: int, chain_id: str = CHAIN_ID, power: int = 10):
     """Deterministic genesis with n validators; returns (doc, priv_vals)

@@ -50,7 +50,31 @@ def test_xchacha_draft_vector():
     )
     ct = aead.xchacha20poly1305_encrypt(key, nonce, pt, aad)
     assert ct[:16].hex() == "bd6d179d3e83d43b9576579493c0e939"
+    # ...and the Poly1305 TAG (A.3.2) — pins the one-time-key derivation
+    # and MAC of whichever backend ran (OpenSSL or the pure fallback)
+    assert ct[-16:].hex() == "c0875924c1c7987947deafd8780acf49"
     assert aead.xchacha20poly1305_decrypt(key, nonce, ct, aad) == pt
+
+
+def test_chacha20poly1305_fallback_rfc8439_vector():
+    """RFC 8439 §2.8.2 known-answer test pinning the PURE fallback
+    explicitly (the wheel path is OpenSSL's problem): keystream,
+    one-time Poly1305 key derivation, tag, and reject-on-tamper."""
+    key = bytes(range(0x80, 0xA0))
+    nonce = bytes.fromhex("070000004041424344454647")
+    aad = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    pt = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    box = aead.ChaCha20Poly1305Fallback(key).encrypt(nonce, pt, aad)
+    assert box[:16].hex() == "d31a8d34648e60db7b86afbc53ef7ec2"
+    assert box[-16:].hex() == "1ae10b594f09e26a7e902ecbd0600691"
+    assert aead.ChaCha20Poly1305Fallback(key).decrypt(nonce, box, aad) == pt
+    with pytest.raises(ValueError):
+        aead.ChaCha20Poly1305Fallback(key).decrypt(
+            nonce, box[:-1] + bytes([box[-1] ^ 1]), aad
+        )
 
 
 def test_xsalsa20_stream_properties():

@@ -62,11 +62,28 @@ class TestConfigFile:
             validate_basic(cfg)
 
     def test_render_is_valid_toml_with_comments(self):
-        import tomllib
+        tomllib = pytest.importorskip(
+            "tomllib", reason="stdlib tomllib needs Python >= 3.11"
+        )
 
         text = render_toml(default_config())
         assert text.startswith("#")
         tomllib.loads(text)
+
+    def test_render_roundtrips_through_minimal_reader(self):
+        # the < 3.11 fallback reader must parse everything we render
+        from cometbft_tpu.config_file import _parse_toml_minimal
+
+        cfg = default_config()
+        cfg.statesync.rpc_servers = ["http://a:26657", "http://b:26657"]
+        cfg.base.moniker = 'quo"ted\tname'
+        data = _parse_toml_minimal(render_toml(cfg))
+        assert data["moniker"] == cfg.base.moniker
+        assert data["statesync"]["rpc_servers"] == cfg.statesync.rpc_servers
+        assert data["consensus"]["timeout_propose_ns"] == (
+            cfg.consensus.timeout_propose_ns
+        )
+        assert data["mempool"]["recheck"] is True
 
 
 class TestCLI:

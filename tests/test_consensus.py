@@ -20,6 +20,7 @@ from cometbft_tpu.types.block import BlockID
 from cometbft_tpu.types.event_bus import QUERY_NEW_BLOCK
 
 from helpers import (
+    HAVE_CRYPTOGRAPHY,
     make_consensus_node,
     make_genesis,
     sign_commit,
@@ -688,6 +689,10 @@ class TestBatchedVoteIngest:
             )
 
 
+@pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="secp256k1/OpenSSL key types need the cryptography wheel",
+)
 def test_secp256k1_validator_produces_blocks():
     """A secp256k1 validator (wire-encodable but with NO batch backend,
     crypto/secp256k1.go) drives consensus through the per-vote verify

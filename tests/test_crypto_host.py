@@ -14,6 +14,8 @@ from cometbft_tpu.crypto import (
     tmhash,
 )
 
+from helpers import HAVE_CRYPTOGRAPHY
+
 
 class TestKeys:
     def test_sign_verify_roundtrip(self):
@@ -29,6 +31,10 @@ class TestKeys:
         assert pk.address() == hashlib.sha256(pk.data).digest()[:20]
         assert len(pk.address()) == 20
 
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY,
+        reason="secp256k1/OpenSSL key types need the cryptography wheel",
+    )
     def test_matches_openssl(self):
         # Cross-check sign path against OpenSSL (same role curve25519-voi
         # plays as oracle for the reference).
@@ -240,4 +246,82 @@ class TestHostThresholdDerivation:
         path.write_text(table(None, rows=({"n": 64}, {"n": 150})))
         assert batch._derive_host_threshold() == (
             batch._DEFAULT_HOST_BATCH_THRESHOLD
+        )
+
+
+class TestPureHandshakeCrypto:
+    """Known-answer vectors for the wheel-less secret-connection crypto
+    (crypto/x25519.py, p2p/conn/secret_connection.hkdf_sha256): a bug
+    that is self-consistent passes every loopback test, then every
+    handshake against a wheel-backed peer fails — only RFC vectors catch
+    it before cross-build deployment."""
+
+    def test_x25519_rfc7748_scalar_mult_vector(self):
+        # RFC 7748 §5.2 vector 1
+        from cometbft_tpu.crypto import x25519
+
+        k = bytes.fromhex(
+            "a546e36bf0527c9d3b16154b82465edd"
+            "62144c0ac1fc5a18506a2244ba449ac4"
+        )
+        u = bytes.fromhex(
+            "e6db6867583030db3594c1a424b15f7c"
+            "726624ec26b3353b10a903a6d0ab1c4c"
+        )
+        assert x25519.x25519(k, u).hex() == (
+            "c3da55379de9c6908e94ea4df28d084f"
+            "32eccf03491c71f754b4075577a28552"
+        )
+
+    def test_x25519_rfc7748_dh_vectors(self):
+        # RFC 7748 §6.1: Alice/Bob keypairs + shared secret
+        from cometbft_tpu.crypto import x25519
+
+        a = bytes.fromhex(
+            "77076d0a7318a57d3c16c17251b26645"
+            "df4c2f87ebc0992ab177fba51db92c2a"
+        )
+        b = bytes.fromhex(
+            "5dab087e624a8a4b79e17f8b83800ee6"
+            "6f3bb1292618b6fd1c2f8b27ff88e0eb"
+        )
+        a_pub, b_pub = x25519.x25519_base(a), x25519.x25519_base(b)
+        assert a_pub.hex() == (
+            "8520f0098930a754748b7ddcb43ef75a"
+            "0dbf3a0d26381af4eba4a98eaa9b4e6a"
+        )
+        assert b_pub.hex() == (
+            "de9edb7d7b7dc1b4d35b61c2ece43537"
+            "3f8343c85b78674dadfc7e146f882b4f"
+        )
+        shared = x25519.x25519(a, b_pub)
+        assert shared == x25519.x25519(b, a_pub)
+        assert shared.hex() == (
+            "4a5d9d5ba4ce2de1728e3bf480350f25"
+            "e07e21c947d19e3376f09b3c1e161742"
+        )
+
+    def test_hkdf_sha256_rfc5869_vectors(self):
+        from cometbft_tpu.p2p.conn.secret_connection import hkdf_sha256
+
+        # RFC 5869 A.1 (basic, explicit salt)
+        okm = hkdf_sha256(
+            ikm=b"\x0b" * 22,
+            info=bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"),
+            length=42,
+            salt=bytes.fromhex("000102030405060708090a0b0c"),
+        )
+        assert okm.hex() == (
+            "3cb25f25faacd57a90434f64d0362f2a"
+            "2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+            "34007208d5b887185865"
+        )
+        # RFC 5869 A.3 (zero-length salt/info). HMAC zero-pads the key,
+        # so the empty salt equals our salt=None default of 32 zeros —
+        # this pins exactly the branch the handshake uses.
+        okm = hkdf_sha256(ikm=b"\x0b" * 22, info=b"", length=42)
+        assert okm.hex() == (
+            "8da4e775a563c18f715f802a063c5a31"
+            "b8a11f5c5ee1879ec3454e5f3c738d2d"
+            "9d201395faa4b61a96c8"
         )

@@ -17,6 +17,11 @@ import time
 
 import pytest
 
+# Process-level testnets: every node is a subprocess with its own jax
+# import; on small CI hosts the convergence timeouts only hold with
+# the full machine — keep the perturbation harness in the slow tier.
+pytestmark = pytest.mark.slow
+
 from cometbft_tpu.e2e import (
     EventLoadMonitor,
     LoadGenerator,

@@ -7,6 +7,8 @@ import pytest
 
 from cometbft_tpu.crypto import ed25519_ref as ref
 
+from helpers import HAVE_CRYPTOGRAPHY
+
 # RFC 8032 §7.1 test vectors (TEST 1..3)
 RFC8032_VECTORS = [
     (
@@ -54,6 +56,10 @@ def test_sign_verify_roundtrip_random():
         assert not ref.verify(pub, msg, bytes(bad))
 
 
+@pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="secp256k1/OpenSSL key types need the cryptography wheel",
+)
 def test_cross_check_cryptography_oracle():
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,

@@ -9,6 +9,8 @@ and the ZIP-215 oracle, including the consensus-critical acceptance
 edge cases.
 """
 
+import pytest
+
 import random
 
 import numpy as np
@@ -17,6 +19,11 @@ from cometbft_tpu.crypto import ed25519_ref as ref
 from cometbft_tpu.ops import curve, pallas_verify, verify
 
 from test_curve import _order8_point, make_batch
+
+# Interpret-mode execution of the full ladder is tens of minutes per
+# invocation on small CPU hosts — slow tier (the XLA-lowering parity
+# tests in test_curve/test_kernel8 stay tier-1).
+pytestmark = pytest.mark.slow
 
 rng = random.Random(77)
 

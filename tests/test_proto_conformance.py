@@ -37,6 +37,10 @@ from cometbft_tpu.types.block import (
 )
 from cometbft_tpu.types.part_set import PartSet
 
+import pytest
+
+from helpers import HAVE_CRYPTOGRAPHY
+
 H32A = bytes([0xAA]) * 32
 H32B = bytes([0xBB]) * 32
 ADDR = bytes(range(20))
@@ -353,6 +357,10 @@ class TestSimpleValidatorEncoding:
             [0x10, 0x0A]
         )
 
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY,
+        reason="secp256k1/OpenSSL key types need the cryptography wheel",
+    )
     def test_secp256k1_validator_leaf(self):
         from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
         from cometbft_tpu.types.validator_set import (

@@ -119,6 +119,7 @@ def test_concurrent_lookups_consistent(fresh_cache):
     assert not errs
 
 
+@pytest.mark.slow  # pallas interpret mode: minutes per launch on CPU
 def test_pallas_cached_kernel_matches_xla():
     """Pallas cached ladder (interpret mode) == XLA cached ladder ==
     oracle over edge lanes, sharing one trace like test_pallas_verify."""

@@ -14,6 +14,8 @@ from cometbft_tpu.crypto import sr25519 as sr
 from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
 from cometbft_tpu.crypto.sr25519 import Sr25519PrivKey
 
+from helpers import HAVE_CRYPTOGRAPHY
+
 
 class TestMerlin:
     def test_published_protocol_vector(self):
@@ -115,6 +117,10 @@ class TestSchnorrkel:
         assert ok_s and all(bits_s)
 
 
+@pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="secp256k1/OpenSSL key types need the cryptography wheel",
+)
 class TestSecp256k1:
     def test_sign_verify_roundtrip(self):
         pv = Secp256k1PrivKey.from_seed(b"\x01" * 32)
@@ -370,6 +376,10 @@ class TestMixedBatchVerifier:
         assert not ok
         assert [int(b) for b in bm] == [1, 0, 1, 1, 1, 1, 0, 1]
 
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY,
+        reason="secp256k1/OpenSSL key types need the cryptography wheel",
+    )
     def test_rejects_unbatchable_type(self):
         bv = crypto_batch.MixedBatchVerifier()
         k = Secp256k1PrivKey.generate()

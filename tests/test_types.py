@@ -40,6 +40,8 @@ from cometbft_tpu.types import (
 from cometbft_tpu.types import canonical, proto
 from cometbft_tpu.types.vote import Proposal
 
+from helpers import HAVE_CRYPTOGRAPHY
+
 
 # --- canonical sign bytes ----------------------------------------------------
 
@@ -535,6 +537,10 @@ class TestValidatorKeyWireScope:
         with pytest.raises(ValueError, match="not wire-encodable"):
             doc.validate_and_complete()
 
+    @pytest.mark.skipif(
+        not HAVE_CRYPTOGRAPHY,
+        reason="secp256k1/OpenSSL key types need the cryptography wheel",
+    )
     def test_genesis_accepts_secp256k1_validator(self):
         from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
         from cometbft_tpu.types.genesis import (
