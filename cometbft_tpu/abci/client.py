@@ -157,7 +157,7 @@ class LocalClient(Client):
         pass
 
     def _call(self, method: str, req):
-        with self.mtx:
+        with self.mtx:  # cometlint: disable=CLNT009 -- the local-client mutex serializes the app exactly like NewLocalClientCreator; app-side persistence is the call's purpose
             return getattr(self.app, method)(req)
 
     def info(self, req):

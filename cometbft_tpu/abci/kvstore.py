@@ -198,7 +198,7 @@ class KVStoreApplication(BaseApplication):
         )
 
     def commit(self, req=None):
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- Commit persists the app state; the app mutex is its atomicity boundary
             batch = self.db.new_batch()
             for k, v in self._staged.items():
                 batch.set(_KV_PREFIX + k, v)
@@ -308,7 +308,7 @@ class KVStoreApplication(BaseApplication):
             "validators": validators,
             "kvs": {k.hex(): v.hex() for k, v in kvs.items()},
         }
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- snapshot-chunk restore writes the app DB; atomic under the app mutex
             batch = self.db.new_batch()
             for k_hex, v_hex in st["kvs"].items():
                 batch.set(_KV_PREFIX + bytes.fromhex(k_hex), bytes.fromhex(v_hex))

@@ -108,7 +108,7 @@ class SocketServer(BaseService):
                     method_out, res = "exception", f"unknown method {method!r}"
                 else:
                     try:
-                        with self._app_mtx:
+                        with self._app_mtx:  # cometlint: disable=CLNT009 -- the server app mutex serializes ABCI calls (socket server contract); app persistence happens inside them
                             res = getattr(self.app, method)(req)
                         method_out = method
                     except Exception as e:  # app bug: report, keep serving

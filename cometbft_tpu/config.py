@@ -59,6 +59,17 @@ ENV_KNOBS: dict[str, str] = {
         "seconds a waiter stalls before the deadlock tier dumps all "
         "thread stacks (default 30; libs/sync.py)"
     ),
+    "COMETBFT_TPU_LOCK_ORDER": (
+        "lock-order sanitizer: off (default) | record accumulates the "
+        "observed acquisition-order edges | enforce raises LockOrderError "
+        "on an edge absent from the static lock-order graph (libs/sync.py; "
+        "graph from `python -m cometbft_tpu.devtools.lint --graph`)"
+    ),
+    "COMETBFT_TPU_LOCK_ORDER_GRAPH": (
+        "path override for the static lock-order graph that enforce mode "
+        "validates against (default: the lockorder.json shipped in "
+        "devtools/lint/graph; libs/sync.py)"
+    ),
     "COMETBFT_TPU_FAIL": (
         "named crash point for fault-injection tests — the process "
         "dies hard when execution reaches it (libs/fail.py)"

@@ -14,6 +14,7 @@ own-vote signing ``sign_vote:2355``/``sign_add_vote:2426``.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import queue
 import threading
@@ -185,6 +186,15 @@ class ConsensusState(BaseService):
         # full node stop; None → os._exit, never a silent dead thread).
         self.on_fatal = None
 
+        # Event-delivery deferral (cometlint CLNT009/CLNT010): while the
+        # receive loop is inside its critical section this collects
+        # (publish_fn, args) pairs; delivery happens after the mutex is
+        # released so subscriber callbacks — the reactor's evsw
+        # re-broadcast does peer sends, pubsub touches its own lock —
+        # never run while 'consensus.state' is held. None => immediate
+        # delivery (replay, init wiring, direct test calls).
+        self._pending_events: list | None = None
+
         self.update_to_state(state)
         self.reconstruct_last_commit_if_needed(state)
 
@@ -204,8 +214,6 @@ class ConsensusState(BaseService):
     def get_round_state(self) -> RoundState:
         """Shallow snapshot — never the live object (state.go GetRoundState
         returns rs.Copy(); field-by-field mutation would tear readers)."""
-        import dataclasses
-
         with self._mtx:
             return dataclasses.replace(self.rs)
 
@@ -341,19 +349,11 @@ class ConsensusState(BaseService):
                     try:
                         if kind == "peer":
                             self.wal.write(payload)
-                            with self._mtx:
-                                self._handle_msg(payload)
                         elif kind == "internal":
                             self.wal.write_sync(payload)
-                            with self._mtx:
-                                self._handle_msg(payload)
                         elif kind == "timeout":
                             self.wal.write(payload)
-                            with self._mtx:
-                                self._handle_timeout(payload)
-                        elif kind == "txs_available":
-                            with self._mtx:
-                                self._handle_txs_available()
+                        self._locked_dispatch(kind, payload)
                     except FatalConsensusError as e:
                         # Fail-stop (state.go finalizeCommit panics): the
                         # node must not keep running on a half-applied
@@ -381,6 +381,49 @@ class ConsensusState(BaseService):
                     # rounds, failed pre-checks) must not let peer-
                     # controlled entries accumulate for the height.
                     memo.clear()
+
+    def _locked_dispatch(self, kind: str, payload) -> None:
+        """One FSM step under the state mutex, with event delivery
+        deferred to AFTER release.
+
+        Holding 'consensus.state' across subscriber callbacks is exactly
+        the blocking-under-lock regime the lock-order pass flags: the
+        reactor's evsw listener re-broadcasts round steps to every peer
+        (socket sends) and the pubsub bus takes its own mutex. Events
+        are *constructed* eagerly at the publish site (the payload is a
+        snapshot), only delivery moves out of the critical section, so
+        RPC/reactor observers see the same data marginally later —
+        ordering among events is preserved.
+        """
+        pending: list = []
+        self._pending_events = pending
+        try:
+            with self._mtx:
+                if kind == "timeout":
+                    self._handle_timeout(payload)
+                elif kind == "txs_available":
+                    self._handle_txs_available()
+                else:
+                    self._handle_msg(payload)
+        finally:
+            self._pending_events = None
+            for fn, args in pending:
+                try:
+                    fn(*args)
+                except Exception:
+                    # a dead subscriber must not take down the FSM loop;
+                    # the traceback still reaches the logs
+                    import traceback
+
+                    traceback.print_exc()
+
+    def _publish(self, fn, *args) -> None:
+        """Route one event through the deferral buffer (or deliver
+        immediately outside the receive loop — replay, init, tests)."""
+        if self._pending_events is not None:
+            self._pending_events.append((fn, args))
+        else:
+            fn(*args)
 
     def _preverify_queued_votes(self, items) -> dict | None:
         """One batched signature launch for all drained current-height votes.
@@ -470,18 +513,21 @@ class ConsensusState(BaseService):
         elif step == RoundStep.NEW_ROUND:
             self._enter_propose(ti.height, 0)
         elif step == RoundStep.PROPOSE:
-            self.event_bus.publish_timeout_propose(
-                EventDataRoundState(**rs.event_fields())
+            self._publish(
+                self.event_bus.publish_timeout_propose,
+                EventDataRoundState(**rs.event_fields()),
             )
             self._enter_prevote(ti.height, ti.round)
         elif step == RoundStep.PREVOTE_WAIT:
-            self.event_bus.publish_timeout_wait(
-                EventDataRoundState(**rs.event_fields())
+            self._publish(
+                self.event_bus.publish_timeout_wait,
+                EventDataRoundState(**rs.event_fields()),
             )
             self._enter_precommit(ti.height, ti.round)
         elif step == RoundStep.PRECOMMIT_WAIT:
-            self.event_bus.publish_timeout_wait(
-                EventDataRoundState(**rs.event_fields())
+            self._publish(
+                self.event_bus.publish_timeout_wait,
+                EventDataRoundState(**rs.event_fields()),
             )
             self._enter_precommit(ti.height, ti.round)
             self._enter_new_round(ti.height, ti.round + 1)
@@ -611,8 +657,14 @@ class ConsensusState(BaseService):
     def _new_step(self) -> None:
         rs = self.rs
         ev = EventDataRoundState(**rs.event_fields())
-        self.event_bus.publish_new_round_step(ev)
-        self.evsw.fire_event(EVENT_NEW_ROUND_STEP, rs)
+        self._publish(self.event_bus.publish_new_round_step, ev)
+        # shallow snapshot: delivery is deferred past further FSM
+        # mutations of rs, and the reactor must broadcast the step
+        # that PUBLISHED the event, not whatever rs ends up at
+        self._publish(
+            self.evsw.fire_event, EVENT_NEW_ROUND_STEP,
+            dataclasses.replace(rs),
+        )
 
     # -- NewRound (state.go:1018) ------------------------------------------
 
@@ -696,7 +748,8 @@ class ConsensusState(BaseService):
             t.start()
             threads.append(t)
             self._prestage_threads = threads
-        self.event_bus.publish_new_round(
+        self._publish(
+            self.event_bus.publish_new_round,
             EventDataNewRound(
                 height=height,
                 round=round_,
@@ -832,12 +885,13 @@ class ConsensusState(BaseService):
             raise
         if not added:
             return
-        self.evsw.fire_event(EVENT_PROPOSAL_BLOCK_PART, msg)
+        self._publish(self.evsw.fire_event, EVENT_PROPOSAL_BLOCK_PART, msg)
         if not rs.proposal_block_parts.is_complete():
             return
         block = ser.loads(rs.proposal_block_parts.assemble())
         rs.proposal_block = block
-        self.event_bus.publish_complete_proposal(
+        self._publish(
+            self.event_bus.publish_complete_proposal,
             EventDataCompleteProposal(
                 height=rs.height,
                 round=rs.round,
@@ -983,7 +1037,10 @@ class ConsensusState(BaseService):
             self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"", None)
             return
 
-        self.event_bus.publish_polka(EventDataRoundState(**rs.event_fields()))
+        self._publish(
+            self.event_bus.publish_polka,
+            EventDataRoundState(**rs.event_fields()),
+        )
 
         pol_round, _ = rs.votes.pol_info()
         if pol_round < round_:
@@ -998,8 +1055,9 @@ class ConsensusState(BaseService):
         if rs.locked_block is not None and rs.locked_block.hash() == maj23.hash:
             # Relock.
             rs.locked_round = round_
-            self.event_bus.publish_relock(
-                EventDataRoundState(**rs.event_fields())
+            self._publish(
+                self.event_bus.publish_relock,
+                EventDataRoundState(**rs.event_fields()),
             )
             self._sign_add_vote(
                 canonical.PRECOMMIT_TYPE, maj23.hash, maj23.part_set_header
@@ -1013,8 +1071,9 @@ class ConsensusState(BaseService):
             rs.locked_round = round_
             rs.locked_block = rs.proposal_block
             rs.locked_block_parts = rs.proposal_block_parts
-            self.event_bus.publish_lock(
-                EventDataRoundState(**rs.event_fields())
+            self._publish(
+                self.event_bus.publish_lock,
+                EventDataRoundState(**rs.event_fields()),
             )
             self._sign_add_vote(
                 canonical.PRECOMMIT_TYPE, maj23.hash, maj23.part_set_header
@@ -1068,7 +1127,10 @@ class ConsensusState(BaseService):
         if rs.proposal_block is None or rs.proposal_block.hash() != maj23.hash:
             rs.proposal_block = None
             rs.proposal_block_parts = PartSet(maj23.part_set_header)
-            self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
+            self._publish(
+                self.evsw.fire_event, EVENT_VALID_BLOCK,
+                dataclasses.replace(rs),
+            )
         self._try_finalize_commit(height)
 
     def _try_finalize_commit(self, height: int) -> None:
@@ -1190,8 +1252,8 @@ class ConsensusState(BaseService):
                 return False
             if not rs.last_commit.add_vote(vote):
                 return False
-            self.event_bus.publish_vote(EventDataVote(vote))
-            self.evsw.fire_event(EVENT_VOTE, vote)
+            self._publish(self.event_bus.publish_vote, EventDataVote(vote))
+            self._publish(self.evsw.fire_event, EVENT_VOTE, vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
                 self._enter_new_round(rs.height, 0)
             return True
@@ -1227,8 +1289,8 @@ class ConsensusState(BaseService):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
-        self.event_bus.publish_vote(EventDataVote(vote))
-        self.evsw.fire_event(EVENT_VOTE, vote)
+        self._publish(self.event_bus.publish_vote, EventDataVote(vote))
+        self._publish(self.evsw.fire_event, EVENT_VOTE, vote)
 
         if vote.msg_type == canonical.PREVOTE_TYPE:
             self._on_prevote_added(vote)
@@ -1262,7 +1324,10 @@ class ConsensusState(BaseService):
                     or rs.proposal_block_parts.header != maj23.part_set_header
                 ):
                     rs.proposal_block_parts = PartSet(maj23.part_set_header)
-                self.evsw.fire_event(EVENT_VALID_BLOCK, rs)
+                self._publish(
+                    self.evsw.fire_event, EVENT_VALID_BLOCK,
+                    dataclasses.replace(rs),
+                )
 
         if rs.round < vote.round and prevotes.has_two_thirds_any():
             self._enter_new_round(rs.height, vote.round)

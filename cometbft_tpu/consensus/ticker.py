@@ -28,10 +28,10 @@ class TimeoutTicker(BaseService):
         self._thread.start()
 
     def on_stop(self) -> None:
-        self._tick_queue.put(None)
+        self._tick_queue.put(None)  # cometlint: disable=CLNT009 -- unbounded queue: put cannot block
 
     def schedule_timeout(self, ti: TimeoutInfo) -> None:
-        self._tick_queue.put(ti)
+        self._tick_queue.put(ti)  # cometlint: disable=CLNT009 -- unbounded queue: put cannot block
 
     def _timeout_routine(self) -> None:
         pending: TimeoutInfo | None = None
@@ -47,7 +47,7 @@ class TimeoutTicker(BaseService):
             except queue.Empty:
                 # deadline reached → fire
                 if pending is not None:
-                    self.tock_queue.put(pending)
+                    self.tock_queue.put(pending)  # cometlint: disable=CLNT009 -- unbounded queue: put cannot block
                 pending, deadline = None, None
                 continue
             if ti is None:

@@ -87,11 +87,11 @@ class WAL:
     def write_sync(self, msg) -> None:
         """fsync before returning — required before signing own msgs."""
         self.write(msg)
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- the WAL mutex serializes frame write+fsync (wal.go WriteSync)
             self.group.flush_and_sync()
 
     def flush_and_sync(self) -> None:
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- flush_and_sync is the caller-requested fsync point
             self.group.flush_and_sync()
 
     def write_end_height(self, height: int) -> None:

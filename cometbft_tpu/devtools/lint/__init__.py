@@ -20,7 +20,18 @@ CLNT004     jit-hygiene         no jax.jit in plain function bodies
 CLNT005     jit-hygiene         shape-like scalar args need static_argnames
 CLNT006     exception-hygiene   no swallowed failures in reactors/servers
 CLNT007     env-knob-registry   COMETBFT_* reads declared in config.py
+CLNT008     lock-order-graph    no cycle in the whole-program lock-
+                                acquisition-order graph (graph/)
+CLNT009     lock-order-graph    no blocking call reachable while an
+                                engine mutex is held
+CLNT010     lock-order-graph    no pubsub publish / event callback
+                                reachable under an engine mutex
 ==========  ==================  ==========================================
+
+CLNT008-010 come from the whole-program pass in ``graph/`` (call graph
++ lock registry + fixpoint), which also emits the ``lockorder.json``
+artifact that ``libs/sync``'s ``COMETBFT_TPU_LOCK_ORDER`` sanitizer
+records against / enforces.
 """
 
 from .engine import (  # noqa: F401

@@ -16,12 +16,13 @@ import sys
 from . import (
     ALL_CHECKERS,
     apply_baseline,
-    lint_root,
     load_baseline,
     save_baseline,
     unjustified,
 )
 from .baseline import BaselineError
+from .engine import lint_contexts, parse_root
+from .graph import GRAPH_RULES, analyze_contexts
 
 
 def _default_root() -> str:
@@ -67,11 +68,31 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-checkers", action="store_true", help="list checkers and exit"
     )
+    ap.add_argument(
+        "--graph",
+        metavar="PATH",
+        help="write the whole-program lock-order graph (deterministic "
+        "JSON) to PATH — the artifact COMETBFT_TPU_LOCK_ORDER=enforce "
+        "validates against",
+    )
+    ap.add_argument(
+        "--dot",
+        metavar="PATH",
+        help="write a GraphViz rendering of the lock-order graph "
+        "(cycle edges red)",
+    )
+    ap.add_argument(
+        "--no-graph",
+        action="store_true",
+        help="skip the whole-program pass (CLNT008-010)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_checkers:
         for c in ALL_CHECKERS:
             print(f"{'/'.join(c.codes):18s} {c.name}: {c.description}")
+        for code, desc in sorted(GRAPH_RULES.items()):
+            print(f"{code:18s} {desc}")
         return 0
 
     roots = args.roots or [_default_root()]
@@ -84,13 +105,26 @@ def main(argv: list[str] | None = None) -> int:
         baseline_path = None
 
     findings, errors = [], []
-    for root in roots:
+    for i, root in enumerate(roots):
         if not os.path.isdir(root):
             print(f"error: not a directory: {root}", file=sys.stderr)
             return 2
-        f, e = lint_root(root, ALL_CHECKERS)
-        findings.extend(f)
+        contexts, e = parse_root(root)
+        findings.extend(lint_contexts(contexts, ALL_CHECKERS))
         errors.extend(e)
+        if not args.no_graph:
+            analysis = analyze_contexts(contexts)
+            findings.extend(analysis.findings())
+            if i == 0 and args.graph:
+                with open(args.graph, "w", encoding="utf-8") as fh:
+                    json.dump(analysis.graph_dict(), fh, indent=2)
+                    fh.write("\n")
+                print(f"wrote lock-order graph to {args.graph}")
+            if i == 0 and args.dot:
+                with open(args.dot, "w", encoding="utf-8") as fh:
+                    fh.write(analysis.to_dot())
+                print(f"wrote lock-order diagram to {args.dot}")
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
 
     for err in errors:
         print(f"error: {err}", file=sys.stderr)

@@ -170,31 +170,32 @@ def declared_knobs_from_config(config_path: str) -> frozenset[str] | None:
     return None
 
 
-def lint_root(
+def parse_root(
     root: str,
-    checkers,
     declared_knobs: frozenset[str] | None = None,
-) -> tuple[list[Finding], list[str]]:
-    """Run ``checkers`` over every .py under ``root``.
-
-    Returns (findings, errors) — errors are human-readable strings for
-    files that failed to parse (a syntax error in the tree is itself a
-    finding-worthy event, but not one attributable to a checker).
-    """
+) -> tuple[list[FileContext], list[str]]:
+    """One parse per file: the shared context list that both the
+    per-file checkers and the whole-program graph pass consume."""
     if declared_knobs is None:
         declared_knobs = declared_knobs_from_config(
             os.path.join(root, "config.py")
         )
-    findings: list[Finding] = []
+    contexts: list[FileContext] = []
     errors: list[str] = []
     for abspath, relpath in iter_py_files(root):
         try:
             with open(abspath, encoding="utf-8") as f:
                 source = f.read()
-            ctx = FileContext(relpath, source, declared_knobs)
+            contexts.append(FileContext(relpath, source, declared_knobs))
         except (OSError, SyntaxError, UnicodeDecodeError) as e:
             errors.append(f"{relpath}: unparseable: {e}")
-            continue
+    return contexts, errors
+
+
+def lint_contexts(contexts, checkers) -> list[Finding]:
+    """Per-file checkers over already-parsed contexts."""
+    findings: list[Finding] = []
+    for ctx in contexts:
         for checker in checkers:
             if not checker.applies(ctx):
                 continue
@@ -203,6 +204,29 @@ def lint_root(
                     _line_probe(fnd.line), fnd.code
                 ) and fnd not in findings:
                     findings.append(fnd)
+    return findings
+
+
+def lint_root(
+    root: str,
+    checkers,
+    declared_knobs: frozenset[str] | None = None,
+    whole_program: bool = True,
+) -> tuple[list[Finding], list[str]]:
+    """Run ``checkers`` over every .py under ``root``, then the
+    whole-program lock-order pass (CLNT008-010) over the same parsed
+    contexts unless ``whole_program`` is False.
+
+    Returns (findings, errors) — errors are human-readable strings for
+    files that failed to parse (a syntax error in the tree is itself a
+    finding-worthy event, but not one attributable to a checker).
+    """
+    contexts, errors = parse_root(root, declared_knobs)
+    findings = lint_contexts(contexts, checkers)
+    if whole_program:
+        from .graph import analyze_contexts
+
+        findings.extend(analyze_contexts(contexts).findings())
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings, errors
 

@@ -1,0 +1,42 @@
+"""Whole-program concurrency analysis (lock-order graph + blocking /
+publish under lock).
+
+Per-file AST rules (CLNT001-007) cannot see an ABBA inversion between
+``consensus/state.py`` and ``mempool/clist_mempool.py`` — the two halves
+of the cycle are each locally innocent.  This package builds the missing
+whole-program view over the same parsed :class:`FileContext` objects the
+engine already produces:
+
+1.  :mod:`index`  — every ``libs/sync`` lock (attributed to its owning
+    class/module, keyed by its *runtime name*), every class/function,
+    and a light type table (constructor assignments + the documented
+    receiver hints) good enough to resolve the engine's call idioms.
+2.  :mod:`analysis` — per-function facts (which locks a ``with`` holds
+    over which calls / blocking primitives / publishes), a fixpoint
+    over the call graph, and the derived engine-wide lock-acquisition-
+    order graph.
+
+Rules emitted on top of the graph:
+
+==========  ==============================================================
+CLNT008     lock-order inversion: a cycle in the acquisition-order graph
+            across any interprocedural path
+CLNT009     blocking call (socket send/recv, blocking queue get/put,
+            subprocess wait, device readback/block_until_ready, fsync,
+            sleep, bare .wait()) reachable while an engine mutex is held
+CLNT010     pubsub publish / event-switch fire reachable under an engine
+            mutex (subscriber callbacks then run inside the critical
+            section)
+==========  ==============================================================
+
+The graph is also a build artifact (``--graph lockorder.json`` /
+``--dot``): ``libs/sync``'s ``COMETBFT_TPU_LOCK_ORDER=record|enforce``
+sanitizer validates the runtime acquisition order against it, so the
+static analysis and the runtime instrumentation verify each other.
+"""
+
+from .analysis import (  # noqa: F401
+    GRAPH_RULES,
+    WholeProgramAnalysis,
+    analyze_contexts,
+)

@@ -1,0 +1,661 @@
+"""Facts extraction, interprocedural propagation, and the three
+whole-program rules (CLNT008/009/010).
+
+Per function the facts pass records, with the *lexical* stack of held
+engine locks at each point: which locks a ``with`` (or bare
+``.acquire()`` / a wrapper like ``mempool.lock()``) takes, which calls
+happen under them, and which blocking / publish primitives fire
+directly.  A fixpoint over the resolved call graph then computes, for
+every function, the locks it may transitively acquire (``ACQ*``), the
+blocking primitives it may transitively reach (``BLK*``), and the
+publishes it may transitively perform (``PUB*``).  Lock-order edges are
+``held-lock -> any lock in ACQ*(callee)`` plus direct lexical nesting;
+CLNT008 is a cycle among them, CLNT009/010 are ``BLK*``/``PUB*``
+reachable from under a held lock.
+
+Soundness bias: the resolver over-approximates (hints, dynamic-dispatch
+unions, capped name fallback) because the runtime sanitizer validates
+its *observed* edges as a subgraph of this graph — a spurious static
+edge is noise, a missing one is a hole in the cross-check.  Same-name
+edges are excluded on both sides (names label roles, not instances).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..engine import Finding
+from . import hints
+from .index import FuncInfo, ProgramIndex
+
+GRAPH_RULES = {
+    "CLNT008": "lock-order-graph: acquisition-order cycle across any "
+    "interprocedural path",
+    "CLNT009": "lock-order-graph: blocking call reachable while an engine "
+    "mutex is held",
+    "CLNT010": "lock-order-graph: pubsub publish / event callback reachable "
+    "under an engine mutex",
+}
+
+_MAX_CHAIN = 12
+
+
+@dataclass(frozen=True)
+class _CallRec:
+    line: int
+    callees: tuple[str, ...]
+    stack: tuple[tuple[str, int], ...]
+
+
+@dataclass(frozen=True)
+class _PrimRec:
+    kind: str
+    line: int
+    stack: tuple[tuple[str, int], ...]
+    exempt: frozenset[str]
+
+
+@dataclass(frozen=True)
+class _PubRec:
+    name: str
+    line: int
+    stack: tuple[tuple[str, int], ...]
+
+
+@dataclass
+class _Facts:
+    acquired: set[str] = field(default_factory=set)
+    direct_edges: list[tuple[str, str, int]] = field(default_factory=list)
+    calls: list[_CallRec] = field(default_factory=list)
+    prims: list[_PrimRec] = field(default_factory=list)
+    pubs: list[_PubRec] = field(default_factory=list)
+    # alias-groups acquired via bare .acquire() and NOT released later in
+    # the same function — the signature of a hold-returning wrapper like
+    # CListMempool.lock(); a balanced acquire/finally-release pair trims
+    # itself back out in the .release() branch
+    net_hold: list[tuple[str, ...]] = field(default_factory=list)
+
+
+class _FactsVisitor:
+    def __init__(self, index: ProgramIndex, fi: FuncInfo, wrapper_net):
+        self.index = index
+        self.fi = fi
+        self.wrapper_net = wrapper_net
+        self.local = index.local_types(fi)
+        self.stack: list[tuple[str, int]] = []
+        self.facts = _Facts()
+
+    def run(self) -> _Facts:
+        for stmt in self.fi.node.body:
+            self._visit(stmt)
+        return self.facts
+
+    # -- stack ------------------------------------------------------------
+    # A stack entry is (alias_group, site_line): one acquisition may be
+    # any name in the group (hints.LOCK_ALIASES — a lock object wired
+    # through under a different construction name). Edges are generated
+    # for the full held-group x acquired-group product.
+
+    def _push(self, keys: tuple[str, ...], line: int) -> None:
+        for held, _ in self.stack:
+            for h in held:
+                for k in keys:
+                    if h != k:
+                        self.facts.direct_edges.append((h, k, line))
+        self.facts.acquired.update(keys)
+        self.stack.append((keys, line))
+
+    def _pop(self, keys: tuple[str, ...]) -> None:
+        for i in range(len(self.stack) - 1, -1, -1):
+            if self.stack[i][0] == keys:
+                del self.stack[i]
+                return
+
+    def _lock_keys(self, ld) -> tuple[str, ...]:
+        key = ld.assoc if (ld.kind == "cond" and ld.assoc) else ld.key
+        return (key,) + hints.LOCK_ALIASES.get(key, ())
+
+    # -- walk -------------------------------------------------------------
+
+    def _visit(self, node) -> None:
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            pushed = []
+            for item in node.items:
+                self._visit(item.context_expr)
+                ld = self.index.resolve_lock_expr(item.context_expr, self.fi)
+                if ld is not None:
+                    keys = self._lock_keys(ld)
+                    self._push(keys, node.lineno)
+                    pushed.append(keys)
+            for stmt in node.body:
+                self._visit(stmt)
+            for keys in reversed(pushed):
+                self._pop(keys)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    # -- calls ------------------------------------------------------------
+
+    def _stack_tuple(self):
+        return tuple(self.stack)
+
+    def _handle_call(self, call: ast.Call) -> None:
+        fn = call.func
+        # getattr(obj, dynamic_name)(...) — LocalClient routing ABCI
+        # methods by request name: may invoke ANY method of obj's type
+        if (
+            isinstance(fn, ast.Call)
+            and isinstance(fn.func, ast.Name)
+            and fn.func.id == "getattr"
+            and fn.args
+        ):
+            types = self.index.expr_types(fn.args[0], self.fi, self.local)
+            dispatch = self.index.all_methods(
+                {t for t in types if not t.startswith("@")}
+            )
+            if dispatch:
+                self.facts.calls.append(
+                    _CallRec(
+                        call.lineno,
+                        tuple(sorted(c.qual for c in dispatch)),
+                        self._stack_tuple(),
+                    )
+                )
+            return
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "acquire":
+                ld = self.index.resolve_lock_expr(fn.value, self.fi)
+                if ld is not None:
+                    keys = self._lock_keys(ld)
+                    self._push(keys, call.lineno)
+                    self.facts.net_hold.append(keys)
+                return
+            if fn.attr == "release":
+                ld = self.index.resolve_lock_expr(fn.value, self.fi)
+                if ld is not None:
+                    keys = self._lock_keys(ld)
+                    self._pop(keys)
+                    for i in range(len(self.facts.net_hold) - 1, -1, -1):
+                        if self.facts.net_hold[i] == keys:
+                            del self.facts.net_hold[i]
+                            break
+                return
+            if self._classify_attr_call(call, fn):
+                return  # a stdlib blocking leaf — nothing to resolve into
+        callees = self.index.resolve_call(call, self.fi, self.local)
+        if callees:
+            self.facts.calls.append(
+                _CallRec(
+                    call.lineno,
+                    tuple(sorted(c.qual for c in callees)),
+                    self._stack_tuple(),
+                )
+            )
+            # wrapper methods that RETURN holding a lock (mempool.lock())
+            for c in callees:
+                for keys in self.wrapper_net.get(c.qual, ()):
+                    self._push(keys, call.lineno)
+
+    def _classify_attr_call(self, call: ast.Call, fn: ast.Attribute) -> bool:
+        """Record blocking/publish primitives; True when the call is a
+        stdlib blocking leaf that needs no callee resolution.
+
+        A suppression ON THE PRIMITIVE's own line (``# cometlint:
+        disable=CLNT009 -- unbounded queue``) removes it at the source —
+        for calls that match a blocking pattern but cannot actually
+        block — so no caller anywhere sees it. A suppression at an
+        acquisition site, by contrast, sanctions only that one critical
+        section."""
+        attr = fn.attr
+        stack = self._stack_tuple()
+        if self.fi.ctx.suppressed(call, "CLNT009"):
+            if hints.is_publish_attr(attr) and not self.fi.ctx.suppressed(
+                call, "CLNT010"
+            ):
+                self.facts.pubs.append(_PubRec(attr, call.lineno, stack))
+            return False
+        if hints.is_publish_attr(attr) and self.fi.ctx.suppressed(
+            call, "CLNT010"
+        ):
+            return False
+        # stdlib module calls: time.sleep, os.fsync, subprocess.run ...
+        if isinstance(fn.value, ast.Name):
+            std = self.index.stdlib_alias.get(self.fi.module, {}).get(
+                fn.value.id
+            )
+            kind = hints.BLOCKING_MODULE_CALLS.get((std, attr))
+            if kind is not None:
+                self.facts.prims.append(
+                    _PrimRec(kind, call.lineno, stack, frozenset())
+                )
+                return True
+        if hints.is_publish_attr(attr):
+            self.facts.pubs.append(_PubRec(attr, call.lineno, stack))
+        recv_types = self.index.expr_types(fn.value, self.fi, self.local)
+        for t in recv_types:
+            kind = hints.PSEUDO_BLOCKING_METHODS.get(t, {}).get(attr)
+            if kind is not None and not self._nonblocking_args(attr, call):
+                self.facts.prims.append(
+                    _PrimRec(kind, call.lineno, stack, frozenset())
+                )
+                return True
+        kind = hints.BLOCKING_ATTR_ANYRECV.get(attr)
+        if kind is not None:
+            self.facts.prims.append(
+                _PrimRec(kind, call.lineno, stack, frozenset())
+            )
+            return True
+        if attr in hints.WAIT_ATTRS:
+            exempt = frozenset()
+            ld = self.index.resolve_lock_expr(fn.value, self.fi)
+            if ld is not None and ld.kind == "cond":
+                # cv.wait() releases the condition's own lock; every
+                # OTHER held lock still blocks on it
+                exempt = frozenset({ld.assoc or ld.key})
+            self.facts.prims.append(
+                _PrimRec("wait", call.lineno, stack, exempt)
+            )
+            return True
+        return False
+
+    @staticmethod
+    def _nonblocking_args(attr: str, call: ast.Call) -> bool:
+        """queue get/put with block=False (or positional False) is a poll."""
+        if attr not in ("get", "put"):
+            return False
+        pos = 0 if attr == "get" else 1
+        if len(call.args) > pos:
+            a = call.args[pos]
+            if isinstance(a, ast.Constant) and not a.value:
+                return True
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant):
+                return not kw.value.value
+        return False
+
+
+class WholeProgramAnalysis:
+    """Build facts for every function, run the fixpoint, derive the
+    lock-order graph and the CLNT008-010 findings."""
+
+    def __init__(self, contexts):
+        self.index = ProgramIndex(contexts)
+        self.facts: dict[str, _Facts] = {}
+        self._build_facts()
+        self._propagate()
+        self._build_edges()
+
+    # ------------------------------------------------------------ facts
+
+    def _build_facts(self) -> None:
+        # round 1: wrapper summaries (functions that return holding a lock)
+        wrapper_net: dict[str, tuple[str, ...]] = {}
+        for qual, fi in self.index.funcs.items():
+            f = _FactsVisitor(self.index, fi, {}).run()
+            if f.net_hold:
+                wrapper_net[qual] = tuple(dict.fromkeys(f.net_hold))
+        # round 2: full facts with wrapper holds applied at call sites
+        for qual, fi in self.index.funcs.items():
+            self.facts[qual] = _FactsVisitor(
+                self.index, fi, wrapper_net
+            ).run()
+
+    # --------------------------------------------------------- fixpoint
+
+    def _propagate(self) -> None:
+        callees: dict[str, set[str]] = {}
+        callers: dict[str, set[str]] = {}
+        for qual, f in self.facts.items():
+            cs = set()
+            for rec in f.calls:
+                cs.update(rec.callees)
+            callees[qual] = cs
+            for c in cs:
+                callers.setdefault(c, set()).add(qual)
+
+        self.acq_star: dict[str, set[str]] = {
+            q: set(f.acquired) for q, f in self.facts.items()
+        }
+        # via maps for witness-chain reconstruction:
+        #   acq_via[f][lock]  = (line, callee | None)
+        #   blk_via[f][(kind, exempt)] = (line, callee | None)
+        #   pub_via[f][name]  = (line, callee | None)
+        self.acq_via: dict[str, dict] = {q: {} for q in self.facts}
+        self.blk_star: dict[str, dict] = {q: {} for q in self.facts}
+        self.pub_star: dict[str, dict] = {q: {} for q in self.facts}
+        for q, f in self.facts.items():
+            for frm, to, line in f.direct_edges:
+                self.acq_via[q].setdefault(to, (line, None))
+            for key in f.acquired:
+                self.acq_via[q].setdefault(key, (0, None))
+            for p in f.prims:
+                self.blk_star[q].setdefault(
+                    (p.kind, p.exempt), (p.line, None)
+                )
+            for p in f.pubs:
+                self.pub_star[q].setdefault(p.name, (p.line, None))
+
+        work = set(self.facts)
+        while work:
+            q = work.pop()
+            for caller in callers.get(q, ()):
+                changed = False
+                line = 0
+                for rec in self.facts[caller].calls:
+                    if q in rec.callees:
+                        line = rec.line
+                        break
+                for key in self.acq_star[q]:
+                    if key not in self.acq_star[caller]:
+                        self.acq_star[caller].add(key)
+                        self.acq_via[caller][key] = (line, q)
+                        changed = True
+                for bk in self.blk_star[q]:
+                    if bk not in self.blk_star[caller]:
+                        self.blk_star[caller][bk] = (line, q)
+                        changed = True
+                for name in self.pub_star[q]:
+                    if name not in self.pub_star[caller]:
+                        self.pub_star[caller][name] = (line, q)
+                        changed = True
+                if changed:
+                    work.add(caller)
+
+    # ------------------------------------------------------------ edges
+
+    def _build_edges(self) -> None:
+        # (frm, to) -> sorted witness list of (path, line, qual, via_qual)
+        edges: dict[tuple[str, str], list] = {}
+
+        def add(frm, to, path, line, qual, via):
+            if frm == to:
+                return
+            edges.setdefault((frm, to), []).append((path, line, qual, via))
+
+        for qual, f in self.facts.items():
+            fi = self.index.funcs[qual]
+            for frm, to, line in f.direct_edges:
+                add(frm, to, fi.ctx.relpath, line, qual, None)
+            for rec in f.calls:
+                if not rec.stack:
+                    continue
+                reach: set[str] = set()
+                for c in rec.callees:
+                    reach |= self.acq_star.get(c, set())
+                if not reach:
+                    continue
+                for keys, _site in rec.stack:
+                    for key in keys:
+                        for to in reach:
+                            add(
+                                frm=key, to=to, path=fi.ctx.relpath,
+                                line=rec.line, qual=qual, via=rec.callees[0],
+                            )
+        self.edges = {k: sorted(v) for k, v in edges.items()}
+
+    # ------------------------------------------------------- chain text
+
+    def _acq_chain(self, start_qual: str, lock: str) -> str:
+        parts = [start_qual]
+        q = start_qual
+        for _ in range(_MAX_CHAIN):
+            via = self.acq_via.get(q, {}).get(lock)
+            if via is None or via[1] is None:
+                break
+            q = via[1]
+            parts.append(q)
+        return " -> ".join(parts)
+
+    def _blk_chain(self, start_qual: str, bk) -> str:
+        parts = [start_qual]
+        q = start_qual
+        for _ in range(_MAX_CHAIN):
+            via = self.blk_star.get(q, {}).get(bk)
+            if via is None or via[1] is None:
+                break
+            q = via[1]
+            parts.append(q)
+        return " -> ".join(parts)
+
+    def _pub_chain(self, start_qual: str, name: str) -> str:
+        parts = [start_qual]
+        q = start_qual
+        for _ in range(_MAX_CHAIN):
+            via = self.pub_star.get(q, {}).get(name)
+            if via is None or via[1] is None:
+                break
+            q = via[1]
+            parts.append(q)
+        return " -> ".join(parts)
+
+    # ---------------------------------------------------------- cycles
+
+    def _sccs(self) -> list[set[str]]:
+        """Tarjan over the lock-order graph; returns SCCs with >= 2 nodes."""
+        graph: dict[str, set[str]] = {}
+        for (frm, to) in self.edges:
+            graph.setdefault(frm, set()).add(to)
+            graph.setdefault(to, set())
+        idx, low, on, st = {}, {}, set(), []
+        out: list[set[str]] = []
+        counter = [0]
+
+        def strong(v):
+            stack = [(v, iter(sorted(graph[v])))]
+            idx[v] = low[v] = counter[0]
+            counter[0] += 1
+            st.append(v)
+            on.add(v)
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for w in it:
+                    if w not in idx:
+                        idx[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        st.append(w)
+                        on.add(w)
+                        stack.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], idx[w])
+                if advanced:
+                    continue
+                stack.pop()
+                if stack:
+                    parent = stack[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == idx[node]:
+                    scc = set()
+                    while True:
+                        w = st.pop()
+                        on.discard(w)
+                        scc.add(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        out.append(scc)
+
+        for v in sorted(graph):
+            if v not in idx:
+                strong(v)
+        return out
+
+    # -------------------------------------------------------- findings
+
+    def findings(self) -> list[Finding]:
+        out: list[Finding] = []
+        seen: set[tuple] = set()
+
+        def emit(relpath, line, code, key, msg):
+            dk = (relpath, line, code, key)
+            if dk in seen:
+                return
+            seen.add(dk)
+            ctx = self.index.contexts.get(relpath)
+            if ctx is not None and ctx.suppressed(_probe(line), code):
+                return
+            out.append(Finding(relpath, line, code, msg))
+
+        # CLNT008: edges participating in a cycle
+        for scc in self._sccs():
+            cyc = "/".join(sorted(scc))
+            for (frm, to), wits in sorted(self.edges.items()):
+                if frm in scc and to in scc:
+                    path, line, qual, via = wits[0]
+                    how = (
+                        f"via {self._acq_chain(qual, to)}"
+                        if via
+                        else f"nested in {qual}"
+                    )
+                    emit(
+                        path, line, "CLNT008", (frm, to),
+                        f"lock-order inversion: acquiring '{to}' while "
+                        f"holding '{frm}' closes a cycle among [{cyc}] "
+                        f"({how})",
+                    )
+
+        # CLNT009 / CLNT010
+        for qual, f in self.facts.items():
+            fi = self.index.funcs[qual]
+            rp = fi.ctx.relpath
+            for p in f.prims:
+                for keys, site in p.stack:
+                    if any(k in p.exempt for k in keys):
+                        continue
+                    key = keys[0]
+                    emit(
+                        rp, site, "CLNT009", (key, p.kind),
+                        f"blocking {p.kind} at line {p.line} runs while "
+                        f"'{key}' is held — move it outside the critical "
+                        f"section or narrow the lock",
+                    )
+            for p in f.pubs:
+                for keys, site in p.stack:
+                    key = keys[0]
+                    emit(
+                        rp, site, "CLNT010", (key,),
+                        f"pubsub/event '{p.name}' fires at line {p.line} "
+                        f"while '{key}' is held — subscriber callbacks run "
+                        f"inside the critical section",
+                    )
+            for rec in f.calls:
+                if not rec.stack:
+                    continue
+                blk: dict = {}
+                pub: dict = {}
+                for c in rec.callees:
+                    for bk, via in self.blk_star.get(c, {}).items():
+                        blk.setdefault((bk, c), via)
+                    for name, via in self.pub_star.get(c, {}).items():
+                        pub.setdefault((name, c), via)
+                for ((kind, exempt), callee), _via in sorted(blk.items()):
+                    for keys, site in rec.stack:
+                        if any(k in exempt for k in keys):
+                            continue
+                        key = keys[0]
+                        emit(
+                            rp, site, "CLNT009", (key, kind),
+                            f"blocking {kind} reachable while '{key}' is "
+                            f"held: {qual} -> "
+                            f"{self._blk_chain(callee, (kind, exempt))}",
+                        )
+                for (name, callee), _via in sorted(pub.items()):
+                    for keys, site in rec.stack:
+                        key = keys[0]
+                        emit(
+                            rp, site, "CLNT010", (key,),
+                            f"pubsub/event '{name}' reachable while "
+                            f"'{key}' is held: {qual} -> "
+                            f"{self._pub_chain(callee, name)}",
+                        )
+        out.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+        return out
+
+    # -------------------------------------------------------- artifact
+
+    def graph_dict(self) -> dict:
+        """Deterministic machine-readable lock-order graph."""
+        cycle_nodes: set[str] = set()
+        for scc in self._sccs():
+            cycle_nodes |= scc
+        locks = [
+            {
+                "name": ld.key,
+                "kind": ld.kind,
+                "path": ld.relpath,
+                "line": ld.line,
+                "owner": (
+                    f"{ld.module}.{ld.cls}.{ld.attr}"
+                    if ld.cls
+                    else f"{ld.module}.{ld.attr}"
+                ),
+            }
+            for ld in sorted(self.index.locks.values(), key=lambda l: l.key)
+        ]
+        edges = []
+        for (frm, to), wits in sorted(self.edges.items()):
+            path, line, qual, via = wits[0]
+            edges.append(
+                {
+                    "from": frm,
+                    "to": to,
+                    "witness": f"{path}:{line}",
+                    "in": qual,
+                    "via": via or "",
+                    "in_cycle": frm in cycle_nodes and to in cycle_nodes,
+                }
+            )
+        return {
+            "version": 1,
+            "generator": "python -m cometbft_tpu.devtools.lint --graph",
+            "locks": locks,
+            "edges": edges,
+        }
+
+    def to_dot(self) -> str:
+        """GraphViz rendering; cycle edges red, conditions dashed."""
+        d = self.graph_dict()
+        lines = [
+            "digraph lockorder {",
+            '  rankdir=LR; node [shape=box, fontsize=10];',
+        ]
+        in_graph = {e["from"] for e in d["edges"]} | {
+            e["to"] for e in d["edges"]
+        }
+        for lk in d["locks"]:
+            if lk["name"] not in in_graph:
+                continue
+            style = ' style=dashed' if lk["kind"] == "cond" else ""
+            lines.append(
+                f'  "{lk["name"]}" [label="{lk["name"]}\\n{lk["kind"]}"'
+                f'{style}];'
+            )
+        for e in d["edges"]:
+            attrs = ' [color=red, penwidth=2]' if e["in_cycle"] else ""
+            lines.append(f'  "{e["from"]}" -> "{e["to"]}"{attrs};')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+class _probe:
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+        self.end_lineno = lineno
+
+
+def analyze_contexts(contexts) -> WholeProgramAnalysis:
+    return WholeProgramAnalysis(contexts)

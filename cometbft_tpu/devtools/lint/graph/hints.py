@@ -1,0 +1,201 @@
+"""Resolution hints and the blocking-call specification.
+
+Python has no static types to lean on, so the call-graph resolver works
+from three sources, in order: constructor assignments it can see
+(``self.wal = NopWAL()``), this table of documented receiver-name hints
+for attributes/params whose construction happens across module
+boundaries (``self.wal = wal``), and a capped unique-method-name
+fallback.  The hints deliberately OVER-approximate (a name maps to every
+class it might be): extra static edges are harmless to the runtime
+subgraph cross-check and the cost of a false CLNT009 is one reviewed
+suppression, while a missing edge is a hole in the sanitizer.
+
+Pseudo-types (``@socket`` etc.) mark stdlib handles whose methods are
+the blocking leaves the analysis is hunting.
+"""
+
+from __future__ import annotations
+
+# attribute / parameter / local-variable name -> possible classes.
+# "@socket" / "@queue" / "@event" / "@thread" / "@popen" are pseudo-types
+# whose blocking methods are listed below.
+RECEIVER_HINTS: dict[str, tuple[str, ...]] = {
+    "wal": ("WAL", "NopWAL"),
+    "group": ("Group",),
+    "block_store": ("BlockStore",),
+    "store": ("BlockStore", "Store"),
+    "state_store": ("Store",),
+    "block_exec": ("BlockExecutor",),
+    "executor": ("BlockExecutor",),
+    "mempool": ("CListMempool", "NopMempool"),
+    "tx_notifier": ("CListMempool", "NopMempool"),
+    "proxy_app": ("LocalClient", "SocketClient", "GRPCClient"),
+    "app_conn": ("LocalClient", "SocketClient"),
+    "conns": ("AppConns",),
+    "event_bus": ("EventBus", "NopEventBus"),
+    "bus": ("EventBus", "NopEventBus"),
+    "evsw": ("EventSwitch",),
+    "evidence_pool": ("EvidencePool",),
+    "votes": ("HeightVoteSet", "VoteSet"),
+    "prevotes": ("VoteSet",),
+    "precommits": ("VoteSet",),
+    "last_commit": ("VoteSet",),
+    "vote_set": ("VoteSet",),
+    "vs": ("VoteSet",),
+    "rs": ("RoundState",),
+    "ps": ("PeerState",),
+    "peer": ("Peer",),
+    "mconn": ("MConnection",),
+    "switch": ("Switch",),
+    "ticker": ("TimeoutTicker",),
+    "pool": ("BlockPool", "EvidencePool", "SnapshotPool"),
+    "chunks": ("ChunkQueue",),
+    "snapshots": ("SnapshotPool",),
+    "syncer": ("Syncer",),
+    "cache": ("LRUTxCache", "NopTxCache"),
+    "txs": ("CList",),
+    "cs": ("ConsensusState",),
+    "db": ("MemDB", "FileDB", "NativeDB"),
+    "_db": ("MemDB", "FileDB", "NativeDB"),
+    "secret_conn": ("SecretConnection",),
+    "conn": ("SecretConnection", "@socket"),
+    "sock": ("@socket",),
+    "_sock": ("@socket",),
+    "transport": ("MultiplexTransport",),
+    "priv_validator": ("FilePV", "MockPV", "SignerClient"),
+    "pv": ("FilePV", "MockPV", "SignerClient"),
+    "send_monitor": ("Monitor",),
+    "recv_monitor": ("Monitor",),
+    "app": ("Application",),
+    "logger": ("Logger",),
+    "tx_indexer": ("KVTxIndexer", "NullTxIndexer"),
+    "block_indexer": ("KVBlockIndexer",),
+}
+
+# One lock OBJECT can flow through wiring under two names: AppConns
+# hands the shared ``proxy.mtx`` to every LocalClient, whose fallback
+# name is "abci.client". The analysis treats an acquisition of the
+# primary name as possibly being any alias, so edges exist under both
+# vocabularies and the runtime recorder (which sees the name the object
+# was CONSTRUCTED with) always validates.
+LOCK_ALIASES: dict[str, tuple[str, ...]] = {
+    "abci.client": ("proxy.mtx",),
+}
+
+# -- blocking specification -------------------------------------------------
+
+# module-level functions that block, by (module alias, attr) — the
+# resolver knows the canonical module from each file's imports.
+BLOCKING_MODULE_CALLS: dict[tuple[str, str], str] = {
+    ("time", "sleep"): "sleep",
+    ("os", "fsync"): "fsync",
+    ("os", "fdatasync"): "fsync",
+    ("select", "select"): "select",
+    ("subprocess", "run"): "subprocess",
+    ("subprocess", "call"): "subprocess",
+    ("subprocess", "check_call"): "subprocess",
+    ("subprocess", "check_output"): "subprocess",
+    ("socket", "create_connection"): "socket",
+    ("socket", "getaddrinfo"): "socket",
+    ("jax", "device_get"): "device-readback",
+}
+
+# methods on pseudo-typed receivers that block
+PSEUDO_BLOCKING_METHODS: dict[str, dict[str, str]] = {
+    "@socket": {
+        "send": "socket-send",
+        "sendall": "socket-send",
+        "sendto": "socket-send",
+        "recv": "socket-recv",
+        "recv_into": "socket-recv",
+        "recvfrom": "socket-recv",
+        "accept": "socket-accept",
+        "connect": "socket-connect",
+        "makefile": "socket-io",
+    },
+    "@queue": {
+        # .get()/.put() unless block=False / block arg False; the
+        # classifier checks the args — get_nowait/put_nowait are
+        # different attr names and never reach this table.
+        "get": "queue-get",
+        "put": "queue-put",
+        "join": "queue-join",
+    },
+    "@event": {"wait": "event-wait"},
+    "@thread": {"join": "thread-join"},
+    "@popen": {"wait": "subprocess", "communicate": "subprocess"},
+}
+
+# attribute names blocking on ANY receiver (no type needed): device
+# syncs and the socket methods distinctive enough to never be dict/str
+# operations.
+BLOCKING_ATTR_ANYRECV: dict[str, str] = {
+    "block_until_ready": "device-readback",
+    "sendall": "socket-send",
+    "recv_into": "socket-recv",
+    "accept": "socket-accept",
+    "read_exact_msg": "socket-recv",
+}
+
+# a bare ``.wait(...)`` / ``.wait_for(...)`` is blocking (Event,
+# Condition, ReqRes, Popen...). When the receiver is a libs/sync
+# Condition the edge to the condition's OWN associated lock is exempt —
+# ``wait()`` releases it — but any OTHER held lock still blocks.
+WAIT_ATTRS = ("wait", "wait_for")
+
+# pseudo-type constructors (module attr form) for the type table
+PSEUDO_CONSTRUCTORS: dict[tuple[str, str], str] = {
+    ("queue", "Queue"): "@queue",
+    ("queue", "SimpleQueue"): "@queue",
+    ("queue", "LifoQueue"): "@queue",
+    ("queue", "PriorityQueue"): "@queue",
+    ("threading", "Event"): "@event",
+    ("threading", "Thread"): "@thread",
+    ("subprocess", "Popen"): "@popen",
+    ("socket", "socket"): "@socket",
+    ("socket", "create_connection"): "@socket",
+}
+
+# name-heuristic fallback for queue-ish attributes the type table
+# misses (``self._send_q``, ``tock_queue``)
+def queueish(name: str) -> bool:
+    low = name.lower()
+    return "queue" in low or low.endswith("_q") or low == "q"
+
+
+# -- publish specification (CLNT010) ---------------------------------------
+
+def is_publish_attr(attr: str) -> bool:
+    return attr == "publish" or attr.startswith("publish_") or attr == "fire_event"
+
+
+# unique-method-name fallback: resolve x.m() to every definition of m in
+# the package when the name has at most this many definitions. Common
+# names (get/set/update/...) exceed the cap and stay unresolved instead
+# of wiring the whole engine together.
+UNIQUE_NAME_CAP = 3
+
+
+def distinctive(name: str) -> bool:
+    """Gate for the unique-name fallback: short bare verbs (read, next,
+    remove, send...) collide with builtins and stdlib objects and wire
+    unrelated subsystems together; project methods are compound names."""
+    return "_" in name or len(name) >= 9
+
+
+# method/function NAME -> classes it returns. The light type inference
+# reads constructor calls; these cover the few factory idioms the engine
+# uses where the constructor is behind a call (the metrics registry
+# chain: node_metrics().proposals.labels(...).inc()).
+RETURN_TYPE_HINTS: dict[str, tuple[str, ...]] = {
+    "node_metrics": ("NodeMetrics",),
+    "counter": ("Counter",),
+    "gauge": ("Gauge",),
+    "histogram": ("Histogram",),
+    "labels": ("Counter", "Gauge", "Histogram"),
+    "get_round_state": ("RoundState",),
+    "new_batch": ("Batch",),
+    "default_logger": ("Logger",),
+    "with_module": ("Logger",),
+    "with_fields": ("Logger",),
+}

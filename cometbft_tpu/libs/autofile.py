@@ -45,7 +45,7 @@ class Group:
             self._head.flush()
 
     def flush_and_sync(self) -> None:
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- the group mutex serializes write+fsync: the WAL durability point
             self._head.flush()
             os.fsync(self._head.fileno())
 

@@ -67,6 +67,8 @@ def _compile(
         "-std=c++17", *extra_flags, src, "-o", so + ".tmp",
     ]
     try:
+        # cometlint: disable=CLNT009 -- one-time lazy toolchain build; the
+        # resulting .so is cached on disk and re-dlopened for free after
         r = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout
         )

@@ -17,6 +17,22 @@ nothing in production. Long-running services construct locks through
 this module (consensus state, switch, mempool) so the whole engine
 flips with one env var — the analog of rebuilding with ``-tags
 deadlock``.
+
+Lock-order sanitizer (``COMETBFT_TPU_LOCK_ORDER=record|enforce``):
+every instrumented acquisition also maintains a per-thread stack of
+held lock *names* and derives acquisition-order edges (outermost held
+name → newly acquired name).  ``record`` accumulates the observed
+edges (:func:`observed_lock_order`) so tests can validate them as a
+subgraph of the static lock-order graph that cometlint's whole-program
+pass (``devtools/lint/graph``) emits; ``enforce`` raises
+:class:`LockOrderError` the moment a thread takes an edge absent from
+the shipped static graph — static analysis and runtime sanitizer
+verifying each other.  Same-name edges are skipped: lock names label
+*roles* (every ``Peer`` shares ``p2p.peer._data_mtx``), so a same-name
+edge is either a reentrant RLock or an instance-ambiguous hierarchy
+hop that neither side can order.  Like deadlock detection, the mode is
+read at lock *construction* — flip it (env var or
+:func:`set_lock_order_mode`) before building the objects under test.
 """
 
 from __future__ import annotations
@@ -50,6 +66,131 @@ def enabled() -> bool:
 
 class DeadlockError(RuntimeError):
     pass
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition-order edge not present in the static lock-order
+    graph was taken under ``COMETBFT_TPU_LOCK_ORDER=enforce``."""
+
+
+# -------------------------------------------------------- lock ordering
+
+_LOCK_ORDER_MODES = ("off", "record", "enforce")
+_order_mode = os.environ.get("COMETBFT_TPU_LOCK_ORDER", "off")
+if _order_mode not in _LOCK_ORDER_MODES:
+    _order_mode = "off"
+_order_graph_path = os.environ.get("COMETBFT_TPU_LOCK_ORDER_GRAPH") or None
+
+_tls = threading.local()  # .held: list[str] of instrumented-lock names
+# observed (from, to) -> first witness "file:line" of the inner acquire
+_observed: dict[tuple[str, str], str] = {}
+_observed_mtx = threading.Lock()  # tier-internal meta-lock, never exposed
+_allowed_edges: frozenset[tuple[str, str]] | None = None
+
+
+def set_lock_order_mode(mode: str, graph_path: str | None = None) -> None:
+    """Programmatic analog of ``COMETBFT_TPU_LOCK_ORDER`` (tests).
+    Only affects locks constructed AFTER the call."""
+    global _order_mode, _order_graph_path, _allowed_edges
+    if mode not in _LOCK_ORDER_MODES:
+        raise ValueError(f"lock-order mode must be one of {_LOCK_ORDER_MODES}")
+    _order_mode = mode
+    if graph_path is not None:
+        _order_graph_path = graph_path
+        _allowed_edges = None
+
+
+def lock_order_mode() -> str:
+    return _order_mode
+
+
+def observed_lock_order() -> dict[tuple[str, str], str]:
+    """Snapshot of recorded (outer_name, inner_name) -> witness edges."""
+    with _observed_mtx:
+        return dict(_observed)
+
+
+def reset_lock_order() -> None:
+    with _observed_mtx:
+        _observed.clear()
+
+
+def _static_graph_path() -> str:
+    if _order_graph_path:
+        return _order_graph_path
+    # the artifact cometlint --graph ships inside the package
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "devtools", "lint", "graph", "lockorder.json",
+    )
+
+
+def _load_allowed_edges() -> frozenset[tuple[str, str]]:
+    global _allowed_edges
+    if _allowed_edges is None:
+        import json
+
+        with open(_static_graph_path(), encoding="utf-8") as f:
+            data = json.load(f)
+        _allowed_edges = frozenset(
+            (e["from"], e["to"]) for e in data.get("edges", [])
+        )
+    return _allowed_edges
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _acquire_site() -> str:
+    """file:line of the engine frame performing the acquire (skips the
+    sync-tier frames themselves)."""
+    f = sys._getframe(1)
+    here = os.path.dirname(os.path.abspath(__file__))
+    while f is not None:
+        fn = f.f_code.co_filename
+        if os.path.join(here, "sync.py") not in fn:
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "?"
+
+
+def _order_check(name: str) -> None:
+    """Enforce-mode gate, called BEFORE the raw acquire so a forbidden
+    edge fails fast instead of deadlocking on the inversion itself."""
+    stack = _held_stack()
+    if not stack or stack[-1] == name:
+        return
+    edge = (stack[-1], name)
+    if edge not in _load_allowed_edges():
+        raise LockOrderError(
+            f"lock-order edge {edge[0]!r} -> {edge[1]!r} is absent from the "
+            f"static lock-order graph ({_static_graph_path()}); held: "
+            f"{stack!r}. Re-run `python -m cometbft_tpu.devtools.lint "
+            f"--graph` after teaching the analysis about this path, or fix "
+            f"the acquisition order."
+        )
+
+
+def _order_note_acquired(name: str) -> None:
+    stack = _held_stack()
+    if stack and stack[-1] != name:
+        edge = (stack[-1], name)
+        with _observed_mtx:
+            if edge not in _observed:
+                _observed[edge] = _acquire_site()
+    stack.append(name)
+
+
+def _order_note_released(name: str) -> None:
+    stack = _held_stack()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == name:
+            del stack[i]
+            return
 
 
 def _dump_all_threads(out=None) -> None:
@@ -94,6 +235,8 @@ class _InstrumentedMutex:
                 f"self-deadlock: thread {me} re-acquiring {self._name}\n"
                 f"first acquired at:\n{self._holder_stack}"
             )
+        if _order_mode == "enforce":
+            _order_check(self._name)
         if not blocking:
             ok = self._lock.acquire(False)
             if ok:
@@ -139,6 +282,8 @@ class _InstrumentedMutex:
             self._holder = None
             self._holder_stack = ""
             self._depth = 0
+            if _order_mode != "off":
+                _order_note_released(self._name)
         self._lock.release()
 
     def locked(self) -> bool:
@@ -153,6 +298,8 @@ class _InstrumentedMutex:
         self._holder = me
         self._depth = 1
         self._holder_stack = "".join(traceback.format_stack(limit=12)[:-2])
+        if _order_mode != "off":
+            _order_note_acquired(self._name)
 
 
 class _InstrumentedRLock(_InstrumentedMutex):
@@ -160,13 +307,19 @@ class _InstrumentedRLock(_InstrumentedMutex):
 
 
 def Mutex(name: str = ""):
-    """A non-reentrant lock; instrumented when deadlock detection is on."""
-    return _InstrumentedMutex(name) if _enabled else threading.Lock()
+    """A non-reentrant lock; instrumented when deadlock detection or the
+    lock-order sanitizer is on."""
+    if _enabled or _order_mode != "off":
+        return _InstrumentedMutex(name)
+    return threading.Lock()
 
 
 def RLock(name: str = ""):
-    """A reentrant lock; instrumented when deadlock detection is on."""
-    return _InstrumentedRLock(name) if _enabled else threading.RLock()
+    """A reentrant lock; instrumented when deadlock detection or the
+    lock-order sanitizer is on."""
+    if _enabled or _order_mode != "off":
+        return _InstrumentedRLock(name)
+    return threading.RLock()
 
 
 def Condition(lock=None, name: str = ""):

@@ -35,7 +35,7 @@ class Store:
     def save_light_block(self, lb: LightBlock) -> None:
         if lb.height <= 0:
             raise ValueError("height must be positive")
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- light-store writes are atomic under its mutex; off the consensus hot path
             existed = self._db.get(_key(lb.height)) is not None
             self._db.set(_key(lb.height), ser.dumps(lb))
             if not existed:
@@ -44,7 +44,7 @@ class Store:
     def delete_light_block(self, height: int) -> None:
         if height <= 0:
             raise ValueError("height must be positive")
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- light-store deletes are atomic under its mutex; off the consensus hot path
             if self._db.get(_key(height)) is not None:
                 self._db.delete(_key(height))
                 self._bump_size(-1)
@@ -52,7 +52,7 @@ class Store:
     def prune(self, size: int) -> None:
         """Delete oldest blocks until at most ``size`` remain
         (light/store/db/db.go Prune)."""
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- light-store pruning is atomic under its mutex; off the consensus hot path
             excess = self._size() - size
             if excess <= 0:
                 return

@@ -107,17 +107,21 @@ class CListMempool:
     # -- CheckTx ingress (clist_mempool.go:247) ----------------------------
 
     def check_tx(self, tx: bytes, cb=None, sender: str = "") -> None:
-        with self._update_mtx:
-            if len(tx) > self.config.max_tx_bytes:
-                raise MempoolError(
-                    f"tx too large: {len(tx)} > {self.config.max_tx_bytes}"
-                )
+        # Size gate and tx hash OUTSIDE the update lock (cometlint
+        # CLNT009 discipline): TxKey is SHA-256 over up to max_tx_bytes
+        # (1 MB) of peer-controlled bytes — pure compute that must not
+        # serialize concurrent CheckTx against commit's Update window.
+        if len(tx) > self.config.max_tx_bytes:
+            raise MempoolError(
+                f"tx too large: {len(tx)} > {self.config.max_tx_bytes}"
+            )
+        key = TxKey(tx)
+        with self._update_mtx:  # cometlint: disable=CLNT009 -- async CheckTx dispatch under the update lock is the reference behavior (clist_mempool.go:247); the dispatch union overapproximates which app method runs
             if self.pre_check is not None:
                 self.pre_check(tx)
             err = self.is_full(len(tx))
             if err is not None:
                 raise err
-            key = TxKey(tx)
             if not self.cache.push(key):
                 # Seen before: record the extra sender for gossip dedup.
                 el = self.tx_map.get(key)

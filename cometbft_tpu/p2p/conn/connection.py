@@ -264,7 +264,7 @@ class MConnection(BaseService):
         return True
 
     def _write_packet(self, data: bytes) -> None:
-        with self._write_mtx:
+        with self._write_mtx:  # cometlint: disable=CLNT009 -- the write mutex exists to serialize whole frames onto the socket
             self.conn.write(data)
 
     # -- recv side (connection.go:562 recvRoutine) -------------------------

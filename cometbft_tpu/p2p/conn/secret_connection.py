@@ -181,7 +181,7 @@ class SecretConnection:
     def write(self, data: bytes) -> int:
         """Encrypt+send; fragments into 1024-byte frames."""
         n = 0
-        with self._send_mtx:
+        with self._send_mtx:  # cometlint: disable=CLNT009 -- send mutex pairs the AEAD nonce sequence with socket order
             for i in range(0, max(len(data), 1), DATA_MAX_SIZE):
                 chunk = data[i : i + DATA_MAX_SIZE]
                 frame = struct.pack("<I", len(chunk)) + chunk
@@ -208,7 +208,7 @@ class SecretConnection:
 
     def read(self, n: int) -> bytes:
         """Read up to n plaintext bytes (at least 1)."""
-        with self._recv_mtx:
+        with self._recv_mtx:  # cometlint: disable=CLNT009 -- recv mutex pairs the AEAD nonce sequence with socket reads
             if not self._recv_buf:
                 self._recv_buf = self._read_frame()
             out, self._recv_buf = self._recv_buf[:n], self._recv_buf[n:]

@@ -302,7 +302,7 @@ class SignerListenerEndpoint(BaseService):
 
     def request(self, msg):
         """Send one request and read its response (serialized)."""
-        with self._req_mtx:
+        with self._req_mtx:  # cometlint: disable=CLNT009 -- the request mutex pairs one signer request with its response on the shared socket
             conn = self._conn
             if conn is None:
                 if not self._conn_ready.wait(self.timeout):

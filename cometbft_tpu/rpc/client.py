@@ -219,7 +219,7 @@ class WSClient:
                 return False
             time.sleep(min(0.1 * (2**attempt), 2.0))
             try:
-                with self._mtx:
+                with self._mtx:  # cometlint: disable=CLNT009 -- reconnect swaps the socket under the mutex so writers never race a half-open conn
                     self._connect()
                 for q_str in list(self._subs):
                     self._send(
@@ -249,7 +249,7 @@ class WSClient:
             head += bytes([0x80 | 126]) + struct.pack(">H", ln)
         else:
             head += bytes([0x80 | 127]) + struct.pack(">Q", ln)
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- websocket frames must not interleave; sendall is ordered with inflight registration
             if self._sock is None:
                 raise ConnectionError("ws not connected")
             self._sock.sendall(head + mask + masked)

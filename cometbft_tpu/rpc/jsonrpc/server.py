@@ -258,7 +258,7 @@ class _WSConn:
         return opcode, payload
 
     def _send_frame(self, opcode: int, payload: bytes) -> None:
-        with self._write_mtx:
+        with self._write_mtx:  # cometlint: disable=CLNT009 -- the per-connection write mutex serializes ws frames: its purpose
             head = bytes([0x80 | opcode])
             ln = len(payload)
             if ln < 126:

@@ -73,7 +73,7 @@ class KVTxIndexer:
     def index(self, rec: TxRecord, events) -> None:
         """Index one tx: by hash plus every (event key, value) pair."""
         rec.tx_hash = rec.tx_hash or tmhash.sum(rec.tx)
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- one tx's index batch is atomic under the indexer mutex; indexing runs on the event-sink thread, not the FSM
             batch = self.db.new_batch()
             batch.set(_TX_HASH_PREFIX + rec.tx_hash, ser.dumps(rec))
             flat = flatten_abci_events(
@@ -135,7 +135,7 @@ class KVBlockIndexer:
         self._mtx = libsync.Mutex("state.indexer.KVBlockIndexer._mtx")
 
     def index(self, height: int, events) -> None:
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- one block's index batch is atomic under the indexer mutex; off the consensus hot path
             batch = self.db.new_batch()
             flat = flatten_abci_events(
                 events, {BLOCK_HEIGHT_KEY: [str(height)]}

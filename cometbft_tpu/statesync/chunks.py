@@ -30,17 +30,50 @@ class ChunkQueue:
     def _path(self, index: int) -> str:
         return os.path.join(self._dir, str(index))
 
+    def _accepts_locked(self, index: int) -> bool:
+        return not (
+            self._closed
+            or index >= self.n_chunks
+            or index < self._next
+            or index in self._peers
+        )
+
     def put(self, index: int, chunk: bytes, peer_id: str) -> bool:
-        """Spool a fetched chunk to disk; True if newly added."""
+        """Spool a fetched chunk to disk; True if newly added.
+
+        The body WRITE happens outside the condition lock (cometlint
+        CLNT009 discipline): chunks can be megabytes and a slow disk
+        must not stall other peers' deliveries or wake-ups of the
+        applier. Only bookkeeping and the atomic rename run under the
+        lock; a racing duplicate loses at the re-check and removes its
+        own spool file.
+        """
         with self._mtx:
-            if self._closed or index >= self.n_chunks or index < self._next:
+            if not self._accepts_locked(index):
                 return False
-            if index in self._peers:
-                return False
-            tmp = self._path(index) + ".tmp"
+        try:
+            fd, tmp = tempfile.mkstemp(prefix=f"{index}.", dir=self._dir)
+        except OSError:
+            # close() may have removed the spool dir between our check
+            # and here — equivalent to delivering after close
+            return False
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(chunk)
+        except OSError:
             try:
-                with open(tmp, "wb") as f:
-                    f.write(chunk)
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        with self._mtx:
+            if not self._accepts_locked(index):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return False
+            try:
                 os.replace(tmp, self._path(index))
             except OSError:
                 return False
@@ -50,7 +83,13 @@ class ChunkQueue:
 
     def next(self, timeout: float | None = None):
         """Blocking in-order consume: (index, chunk, peer_id) or None on
-        close/timeout. The chunk file is deleted once loaded."""
+        close/timeout. The chunk file is deleted once loaded.
+
+        The body READ happens after the lock is released — there is one
+        consumer (the applier thread; ``retry`` runs on the same
+        thread), so claiming index + peer under the lock is enough, and
+        a multi-megabyte load never blocks ``put``.
+        """
         with self._mtx:
             if not self._mtx.wait_for(
                 lambda: self._closed or self._next in self._peers,
@@ -61,17 +100,23 @@ class ChunkQueue:
                 return None
             idx = self._next
             peer = self._peers.pop(idx)
-            try:
-                with open(self._path(idx), "rb") as f:
-                    chunk = f.read()
-                os.remove(self._path(idx))
-            except OSError:
-                # spool file vanished (operator tampering / disk fault):
-                # treat as never received so the fetcher re-requests it
+            # claim the index BEFORE releasing: a duplicate delivery of
+            # idx during the unlocked read below must be rejected
+            # (index < _next), not re-admitted into _peers
+            self._next = idx + 1
+        try:
+            with open(self._path(idx), "rb") as f:
+                chunk = f.read()
+            os.remove(self._path(idx))
+        except OSError:
+            # spool file vanished (operator tampering / disk fault):
+            # unclaim so pending() re-requests this index, and wake the
+            # fetcher
+            with self._mtx:
+                self._next = min(self._next, idx)
                 self._mtx.notify_all()
-                return None
-            self._next += 1
-            return idx, chunk, peer
+            return None
+        return idx, chunk, peer
 
     def retry(self, index: int) -> None:
         """Re-request from ``index`` on (refetch semantics of
@@ -103,4 +148,5 @@ class ChunkQueue:
         with self._mtx:
             self._closed = True
             self._mtx.notify_all()
-            shutil.rmtree(self._dir, ignore_errors=True)
+        # directory teardown is pure disk work — outside the lock
+        shutil.rmtree(self._dir, ignore_errors=True)

@@ -61,13 +61,13 @@ class BlockStore:
     def save_block(
         self, block: Block, part_set: PartSet, seen_commit: Commit
     ) -> None:
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- block persistence is atomic under the store mutex; once per height
             self._save_block_locked(block, part_set, seen_commit, None)
 
     def save_block_with_extended_commit(
         self, block: Block, part_set: PartSet, seen_ext_commit
     ) -> None:
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- extended-commit save shares save_block's atomicity contract
             self._save_block_locked(
                 block, part_set, seen_ext_commit.to_commit(), seen_ext_commit
             )
@@ -163,7 +163,7 @@ class BlockStore:
         it arrived inside the deleted block as its LastCommit and becomes
         the new seen commit, so a restarted node can still reconstruct
         rs.last_commit and propose."""
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- delete_block rewrites base/height atomically; rare rollback path
             if height != self._height:
                 raise ValueError(
                     f"can only delete the tip block ({self._height}), "
@@ -189,7 +189,7 @@ class BlockStore:
     def prune_blocks(self, retain_height: int) -> int:
         """Delete blocks below ``retain_height``; returns number pruned
         (store/store.go:293). Keeps the commit chain above the new base."""
-        with self._mtx:
+        with self._mtx:  # cometlint: disable=CLNT009 -- pruning updates base/height atomically; operator-paced
             if retain_height <= self._base:
                 return 0
             if retain_height > self._height:

@@ -1,5 +1,8 @@
 """Multi-validator network over real TCP p2p (reference analog:
-consensus/reactor_test.go + e2e ci topology, in-process tier)."""
+consensus/reactor_test.go + e2e ci topology, in-process tier) — plus the
+lock-order sanitizer cross-check: a net run under
+``COMETBFT_TPU_LOCK_ORDER=record`` must observe only acquisition-order
+edges the static whole-program graph (devtools/lint/graph) predicts."""
 
 import dataclasses
 import time
@@ -13,6 +16,84 @@ from cometbft_tpu.types import GenesisDoc
 from helpers import make_genesis
 
 _MS = 1_000_000
+
+
+def test_recorded_lock_order_is_subgraph_of_static_graph(tmp_path):
+    """Static analysis and runtime sanitizer verify each other: drive a
+    real consensus burst AND a real TCP p2p exchange with lock-order
+    recording on, then validate every observed (outer -> inner)
+    acquisition edge against the whole-program lock-order graph."""
+    from cometbft_tpu.devtools.lint.engine import parse_root
+    from cometbft_tpu.devtools.lint.graph import analyze_contexts
+    from cometbft_tpu.libs import sync as libsync
+
+    import os
+    import test_p2p
+    from helpers import make_consensus_node, stop_node, wait_for_height
+
+    libsync.set_lock_order_mode("record")
+    libsync.reset_lock_order()
+    try:
+        # consensus: a single validator commits a couple of heights
+        genesis, pvs = make_genesis(1)
+        cs, parts = make_consensus_node(genesis, pvs[0])
+        cs.start()
+        try:
+            assert wait_for_height(parts, 2, timeout=60), (
+                f"chain stalled at {parts['block_store'].height()}"
+            )
+        finally:
+            stop_node(cs, parts)
+
+        # p2p: two switches handshake and exchange over real sockets
+        sw1, r1, nk1 = test_p2p._make_switch()
+        sw2, r2, _ = test_p2p._make_switch(echo=False)
+        sw1.start()
+        sw2.start()
+        try:
+            addr = f"{nk1.node_id}@{sw1.transport.listen_addr[len('tcp://'):]}"
+            sw2.dial_peers_async([addr])
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if sw1.peers() and sw2.peers():
+                    break
+                time.sleep(0.05)
+            assert sw2.peers(), "switches failed to connect"
+            assert sw2.peers()[0].send(0x42, b"order-check")
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if r1.received and r2.received:
+                    break
+                time.sleep(0.05)
+            assert r1.received and r2.received
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+        observed = libsync.observed_lock_order()
+    finally:
+        libsync.set_lock_order_mode("off")
+
+    assert observed, "record mode observed no edges — instrumentation broken?"
+
+    pkg = os.path.dirname(
+        os.path.dirname(os.path.abspath(test_p2p.__file__))
+    ) + "/cometbft_tpu"
+    contexts, errors = parse_root(pkg)
+    assert not errors, errors
+    static_edges = {
+        (e["from"], e["to"])
+        for e in analyze_contexts(contexts).graph_dict()["edges"]
+    }
+    missing = {
+        edge: site
+        for edge, site in observed.items()
+        if edge not in static_edges
+    }
+    assert not missing, (
+        "runtime observed acquisition edges the static lock-order graph "
+        f"does not predict: {missing}"
+    )
 
 
 def _net_config(home: str) -> "Config":
