@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..libs import trace as libtrace
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
 from ..types import serialization as ser
 from ..types.validation import VerificationError, verify_commit_light
@@ -210,6 +211,7 @@ class BlocksyncReactor(Reactor):
         """reactor.go:447: first's validity is proven by second.LastCommit."""
         from ..types import BlockID, PartSet
 
+        t0 = time.perf_counter() if libtrace.enabled() else 0.0
         parts = PartSet.from_data(ser.dumps(first))
         first_id = BlockID(first.hash(), parts.header)
         try:
@@ -227,6 +229,10 @@ class BlocksyncReactor(Reactor):
         except (VerificationError, ValueError):
             # Either block may be the forged one: redo BOTH and punish both
             # serving peers (reactor.go:447-470).
+            if t0:
+                libtrace.event(
+                    "blocksync.reject", height=first.header.height
+                )
             self.pool.redo_request(first.header.height)
             self.pool.redo_request(second.header.height)
             return
@@ -243,6 +249,13 @@ class BlocksyncReactor(Reactor):
         # ApplyBlock failure on a commit-verified block is a LOCAL fault —
         # fail-stop like the reference's panic, never punish the peer.
         self.state = self.block_exec.apply_block(self.state, first_id, first)
+        if t0:
+            libtrace.event(
+                "blocksync.apply",
+                height=first.header.height,
+                lanes=len(seen_commit.signatures),
+                dur_ns=int((time.perf_counter() - t0) * 1e9),
+            )
         self._n_synced += 1
         self.pool.pop_request()
 

@@ -412,6 +412,8 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
         for name, path in (
             ("goroutines.txt", "/debug/pprof/goroutine"),
             ("heap.txt", "/debug/pprof/heap"),
+            ("locks.json", "/debug/locks"),
+            ("trace.json", "/debug/trace"),
         ):
             try:
                 with urllib.request.urlopen(base + path, timeout=5) as r:

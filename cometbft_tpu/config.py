@@ -74,6 +74,20 @@ ENV_KNOBS: dict[str, str] = {
         "named crash point for fault-injection tests — the process "
         "dies hard when execution reaches it (libs/fail.py)"
     ),
+    "COMETBFT_TPU_TRACE": (
+        "span/event tracer: off (default) | on/1 — consensus "
+        "height/round/step spans, verify phase events, mempool/p2p/"
+        "blocksync/WAL events into the in-memory ring (libs/trace.py; "
+        "also /debug/trace on the pprof server)"
+    ),
+    "COMETBFT_TPU_TRACE_FILE": (
+        "JSONL sink path for the tracer — records tee to a rotating "
+        "libs/autofile Group when tracing is on (libs/trace.py)"
+    ),
+    "COMETBFT_TPU_TRACE_RING": (
+        "trace ring-buffer capacity in records (default 8192; "
+        "libs/trace.py)"
+    ),
     "COMETBFT_TPU_SOFTWARE_VERSION": (
         "node software version advertised in p2p NodeInfo/RPC status "
         "(node/node.py; set per-node by the e2e harness)"

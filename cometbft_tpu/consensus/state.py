@@ -25,6 +25,7 @@ import time
 from ..config import ConsensusConfig
 from ..crypto import batch as crypto_batch
 from ..libs import metrics as libmetrics
+from ..libs import trace as libtrace
 from ..libs.events import EventSwitch
 from ..libs.service import BaseService
 from ..types import BlockID, PartSet, canonical
@@ -186,6 +187,16 @@ class ConsensusState(BaseService):
         # full node stop; None → os._exit, never a silent dead thread).
         self.on_fatal = None
 
+        # libs/trace spans for the current height/round/step. Manual
+        # (begin/end) because the FSM is event-driven — the intervals
+        # do not nest lexically. All three are touched only with the
+        # state mutex held (FSM thread + init/replay), ended eagerly on
+        # each transition; None whenever tracing was off at the last
+        # transition.
+        self._tr_height = None
+        self._tr_round = None
+        self._tr_step = None
+
         # Event-delivery deferral (cometlint CLNT009/CLNT010): while the
         # receive loop is inside its critical section this collects
         # (publish_fn, args) pairs; delivery happens after the mutex is
@@ -283,6 +294,13 @@ class ConsensusState(BaseService):
         for pt in getattr(self, "_prestage_threads", []):
             pt.join(timeout=2)
         self.wal.flush_and_sync()
+        # close any open trace spans so a stopped node's trace has no
+        # dangling intervals
+        for attr in ("_tr_step", "_tr_round", "_tr_height"):
+            sp = getattr(self, attr, None)
+            if sp is not None:
+                sp.end()
+                setattr(self, attr, None)
 
     def _tock_forwarder(self) -> None:
         while not self.quit_event().is_set():
@@ -486,6 +504,13 @@ class ConsensusState(BaseService):
             return None
         for (pub_key, sign_bytes, sig), ok in zip(triples, bits):
             memo[(pub_key.bytes(), sign_bytes, sig)] = bool(ok)
+        if libtrace.enabled():
+            libtrace.event(
+                "consensus.preverify",
+                height=height,
+                lanes=len(triples),
+                ok=sum(1 for b in bits if b),
+            )
         return memo
 
     def _handle_msg(self, mi: MsgInfo) -> None:
@@ -586,6 +611,17 @@ class ConsensusState(BaseService):
         )
 
         rs.height = height
+        if libtrace.enabled():
+            for attr in ("_tr_step", "_tr_round", "_tr_height"):
+                sp = getattr(self, attr, None)
+                if sp is not None:
+                    sp.end()
+            self._tr_round = self._tr_step = None
+            self._tr_height = libtrace.begin("consensus.height",
+                                             height=height)
+        else:
+            # see _set_step: no stale spans across a disabled window
+            self._tr_height = self._tr_round = self._tr_step = None
         if rs.commit_time_ns == 0:
             rs.start_time_ns = (
                 state.last_block_time_ns
@@ -678,6 +714,22 @@ class ConsensusState(BaseService):
                 rs.step.name
             ).observe(now - started)
         self._step_started = now
+        if libtrace.enabled():
+            sp = getattr(self, "_tr_step", None)
+            if sp is not None:
+                sp.end()
+            self._tr_step = libtrace.begin(
+                "consensus.step",
+                parent=getattr(self, "_tr_round", None),
+                height=rs.height,
+                round=rs.round,
+                step=step.name,
+            )
+        else:
+            # tracing turned off mid-run: drop the stale span so a
+            # later re-enable doesn't end it with a duration covering
+            # the whole disabled window
+            self._tr_step = None
         rs.step = step
 
     def _enter_new_round(self, height: int, round_: int) -> None:
@@ -692,6 +744,18 @@ class ConsensusState(BaseService):
             m.round_duration.observe(now_mono - self._round_started)
         self._round_started = now_mono
         m.rounds.set(round_)
+        if libtrace.enabled():
+            sp = getattr(self, "_tr_round", None)
+            if sp is not None:
+                sp.end()
+            self._tr_round = libtrace.begin(
+                "consensus.round",
+                parent=getattr(self, "_tr_height", None),
+                height=height,
+                round=round_,
+            )
+        else:
+            self._tr_round = None  # see _set_step: no stale spans
         validators = rs.validators
         if rs.round < round_:
             validators = validators.copy_increment_proposer_priority(
@@ -1252,6 +1316,15 @@ class ConsensusState(BaseService):
                 return False
             if not rs.last_commit.add_vote(vote):
                 return False
+            if libtrace.enabled():
+                libtrace.event(
+                    "consensus.vote",
+                    height=vote.height,
+                    round=vote.round,
+                    type="precommit-late",
+                    index=vote.validator_index,
+                    peer=peer_id,
+                )
             self._publish(self.event_bus.publish_vote, EventDataVote(vote))
             self._publish(self.evsw.fire_event, EVENT_VOTE, vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
@@ -1289,6 +1362,19 @@ class ConsensusState(BaseService):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        if libtrace.enabled():
+            libtrace.event(
+                "consensus.vote",
+                height=vote.height,
+                round=vote.round,
+                type=(
+                    "precommit"
+                    if vote.msg_type == canonical.PRECOMMIT_TYPE
+                    else "prevote"
+                ),
+                index=vote.validator_index,
+                peer=peer_id,
+            )
         self._publish(self.event_bus.publish_vote, EventDataVote(vote))
         self._publish(self.evsw.fire_event, EVENT_VOTE, vote)
 

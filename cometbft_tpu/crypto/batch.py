@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..libs import metrics as libmetrics
 from . import keys
 from .keys import Ed25519PubKey
 
@@ -122,10 +123,18 @@ class Ed25519BatchVerifier(BatchVerifier):
             bitmap = host_batch.verify_many(
                 self._pubkeys, self._msgs, self._sigs
             )
+            libmetrics.observe_verify_phase(
+                "fallback",
+                "ed25519-host",
+                _time.perf_counter() - t0,
+                len(bitmap),
+            )
             _observe("ed25519-host", t0, len(bitmap))
             return all(bitmap), bitmap
         from ..ops import verify as ov
 
+        # pack/dispatch/readback phase attribution happens inside
+        # ops.verify.verify_batch (the phases live there)
         ok_all, bitmap = ov.verify_batch(self._pubkeys, self._msgs, self._sigs)
         _observe("ed25519-tpu", t0, len(self._pubkeys))
         return ok_all, list(np.asarray(bitmap, bool))
@@ -197,6 +206,9 @@ class Sr25519BatchVerifier(BatchVerifier):
                         self._pubkeys, self._msgs, self._sigs
                     )
                 ]
+            libmetrics.observe_verify_phase(
+                "fallback", "sr25519-host", _time.perf_counter() - t0, n
+            )
             _observe("sr25519-host", t0, n)
             return all(bitmap), bitmap
         from ..ops import verify as ov
@@ -209,7 +221,15 @@ class Sr25519BatchVerifier(BatchVerifier):
         # sr25519 validators (converted ristretto points) share the same
         # arena as ed25519 pubkeys.
         a_keys = [p[0] if p is not None else b"" for p in parts]
-        device_ok = ov.verify_prepacked(buf, a_keys, n)()
+        t1 = _time.perf_counter()
+        libmetrics.observe_verify_phase("pack", "sr25519-tpu", t1 - t0, n)
+        done = ov.verify_prepacked(buf, a_keys, n)
+        t2 = _time.perf_counter()
+        libmetrics.observe_verify_phase("dispatch", "sr25519-tpu", t2 - t1, n)
+        device_ok = done()
+        libmetrics.observe_verify_phase(
+            "readback", "sr25519-tpu", _time.perf_counter() - t2, n
+        )
         valid = device_ok & host_ok
         _observe("sr25519-tpu", t0, n)
         return bool(valid.all()), list(np.asarray(valid, bool))
@@ -428,12 +448,23 @@ class MixedBatchVerifier(BatchVerifier):
                         self._types, self._pubkeys, self._msgs, self._sigs
                     )
                 ]
+            libmetrics.observe_verify_phase(
+                "fallback", "mixed-host", _time.perf_counter() - t0, n
+            )
             _observe("mixed-host", t0, n)
             return all(bitmap), list(bitmap)
         from ..ops import verify as ov
 
         buf, host_ok, a_keys = self._pack_rows()
-        device_ok = ov.verify_prepacked(buf, a_keys, n)()
+        t1 = _time.perf_counter()
+        libmetrics.observe_verify_phase("pack", "mixed-tpu", t1 - t0, n)
+        done = ov.verify_prepacked(buf, a_keys, n)
+        t2 = _time.perf_counter()
+        libmetrics.observe_verify_phase("dispatch", "mixed-tpu", t2 - t1, n)
+        device_ok = done()
+        libmetrics.observe_verify_phase(
+            "readback", "mixed-tpu", _time.perf_counter() - t2, n
+        )
         valid = device_ok & host_ok
         _observe("mixed-tpu", t0, n)
         return bool(valid.all()), list(np.asarray(valid, bool))
@@ -477,17 +508,16 @@ def create_commit_batch_verifier(validator_set) -> BatchVerifier:
 
 
 def _observe(backend: str, t0: float, n: int) -> None:
-    """Record batch-verify latency/volume when a node's metrics are live."""
+    """Record end-to-end batch-verify latency/volume. Routed through
+    node_metrics() like every other instrumentation site: the running
+    node's registry when one is up, a throwaway sink otherwise."""
     import time as _time
 
-    from ..libs import metrics as libmetrics
-
-    m = libmetrics.DEFAULT_NODE_METRICS
-    if m is not None:
-        m.verify_batch_seconds.labels(backend).observe(
-            _time.perf_counter() - t0
-        )
-        m.verify_batch_sigs.labels(backend).inc(n)
+    m = libmetrics.node_metrics()
+    m.verify_batch_seconds.labels(backend).observe(
+        _time.perf_counter() - t0
+    )
+    m.verify_batch_sigs.labels(backend).inc(n)
 
 
 def prestage_validators(validator_set) -> int:

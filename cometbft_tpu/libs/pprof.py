@@ -11,6 +11,10 @@ Endpoints (all GET, plain text or JSON):
                                     format) capturing kernel launches
   /debug/jax/stop_trace             stop it
   /debug/locks             deadlock-tier status (libs/sync)
+  /debug/trace             libs/trace ring-buffer dump (JSON)
+  /debug/trace/start?file=PATH   enable the span tracer (+ optional
+                                 JSONL file sink at PATH on the node host)
+  /debug/trace/stop        disable the tracer and close the file sink
 
 The debug CLI (``cometbft-tpu debug dump|kill``) scrapes these into a
 crash bundle the way cmd/cometbft/commands/debug does with pprof URLs.
@@ -169,6 +173,9 @@ class PprofServer(BaseService):
                 "/debug/jax/start_trace?dir=PATH\n"
                 "/debug/jax/stop_trace\n"
                 "/debug/locks\n"
+                "/debug/trace            span-tracer ring dump\n"
+                "/debug/trace/start?file=PATH\n"
+                "/debug/trace/stop\n"
             )
 
         def goroutine(q):
@@ -202,6 +209,39 @@ class PprofServer(BaseService):
                 }
             )
 
+        def trace_dump(q):
+            from . import trace as libtrace
+
+            out = libtrace.status()
+            out["events"] = libtrace.ring_dump()
+            return json.dumps(out, default=str)
+
+        def trace_start(q):
+            from . import trace as libtrace
+
+            # sink FIRST: if the path can't be opened the request 500s
+            # with tracing still off, instead of silently enabling a
+            # ring-only tracer the operator thinks failed
+            files = q.get("file")
+            if files:
+                started = libtrace.start_file_sink(files[0])
+                libtrace.enable()
+                sink = (
+                    f"sink started at {files[0]}"
+                    if started
+                    else "sink already active"
+                )
+                return f"tracing on; {sink}\n"
+            libtrace.enable()
+            return "tracing on (ring only)\n"
+
+        def trace_stop(q):
+            from . import trace as libtrace
+
+            libtrace.disable()
+            closed = libtrace.stop_file_sink()
+            return "tracing off" + ("; sink closed\n" if closed else "\n")
+
         return {
             "/debug/pprof/": index,
             "/debug/pprof": index,
@@ -212,4 +252,7 @@ class PprofServer(BaseService):
             "/debug/jax/start_trace": jax_start,
             "/debug/jax/stop_trace": jax_stop,
             "/debug/locks": locks,
+            "/debug/trace": trace_dump,
+            "/debug/trace/start": trace_start,
+            "/debug/trace/stop": trace_stop,
         }

@@ -13,6 +13,7 @@ import threading
 
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
+from ..libs import trace as libtrace
 from dataclasses import dataclass, field
 
 from ..abci import types as abci
@@ -116,6 +117,10 @@ class CListMempool:
                 f"tx too large: {len(tx)} > {self.config.max_tx_bytes}"
             )
         key = TxKey(tx)
+        if libtrace.enabled():  # before the lock: pure ring append
+            libtrace.event(
+                "mempool.checktx", bytes=len(tx), sender=sender
+            )
         with self._update_mtx:  # cometlint: disable=CLNT009 -- async CheckTx dispatch under the update lock is the reference behavior (clist_mempool.go:247); the dispatch union overapproximates which app method runs
             if self.pre_check is not None:
                 self.pre_check(tx)
@@ -174,8 +179,16 @@ class CListMempool:
                 el = self.txs.push_back(memtx)
                 self.tx_map[key] = el
                 self._size_bytes += len(tx)
+                if libtrace.enabled():
+                    libtrace.event(
+                        "mempool.admit", bytes=len(tx), code=res.code
+                    )
                 self._notify_txs_available()
             else:
+                if libtrace.enabled():
+                    libtrace.event(
+                        "mempool.reject", bytes=len(tx), code=res.code
+                    )
                 libmetrics.node_metrics().mempool_failed_txs.inc()
                 self._pending_senders.pop(key, None)
                 if not self.config.keep_invalid_txs_in_cache:

@@ -129,7 +129,7 @@ class Node(BaseService):
             level=liblog.parse_level(config.base.log_level)
         ).with_fields(chain=genesis.chain_id[:16])
         self.metrics = libmetrics.NodeMetrics()
-        libmetrics.DEFAULT_NODE_METRICS = self.metrics
+        libmetrics.push_node_metrics(self.metrics)
 
         # 1. DBs (setup.go initDBs:107)
         self.app_db = _make_db(config, "app")
@@ -623,8 +623,10 @@ class Node(BaseService):
     def on_stop(self) -> None:
         from ..libs import metrics as libmetrics
 
-        if libmetrics.DEFAULT_NODE_METRICS is self.metrics:
-            libmetrics.DEFAULT_NODE_METRICS = None
+        # pop THIS node's registry; an in-process peer node pushed later
+        # keeps the top slot, an earlier one is restored (libs/metrics
+        # node-stack semantics)
+        libmetrics.pop_node_metrics(self.metrics)
         # Remote-signer endpoint (default_new_node attaches it): release
         # the listening socket + ping thread or a same-process restart on
         # the same laddr fails with EADDRINUSE.

@@ -22,6 +22,9 @@ nibbles) — see ops/field.py for why batch-minor wins on TPU.
 
 from __future__ import annotations
 
+import time
+
+from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from collections import OrderedDict
 from functools import lru_cache
@@ -953,6 +956,7 @@ def _verify_batch_sharded(pubkeys, msgs, sigs, n_dev: int):
     from ..parallel import mesh as pmesh
 
     n = len(pubkeys)
+    t0 = time.perf_counter()
     arrays, host_ok = pack_inputs(pubkeys, msgs, sigs)
     per_dev = _MIN_BUCKET
     while per_dev * n_dev < n:
@@ -964,9 +968,19 @@ def _verify_batch_sharded(pubkeys, msgs, sigs, n_dev: int):
             for k, v in arrays.items()
         }
         host_ok = np.pad(host_ok, (0, nb - n))
+    t1 = time.perf_counter()
+    libmetrics.observe_verify_phase(
+        "pack", "ed25519-tpu", t1 - t0, n, arena="sharded"
+    )
     ok = pmesh.verify_sharded(
         arrays, host_ok, pmesh.default_mesh(), 1, nb
     )[0][:n]
+    # pjit materializes inside verify_sharded — dispatch and readback
+    # are one phase on the multi-chip path
+    libmetrics.observe_verify_phase(
+        "dispatch", "ed25519-tpu", time.perf_counter() - t1, n,
+        arena="sharded",
+    )
     return bool(ok.all()), ok
 
 
@@ -1050,26 +1064,56 @@ def verify_batch(pubkeys, msgs, sigs) -> tuple[bool, np.ndarray]:
         return _verify_batch_sharded(pubkeys, msgs, sigs, len(devs))
     use_cache = _cache_enabled()
     finals, host_oks = [], []
+    # Phase attribution (crypto_verify_phase_seconds + verify.* trace
+    # events): pack = host staging incl. the arena lookup (a miss's
+    # builder launch is part of staging cost), dispatch = the async jit
+    # launches, readback = the one sanctioned materialization. Summed
+    # across pipelined chunks so the three phases tile the end-to-end
+    # crypto_verify_batch_seconds interval.
+    pack_s = disp_s = 0.0
+    arena_state = "hit" if use_cache else "off"
+    builds_before = _PUBKEY_CACHE.builds
     step = min(_PIPE_CHUNK, _CHUNK)
     for lo in range(0, n, step):
         hi = min(lo + step, n)
         # Pipeline host packing with device execution: each chunk is
         # dispatched as soon as it is packed, so the per-lane SHA-512 /
         # packing cost of chunk i+1 overlaps chunk i's kernel time.
+        tp = time.perf_counter()
         buf, hok = pack_bytes(pubkeys[lo:hi], msgs[lo:hi], sigs[lo:hi])
         hit = _PUBKEY_CACHE.lookup(pubkeys[lo:hi]) if use_cache else None
+        td = time.perf_counter()
+        pack_s += td - tp
         if hit is not None:
             idxs, arena, arena_ok = hit
             finals.append(
                 verify_rsk_async(buf[32:], idxs, arena, arena_ok, hi - lo)
             )
         else:
+            if use_cache:
+                arena_state = "bypass"  # churn exhausted the arena
             finals.append(verify_bytes_async(buf, hi - lo))
+        disp_s += time.perf_counter() - td
         host_oks.append(hok)
+    if use_cache and arena_state == "hit" and (
+        _PUBKEY_CACHE.builds > builds_before
+    ):
+        arena_state = "miss"  # lookup succeeded but had to build tables
+    tr = time.perf_counter()
     if len(finals) == 1:
         device_ok, host_ok = finals[0](), host_oks[0]
     else:
         device_ok = np.concatenate([f() for f in finals])
         host_ok = np.concatenate(host_oks)
+    read_s = time.perf_counter() - tr
+    libmetrics.observe_verify_phase(
+        "pack", "ed25519-tpu", pack_s, n, arena=arena_state
+    )
+    libmetrics.observe_verify_phase(
+        "dispatch", "ed25519-tpu", disp_s, n, arena=arena_state
+    )
+    libmetrics.observe_verify_phase(
+        "readback", "ed25519-tpu", read_s, n, arena=arena_state
+    )
     valid = device_ok & host_ok
     return bool(valid.all()), valid

@@ -16,6 +16,7 @@ from __future__ import annotations
 import struct
 
 from ...libs import metrics as libmetrics
+from ...libs import trace as libtrace
 import threading
 from ...libs import sync as libsync
 import time
@@ -261,6 +262,10 @@ class MConnection(BaseService):
         )
         self.send_monitor.update(len(chunk) + 5)
         self._send_ctr[best.desc.id].inc(len(chunk) + 5)
+        if libtrace.enabled():
+            libtrace.event(
+                "p2p.send", ch=best.desc.id, bytes=len(chunk) + 5, eof=eof
+            )
         return True
 
     def _write_packet(self, data: bytes) -> None:
@@ -310,6 +315,10 @@ class MConnection(BaseService):
                     )
                 if eof:
                     msg, ch.recving = ch.recving, b""
+                    if libtrace.enabled():
+                        libtrace.event(
+                            "p2p.recv", ch=ch_id, bytes=len(msg)
+                        )
                     self.on_receive(ch_id, msg)
             except Exception as e:
                 if not self.quit_event().is_set():

@@ -470,3 +470,18 @@ class TestEngineWideGate:
             ("store.block_store._mtx", "libs.db._mtx"),
         ]:
             assert edge in pairs, f"missing hierarchy edge {edge}"
+
+    def test_trace_lock_registered_and_leaf(self, analysis):
+        """The tracer's sink-management mutex is in the shipped artifact
+        (so the freshness gate covers it) and participates in NO
+        acquisition-order edges: trace emission is lock-free by design
+        — a trace.* edge appearing here means someone made the hot-path
+        tracer take a lock under (or over) engine mutexes."""
+        d = analysis.graph_dict()
+        assert "libs.trace._mtx" in {lk["name"] for lk in d["locks"]}
+        trace_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "libs.trace._mtx" in (e["from"], e["to"])
+        ]
+        assert trace_edges == [], trace_edges

@@ -1,13 +1,40 @@
-"""Logging + metrics tests (reference analog: libs/log tests,
-metrics exposition via the prometheus endpoint)."""
+"""Logging + metrics + tracer tests (reference analogs: libs/log
+tests, prometheus exposition, CometBFT's libs/trace): the libs/trace
+span tracer (ring, sink, disabled fast path), the exposition escaping
+and registry dedupe contracts, the node-metrics stack, the
+pprof/debug HTTP server end-to-end, and the verify-phase breakdown
+through a real in-process consensus burst."""
 
 import io
+import json
+import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
 
 from cometbft_tpu.libs import log as liblog
+from cometbft_tpu.libs import metrics as libmetrics
+from cometbft_tpu.libs import trace as libtrace
 from cometbft_tpu.libs.metrics import NodeMetrics, Registry
+
+import helpers
+
+
+@pytest.fixture
+def tracer():
+    """Enabled tracer with a clean ring; always restored to off."""
+    libtrace.reset()
+    libtrace.enable()
+    yield libtrace
+    libtrace.disable()
+    libtrace.stop_file_sink()
+    libtrace.reset()
+
+
+def _get(url: str, timeout: float = 5.0) -> tuple[int, str]:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
 
 
 class TestLogger:
@@ -93,9 +120,107 @@ class TestMetrics:
         m = NodeMetrics()
         m.height.set(7)
         m.verify_batch_sigs.labels("ed25519-host").inc(100)
+        m.verify_phase_seconds.labels("pack", "ed25519-tpu").observe(0.002)
         text = m.registry.render()
         assert "cometbft_tpu_consensus_height 7.0" in text
         assert 'backend="ed25519-host"' in text
+        assert "cometbft_tpu_crypto_verify_phase_seconds_bucket" in text
+        assert 'phase="pack"' in text
+
+    def test_label_value_exposition_escaping(self):
+        """Backslash, double quote and newline in label VALUES are
+        escaped per the exposition spec — raw interpolation would tear
+        the whole scrape at the first hostile value."""
+        r = Registry(namespace="t")
+        c = r.counter("esc_total", label_names=("v",))
+        c.labels('a"b\\c\nd').inc()
+        text = r.render()
+        line = [ln for ln in text.splitlines() if ln.startswith("t_esc")][0]
+        assert line == 't_esc_total{v="a\\"b\\\\c\\nd"} 1.0'
+
+    def test_help_text_escaping(self):
+        r = Registry(namespace="t")
+        r.counter("h_total", "line one\nline two \\ done")
+        text = r.render()
+        assert "# HELP t_h_total line one\\nline two \\\\ done" in text
+
+    def test_histogram_label_escaping(self):
+        r = Registry(namespace="t")
+        h = r.histogram("lat_seconds", label_names=("q",), buckets=(1.0,))
+        h.labels('x"y').observe(0.5)
+        text = r.render()
+        assert 'le="1.0",q="x\\"y"' in text
+        assert 't_lat_seconds_count{q="x\\"y"} 1' in text
+
+    def test_duplicate_name_returns_existing_instance(self):
+        r = Registry(namespace="t")
+        a = r.counter("dup_total", "h", label_names=("l",))
+        b = r.counter("dup_total", "h", label_names=("l",))
+        assert b is a
+        # only one # TYPE block in the exposition output
+        text = r.render()
+        assert text.count("# TYPE t_dup_total counter") == 1
+
+    def test_duplicate_name_mismatched_shape_rejected(self):
+        r = Registry(namespace="t")
+        r.counter("clash_total")
+        with pytest.raises(ValueError):
+            r.gauge("clash_total")
+        with pytest.raises(ValueError):
+            r.counter("clash_total", label_names=("other",))
+        h = r.histogram("lat_seconds", buckets=(0.1, 1.0))
+        assert r.histogram("lat_seconds", buckets=(0.1, 1.0)) is h
+        with pytest.raises(ValueError):
+            r.histogram("lat_seconds", buckets=(0.2,))
+
+
+class TestNodeMetricsStack:
+    def test_push_pop_restores_previous(self):
+        nop = libmetrics.node_metrics()
+        m1, m2 = NodeMetrics(), NodeMetrics()
+        libmetrics.push_node_metrics(m1)
+        try:
+            assert libmetrics.node_metrics() is m1
+            libmetrics.push_node_metrics(m2)
+            assert libmetrics.node_metrics() is m2
+            libmetrics.pop_node_metrics(m2)
+            # the FIRST node's registry is restored, not the no-op sink
+            assert libmetrics.node_metrics() is m1
+        finally:
+            libmetrics.pop_node_metrics(m1)
+            libmetrics.pop_node_metrics(m2)
+        assert libmetrics.node_metrics() is nop
+
+    def test_out_of_order_pop_keeps_live_top(self):
+        m1, m2 = NodeMetrics(), NodeMetrics()
+        libmetrics.push_node_metrics(m1)
+        libmetrics.push_node_metrics(m2)
+        try:
+            libmetrics.pop_node_metrics(m1)  # older node stops first
+            assert libmetrics.node_metrics() is m2
+        finally:
+            libmetrics.pop_node_metrics(m2)
+            libmetrics.pop_node_metrics(m1)
+
+    def test_observe_routes_through_stack(self):
+        from cometbft_tpu.crypto.batch import _observe
+
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        try:
+            import time
+
+            _observe("ed25519-host", time.perf_counter(), 7)
+        finally:
+            libmetrics.pop_node_metrics(m)
+        assert (
+            m.verify_batch_sigs.labels("ed25519-host").value() == 7
+        )
+        # with no node pushed the same call lands in the throwaway sink
+        _observe("ed25519-host", 0.0, 3)
+        assert (
+            m.verify_batch_sigs.labels("ed25519-host").value() == 7
+        )
 
 
 class TestNodeObservability:
@@ -182,3 +307,437 @@ class TestNodeObservability:
             assert "module=consensus" in logs
         finally:
             node.stop()
+
+
+class TestTrace:
+    """libs/trace unit contract: disabled fast path, spans/events,
+    ring bounds, JSONL file sink, knob registration."""
+
+    def test_disabled_is_noop(self):
+        assert not libtrace.enabled()
+        libtrace.reset()
+        libtrace.event("x", a=1)
+        with libtrace.span("y"):
+            libtrace.event("inner")
+        sp = libtrace.begin("z")
+        sp.event("e")
+        sp.end()
+        assert libtrace.ring_dump() == []
+        assert libtrace.span("y") is libtrace.NOP_SPAN
+
+    def test_disabled_fast_path_retains_no_allocations(self):
+        """The tier-1 allocation guard for the verify hot path: with
+        tracing off, event/span/begin must not retain a single byte
+        allocated inside libs/trace (no ring growth, no span objects,
+        no garbage) — the instrumented verify path stays free."""
+        import tracemalloc
+
+        assert not libtrace.enabled()
+
+        def hot():
+            for _ in range(300):
+                libtrace.event("verify.pack")
+                with libtrace.span("verify"):
+                    pass
+                libtrace.begin("consensus.step").end()
+
+        hot()  # warm interpreter caches outside the measured window
+        tracemalloc.start()
+        try:
+            tracemalloc.clear_traces()
+            hot()
+            snap = tracemalloc.take_snapshot()
+        finally:
+            tracemalloc.stop()
+        stats = snap.filter_traces(
+            [tracemalloc.Filter(True, libtrace.__file__)]
+        ).statistics("lineno")
+        assert sum(s.size for s in stats) == 0, stats
+        assert libtrace.ring_dump() == []
+
+    def test_events_spans_and_nesting(self, tracer):
+        with libtrace.span("outer", k="v") as outer:
+            libtrace.event("mid", n=1)
+            with libtrace.span("inner"):
+                libtrace.event("deep")
+        libtrace.event("loose")
+        recs = libtrace.ring_dump()
+        by_name = {r["name"]: r for r in recs}
+        assert by_name["mid"]["span"] == outer.id
+        assert by_name["deep"]["span"] == by_name["inner"]["span"]
+        assert by_name["inner"]["parent"] == outer.id
+        assert by_name["outer"]["dur_ns"] >= 0
+        assert by_name["outer"]["k"] == "v"
+        assert "span" not in by_name["loose"]
+        assert all("ts" in r and "thread" in r for r in recs)
+
+    def test_manual_spans_parent_chain(self, tracer):
+        h = libtrace.begin("consensus.height", height=5)
+        r = libtrace.begin("consensus.round", parent=h, height=5, round=0)
+        s = libtrace.begin(
+            "consensus.step", parent=r, height=5, round=0, step="PROPOSE"
+        )
+        s.end()
+        r.end()
+        h.end()
+        recs = {x["name"]: x for x in libtrace.ring_dump()}
+        assert recs["consensus.step"]["parent"] == r.id
+        assert recs["consensus.round"]["parent"] == h.id
+        assert "parent" not in recs["consensus.height"]
+        # double end is a no-op, not a duplicate record
+        s.end()
+        assert len(libtrace.ring_dump()) == 3
+
+    def test_ring_is_bounded(self):
+        libtrace.reset()
+        libtrace.enable(ring=32)
+        try:
+            for i in range(100):
+                libtrace.event("e", i=i)
+            recs = libtrace.ring_dump()
+            assert len(recs) == 32
+            assert recs[0]["i"] == 68 and recs[-1]["i"] == 99
+        finally:
+            # restore the default capacity for later tests in-process
+            libtrace.enable(ring=libtrace.DEFAULT_RING_SIZE)
+            libtrace.disable()
+            libtrace.reset()
+
+    def test_file_sink_writes_jsonl(self, tracer, tmp_path):
+        path = str(tmp_path / "trace" / "trace.jsonl")
+        assert libtrace.start_file_sink(path)
+        assert not libtrace.start_file_sink(path)  # already active
+        for i in range(20):
+            libtrace.event("sunk", i=i)
+        assert libtrace.stop_file_sink()  # joins + flushes the writer
+        assert not libtrace.stop_file_sink()
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["i"] for ln in lines] == list(range(20))
+        assert all(ln["name"] == "sunk" for ln in lines)
+
+    def test_span_ended_after_disable_emits_nothing(self):
+        """Disabling mid-span drops the end record: once off, nothing
+        reaches the ring (the consensus FSM ends its manual spans on
+        stop, possibly after an operator hit /debug/trace/stop)."""
+        libtrace.reset()
+        libtrace.enable()
+        sp = libtrace.begin("consensus.height", height=1)
+        libtrace.disable()
+        try:
+            sp.end()
+            assert libtrace.ring_dump() == []
+        finally:
+            libtrace.reset()
+
+    def test_status_shape(self, tracer):
+        st = libtrace.status()
+        assert st["enabled"] is True
+        assert st["ring_capacity"] >= 16
+        assert st["sink"] is None
+
+    def test_failed_sink_deregisters_itself(self, tracer, tmp_path):
+        """A sink whose writer dies on I/O error (disk full) must
+        deregister: status() stops claiming it and a replacement sink
+        can start without an explicit stop."""
+        import time
+
+        path = str(tmp_path / "dying.jsonl")
+        assert libtrace.start_file_sink(path)
+        sink = libtrace.status()
+        assert sink["sink"] == path
+
+        def boom(data):
+            raise OSError("disk full")
+
+        # break the group under the writer, then force a drain
+        libtrace._sink.group.write = boom
+        libtrace.event("doomed")
+        deadline = time.monotonic() + 5
+        while libtrace.status()["sink"] is not None:
+            assert time.monotonic() < deadline, "sink never deregistered"
+            time.sleep(0.02)
+        # a fresh sink starts cleanly
+        path2 = str(tmp_path / "fresh.jsonl")
+        assert libtrace.start_file_sink(path2)
+        libtrace.event("alive")
+        assert libtrace.stop_file_sink()
+        assert any(
+            json.loads(ln)["name"] == "alive" for ln in open(path2)
+        )
+
+    def test_knobs_registered_and_documented(self):
+        """CLNT007 extension: the trace knobs are first-class citizens
+        of the operator catalog and the observability doc."""
+        import os
+
+        from cometbft_tpu.config import ENV_KNOBS
+
+        doc = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "docs",
+                "observability.md",
+            )
+        ).read()
+        for knob in (
+            "COMETBFT_TPU_TRACE",
+            "COMETBFT_TPU_TRACE_FILE",
+            "COMETBFT_TPU_TRACE_RING",
+        ):
+            assert knob in ENV_KNOBS, knob
+            assert knob in doc, f"{knob} missing from docs/observability.md"
+
+
+class TestVerifyPhases:
+    """crypto_verify_phase_seconds + verify.* trace events: the same
+    pack/dispatch/readback/fallback breakdown lands in Prometheus and
+    the trace, and the device phases tile the end-to-end interval."""
+
+    def _triples(self, n):
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+        out = []
+        for i in range(1, n + 1):
+            pv = Ed25519PrivKey.from_seed(i.to_bytes(32, "big"))
+            msg = b"phase-msg-%d" % i
+            out.append((pv.pub_key(), msg, pv.sign(msg)))
+        return out
+
+    def _run_batch(self, triples):
+        from cometbft_tpu.crypto.batch import Ed25519BatchVerifier
+
+        v = Ed25519BatchVerifier()
+        for pk, msg, sig in triples:
+            v.add(pk, msg, sig)
+        return v.verify()
+
+    def test_host_fallback_phase(self, tracer, monkeypatch):
+        from cometbft_tpu.crypto import batch as cbatch
+
+        monkeypatch.setattr(cbatch, "HOST_BATCH_THRESHOLD", 1 << 30)
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        try:
+            ok, bitmap = self._run_batch(self._triples(8))
+        finally:
+            libmetrics.pop_node_metrics(m)
+        assert ok and all(bitmap)
+        evs = [
+            e
+            for e in libtrace.ring_dump()
+            if e["name"] == "verify.fallback"
+        ]
+        assert evs and evs[0]["backend"] == "ed25519-host"
+        assert evs[0]["lanes"] == 8 and evs[0]["dur_ns"] > 0
+        text = m.registry.render()
+        assert 'phase="fallback",backend="ed25519-host"' in text
+
+    def test_device_phases_tile_end_to_end(self, tracer, monkeypatch):
+        from cometbft_tpu.crypto import batch as cbatch
+
+        monkeypatch.setattr(cbatch, "HOST_BATCH_THRESHOLD", 2)
+        # pin the single-device path: on a multi-chip accelerator host
+        # the sharded route merges dispatch+readback (arena="sharded")
+        monkeypatch.setenv("COMETBFT_TPU_SHARD", "0")
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        try:
+            ok, bitmap = self._run_batch(self._triples(8))
+        finally:
+            libmetrics.pop_node_metrics(m)
+        assert ok and all(bitmap)
+        evs = [
+            e
+            for e in libtrace.ring_dump()
+            if e["name"].startswith("verify.")
+            and e.get("backend") == "ed25519-tpu"
+        ]
+        phases = {e["name"].split(".", 1)[1] for e in evs}
+        assert {"pack", "dispatch", "readback"} <= phases, phases
+        assert all(e["lanes"] == 8 for e in evs)
+        assert all(
+            e["arena"] in ("hit", "miss", "bypass", "off") for e in evs
+        )
+        # phase durations tile the recorded end-to-end observation
+        phase_s = sum(e["dur_ns"] for e in evs) / 1e9
+        total_s = m.verify_batch_seconds.labels("ed25519-tpu")._sum
+        assert 0 < phase_s <= total_s * 1.01
+        assert phase_s >= total_s * 0.3, (phase_s, total_s)
+        # Prometheus carries the same families
+        text = m.registry.render()
+        for ph in ("pack", "dispatch", "readback"):
+            assert f'phase="{ph}",backend="ed25519-tpu"' in text
+
+
+class TestPprofDebugServer:
+    """End-to-end over real HTTP: goroutine dump, heap gating, lock
+    status, and the /debug/trace surface."""
+
+    @pytest.fixture
+    def server(self):
+        from cometbft_tpu.libs.pprof import PprofServer
+
+        srv = PprofServer("tcp://127.0.0.1:0")
+        srv.start()
+        yield f"http://127.0.0.1:{srv.bound_port}"
+        srv.stop()
+
+    def test_index_and_goroutine(self, server):
+        status, body = _get(server + "/debug/pprof/")
+        assert status == 200 and "/debug/trace" in body
+        status, dump = _get(server + "/debug/pprof/goroutine")
+        assert status == 200
+        assert "--- thread" in dump and "MainThread" in dump
+
+    def test_heap_gating(self, server):
+        import tracemalloc
+
+        was_tracing = tracemalloc.is_tracing()
+        try:
+            _, body = _get(server + "/debug/pprof/heap")
+            assert "max rss" in body
+            if not was_tracing:
+                assert "tracemalloc off" in body
+            _, body = _get(server + "/debug/heap/start")
+            assert "tracemalloc" in body
+            _, body = _get(server + "/debug/pprof/heap")
+            assert "total traced" in body
+        finally:
+            if not was_tracing:
+                _, body = _get(server + "/debug/heap/stop")
+                assert "stopped" in body or "not tracing" in body
+
+    def test_locks_endpoint(self, server):
+        _, body = _get(server + "/debug/locks")
+        st = json.loads(body)
+        assert set(st) == {"deadlock_detection", "timeout_s"}
+
+    def test_unknown_route_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server + "/debug/nope")
+        assert ei.value.code == 404
+
+    def test_trace_start_sink_failure_leaves_tracing_off(
+        self, server, tmp_path
+    ):
+        """An unopenable sink path 500s WITHOUT enabling the tracer —
+        the operator must not be left with a silent ring-only tracer
+        they believe failed to start."""
+        assert not libtrace.enabled()
+        blocker = tmp_path / "a-file"
+        blocker.write_text("x")  # makedirs under a FILE fails
+        bad = str(blocker / "sub" / "trace.jsonl")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(
+                server
+                + "/debug/trace/start?file="
+                + urllib.parse.quote(bad)
+            )
+        assert ei.value.code == 500
+        assert not libtrace.enabled()
+        assert libtrace.status()["sink"] is None
+
+    def test_trace_start_dump_stop(self, server, tmp_path):
+        sink_path = str(tmp_path / "srv-trace.jsonl")
+        try:
+            _, body = _get(
+                server
+                + "/debug/trace/start?file="
+                + urllib.parse.quote(sink_path)
+            )
+            assert "tracing on" in body and "sink started" in body
+            assert libtrace.enabled()
+            libtrace.event("from-test", n=42)
+            _, body = _get(server + "/debug/trace")
+            st = json.loads(body)
+            assert st["enabled"] is True and st["sink"] == sink_path
+            mine = [
+                e for e in st["events"] if e.get("name") == "from-test"
+            ]
+            assert mine and mine[0]["n"] == 42
+            _, body = _get(server + "/debug/trace/stop")
+            assert "tracing off" in body and "sink closed" in body
+            assert not libtrace.enabled()
+            lines = [json.loads(ln) for ln in open(sink_path)]
+            assert any(ln.get("name") == "from-test" for ln in lines)
+        finally:
+            libtrace.disable()
+            libtrace.stop_file_sink()
+            libtrace.reset()
+
+
+class TestConsensusTraceBurst:
+    """The acceptance gate: a real in-process consensus burst (4
+    validators, perfect gossip) traced end-to-end yields
+    height/round/step spans, vote-admission events, and batch-verify
+    pack/dispatch/readback phase events whose durations tile the
+    recorded crypto_verify_batch_seconds observations."""
+
+    def test_burst_trace(self, monkeypatch):
+        from cometbft_tpu.crypto import batch as cbatch
+
+        # Route every >=2-lane batch through the device path so the
+        # burst exercises pack/dispatch/readback on the CPU backend;
+        # pin single-device dispatch (the sharded route merges phases).
+        monkeypatch.setattr(cbatch, "HOST_BATCH_THRESHOLD", 2)
+        monkeypatch.setenv("COMETBFT_TPU_SHARD", "0")
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        libtrace.reset()
+        # a burst-sized ring: the phase/total tiling check below needs
+        # EVERY verify event of the run, not the last N
+        libtrace.enable(ring=1 << 16)
+        genesis, pvs = helpers.make_genesis(4)
+        nodes = [
+            helpers.make_consensus_node(genesis, pv) for pv in pvs
+        ]
+        helpers.wire_perfect_gossip(nodes)
+        try:
+            for cs, _ in nodes:
+                cs.start()
+            assert helpers.wait_for_height(nodes[0][1], 2, timeout=120)
+        finally:
+            for cs, parts in nodes:
+                helpers.stop_node(cs, parts)
+            libtrace.disable()
+            libmetrics.pop_node_metrics(m)
+            events = libtrace.ring_dump()
+            # restore the default ring even when the burst failed
+            libtrace.enable(ring=libtrace.DEFAULT_RING_SIZE)
+            libtrace.disable()
+            libtrace.reset()
+
+        spans = {
+            e["name"] for e in events if e["kind"] == "span"
+        }
+        assert {
+            "consensus.height", "consensus.round", "consensus.step"
+        } <= spans, spans
+        # step spans carry their position and chain to the round span
+        steps = [
+            e
+            for e in events
+            if e["kind"] == "span" and e["name"] == "consensus.step"
+        ]
+        assert any(e.get("parent") for e in steps)
+        assert all(
+            "height" in e and "round" in e and "step" in e for e in steps
+        )
+        # vote admission + batched preverify
+        assert any(e["name"] == "consensus.vote" for e in events)
+        assert any(e["name"] == "consensus.preverify" for e in events)
+
+        # device phase events tile the end-to-end batch observations
+        phase_evs = [
+            e
+            for e in events
+            if e["name"].startswith("verify.")
+            and e.get("backend") == "ed25519-tpu"
+        ]
+        phases = {e["name"].split(".", 1)[1] for e in phase_evs}
+        assert {"pack", "dispatch", "readback"} <= phases, phases
+        phase_s = sum(e["dur_ns"] for e in phase_evs) / 1e9
+        total_s = m.verify_batch_seconds.labels("ed25519-tpu")._sum
+        assert total_s > 0
+        assert 0 < phase_s <= total_s * 1.01, (phase_s, total_s)
+        assert phase_s >= total_s * 0.3, (phase_s, total_s)
