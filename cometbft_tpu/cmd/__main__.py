@@ -413,6 +413,7 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
             ("goroutines.txt", "/debug/pprof/goroutine"),
             ("heap.txt", "/debug/pprof/heap"),
             ("locks.json", "/debug/locks"),
+            ("devstats.json", "/debug/devstats"),
             ("trace.json", "/debug/trace"),
         ):
             try:

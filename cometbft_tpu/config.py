@@ -88,6 +88,18 @@ ENV_KNOBS: dict[str, str] = {
         "trace ring-buffer capacity in records (default 8192; "
         "libs/trace.py)"
     ),
+    "COMETBFT_TPU_DEVSTATS": (
+        "device/XLA telemetry (libs/devstats): 1/on enables compile "
+        "accounting, device-memory + pubkey-arena sampling and "
+        "host<->device transfer counters; default off (a node "
+        "auto-enables it when it starts a Prometheus listener)"
+    ),
+    "COMETBFT_TPU_PROM_ADDR": (
+        "Prometheus scrape-listener address (tcp://host:port or "
+        ":port); when set (or instrumentation.prometheus in config) "
+        "the node serves the metrics registry at GET /metrics on a "
+        "dedicated libs/devstats.PrometheusServer"
+    ),
     "COMETBFT_TPU_SOFTWARE_VERSION": (
         "node software version advertised in p2p NodeInfo/RPC status "
         "(node/node.py; set per-node by the e2e harness)"

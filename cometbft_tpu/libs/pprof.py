@@ -11,6 +11,7 @@ Endpoints (all GET, plain text or JSON):
                                     format) capturing kernel launches
   /debug/jax/stop_trace             stop it
   /debug/locks             deadlock-tier status (libs/sync)
+  /debug/devstats          device/XLA telemetry snapshot (libs/devstats)
   /debug/trace             libs/trace ring-buffer dump (JSON)
   /debug/trace/start?file=PATH   enable the span tracer (+ optional
                                  JSONL file sink at PATH on the node host)
@@ -27,10 +28,8 @@ import json
 import sys
 import threading
 import traceback
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
 
-from .service import BaseService
+from .service import HTTPService
 
 
 def thread_dump() -> str:
@@ -114,53 +113,19 @@ def stop_jax_trace() -> str:
     return f"trace written to {d}"
 
 
-class PprofServer(BaseService):
-    """Tiny threaded HTTP server bound to ``pprof_laddr``."""
+class PprofServer(HTTPService):
+    """Tiny threaded HTTP server bound to ``pprof_laddr`` (scaffolding
+    shared with the Prometheus exporter via ``libs/service.HTTPService``)."""
 
     def __init__(self, addr: str, logger=None):
-        super().__init__("pprof", logger)
-        if addr.startswith("tcp://"):
-            addr = addr[len("tcp://") :]
-        host, _, port = addr.rpartition(":")
-        self.host = host or "127.0.0.1"
-        self.port = int(port)
-        self._httpd = None
+        super().__init__("pprof", addr, logger)
+        self._route_map = self._routes()
 
-    def on_start(self) -> None:
-        routes = self._routes()
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
-
-            def do_GET(self):
-                parsed = urlparse(self.path)
-                fn = routes.get(parsed.path)
-                if fn is None:
-                    self.send_error(404)
-                    return
-                try:
-                    body = fn(parse_qs(parsed.query)).encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type", "text/plain; charset=utf-8"
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:
-                    self.send_error(500, repr(e))
-
-        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
-        self.bound_port = self._httpd.server_address[1]
-        threading.Thread(
-            target=self._httpd.serve_forever, name="pprof-http", daemon=True
-        ).start()
-
-    def on_stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+    def handle_get(self, path: str, query: dict) -> tuple[str, str]:
+        fn = self._route_map.get(path)
+        if fn is None:
+            raise KeyError(path)
+        return "text/plain; charset=utf-8", fn(query)
 
     def _routes(self):
         def index(q):
@@ -173,6 +138,7 @@ class PprofServer(BaseService):
                 "/debug/jax/start_trace?dir=PATH\n"
                 "/debug/jax/stop_trace\n"
                 "/debug/locks\n"
+                "/debug/devstats         device/XLA telemetry (JSON)\n"
                 "/debug/trace            span-tracer ring dump\n"
                 "/debug/trace/start?file=PATH\n"
                 "/debug/trace/stop\n"
@@ -208,6 +174,11 @@ class PprofServer(BaseService):
                     "timeout_s": libsync.DEADLOCK_TIMEOUT,
                 }
             )
+
+        def devstats_dump(q):
+            from . import devstats as libdevstats
+
+            return libdevstats.debug_devstats_json()
 
         def trace_dump(q):
             from . import trace as libtrace
@@ -252,6 +223,7 @@ class PprofServer(BaseService):
             "/debug/jax/start_trace": jax_start,
             "/debug/jax/stop_trace": jax_stop,
             "/debug/locks": locks,
+            "/debug/devstats": devstats_dump,
             "/debug/trace": trace_dump,
             "/debug/trace/start": trace_start,
             "/debug/trace/stop": trace_stop,

@@ -413,6 +413,22 @@ class Node(BaseService):
                 config.rpc.pprof_laddr,
                 logger=self.logger.with_module("pprof"),
             )
+        # Dedicated Prometheus scrape listener (the reference's
+        # Instrumentation server, node/node.go:630 + config/config.go
+        # prometheus_listen_addr). COMETBFT_TPU_PROM_ADDR overrides the
+        # config section; starting it also enables libs/devstats so the
+        # XLA compile/device-memory/transfer families carry real data.
+        from ..libs import devstats as libdevstats
+
+        prom_addr = libdevstats.prometheus_addr(config)
+        self.prometheus_server = None
+        if prom_addr:
+            self.prometheus_server = libdevstats.PrometheusServer(
+                prom_addr,
+                self.metrics.registry,
+                refresh=self._refresh_metrics,
+                logger=self.logger.with_module("prometheus"),
+            )
         self.switch.logger = self.logger.with_module("p2p")
         self.blocksync_reactor.logger = self.logger.with_module("blocksync")
         self.statesync_reactor.logger = self.logger.with_module("statesync")
@@ -465,6 +481,12 @@ class Node(BaseService):
     def _refresh_metrics(self) -> None:
         """Pull-time gauges (collector pattern): cheap reads at scrape —
         nothing here may touch the consensus commit path or disk."""
+        from ..libs import devstats as libdevstats
+
+        # device memory + arena occupancy into THIS node's registry
+        # (no-op unless devstats is on; never initializes a jax backend
+        # from the scrape path)
+        libdevstats.sample(self.metrics)
         out, inb = self.switch.num_peers()
         self.metrics.peers.set(out + inb)
         self.metrics.mempool_size.set(self.mempool.size())
@@ -612,6 +634,25 @@ class Node(BaseService):
                 target=self._forward_txs_available, daemon=True
             )
             self._txs_available_thread.start()
+        # Prometheus exporter LAST: device telemetry lives exactly as
+        # long as someone can scrape it (acquired here, released in
+        # on_stop, refcounted across in-process nodes), and starting it
+        # after every fallible boot step means a failed boot — where
+        # stop() raises NotStartedError and on_stop never runs — cannot
+        # leak the acquire.
+        if self.prometheus_server is not None:
+            from ..libs import devstats as libdevstats
+
+            libdevstats.acquire()
+            try:
+                self.prometheus_server.start()
+            except BaseException:
+                libdevstats.release()
+                raise
+            self.logger.with_module("prometheus").info(
+                "prometheus exporter listening",
+                port=self.prometheus_server.bound_port,
+            )
 
     def _forward_txs_available(self) -> None:
         ev = self.mempool.txs_available()
@@ -651,6 +692,15 @@ class Node(BaseService):
                 self.pprof_server.stop()
             except Exception:
                 pass
+        if self.prometheus_server is not None:
+            from ..libs import devstats as libdevstats
+
+            if self.prometheus_server.is_running():
+                try:
+                    self.prometheus_server.stop()
+                except Exception:
+                    pass
+            libdevstats.release()
         for svc in (self.switch, self.event_bus, self.proxy_app):
             try:
                 if svc.is_running():

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import time
 
+from ..libs import devstats as libdevstats
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from collections import OrderedDict
@@ -444,22 +445,34 @@ def _cached_jits():
     # that buffer under it. Updates are rare (new validator keys), the
     # ~21 MB copy is cheap. (The verify-side jits live in
     # _jitted_cached_kernel, keyed by lowering.)
+    # devstats.track wraps each jit for compile accounting (axis = the
+    # positional arg whose last dim is the lane bucket): every XLA
+    # compile lands in xla_compile_total{kernel,bucket} and the
+    # no-recompile tier-1 guard.
     return (
-        jax.jit(_builder_kernel),
-        jax.jit(_scatter_kernel),
+        libdevstats.track("arena.build", jax.jit(_builder_kernel), axis=0),
+        libdevstats.track(
+            "arena.scatter", jax.jit(_scatter_kernel), axis=3
+        ),
     )
 
 
 @lru_cache(maxsize=None)
 def _jitted_cached_kernel(which: str):
     _enable_compilation_cache()
-    fn = {
+    flavors = {
         "pallas": _cached_kernel_pallas,
         "pallas8": _cached_kernel_pallas8,
         "xla8": _cached_kernel8,
-    }.get(which, _cached_kernel)
+    }
+    fn = flavors.get(which, _cached_kernel)
+    label = which if which in flavors else "xla"
     # donate the per-launch R|S|kneg wire rows (arg 3) — NEVER the arena
-    return jax.jit(fn, donate_argnums=_donatable((3,)))
+    return libdevstats.track(
+        "verify_cached." + label,
+        jax.jit(fn, donate_argnums=_donatable((3,))),
+        axis=3,
+    )
 
 
 def _run_cached_kernel(arena, arena_ok, idxs, buf):
@@ -468,18 +481,19 @@ def _run_cached_kernel(arena, arena_ok, idxs, buf):
     if buf.shape[1] >= _PALLAS_MIN_LANES and _pallas_wanted():
         for which in _pallas_candidates():
             try:
-                return (
-                    _jitted_cached_kernel(which)(
-                        arena, arena_ok, idxs, buf
-                    ),
-                    which,
+                out = _jitted_cached_kernel(which)(
+                    arena, arena_ok, idxs, buf
                 )
             except Exception as e:
                 _note_pallas_broken(which, e)
-    return (
-        _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf),
-        None,
-    )
+            else:
+                # the arena stays HBM-resident; only the wire rows and
+                # the slot indices cross the PCIe/tunnel edge
+                libdevstats.record_h2d(buf.nbytes + idxs.nbytes)
+                return out, which
+    out = _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf)
+    libdevstats.record_h2d(buf.nbytes + idxs.nbytes)
+    return out, None
 
 
 class PubkeyTableCache:
@@ -509,6 +523,7 @@ class PubkeyTableCache:
         self.hits = 0
         self.misses = 0
         self.builds = 0  # builder launches (device round trips)
+        self.evictions = 0  # LRU slot reclaims (devstats exports these)
 
     def _ensure_arena(self):
         import jax.numpy as jnp
@@ -578,6 +593,7 @@ class PubkeyTableCache:
                                     for old in self._slots:
                                         if old not in in_use:
                                             slot = self._slots.pop(old)
+                                            self.evictions += 1
                                             break
                                     # unreachable: len(in_use) <=
                                     # capacity guarantees an evictable
@@ -615,6 +631,7 @@ class PubkeyTableCache:
                     buf[:, j] = np.frombuffer(pk, np.uint8)
             self.builds += 1
             tables, oks = builder(buf)
+            libdevstats.record_h2d(buf.nbytes)
             import jax.numpy as jnp
 
             host_wellformed = np.array(
@@ -714,12 +731,18 @@ def _enable_compilation_cache() -> None:
 @lru_cache(maxsize=None)
 def _jitted_kernel(which: str = "xla"):
     _enable_compilation_cache()
-    fn = {
+    flavors = {
         "pallas": _kernel_from_bytes_pallas,
         "pallas8": _kernel_from_bytes_pallas8,
         "xla8": _kernel_from_bytes8,
-    }.get(which, _kernel_from_bytes)
-    return jax.jit(fn, donate_argnums=_donatable((0,)))
+    }
+    fn = flavors.get(which, _kernel_from_bytes)
+    label = which if which in flavors else "xla"
+    return libdevstats.track(
+        "verify." + label,
+        jax.jit(fn, donate_argnums=_donatable((0,))),
+        axis=0,
+    )
 
 
 # Kernel selection: "auto" routes single-chip batches through the Pallas
@@ -845,10 +868,15 @@ def _run_kernel(buf):
     if buf.shape[1] >= _PALLAS_MIN_LANES and _pallas_wanted():
         for which in _pallas_candidates():
             try:
-                return _jitted_kernel(which)(buf), which
+                out = _jitted_kernel(which)(buf)
             except Exception as e:  # synchronous trace/compile failure
                 _note_pallas_broken(which, e)
-    return _jitted_kernel(_xla_which())(buf), None
+            else:
+                libdevstats.record_h2d(buf.nbytes)
+                return out, which
+    out = _jitted_kernel(_xla_which())(buf)
+    libdevstats.record_h2d(buf.nbytes)
+    return out, None
 
 
 def _materialize(out, used_pallas, buf):
@@ -859,13 +887,15 @@ def _materialize(out, used_pallas, buf):
     try:
         # cometlint: disable=CLNT002 -- THE sanctioned per-launch readback:
         # every async dispatch materializes exactly once, here
-        return np.asarray(out)
+        arr = np.asarray(out)
     except Exception as e:
         if used_pallas is None:
             raise
         _note_pallas_broken(used_pallas, e)
         out2, which2 = _run_kernel(buf)
         return _materialize(out2, which2, buf)
+    libdevstats.record_d2h(arr.nbytes)
+    return arr
 
 
 # Measured on a v5e (round 5, Pallas kernel): the launch has a ~40-50 ms
@@ -972,9 +1002,17 @@ def _verify_batch_sharded(pubkeys, msgs, sigs, n_dev: int):
     libmetrics.observe_verify_phase(
         "pack", "ed25519-tpu", t1 - t0, n, arena="sharded"
     )
+    if libdevstats.enabled():
+        # the sharded path ships pre-unpacked limb arrays (pack_inputs),
+        # not the compact 128 B/lane wire rows — record what actually
+        # crosses the edge
+        libdevstats.record_h2d(
+            sum(v.nbytes for v in arrays.values()) + host_ok.nbytes
+        )
     ok = pmesh.verify_sharded(
         arrays, host_ok, pmesh.default_mesh(), 1, nb
     )[0][:n]
+    libdevstats.record_d2h(ok.nbytes)
     # pjit materializes inside verify_sharded — dispatch and readback
     # are one phase on the multi-chip path
     libmetrics.observe_verify_phase(
@@ -1002,7 +1040,7 @@ def verify_rsk_async(buf: np.ndarray, idxs: np.ndarray, arena, arena_ok,
             try:
                 # cometlint: disable=CLNT002 -- sanctioned readback of the
                 # cached-table launch (the _materialize analog)
-                return np.asarray(o)[:n]
+                arr = np.asarray(o)
             except Exception as e:
                 if which is None:
                     raise
@@ -1010,6 +1048,9 @@ def verify_rsk_async(buf: np.ndarray, idxs: np.ndarray, arena, arena_ok,
                 # tries the sibling, bottoming out at XLA (which=None)
                 _note_pallas_broken(which, e)
                 o, which = _run_cached_kernel(arena, arena_ok, idxs, buf)
+            else:
+                libdevstats.record_d2h(arr.nbytes)
+                return arr[:n]
 
     return materialize
 

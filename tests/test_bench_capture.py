@@ -66,5 +66,23 @@ def test_bench_capture_path_end_to_end(tmp_path):
     assert "xla8_uncached_sigs_per_sec" in ab, ab
     assert "xla_cached_sigs_per_sec" in ab, ab
 
+    # provenance stamping: the 0_provenance row and the headline both
+    # carry jax/jaxlib/backend so BENCH_*.json stays comparable across
+    # hosts and rounds
+    assert "0_provenance" in configs
+    prov = next(d for d in details if d.get("config") == "0_provenance")
+    for key in ("jax", "jaxlib", "backend", "python"):
+        assert prov.get(key), (key, prov)
+    assert headline["provenance"].get("jax") == prov["jax"]
+
+    # the 9_device_floor compile-attribution fix: one-time XLA compile
+    # is its own column, and the utilization estimate declares its
+    # execute-only basis
+    floor = next(d for d in details if d.get("config") == "9_device_floor")
+    for row in floor["rows"]:
+        assert "compile_ms" in row and "compiles" in row, row
+        assert "est_vpu_util_basis" in row, row
+
     table = json.loads((tmp_path / "BENCH_CHIP_TABLE.json").read_text())
     assert table["table"], "chip table must be written on a live backend"
+    assert "device_kind" in table  # None on CPU, the chip kind on TPU

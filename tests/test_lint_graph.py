@@ -485,3 +485,18 @@ class TestEngineWideGate:
             if "libs.trace._mtx" in (e["from"], e["to"])
         ]
         assert trace_edges == [], trace_edges
+
+    def test_devstats_lock_registered_and_leaf(self, analysis):
+        """libs/devstats' compile-ledger mutex has the same contract as
+        the tracer's: present in the shipped artifact, edge-free. The
+        telemetry layer records compiles/transfers from inside the
+        verify hot path — metrics and trace emission happen OUTSIDE the
+        ledger lock, so it must never gain an acquisition-order edge."""
+        d = analysis.graph_dict()
+        assert "libs.devstats._mtx" in {lk["name"] for lk in d["locks"]}
+        devstats_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "libs.devstats._mtx" in (e["from"], e["to"])
+        ]
+        assert devstats_edges == [], devstats_edges

@@ -7,6 +7,7 @@ through a real in-process consensus burst."""
 
 import io
 import json
+import re
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -35,6 +36,96 @@ def tracer():
 def _get(url: str, timeout: float = 5.0) -> tuple[int, str]:
     with urllib.request.urlopen(url, timeout=timeout) as r:
         return r.status, r.read().decode()
+
+
+# ------------------------------------------------------------------
+# Prometheus exposition-format conformance (the contract every scrape
+# of /metrics depends on): shared by the registry-level and endpoint-
+# level tests below.
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"  # metric name
+    r"(?:\{(.*)\})?"  # optional label body
+    r" (-?(?:[0-9.eE+-]+|Inf)|NaN)$"  # value
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_sample_line(ln: str):
+    m = _SAMPLE_RE.match(ln)
+    assert m, f"malformed sample line: {ln!r}"
+    labels = dict(_LABEL_RE.findall(m.group(2) or ""))
+    return m.group(1), labels, float(m.group(3).replace("Inf", "inf"))
+
+
+def assert_exposition_conformant(text: str) -> dict:
+    """Structural conformance of a text-exposition payload: every
+    sample belongs to a ``# TYPE``-declared family (HELP, when present,
+    precedes TYPE; neither duplicated), sample lines parse, and every
+    histogram series has monotonically non-decreasing cumulative
+    buckets ending at ``le="+Inf"`` == ``_count``, plus a ``_sum``.
+    Returns {family: kind}."""
+    types: dict[str, str] = {}
+    helps: set[str] = set()
+    samples = []
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            fam = ln.split()[2]
+            assert fam not in types, f"HELP after TYPE for {fam}"
+            assert fam not in helps, f"duplicate HELP for {fam}"
+            helps.add(fam)
+        elif ln.startswith("# TYPE "):
+            parts = ln.split()
+            fam, kind = parts[2], parts[3]
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            types[fam] = kind
+        else:
+            assert not ln.startswith("#"), f"unknown comment: {ln!r}"
+            samples.append(_parse_sample_line(ln))
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)]
+            if name.endswith(suffix) and types.get(base) == "histogram":
+                return base
+        return name
+
+    hist: dict = {}
+    for name, labels, value in samples:
+        fam = family_of(name)
+        assert fam in types, f"sample {name!r} has no # TYPE"
+        if types[fam] == "histogram":
+            series = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            d = hist.setdefault(
+                (fam, series), {"buckets": [], "sum": None, "count": None}
+            )
+            if name.endswith("_bucket"):
+                assert "le" in labels, f"bucket without le: {labels}"
+                d["buckets"].append((labels["le"], value))
+            elif name.endswith("_sum"):
+                d["sum"] = value
+            else:
+                d["count"] = value
+    for (fam, series), d in hist.items():
+        les = [b[0] for b in d["buckets"]]
+        assert les and les[-1] == "+Inf", (fam, series, les)
+        edges = [float(le.replace("+Inf", "inf")) for le in les]
+        assert edges == sorted(edges), (fam, series, les)
+        counts = [b[1] for b in d["buckets"]]
+        assert counts == sorted(counts), (
+            f"{fam}{series}: non-monotone cumulative buckets {counts}"
+        )
+        assert d["sum"] is not None, (fam, series, "missing _sum")
+        assert d["count"] == counts[-1], (
+            f"{fam}{series}: +Inf bucket {counts[-1]} != count {d['count']}"
+        )
+    return types
 
 
 class TestLogger:
@@ -224,15 +315,21 @@ class TestNodeMetricsStack:
 
 
 class TestNodeObservability:
-    def test_metrics_endpoint_and_commit_logs(self, tmp_path):
-        """A live node serves /metrics with real values and logs commits."""
+    def test_metrics_endpoint_and_commit_logs(self, tmp_path, monkeypatch):
+        """A live node serves /metrics with real values and logs commits;
+        with COMETBFT_TPU_PROM_ADDR set it ALSO serves the dedicated
+        Prometheus listener (the reference's Instrumentation server),
+        whose scrape carries every devstats family with spec-compliant
+        exposition — the acceptance curl of this PR."""
         import dataclasses
         import time
 
         from cometbft_tpu.config import default_config
+        from cometbft_tpu.libs import devstats
         from cometbft_tpu.node import Node, init_files
         from helpers import make_genesis
 
+        monkeypatch.setenv("COMETBFT_TPU_PROM_ADDR", "tcp://127.0.0.1:0")
         _MS = 1_000_000
         cfg = default_config()
         cfg.base.home = str(tmp_path)
@@ -305,8 +402,46 @@ class TestNodeObservability:
             logs = sink.getvalue()
             assert "finalized block" in logs
             assert "module=consensus" in logs
+            # -- the dedicated Prometheus listener (devstats tentpole):
+            # starting it flipped devstats on, and the scrape returns
+            # every device-telemetry family, spec-compliant.
+            assert node.prometheus_server is not None
+            assert devstats.enabled()
+            url = f"http://127.0.0.1:{node.prometheus_server.bound_port}"
+            with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+                assert (
+                    r.headers["Content-Type"]
+                    == "text/plain; version=0.0.4; charset=utf-8"
+                )
+                prom_text = r.read().decode()
+            families = assert_exposition_conformant(prom_text)
+            for family in (
+                "cometbft_tpu_xla_compile_total",
+                "cometbft_tpu_xla_compile_seconds",
+                "cometbft_tpu_xla_recompile_total",
+                "cometbft_tpu_xla_cache_hit_total",
+                "cometbft_tpu_device_memory_bytes",
+                "cometbft_tpu_pubkey_arena_slots",
+                "cometbft_tpu_pubkey_arena_lookups_total",
+                "cometbft_tpu_device_transfer_bytes_total",
+                "cometbft_tpu_device_transfer_ops_total",
+            ):
+                assert family in families, family
+            # the refresh hook ran: the arena occupancy gauges carry the
+            # sampled capacity, and the node gauges are live here too
+            assert (
+                'cometbft_tpu_pubkey_arena_slots{state="capacity"}'
+                in prom_text
+            )
+            height_line = [
+                ln
+                for ln in prom_text.splitlines()
+                if ln.startswith("cometbft_tpu_consensus_height ")
+            ][0]
+            assert float(height_line.split()[-1]) >= 3
         finally:
             node.stop()
+            devstats.disable()
 
 
 class TestTrace:
@@ -327,12 +462,21 @@ class TestTrace:
 
     def test_disabled_fast_path_retains_no_allocations(self):
         """The tier-1 allocation guard for the verify hot path: with
-        tracing off, event/span/begin must not retain a single byte
-        allocated inside libs/trace (no ring growth, no span objects,
-        no garbage) — the instrumented verify path stays free."""
+        tracing AND devstats off, the instrumented entry points (trace
+        event/span/begin, the tracked-jit wrapper, the transfer
+        recorders, the gauge sampler) must not retain a single byte
+        allocated inside libs/trace or libs/devstats — the verify path
+        stays free when telemetry is off."""
         import tracemalloc
 
+        import numpy as np
+
+        from cometbft_tpu.libs import devstats
+
         assert not libtrace.enabled()
+        assert not devstats.enabled()
+        tracked = devstats.track("guard.kernel", lambda buf: buf, axis=0)
+        wire = np.zeros((4, 8), np.uint8)
 
         def hot():
             for _ in range(300):
@@ -340,7 +484,12 @@ class TestTrace:
                 with libtrace.span("verify"):
                     pass
                 libtrace.begin("consensus.step").end()
+                tracked(wire)
+                devstats.record_h2d(1024)
+                devstats.record_d2h(8)
+                devstats.sample()
 
+        c0 = devstats.counters()
         hot()  # warm interpreter caches outside the measured window
         tracemalloc.start()
         try:
@@ -350,10 +499,14 @@ class TestTrace:
         finally:
             tracemalloc.stop()
         stats = snap.filter_traces(
-            [tracemalloc.Filter(True, libtrace.__file__)]
+            [
+                tracemalloc.Filter(True, libtrace.__file__),
+                tracemalloc.Filter(True, devstats.__file__),
+            ]
         ).statistics("lineno")
         assert sum(s.size for s in stats) == 0, stats
         assert libtrace.ring_dump() == []
+        assert devstats.counters() == c0  # nothing recorded while off
 
     def test_events_spans_and_nesting(self, tracer):
         with libtrace.span("outer", k="v") as outer:
@@ -483,6 +636,8 @@ class TestTrace:
             "COMETBFT_TPU_TRACE",
             "COMETBFT_TPU_TRACE_FILE",
             "COMETBFT_TPU_TRACE_RING",
+            "COMETBFT_TPU_DEVSTATS",
+            "COMETBFT_TPU_PROM_ADDR",
         ):
             assert knob in ENV_KNOBS, knob
             assert knob in doc, f"{knob} missing from docs/observability.md"
@@ -617,6 +772,25 @@ class TestPprofDebugServer:
             _get(server + "/debug/nope")
         assert ei.value.code == 404
 
+    def test_devstats_route(self, server):
+        """/debug/devstats: the JSON twin of the Prometheus families,
+        linked from the index (and captured into the debug-dump crash
+        bundle as devstats.json)."""
+        _, body = _get(server + "/debug/devstats")
+        st = json.loads(body)
+        assert set(st) >= {"enabled", "xla", "transfers"}
+        assert set(st["xla"]) >= {
+            "compiles",
+            "recompiles",
+            "per_kernel_bucket",
+            "persistent_cache",
+        }
+        assert set(st["transfers"]) == {
+            "h2d_ops", "h2d_bytes", "d2h_ops", "d2h_bytes"
+        }
+        _, index = _get(server + "/debug/pprof/")
+        assert "/debug/devstats" in index
+
     def test_trace_start_sink_failure_leaves_tracing_off(
         self, server, tmp_path
     ):
@@ -664,6 +838,335 @@ class TestPprofDebugServer:
             libtrace.disable()
             libtrace.stop_file_sink()
             libtrace.reset()
+
+
+class TestDevstats:
+    """libs/devstats unit contract: compile accounting per kernel x
+    bucket through the tracked-jit wrapper, recompile detection on
+    dtype drift, persistent-cache outcome classification, transfer
+    counters, and the snapshot/JSON surface."""
+
+    @pytest.fixture
+    def devstats(self):
+        from cometbft_tpu.libs import devstats as ds
+
+        ds.enable()
+        yield ds
+        ds.disable()
+
+    @pytest.fixture
+    def node_m(self):
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        yield m
+        libmetrics.pop_node_metrics(m)
+
+    def test_tracked_jit_counts_compiles_per_bucket(self, devstats, node_m):
+        import jax
+        import numpy as np
+
+        tracked = devstats.track(
+            "test.kern_a", jax.jit(lambda x: x.sum(axis=0)), axis=0
+        )
+        c0 = devstats.compile_count()
+        a8 = np.zeros((4, 8), np.int32)
+        tracked(a8)  # first dispatch of bucket 8: one compile
+        tracked(a8)  # steady state: none
+        assert devstats.compile_count() == c0 + 1
+        tracked(np.zeros((4, 16), np.int32))  # new bucket: one more
+        assert devstats.compile_count() == c0 + 2
+        snap = devstats.snapshot()
+        assert snap["xla"]["per_kernel_bucket"]["test.kern_a:8"] == 1
+        assert snap["xla"]["per_kernel_bucket"]["test.kern_a:16"] == 1
+        text = node_m.registry.render()
+        assert (
+            'cometbft_tpu_xla_compile_total'
+            '{kernel="test.kern_a",bucket="8"} 1.0' in text
+        )
+        assert (
+            'cometbft_tpu_xla_compile_total'
+            '{kernel="test.kern_a",bucket="16"} 1.0' in text
+        )
+        # the compile was timed into the histogram
+        assert (
+            'cometbft_tpu_xla_compile_seconds_count'
+            '{kernel="test.kern_a"} 2' in text
+        )
+
+    def test_dtype_drift_is_a_recompile(self, devstats, node_m, tracer):
+        """The silent-recompile failure mode this layer exists to catch:
+        a dtype drift past CLNT003 re-traces an ALREADY-compiled kernel
+        x bucket — same shapes, new executable — and must land in the
+        process-wide recompile counter, not pass as a fresh bucket."""
+        import jax
+        import numpy as np
+
+        tracked = devstats.track(
+            "test.kern_drift", jax.jit(lambda x: x * 2), axis=0
+        )
+        tracked(np.zeros((4, 8), np.int32))
+        rec0 = devstats.counters()["recompiles"]
+        tracked(np.zeros((4, 8), np.float32))  # drift: bucket 8 again
+        assert devstats.counters()["recompiles"] == rec0 + 1
+        assert (
+            devstats.snapshot()["xla"]["per_kernel_bucket"][
+                "test.kern_drift:8"
+            ]
+            == 2
+        )
+        assert "cometbft_tpu_xla_recompile_total 1.0" in (
+            node_m.registry.render()
+        )
+        # the compile surfaced in the trace ring, flagged as a recompile
+        evs = [
+            e
+            for e in libtrace.ring_dump()
+            if e["name"] == "xla.compile"
+            and e.get("kernel") == "test.kern_drift"
+        ]
+        assert len(evs) == 2
+        assert [e["recompile"] for e in evs] == [False, True]
+        assert all(e["bucket"] == 8 and e["dur_ns"] > 0 for e in evs)
+
+    def test_persistent_cache_outcomes_classified(self, devstats, node_m):
+        """Each compile is classified against the persistent XLA cache
+        (jax.monitoring): the hit/miss tallies advance with compiles,
+        so a fleet-wide cold boot (all misses) is distinguishable from
+        warm restarts (all hits)."""
+        import jax
+        import numpy as np
+
+        c0 = devstats.counters()
+        tracked = devstats.track(
+            "test.kern_pc", jax.jit(lambda x: x - 1), axis=0
+        )
+        tracked(np.zeros((2, 8), np.int32))
+        c1 = devstats.counters()
+        assert c1["compiles"] == c0["compiles"] + 1
+        # the suite enables the persistent cache (conftest), so the
+        # compile consulted it and was classified one way or the other
+        assert (c1["pcache_hits"] + c1["pcache_misses"]) == (
+            c0["pcache_hits"] + c0["pcache_misses"] + 1
+        )
+        snap = devstats.snapshot()
+        pc = snap["xla"]["persistent_cache"]
+        assert pc == {"hits": c1["pcache_hits"], "misses": c1["pcache_misses"]}
+
+    def test_transfer_counters(self, devstats, node_m):
+        # the launch path only touches the process ledger; a registry
+        # catches up at sample() time from its own watermark (the first
+        # sample replays the full process series into this registry)
+        devstats.sample(node_m)
+        c0 = devstats.counters()
+        devstats.record_h2d(1000)
+        devstats.record_h2d(24)
+        devstats.record_d2h(8)
+        c1 = devstats.counters()
+        assert c1["h2d_ops"] - c0["h2d_ops"] == 2
+        assert c1["h2d_bytes"] - c0["h2d_bytes"] == 1024
+        assert c1["d2h_ops"] - c0["d2h_ops"] == 1
+        assert c1["d2h_bytes"] - c0["d2h_bytes"] == 8
+        before = node_m.transfer_bytes.labels("h2d").value()
+        devstats.sample(node_m)  # bridge the new deltas into THIS registry
+        text = node_m.registry.render()
+        assert (
+            node_m.transfer_bytes.labels("h2d").value() - before == 1024
+        )
+        assert 'cometbft_tpu_device_transfer_bytes_total{direction="h2d"}' in text
+        # a SECOND registry sampled later still sees the full series
+        m2 = NodeMetrics()
+        devstats.sample(m2)
+        assert m2.transfer_bytes.labels("h2d").value() >= 1024
+
+    def test_acquire_release_refcount(self, monkeypatch):
+        """Node lifecycles refcount the enable: telemetry stays on
+        while ANY Prometheus-serving node is up, turns itself off when
+        the last one stops (unless the env knob pins it on)."""
+        from cometbft_tpu.libs import devstats as ds
+
+        monkeypatch.delenv("COMETBFT_TPU_DEVSTATS", raising=False)
+        assert not ds.enabled()
+        ds.acquire()
+        ds.acquire()
+        assert ds.enabled()
+        ds.release()
+        assert ds.enabled()  # the second node still holds it
+        ds.release()
+        assert not ds.enabled()
+        # the env knob outlives node lifecycles
+        monkeypatch.setenv("COMETBFT_TPU_DEVSTATS", "1")
+        ds.acquire()
+        ds.release()
+        assert ds.enabled()
+        monkeypatch.delenv("COMETBFT_TPU_DEVSTATS")
+        ds.disable()
+
+    def test_sample_populates_arena_gauges(self, devstats, node_m):
+        from cometbft_tpu.ops.verify import _PUBKEY_CACHE
+
+        # explicit target registry (what a scraped node passes): the
+        # gauges land in THAT NodeMetrics, not whatever tops the stack
+        out = devstats.sample(node_m)
+        assert out["pubkey_arena"]["capacity"] == _PUBKEY_CACHE.capacity
+        text = node_m.registry.render()
+        assert (
+            f'cometbft_tpu_pubkey_arena_slots{{state="capacity"}} '
+            f"{float(_PUBKEY_CACHE.capacity)}" in text
+        )
+        # CPU backend: memory_stats() is None, so no device series —
+        # but the family still renders (TYPE line) for scrapers
+        assert "# TYPE cometbft_tpu_device_memory_bytes gauge" in text
+
+    def test_exposition_conformance_of_new_families(self, devstats, node_m):
+        """The satellite contract: every new family renders
+        spec-compliant exposition — hostile label values escaped,
+        HELP/TYPE present, histogram buckets monotone through +Inf."""
+        m = node_m
+        m.xla_compiles.labels('ker"n\\el\nx', "8").inc()
+        m.xla_compile_seconds.labels('ker"n\\el\nx').observe(0.3)
+        m.xla_compile_seconds.labels('ker"n\\el\nx').observe(400.0)  # +Inf
+        m.xla_cache.labels("hit").inc()
+        m.device_memory.labels("0", "bytes_in_use").set(123456)
+        m.arena_slots.labels("used").set(4)
+        m.arena_lookups.labels("hit").inc(7)
+        m.arena_evictions.inc()
+        m.transfer_bytes.labels("h2d").inc(800)
+        m.transfer_ops.labels("h2d").inc()
+        m.verify_phase_seconds.labels("pack", "ed25519-tpu").observe(1e-5)
+        text = m.registry.render()
+        families = assert_exposition_conformant(text)
+        for fam, kind in (
+            ("cometbft_tpu_xla_compile_total", "counter"),
+            ("cometbft_tpu_xla_compile_seconds", "histogram"),
+            ("cometbft_tpu_xla_recompile_total", "counter"),
+            ("cometbft_tpu_xla_cache_hit_total", "counter"),
+            ("cometbft_tpu_device_memory_bytes", "gauge"),
+            ("cometbft_tpu_pubkey_arena_slots", "gauge"),
+            ("cometbft_tpu_pubkey_arena_lookups_total", "counter"),
+            ("cometbft_tpu_pubkey_arena_builds_total", "counter"),
+            ("cometbft_tpu_pubkey_arena_evictions_total", "counter"),
+            ("cometbft_tpu_device_transfer_bytes_total", "counter"),
+            ("cometbft_tpu_device_transfer_ops_total", "counter"),
+        ):
+            assert families.get(fam) == kind, fam
+        # the hostile kernel label survived escaping on counter AND
+        # histogram series
+        assert 'kernel="ker\\"n\\\\el\\nx"' in text
+
+    def test_conformance_checker_rejects_violations(self):
+        """The checker itself must catch what it claims to: a sample
+        with no TYPE, and a non-monotone histogram."""
+        with pytest.raises(AssertionError):
+            assert_exposition_conformant("orphan_total 1.0\n")
+        bad_hist = (
+            "# TYPE h_seconds histogram\n"
+            'h_seconds_bucket{le="0.1"} 5\n'
+            'h_seconds_bucket{le="1.0"} 3\n'
+            'h_seconds_bucket{le="+Inf"} 6\n'
+            "h_seconds_sum 1.0\n"
+            "h_seconds_count 6\n"
+        )
+        with pytest.raises(AssertionError):
+            assert_exposition_conformant(bad_hist)
+        no_inf = (
+            "# TYPE h2_seconds histogram\n"
+            'h2_seconds_bucket{le="0.1"} 5\n'
+            "h2_seconds_sum 1.0\n"
+            "h2_seconds_count 5\n"
+        )
+        with pytest.raises(AssertionError):
+            assert_exposition_conformant(no_inf)
+
+
+class TestPrometheusServer:
+    """The scrape endpoint end-to-end over real HTTP: exposition body,
+    content type, refresh hook, index, 404."""
+
+    def test_scrape_end_to_end(self):
+        from cometbft_tpu.libs import devstats
+
+        m = NodeMetrics()
+        devstats.enable()
+        libmetrics.push_node_metrics(m)
+        srv = None
+        try:
+            m.height.set(5)
+            # first sample replays the registry up to the full process
+            # series; what the SCRAPE must then add is exactly our two
+            # records below
+            devstats.sample(m)
+            base_h2d = m.transfer_bytes.labels("h2d").value()
+            devstats.record_h2d(96 * 8 + 32)
+            devstats.record_d2h(8)
+            refreshed = []
+
+            def refresh():
+                refreshed.append(1)
+                devstats.sample(m)
+
+            srv = devstats.PrometheusServer(
+                "tcp://127.0.0.1:0", m.registry, refresh=refresh
+            )
+            srv.start()
+            url = f"http://127.0.0.1:{srv.bound_port}"
+            with urllib.request.urlopen(url + "/metrics", timeout=5) as r:
+                assert (
+                    r.headers["Content-Type"]
+                    == "text/plain; version=0.0.4; charset=utf-8"
+                )
+                text = r.read().decode()
+            assert refreshed  # pull-time gauges sampled at scrape
+            families = assert_exposition_conformant(text)
+            for fam in (
+                "cometbft_tpu_xla_compile_total",
+                "cometbft_tpu_xla_cache_hit_total",
+                "cometbft_tpu_device_memory_bytes",
+                "cometbft_tpu_pubkey_arena_slots",
+                "cometbft_tpu_device_transfer_bytes_total",
+            ):
+                assert fam in families, fam
+            assert "cometbft_tpu_consensus_height 5.0" in text
+            # the scrape's refresh bridged exactly our 800 new bytes
+            assert (
+                m.transfer_bytes.labels("h2d").value() - base_h2d == 800
+            )
+            assert (
+                'cometbft_tpu_device_transfer_bytes_total'
+                '{direction="h2d"}' in text
+            )
+            _, body = _get(url + "/")
+            assert "/metrics" in body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(url + "/nope")
+            assert ei.value.code == 404
+        finally:
+            if srv is not None and srv.is_running():
+                srv.stop()
+            devstats.disable()
+            libmetrics.pop_node_metrics(m)
+
+    def test_scrape_survives_refresh_failure(self):
+        """A broken pull-time collector must not take down the scrape:
+        counters and histograms still serve."""
+        from cometbft_tpu.libs import devstats
+
+        m = NodeMetrics()
+        m.height.set(9)
+
+        def boom():
+            raise RuntimeError("collector broke")
+
+        srv = devstats.PrometheusServer(
+            "tcp://127.0.0.1:0", m.registry, refresh=boom
+        )
+        srv.start()
+        try:
+            _, text = _get(
+                f"http://127.0.0.1:{srv.bound_port}/metrics"
+            )
+            assert "cometbft_tpu_consensus_height 9.0" in text
+        finally:
+            srv.stop()
 
 
 class TestConsensusTraceBurst:
@@ -741,3 +1244,127 @@ class TestConsensusTraceBurst:
         assert total_s > 0
         assert 0 < phase_s <= total_s * 1.01, (phase_s, total_s)
         assert phase_s >= total_s * 0.3, (phase_s, total_s)
+
+
+class TestNoRecompileGuard:
+    """The tier-1 no-recompile regression guard (the enforced form of
+    ops/verify's shape-bucket invariant): after warmup, a real 4-
+    validator consensus burst must record ZERO new XLA compiles and
+    zero arena builder launches, and the devstats transfer counters
+    must reconcile exactly with the traced verify phase events. A
+    failure here means a shape-bucket leak or a dtype drift is paying
+    (and hiding) compile time inside the consensus hot loop."""
+
+    def test_warm_burst_compiles_nothing_and_transfers_reconcile(
+        self, monkeypatch
+    ):
+        from cometbft_tpu.crypto import batch as cbatch
+        from cometbft_tpu.crypto.keys import Ed25519PrivKey
+        from cometbft_tpu.libs import devstats
+        from cometbft_tpu.ops import verify as ov
+
+        # Route every >=2-lane batch through the device path and pin
+        # single-device dispatch, mirroring the traced-burst test.
+        monkeypatch.setattr(cbatch, "HOST_BATCH_THRESHOLD", 2)
+        monkeypatch.setenv("COMETBFT_TPU_SHARD", "0")
+        genesis, pvs = helpers.make_genesis(4)
+        devstats.enable()
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        try:
+            # -- Warmup. Every device batch the burst can produce has
+            # 2..8 lanes -> the one minimum shape bucket (8). Compile
+            # all kernels that bucket can touch (uncached lowering,
+            # arena builder + scatter, cached lowering) and stage the
+            # validator pubkeys so the burst performs no builds.
+            trip = [
+                (
+                    pv.pub_key().bytes(),
+                    b"warm-%d" % i,
+                    pv.sign(b"warm-%d" % i),
+                )
+                for i, pv in enumerate(
+                    Ed25519PrivKey.from_seed(
+                        (1000 + j).to_bytes(32, "big")
+                    )
+                    for j in range(8)
+                )
+            ]
+            pks, msgs_, sigs = map(list, zip(*trip))
+            ok, bitmap = ov.verify_batch(pks, msgs_, sigs)
+            assert ok and bitmap.all()
+            buf, _hok = ov.pack_bytes(pks, msgs_, sigs)
+            assert ov.verify_bytes_async(buf, 8)().all()  # uncached jit
+            val_keys = [bytes(pv.get_pub_key().data) for pv in pvs]
+            assert ov._PUBKEY_CACHE.lookup(val_keys) is not None
+            ok, bitmap = ov.verify_batch(pks, msgs_, sigs)  # cached jit
+            assert ok and bitmap.all()
+
+            libtrace.reset()
+            libtrace.enable(ring=1 << 16)
+            compiles0 = devstats.compile_count()
+            c0 = devstats.counters()
+            builds0 = ov._PUBKEY_CACHE.builds
+
+            nodes = [
+                helpers.make_consensus_node(genesis, pv) for pv in pvs
+            ]
+            helpers.wire_perfect_gossip(nodes)
+            try:
+                for cs, _ in nodes:
+                    cs.start()
+                assert helpers.wait_for_height(nodes[0][1], 2, timeout=120)
+            finally:
+                for cs, parts in nodes:
+                    helpers.stop_node(cs, parts)
+                libtrace.disable()
+                events = libtrace.ring_dump()
+                libtrace.enable(ring=libtrace.DEFAULT_RING_SIZE)
+                libtrace.disable()
+                libtrace.reset()
+
+            # -- THE contract: steady state compiles nothing.
+            assert devstats.compile_count() == compiles0, (
+                "XLA recompiled during a warmed consensus burst:\n"
+                + json.dumps(devstats.snapshot()["xla"], indent=1)
+            )
+            assert not [e for e in events if e["name"] == "xla.compile"]
+            assert ov._PUBKEY_CACHE.builds == builds0, (
+                "arena builder launched during a warmed burst"
+            )
+
+            # -- Counter/trace reconciliation: every traced device
+            # dispatch is one cached-arena launch at bucket 8 (96-byte
+            # wire rows + int32 slot per lane up, a bool per lane back)
+            # and exactly one h2d and one d2h transfer was counted.
+            disp = [
+                e
+                for e in events
+                if e["name"] == "verify.dispatch"
+                and e.get("backend") == "ed25519-tpu"
+            ]
+            assert disp, "burst never exercised the device verify path"
+            assert all(e["arena"] == "hit" for e in disp), (
+                "non-hit arena disposition in steady state"
+            )
+            c1 = devstats.counters()
+            launches = len(disp)
+            assert c1["h2d_ops"] - c0["h2d_ops"] == launches, (
+                launches, c0, c1
+            )
+            assert c1["d2h_ops"] - c0["d2h_ops"] == launches
+            per_launch_up = 96 * 8 + 8 * 4  # wire rows + slot indices
+            assert (
+                c1["h2d_bytes"] - c0["h2d_bytes"]
+                == launches * per_launch_up
+            )
+            assert c1["d2h_bytes"] - c0["d2h_bytes"] == launches * 8
+            # the same launches land in the Prometheus families at
+            # scrape time (the sample bridge)
+            devstats.sample(m)
+            assert (
+                m.transfer_ops.labels("h2d").value() >= launches
+            )
+        finally:
+            devstats.disable()
+            libmetrics.pop_node_metrics(m)

@@ -208,7 +208,14 @@ class TestDebugCLI:
                 "consensus_state.json",
                 "goroutines.txt",
                 "heap.txt",
+                "locks.json",
+                "devstats.json",
+                "trace.json",
             } <= files
+            devstats_snap = json.load(
+                open(os.path.join(out, bundle, "devstats.json"))
+            )
+            assert "xla" in devstats_snap and "transfers" in devstats_snap
             status = json.load(
                 open(os.path.join(out, bundle, "status.json"))
             )
