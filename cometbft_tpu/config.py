@@ -104,6 +104,30 @@ ENV_KNOBS: dict[str, str] = {
         "node software version advertised in p2p NodeInfo/RPC status "
         "(node/node.py; set per-node by the e2e harness)"
     ),
+    "COMETBFT_TPU_COALESCE": (
+        "cross-caller verify coalescer: auto (default, node starts it "
+        "on accelerator backends) | 1 force | 0 off (crypto/coalesce.py)"
+    ),
+    "COMETBFT_TPU_COALESCE_WINDOW_US": (
+        "coalescer deadline window in microseconds before a sub-size "
+        "window flushes (default 500; crypto/coalesce.py)"
+    ),
+    "COMETBFT_TPU_COALESCE_MAX_LANES": (
+        "lanes that trigger an immediate coalescer size flush / the "
+        "per-window cap (default 1024; crypto/coalesce.py)"
+    ),
+    "COMETBFT_TPU_COALESCE_MIN_DEVICE_LANES": (
+        "pin the lane count above which coalescer windows go to the "
+        "device; unset defers to the live host/device crossover "
+        "(crypto/batch.host_batch_threshold) — sub-cutover windows "
+        "still coalesce into one host MSM (crypto/coalesce.py)"
+    ),
+    "COMETBFT_TPU_ADAPTIVE_THRESHOLD": (
+        "adaptive host/device batch crossover from measured timings: "
+        "auto (default, accelerator-only) | 1 force | 0 static seed "
+        "only; a COMETBFT_TPU_HOST_THRESHOLD pin always wins "
+        "(crypto/batch.py AdaptiveCrossover)"
+    ),
 }
 
 

@@ -452,11 +452,20 @@ class ConsensusState(BaseService):
         device-batched layout of SURVEY §7(d). Never changes consensus
         state — a memo miss just falls back to the per-vote host verify.
         """
+        from ..crypto import coalesce as crypto_coalesce
+
         votes: list[Vote] = []
         for kind, payload in items:
             if kind == "peer" and isinstance(payload.msg, VoteMessage):
                 votes.append(payload.msg.vote)
-        if len(votes) < 2:
+        # A lone drained vote is worth pre-verifying only when a
+        # coalescer is routed: the batch verifier then submits it as a
+        # coalescer lane that merges with concurrent callers' windows
+        # (the whole point of the steady-state path); without one, a
+        # single-lane "batch" is just the per-vote host verify done
+        # earlier, so skip straight to admission.
+        min_lanes = 1 if crypto_coalesce.active() is not None else 2
+        if len(votes) < min_lanes:
             return None
         with self._mtx:
             rs = self.rs
@@ -487,7 +496,7 @@ class ConsensusState(BaseService):
                         vote.extension_signature,
                     )
                 )
-        if len(triples) < 2:
+        if len(triples) < min_lanes:
             return None
         try:
             # Keyed off the SET: a heterogeneous ed25519+sr25519 valset
@@ -923,8 +932,14 @@ class ConsensusState(BaseService):
             raise ConsensusError("invalid POL round in proposal")
         proposer = rs.validators.get_proposer()
         sign_bytes = proposal.sign_bytes(self.state.chain_id)
-        if not proposer.pub_key.verify_signature(
-            sign_bytes, proposal.signature
+        # Routed through the cross-caller coalescer when one is active:
+        # the proposal check then shares a device micro-batch with the
+        # votes draining around it (identical verdict; clean host
+        # fallback inside crypto/coalesce.verify_signature).
+        from ..crypto import coalesce as crypto_coalesce
+
+        if not crypto_coalesce.verify_signature(
+            proposer.pub_key, sign_bytes, proposal.signature
         ):
             raise ConsensusError("invalid proposal signature")
         rs.proposal = proposal

@@ -12,9 +12,12 @@ come through this interface.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ..libs import metrics as libmetrics
+from ..libs import sync as libsync
 from . import keys
 from .keys import Ed25519PubKey
 
@@ -92,6 +95,152 @@ def _derive_host_threshold() -> int:
 HOST_BATCH_THRESHOLD = _derive_host_threshold()
 
 
+class AdaptiveCrossover:
+    """Runtime-calibrated host/device batch-size crossover.
+
+    The static cutover (HOST_BATCH_THRESHOLD's env > chip-table > 768
+    chain) is a boot-time guess; this class refines it from the SAME
+    measurements the phase metrics record. Both sides get the same
+    model, matching what 9_device_floor measures:
+    ``time(n) = floor + slope * n`` — the device floor is the launch
+    cost that dominates small batches, and the host floor is the fixed
+    per-call cost of ``host_batch.verify_many`` (the dominant host feed
+    is tiny sub-cutover coalescer windows, and folding that per-call
+    cost into a per-lane rate would drag the crossover below the host
+    MSM's true win region). Every end-to-end observation
+    (crypto/batch._observe, plus the coalescer's windows — the steady
+    state's only source of small-n samples on both sides) feeds decayed
+    least-squares accumulators; the crossover solves
+    ``h_floor + h_rate * n = d_floor + d_slope * n`` and is clamped to
+    [64, 16384].
+
+    Until both sides have ``MIN_SAMPLES`` the seed answers, so boot
+    behavior is exactly the old static routing; an operator env pin
+    (COMETBFT_TPU_HOST_THRESHOLD) disables adaptation entirely.
+    """
+
+    DECAY = 0.98  # per-observation decay of the running moments
+    MIN_SAMPLES = 5
+    LO, HI = 64, 16384
+
+    def __init__(self) -> None:
+        self._mtx = libsync.Mutex("crypto.batch._crossover")
+        # decayed least-squares moments of (n, seconds) pairs per side
+        self._host = [0.0, 0.0, 0.0, 0.0, 0.0]  # sw, sx, sy, sxx, sxy
+        self._dev = [0.0, 0.0, 0.0, 0.0, 0.0]
+        self._host_n = 0
+        self._dev_n = 0
+
+    def _accumulate(self, acc: list[float], n: int, seconds: float) -> None:
+        d = self.DECAY
+        acc[0] = d * acc[0] + 1.0
+        acc[1] = d * acc[1] + n
+        acc[2] = d * acc[2] + seconds
+        acc[3] = d * acc[3] + float(n) * n
+        acc[4] = d * acc[4] + n * seconds
+
+    def observe_host(self, n: int, seconds: float) -> None:
+        if n <= 0 or seconds <= 0:
+            return
+        with self._mtx:
+            self._host_n += 1
+            self._accumulate(self._host, n, seconds)
+
+    def observe_device(self, n: int, seconds: float) -> None:
+        if n <= 0 or seconds <= 0:
+            return
+        with self._mtx:
+            self._dev_n += 1
+            self._accumulate(self._dev, n, seconds)
+
+    @staticmethod
+    def _fit(acc: list[float]) -> tuple[float, float]:
+        """(floor, slope) of time(n) = floor + slope*n from the decayed
+        moments. Samples at ~one size give a pure floor (slope 0) —
+        conservative, since a flat model overstates that side's cost at
+        small n and understates it at large n only where the other
+        side's slope decides anyway."""
+        sw, sx, sy, sxx, sxy = acc
+        mx = sx / sw
+        my = sy / sw
+        var = sxx / sw - mx * mx
+        cov = sxy / sw - mx * my
+        if var > 1e-9:
+            slope = max(0.0, cov / var)
+            floor = max(0.0, my - slope * mx)
+        else:
+            slope, floor = 0.0, my
+        return floor, slope
+
+    def threshold(self) -> int | None:
+        """The calibrated crossover, or None while uncalibrated."""
+        with self._mtx:
+            if (
+                self._host_n < self.MIN_SAMPLES
+                or self._dev_n < self.MIN_SAMPLES
+                or self._host[0] <= 0
+                or self._dev[0] <= 0
+            ):
+                return None
+            h_floor, h_rate = self._fit(self._host)
+            d_floor, d_slope = self._fit(self._dev)
+        if h_rate <= d_slope:
+            # the host's per-lane cost never exceeds the device's: past
+            # any floors the host wins at EVERY size, keep everything up
+            # to the clamp ceiling on host
+            return self.HI
+        # h_floor + h_rate*n = d_floor + d_slope*n; a device floor
+        # already below the host floor clamps at LO (device wins from
+        # the smallest routed sizes)
+        n_star = (d_floor - h_floor) / (h_rate - d_slope)
+        return int(min(self.HI, max(self.LO, n_star)))
+
+
+CROSSOVER = AdaptiveCrossover()
+
+_ENV_PINNED = bool(os.environ.get("COMETBFT_TPU_HOST_THRESHOLD"))
+
+
+def _adaptive_enabled() -> bool:
+    """Adaptation applies when not env-pinned and either forced
+    (COMETBFT_TPU_ADAPTIVE_THRESHOLD=1) or running on an accelerator
+    backend — CPU test runs must stay deterministically on the seed."""
+    if _ENV_PINNED:
+        return False
+    mode = os.environ.get("COMETBFT_TPU_ADAPTIVE_THRESHOLD", "auto")
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    # live peek only: host_batch_threshold() sits inside every batch
+    # verify, which must never pay (or hang in) jax backend init
+    from ..libs.accel import accelerator_backend_live
+
+    return accelerator_backend_live()
+
+
+def host_batch_threshold() -> int:
+    """The LIVE host/device cutover: operator env pin > adaptive
+    runtime calibration > the boot seed (module attr
+    HOST_BATCH_THRESHOLD — monkeypatchable, chip-table-derived)."""
+    base = HOST_BATCH_THRESHOLD
+    if not _adaptive_enabled():
+        return base
+    t = CROSSOVER.threshold()
+    return base if t is None else t
+
+
+def note_device_window(n: int, seconds: float) -> None:
+    """Adaptive-crossover feed from the coalescer's device windows."""
+    if _adaptive_enabled():
+        CROSSOVER.observe_device(n, seconds)
+
+
+def note_host_window(n: int, seconds: float) -> None:
+    if _adaptive_enabled():
+        CROSSOVER.observe_host(n, seconds)
+
+
 class Ed25519BatchVerifier(BatchVerifier):
     """TPU-backed ed25519 batch verification with a host small-batch path."""
 
@@ -114,12 +263,29 @@ class Ed25519BatchVerifier(BatchVerifier):
         import time as _time
 
         t0 = _time.perf_counter()
-        if len(self._pubkeys) < HOST_BATCH_THRESHOLD:
-            # Native RLC batch (one multiscalar mult, the voi algorithm);
-            # falls back to sequential OpenSSL inside when the native
-            # engine can't build.
-            from . import host_batch
+        if len(self._pubkeys) < host_batch_threshold():
+            # Sub-crossover batches first try the cross-caller
+            # coalescer: concurrent small callers (per-vote admission,
+            # commit checks, preverify windows) share ONE device
+            # micro-batch instead of each paying the host path alone.
+            # Not routed / unavailable -> the native RLC host batch
+            # (one multiscalar mult, the voi algorithm), which itself
+            # falls back to sequential OpenSSL when the engine can't
+            # build.
+            from . import coalesce, host_batch
 
+            bits = coalesce.verify_bytes(
+                self._pubkeys, self._msgs, self._sigs
+            )
+            if bits is not None:
+                _observe("ed25519-coalesce", t0, len(bits))
+                return all(bits), list(bits)
+            # restart the clock: a failed coalesce attempt's wait
+            # (worst case a stalled-device ticket timeout) must not be
+            # charged to the host backend's metrics or the crossover's
+            # host-rate fit — that would collapse the threshold toward
+            # the device exactly when the device path is unhealthy
+            t0 = _time.perf_counter()
             bitmap = host_batch.verify_many(
                 self._pubkeys, self._msgs, self._sigs
             )
@@ -190,7 +356,7 @@ class Sr25519BatchVerifier(BatchVerifier):
         # (~30 ms/sig) and the device wins from a handful of lanes.
         # COMETBFT_TPU_SR_HOST=1 is the explicit dead-tunnel escape.
         native = host_batch.available()
-        host_cut = HOST_BATCH_THRESHOLD if native else self.HOST_THRESHOLD
+        host_cut = host_batch_threshold() if native else self.HOST_THRESHOLD
         if n < host_cut or _os.environ.get("COMETBFT_TPU_SR_HOST") == "1":
             bitmap = None
             if native:
@@ -419,7 +585,7 @@ class MixedBatchVerifier(BatchVerifier):
         n = len(self._pubkeys)
         native = host_batch.available()
         if native:
-            host_cut = HOST_BATCH_THRESHOLD
+            host_cut = host_batch_threshold()
         else:
             # Toolchain-less host cost is dominated by pure-Python
             # sr25519 verifies (~30 ms/sig); ed25519 lanes verify via
@@ -430,7 +596,7 @@ class MixedBatchVerifier(BatchVerifier):
             host_cut = (
                 Sr25519BatchVerifier.HOST_THRESHOLD
                 if n_sr >= Sr25519BatchVerifier.HOST_THRESHOLD
-                else HOST_BATCH_THRESHOLD
+                else host_batch_threshold()
             )
         if n < host_cut or _os.environ.get("COMETBFT_TPU_SR_HOST") == "1":
             bitmap = host_batch.verify_quads(self._quads()) if native \
@@ -510,14 +676,24 @@ def create_commit_batch_verifier(validator_set) -> BatchVerifier:
 def _observe(backend: str, t0: float, n: int) -> None:
     """Record end-to-end batch-verify latency/volume. Routed through
     node_metrics() like every other instrumentation site: the running
-    node's registry when one is up, a throwaway sink otherwise."""
+    node's registry when one is up, a throwaway sink otherwise. The
+    same measurement feeds the adaptive host/device crossover — the
+    phase metrics and the routing decision see one set of timings."""
     import time as _time
 
+    dt = _time.perf_counter() - t0
     m = libmetrics.node_metrics()
-    m.verify_batch_seconds.labels(backend).observe(
-        _time.perf_counter() - t0
-    )
+    m.verify_batch_seconds.labels(backend).observe(dt)
     m.verify_batch_sigs.labels(backend).inc(n)
+    # Only ed25519 lanes feed the crossover: its linear host/device
+    # model is fit for ONE kernel's cost profile, and an sr25519 or
+    # mixed sample (pure-Python host sr25519 runs ~1000x the ed25519
+    # per-lane cost when the native engine is absent) would poison the
+    # shared fit and misroute every verifier.
+    if backend == "ed25519-host":
+        note_host_window(n, dt)
+    elif backend == "ed25519-tpu":
+        note_device_window(n, dt)
 
 
 def prestage_validators(validator_set) -> int:

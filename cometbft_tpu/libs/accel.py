@@ -1,0 +1,89 @@
+"""Process-wide accelerator-backend probe.
+
+One answer to "is jax's default backend an accelerator?", shared by
+every auto-mode gate (the verify coalescer's device windows, the node's
+coalescer boot decision, the adaptive host/device crossover) so the
+gates can never disagree within a process and a new platform string is
+added in exactly one place.
+
+``jax.default_backend()`` initializes an XLA backend, which a host-only
+node may otherwise never pay for (seconds of import + backend init).
+When ``JAX_PLATFORMS`` pins a host-only platform set — every CPU test
+run does — the probe answers False without importing jax at all; only
+an unpinned environment (where a device may genuinely exist) pays the
+probe, once per process.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ACCELERATOR_BACKENDS = ("tpu", "axon")
+
+_probe: bool | None = None
+_live_peek_warned = False
+
+
+def _host_only_pinned() -> bool:
+    """True when JAX_PLATFORMS pins a platform set with no accelerator
+    in it — the one parse both probes share."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    return bool(plats) and not any(
+        p.strip().lower() in ACCELERATOR_BACKENDS for p in plats.split(",")
+    )
+
+
+def accelerator_backend() -> bool:
+    """True when jax's default backend is an accelerator (cached)."""
+    global _probe
+    if _probe is None:
+        if _host_only_pinned():
+            _probe = False
+        else:
+            try:
+                import jax
+
+                _probe = jax.default_backend() in ACCELERATOR_BACKENDS
+            except Exception:
+                _probe = False
+    return _probe
+
+
+def accelerator_backend_live() -> bool:
+    """True when an accelerator backend is ALREADY initialized in this
+    process. NEVER triggers backend init, so it is safe on hot paths
+    and on hosts with a dead device tunnel (where ``default_backend()``
+    would hang in PJRT init). Steady-state gates (the adaptive
+    crossover, the coalescer's per-window device check) use this: a
+    process that never initialized an accelerator has, by construction,
+    no device work to route or calibrate — the node's boot-time
+    :func:`accelerator_backend` probe is what brings the backend up on
+    accelerator deployments.
+    """
+    if _host_only_pinned():
+        return False
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        # peek at initialized backends only — xla_bridge populates
+        # _backends as platforms come up; reading it never inits one
+        backends = getattr(jax._src.xla_bridge, "_backends", None) or {}
+        return any(name in ACCELERATOR_BACKENDS for name in backends)
+    except Exception:
+        # a jax relayout that moves _backends must not SILENTLY retire
+        # device windows and the adaptive crossover on accelerator
+        # deployments — flag it once, then degrade to host
+        global _live_peek_warned
+        if not _live_peek_warned:
+            _live_peek_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "accelerator liveness peek failed (jax internals moved?);"
+                " treating the process as host-only: device verify"
+                " windows and adaptive-crossover calibration are disabled",
+                exc_info=True,
+            )
+        return False

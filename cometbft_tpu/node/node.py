@@ -429,6 +429,12 @@ class Node(BaseService):
                 refresh=self._refresh_metrics,
                 logger=self.logger.with_module("prometheus"),
             )
+        # Cross-caller verify coalescer (crypto/coalesce.py): the
+        # steady-state vote path's feeder for the device kernel.
+        # COMETBFT_TPU_COALESCE gates it; the decision is deferred to
+        # on_start because in "auto" mode it probes the jax backend —
+        # constructing a Node must stay free of backend init.
+        self.verify_coalescer = None
         self.switch.logger = self.logger.with_module("p2p")
         self.blocksync_reactor.logger = self.logger.with_module("blocksync")
         self.statesync_reactor.logger = self.logger.with_module("statesync")
@@ -608,6 +614,39 @@ class Node(BaseService):
             "p2p transport listening", addr=self.transport.listen_addr
         )
         self.node_info.listen_addr = self.transport.listen_addr
+        # The verify coalescer starts after every other fallible boot
+        # step but before the switch (which starts consensus), so the
+        # very first admitted votes coalesce and an earlier boot
+        # failure — pprof/RPC/listen — can't leak a routed coalescer
+        # that Node.stop() (NotStartedError) would never unwind. "auto"
+        # starts one only when an accelerator backend is live, so
+        # host-only deployments keep their unrouted paths untouched.
+        from ..crypto import coalesce as crypto_coalesce
+
+        if crypto_coalesce.node_wants_coalescer():
+            self.verify_coalescer = crypto_coalesce.VerifyCoalescer(
+                logger=self.logger.with_module("coalesce")
+            )
+            self.verify_coalescer.start()
+            crypto_coalesce.push_active(self.verify_coalescer)
+        try:
+            self._finish_start()
+        except BaseException:
+            # a failed boot leaves _started unset, so Node.stop() would
+            # raise NotStartedError and on_stop would never unroute the
+            # coalescer — unwind it here or the orphan stays atop the
+            # process-wide routing stack with its executor running
+            if self.verify_coalescer is not None:
+                crypto_coalesce.pop_active(self.verify_coalescer)
+                self.verify_coalescer.stop()
+                self.verify_coalescer = None
+            raise
+
+    def _finish_start(self) -> None:
+        """Boot steps after the verify coalescer is routed: the switch
+        (which starts consensus), peer dialing, background routines and
+        the Prometheus exporter. Split out so on_start can unwind the
+        coalescer if ANY of them fails."""
         self.switch.start()
         persistent = [
             a.strip()
@@ -705,6 +744,18 @@ class Node(BaseService):
             try:
                 if svc.is_running():
                     svc.stop()
+            except Exception:
+                pass
+        # Coalescer after consensus is down: unroute first (new callers
+        # fall back to host instantly), then drain — stop() resolves
+        # every pending ticket, so no verifier thread is left hanging.
+        if getattr(self, "verify_coalescer", None) is not None:
+            from ..crypto import coalesce as crypto_coalesce
+
+            crypto_coalesce.pop_active(self.verify_coalescer)
+            try:
+                if self.verify_coalescer.is_running():
+                    self.verify_coalescer.stop()
             except Exception:
                 pass
         try:

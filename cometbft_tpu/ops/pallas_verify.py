@@ -39,6 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import curve, field
+from ..libs.accel import ACCELERATOR_BACKENDS
 
 BITS = field.BITS
 NLIMB = field.NLIMB
@@ -778,7 +779,7 @@ def verify_kernel8(y_a, sign_a, y_r, sign_r, s_bytes, kneg_nibs, *,
     (COMETBFT_TPU_KERNEL=pallas8); same contract as
     curve.verify_kernel8."""
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = jax.default_backend() not in ACCELERATOR_BACKENDS
     n = y_a.shape[-1]
     block = _block_for(n)
     if n % block:
@@ -838,7 +839,7 @@ def verify_kernel8_cached(table, ok_a, y_r, sign_r, s_bytes, kneg_nibs, *,
                           interpret=None):
     """Cached-table 8-bit-window Pallas lowering."""
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = jax.default_backend() not in ACCELERATOR_BACKENDS
     n = y_r.shape[-1]
     block = _block_for(n)
     if n % block:
@@ -895,7 +896,7 @@ def verify_kernel_cached(table, ok_a, y_r, sign_r, s_nibs, kneg_nibs, *,
                          interpret=None):
     """Cached-table drop-in for ops.curve.verify_kernel_cached (+ ok AND)."""
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = jax.default_backend() not in ACCELERATOR_BACKENDS
     n = y_r.shape[-1]
     block = _block_for(n)
     if n % block:
@@ -955,7 +956,7 @@ def verify_kernel(y_a, sign_a, y_r, sign_r, s_nibs, kneg_nibs, *, interpret=None
     working) and False on TPU.
     """
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        interpret = jax.default_backend() not in ACCELERATOR_BACKENDS
     n = y_a.shape[-1]
     block = _block_for(n)
     if n % block:

@@ -154,8 +154,10 @@ def _dispatch_sharded(mesh: Mesh, args, lanes_per_shard: int):
     global _SHARDED_PALLAS_BROKEN
     from ..ops import verify as ov
 
+    from ..libs.accel import ACCELERATOR_BACKENDS
+
     try:
-        on_accel = jax.default_backend() in ("tpu", "axon")
+        on_accel = jax.default_backend() in ACCELERATOR_BACKENDS
     except Exception:
         on_accel = False
     if (

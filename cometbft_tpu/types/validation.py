@@ -207,9 +207,31 @@ def _verify_batch(
 def _verify_single(
     chain_id, vals, commit, needed, ignore, count, count_all, by_index
 ) -> None:
-    """Mirror of verifyCommitSingle (types/validation.go:266-330)."""
+    """Mirror of verifyCommitSingle (types/validation.go:266-330).
+
+    With a cross-caller coalescer routed (crypto/coalesce), the
+    eligible per-signature verifies of one commit are deferred and
+    submitted as a group — concurrent single-verify commit checks
+    (light bisection, evidence) then share device micro-batches — with
+    the same tally walk, the same early stop, and the same
+    first-invalid error by index. Ineligible key types verify inline
+    exactly as before.
+    """
+    from ..crypto import coalesce
+
+    co = coalesce.active()
     seen: dict[int, int] = {}
     tallied = 0
+    deferred: list[tuple] = []  # (idx, pubkey_data, sign_bytes, sig)
+    stopped_early = False
+    # Any raise inside the walk is HELD, not thrown: deferred ed25519
+    # lanes collected earlier in the walk are still unverified, and the
+    # unrouted walk raises at the earliest failing index — an invalid
+    # deferred lane must surface before a later double-vote /
+    # sign-bytes / wrong-signature error. All deferred lanes precede
+    # the break point by construction, so resolving them first and
+    # then re-raising preserves the reference error identity.
+    walk_exc: BaseException | None = None
     for idx, cs in enumerate(commit.signatures):
         if ignore(cs):
             continue
@@ -220,17 +242,46 @@ def _verify_single(
             if val is None:
                 continue
             if val_idx in seen:
-                raise VerificationError(
+                walk_exc = VerificationError(
                     f"double vote from validator {val_idx} "
                     f"({seen[val_idx]} and {idx})"
                 )
+                break
             seen[val_idx] = idx
-        sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-        if not val.pub_key.verify_signature(sign_bytes, cs.signature):
-            raise VerificationError(f"wrong signature (#{idx})")
+        try:
+            sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+        except Exception as e:
+            walk_exc = e
+            break
+        if co is not None and coalesce.eligible(val.pub_key):
+            deferred.append(
+                (idx, val.pub_key, sign_bytes, cs.signature)
+            )
+        elif not val.pub_key.verify_signature(sign_bytes, cs.signature):
+            walk_exc = VerificationError(f"wrong signature (#{idx})")
+            break
         if count(cs):
             tallied += val.voting_power
         if not count_all and tallied > needed:
-            return
+            stopped_early = True
+            break
+    if deferred:
+        bits = coalesce.verify_bytes(
+            [pk.data for _, pk, _, _ in deferred],
+            [sb for _, _, sb, _ in deferred],
+            [sig for _, _, _, sig in deferred],
+        )
+        if bits is None:  # coalescer went away mid-walk: host verify
+            bits = [
+                pk.verify_signature(sb, sig)
+                for _, pk, sb, sig in deferred
+            ]
+        for (idx, _, _, _), ok in zip(deferred, bits):
+            if not ok:
+                raise VerificationError(f"wrong signature (#{idx})")
+    if walk_exc is not None:
+        raise walk_exc
+    if stopped_early:
+        return
     if tallied <= needed:
         raise NotEnoughVotingPowerError(got=tallied, needed=needed)

@@ -58,11 +58,21 @@ class Vote:
         )
 
     def verify(self, chain_id: str, pub_key) -> None:
-        """Signature + address check (types/vote.go:210-232)."""
+        """Signature + address check (types/vote.go:210-232).
+
+        The signature check routes through the cross-caller verify
+        coalescer when one is active (crypto/coalesce): identical
+        verdicts — the coalescer runs the same kernels/host verifiers
+        — but concurrent per-vote callers share one device launch.
+        Unrouted (no coalescer, foreign key type, routing failure) it
+        is exactly ``pub_key.verify_signature``.
+        """
+        from ..crypto import coalesce
+
         if bytes(pub_key.address()) != self.validator_address:
             raise VoteError("invalid validator address")
-        if not pub_key.verify_signature(
-            self.sign_bytes(chain_id), self.signature
+        if not coalesce.verify_signature(
+            pub_key, self.sign_bytes(chain_id), self.signature
         ):
             raise VoteError("invalid signature")
 
@@ -76,11 +86,15 @@ class Vote:
             self.verify_extension(chain_id, pub_key)
 
     def verify_extension(self, chain_id: str, pub_key) -> None:
-        """Extension signature only (types/vote.go:254-270)."""
+        """Extension signature only (types/vote.go:254-270); coalesced
+        like :meth:`verify`."""
+        from ..crypto import coalesce
+
         if self.msg_type != canonical.PRECOMMIT_TYPE or self.block_id.is_nil():
             return
-        if not pub_key.verify_signature(
-            self.extension_sign_bytes(chain_id), self.extension_signature
+        if not coalesce.verify_signature(
+            pub_key, self.extension_sign_bytes(chain_id),
+            self.extension_signature,
         ):
             raise VoteError("invalid extension signature")
 

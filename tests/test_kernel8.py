@@ -57,7 +57,10 @@ def test_kernel8_matches_conformance_corpus():
     size = verify.bucket_size(n)
     if size != n:
         buf = np.pad(buf, [(0, 0), (0, size - n)])
-    got = np.asarray(verify._jitted_kernel("xla8")(buf))[:n] & host_ok
+    # the jitted kernel ships the bit-packed ok mask (verify._pack_ok_bits)
+    got = verify.unpack_ok_bits(
+        np.asarray(verify._jitted_kernel("xla8")(buf)), n
+    ) & host_ok
     bad = [
         (name, e, bool(g))
         for (name, *_), e, g in zip(CORPUS, expect, got)

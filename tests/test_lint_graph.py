@@ -486,6 +486,37 @@ class TestEngineWideGate:
         ]
         assert trace_edges == [], trace_edges
 
+    def test_coalescer_lock_registered_and_flush_never_blocks_under_it(
+        self, analysis
+    ):
+        """The verify coalescer's queue mutex is modeled in the shipped
+        artifact, and the flush path holds no engine mutex while
+        blocking on the device: 'crypto.coalesce._mtx' may be acquired
+        UNDER caller locks (submit runs inside vote_set / consensus
+        admission), but it must never be the OUTER lock of any
+        acquisition-order edge — the executor pops a window under it
+        and releases it before pack, dispatch and the materializing
+        readback — and no CLNT009 blocking-under-lock finding may name
+        it (its own condition wait is the sanctioned exempt case)."""
+        d = analysis.graph_dict()
+        assert "crypto.coalesce._mtx" in {lk["name"] for lk in d["locks"]}
+        outgoing = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if e["from"] == "crypto.coalesce._mtx"
+        ]
+        assert outgoing == [], (
+            "the coalescer flush path acquired a lock while holding "
+            f"its queue mutex: {outgoing}"
+        )
+        blocked = [
+            f.render()
+            for f in analysis.findings()
+            if f.code == "CLNT009"
+            and "'crypto.coalesce._mtx'" in f.message
+        ]
+        assert blocked == [], blocked
+
     def test_devstats_lock_registered_and_leaf(self, analysis):
         """libs/devstats' compile-ledger mutex has the same contract as
         the tracer's: present in the shipped artifact, edge-free. The

@@ -1335,8 +1335,9 @@ class TestNoRecompileGuard:
 
             # -- Counter/trace reconciliation: every traced device
             # dispatch is one cached-arena launch at bucket 8 (96-byte
-            # wire rows + int32 slot per lane up, a bool per lane back)
-            # and exactly one h2d and one d2h transfer was counted.
+            # wire rows + int32 slot per lane up, ONE bit-packed ok
+            # word — bucket/8 uint8 bytes — back) and exactly one h2d
+            # and one d2h transfer was counted.
             disp = [
                 e
                 for e in events
@@ -1358,7 +1359,7 @@ class TestNoRecompileGuard:
                 c1["h2d_bytes"] - c0["h2d_bytes"]
                 == launches * per_launch_up
             )
-            assert c1["d2h_bytes"] - c0["d2h_bytes"] == launches * 8
+            assert c1["d2h_bytes"] - c0["d2h_bytes"] == launches * (8 // 8)
             # the same launches land in the Prometheus families at
             # scrape time (the sample bridge)
             devstats.sample(m)
