@@ -1258,6 +1258,203 @@ def bench_coalesce_steady_state(
     }
 
 
+def bench_health_overhead(n_heights: int | None = None):
+    """Config 13: flight-recorder overhead on a warmed 4-validator burst.
+
+    The libs/health flight recorder is ON by default for every node, so
+    its record path sits inside the consensus FSM (step transitions,
+    vote admission, commit latency) and the WAL fsync path. This config
+    runs the SAME in-process 4-validator consensus burst with the
+    recorder off and on (min-of-2 each, warmup heights excluded) and
+    reports the per-commit latency delta — the headline target is <1%.
+    A direct nanosecond cost of one ``record()`` call is reported
+    alongside, because the burst delta is dominated by consensus
+    timeouts and scheduler noise.
+    """
+    import threading as _threading  # noqa: F401  (parity with config 12)
+
+    from cometbft_tpu import proxy
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.consensus import ConsensusState
+    from cometbft_tpu.consensus.messages import (
+        BlockPartMessage,
+        ProposalMessage,
+        VoteMessage,
+    )
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.libs import db as dbm
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.state import BlockExecutor, Store, make_genesis_state
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.types import GenesisDoc, GenesisValidator, MockPV
+    from cometbft_tpu.types.event_bus import EventBus
+
+    if n_heights is None:
+        n_heights = _sz(25, 4)
+    warm_heights = _sz(3, 1)
+
+    def make_net():
+        pvs = [
+            MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
+            for i in range(4)
+        ]
+        doc = GenesisDoc(
+            chain_id="bench-health",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[
+                GenesisValidator(pub_key=pv.get_pub_key(), power=10)
+                for pv in pvs
+            ],
+        )
+        vs = doc.validator_set()
+        by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+        pvs = [by_addr[v.address] for v in vs.validators]
+        nodes = []
+        for pv in pvs:
+            conns = proxy.AppConns(
+                proxy.local_client_creator(KVStoreApplication(dbm.MemDB()))
+            )
+            conns.start()
+            state_store = Store(dbm.MemDB())
+            block_store = BlockStore(dbm.MemDB())
+            bus = EventBus()
+            bus.start()
+            state = make_genesis_state(doc)
+            state_store.save(state)
+            executor = BlockExecutor(
+                state_store, conns.consensus,
+                block_store=block_store, event_bus=bus,
+            )
+            cs = ConsensusState(
+                test_config().consensus, state, executor, block_store,
+                event_bus=bus,
+            )
+            cs.set_priv_validator(pv)
+            nodes.append(
+                (cs, dict(conns=conns, bus=bus, block_store=block_store))
+            )
+        css = [cs for cs, _ in nodes]
+        for i, cs in enumerate(css):  # perfect gossip, as in the tests
+            orig = cs._send_internal
+
+            def send(msg, cs=cs, orig=orig, me=i):
+                orig(msg)
+                for j, other in enumerate(css):
+                    if j == me:
+                        continue
+                    if isinstance(msg, VoteMessage):
+                        other.add_vote_from_peer(msg.vote, f"n{me}")
+                    elif isinstance(msg, ProposalMessage):
+                        other.set_proposal_from_peer(msg.proposal, f"n{me}")
+                    elif isinstance(msg, BlockPartMessage):
+                        other.add_block_part_from_peer(
+                            msg.height, msg.round, msg.part, f"n{me}"
+                        )
+
+            cs._send_internal = send
+        return nodes
+
+    was_on = libhealth.enabled()
+    per_off = []
+    per_on = []
+    records_on = 0
+    commits_on = 0
+    nodes = make_net()
+    store = nodes[0][1]["block_store"]
+    try:
+        for cs, _ in nodes:
+            cs.start()
+        deadline = time.monotonic() + 240
+        while (
+            store.height() < warm_heights and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+        if store.height() < warm_heights:
+            raise RuntimeError("burst never warmed")
+        # Alternate recorder-off / recorder-on WINDOWS over one live
+        # net: same threads, same warmed jit/page-cache state, so the
+        # off/on delta isolates the record path instead of measuring
+        # node-construction and scheduler noise (a fresh-net A/B showed
+        # ±5% run-to-run variance at a ~0.05% expected effect).
+        for rep in range(3):
+            for on in (False, True):
+                if on:
+                    libhealth.enable()
+                    libhealth.reset()
+                else:
+                    libhealth.disable()
+                h0 = store.height()
+                rec0 = libhealth.recorder().status()["recorded"]
+                t0 = time.perf_counter()
+                while (
+                    store.height() < h0 + n_heights
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.002)
+                dt = time.perf_counter() - t0
+                commits = store.height() - h0
+                if commits <= 0:
+                    raise RuntimeError("burst stalled mid-measurement")
+                (per_on if on else per_off).append(dt / commits)
+                if on:
+                    records_on += (
+                        libhealth.recorder().status()["recorded"] - rec0
+                    )
+                    commits_on += commits
+    finally:
+        for cs, parts in nodes:
+            for closer in (
+                cs.stop, parts["bus"].stop, parts["conns"].stop
+            ):
+                try:
+                    closer()
+                except Exception:
+                    pass
+        libhealth.enable() if was_on else libhealth.disable()
+
+    # direct record-path cost: tight loop over the four hot call shapes
+    libhealth.enable()
+    reps = _sz(200_000, 5_000)
+    t0 = time.perf_counter()
+    for _ in range(reps // 4):
+        libhealth.record(libhealth.EV_STEP, 5, 0, 3)
+        libhealth.record(libhealth.EV_VOTE, 5, 0, 1, 2)
+        libhealth.record(libhealth.EV_COMMIT, 5, 0, 120_000_000)
+        libhealth.record(libhealth.EV_FSYNC, a=3_000_000)
+    record_ns = (time.perf_counter() - t0) / ((reps // 4) * 4) * 1e9
+    libhealth.reset()
+    libhealth.enable() if was_on else libhealth.disable()
+
+    off_s, on_s = min(per_off), min(per_on)
+    records_per_commit = records_on / max(1, commits_on)
+    # The per-commit cost of the recorder IS records/commit x the
+    # measured per-record cost: ~60 events x ~2 us ~ 0.1 ms against a
+    # ~100 ms commit. The raw A/B delta cannot resolve that — the off-
+    # window spread alone is >10% on a shared container — so the
+    # headline number is the mechanism-level bound and the raw delta
+    # ships alongside with its noise floor as evidence.
+    derived_pct = 100.0 * (records_per_commit * record_ns / 1e9) / off_s
+    noise_pct = 100.0 * (max(per_off) - min(per_off)) / min(per_off)
+    return {
+        "heights_per_window": n_heights,
+        "windows": len(per_off) + len(per_on),
+        "validators": 4,
+        "commit_ms_recorder_off": round(off_s * 1e3, 3),
+        "commit_ms_recorder_on": round(on_s * 1e3, 3),
+        "overhead_pct": round(derived_pct, 4),
+        "measured_delta_pct": round(100.0 * (on_s - off_s) / off_s, 2),
+        "ab_noise_floor_pct": round(noise_pct, 2),
+        "record_ns": round(record_ns, 1),
+        "records_per_commit": round(records_per_commit, 1),
+        "stat": "min_of_3_alternating_windows",
+        "note": "one live 4-validator net, recorder toggled per "
+        "window; overhead_pct = records/commit x record_ns / commit "
+        "latency (the raw A/B delta, measured_delta_pct, is noise: "
+        "its floor is ab_noise_floor_pct)",
+    }
+
+
 def _probe_device(timeout_s: float = 60.0, attempts: int = 3) -> bool:
     """Device liveness probe in a killable subprocess, with retries.
 
@@ -1441,6 +1638,19 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "12_coalesce_steady_state",
                      "backend": "host", "error": repr(e)[:200]})
+        health_row = None
+        try:
+            health_row = bench_health_overhead()
+            _eprint(
+                {
+                    "config": "13_health_overhead",
+                    "backend": "host",
+                    **health_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "13_health_overhead", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -1463,6 +1673,11 @@ def main() -> None:
                             ]
                         }
                         if coalesce_row
+                        else {}
+                    ),
+                    **(
+                        {"health_overhead_pct": health_row["overhead_pct"]}
+                        if health_row
                         else {}
                     ),
                 }
@@ -1569,6 +1784,15 @@ def main() -> None:
             {"config": "12_coalesce_steady_state", "error": repr(e)[:200]}
         )
 
+    health_row = None
+    try:
+        # host-side consensus burst: no device dependence, but recorded
+        # in the chip round too so overhead regressions stay visible
+        health_row = bench_health_overhead()
+        _eprint({"config": "13_health_overhead", **health_row})
+    except Exception as e:
+        _eprint({"config": "13_health_overhead", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -1606,6 +1830,13 @@ def main() -> None:
                         ]
                     }
                     if coalesce_row
+                    else {}
+                ),
+                # always-on flight recorder's per-commit cost
+                # (config 13_health_overhead; target <1%)
+                **(
+                    {"health_overhead_pct": health_row["overhead_pct"]}
+                    if health_row
                     else {}
                 ),
             }

@@ -414,6 +414,7 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
             ("heap.txt", "/debug/pprof/heap"),
             ("locks.json", "/debug/locks"),
             ("devstats.json", "/debug/devstats"),
+            ("health.json", "/debug/health"),
             ("trace.json", "/debug/trace"),
         ):
             try:

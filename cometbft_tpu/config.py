@@ -122,6 +122,29 @@ ENV_KNOBS: dict[str, str] = {
         "(crypto/batch.host_batch_threshold) — sub-cutover windows "
         "still coalesce into one host MSM (crypto/coalesce.py)"
     ),
+    "COMETBFT_TPU_HEALTH": (
+        "consensus flight recorder + SLO watchdogs (libs/health): auto "
+        "(default — on while a node runs, refcounted like devstats) | "
+        "1 force-on process-wide | 0 off (kill switch: no recording, "
+        "no watchdogs, no black-box bundles)"
+    ),
+    "COMETBFT_TPU_HEALTH_RING": (
+        "flight-recorder ring capacity in events (default 4096; "
+        "libs/health.py)"
+    ),
+    "COMETBFT_TPU_HEALTH_STALL_MULT": (
+        "consensus stall watchdog window as a multiple of the node's "
+        "timeout_commit + timeout_propose cycle (default 25; "
+        "libs/health.py HealthMonitor)"
+    ),
+    "COMETBFT_TPU_HEALTH_BUNDLE_DIR": (
+        "black-box bundle directory override for watchdog trips "
+        "(default: the node's data/health dir; libs/health.py)"
+    ),
+    "COMETBFT_TPU_HEALTH_BUNDLE_RL_S": (
+        "minimum seconds between black-box bundles (default 60 — a "
+        "flapping watchdog must not fill the disk; libs/health.py)"
+    ),
     "COMETBFT_TPU_ADAPTIVE_THRESHOLD": (
         "adaptive host/device batch crossover from measured timings: "
         "auto (default, accelerator-only) | 1 force | 0 static seed "

@@ -24,6 +24,7 @@ import time
 
 from ..config import ConsensusConfig
 from ..crypto import batch as crypto_batch
+from ..libs import health as libhealth
 from ..libs import metrics as libmetrics
 from ..libs import trace as libtrace
 from ..libs.events import EventSwitch
@@ -529,6 +530,10 @@ class ConsensusState(BaseService):
                 self._set_proposal(msg.proposal)
             except ConsensusError:
                 libmetrics.node_metrics().proposals.labels("rejected").inc()
+                libhealth.record(
+                    libhealth.EV_PROPOSAL,
+                    msg.proposal.height, msg.proposal.round, 0,
+                )
                 raise
         elif isinstance(msg, BlockPartMessage):
             self._add_proposal_block_part(msg, peer_id)
@@ -620,6 +625,8 @@ class ConsensusState(BaseService):
         )
 
         rs.height = height
+        # flight-recorder anchor for the per-height commit-latency SLI
+        self._height_started = time.monotonic()
         if libtrace.enabled():
             for attr in ("_tr_step", "_tr_round", "_tr_height"):
                 sp = getattr(self, attr, None)
@@ -740,6 +747,11 @@ class ConsensusState(BaseService):
             # the whole disabled window
             self._tr_step = None
         rs.step = step
+        # always-on flight recorder: the stall watchdog keys off this
+        # transition's timestamp (libs/health; allocation- and lock-free)
+        libhealth.record(
+            libhealth.EV_STEP, rs.height, rs.round, int(step)
+        )
 
     def _enter_new_round(self, height: int, round_: int) -> None:
         rs = self.rs
@@ -944,6 +956,7 @@ class ConsensusState(BaseService):
             raise ConsensusError("invalid proposal signature")
         rs.proposal = proposal
         libmetrics.node_metrics().proposals.labels("accepted").inc()
+        libhealth.record(libhealth.EV_PROPOSAL, rs.height, rs.round, 1)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header
@@ -1268,6 +1281,18 @@ class ConsensusState(BaseService):
         new_state = self.block_exec.apply_block(self.state, block_id, block)
         fail_point("cs-after-apply-block")
 
+        # per-height commit latency into the flight recorder (the
+        # health engine's commit SLI; commit_round+1 = rounds needed)
+        libhealth.record(
+            libhealth.EV_COMMIT, height, rs.commit_round,
+            int(
+                (
+                    time.monotonic()
+                    - getattr(self, "_height_started", time.monotonic())
+                ) * 1e9
+            ),
+        )
+
         for hook in self._on_block_committed:
             hook(height)
 
@@ -1377,6 +1402,10 @@ class ConsensusState(BaseService):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        libhealth.record(
+            libhealth.EV_VOTE, vote.height, vote.round,
+            vote.msg_type, vote.validator_index,
+        )
         if libtrace.enabled():
             libtrace.event(
                 "consensus.vote",

@@ -20,6 +20,7 @@ from ..libs import sync as libsync
 import zlib
 
 from ..libs import autofile
+from ..libs import health as libhealth
 from ..libs import trace as libtrace
 from ..libs.jsoncodec import Codec
 from ..types import serialization as ser
@@ -89,24 +90,26 @@ class WAL:
     def write_sync(self, msg) -> None:
         """fsync before returning — required before signing own msgs."""
         self.write(msg)
-        t0 = time.perf_counter() if libtrace.enabled() else 0.0
+        timed = libtrace.enabled() or libhealth.enabled()
+        t0 = time.perf_counter() if timed else 0.0
         with self._mtx:  # cometlint: disable=CLNT009 -- the WAL mutex serializes frame write+fsync (wal.go WriteSync)
             self.group.flush_and_sync()
-        if t0:
-            libtrace.event(
-                "wal.fsync",
-                dur_ns=int((time.perf_counter() - t0) * 1e9),
-            )
+        if timed:
+            dur_ns = int((time.perf_counter() - t0) * 1e9)
+            libhealth.record(libhealth.EV_FSYNC, a=dur_ns)
+            if libtrace.enabled():
+                libtrace.event("wal.fsync", dur_ns=dur_ns)
 
     def flush_and_sync(self) -> None:
-        t0 = time.perf_counter() if libtrace.enabled() else 0.0
+        timed = libtrace.enabled() or libhealth.enabled()
+        t0 = time.perf_counter() if timed else 0.0
         with self._mtx:  # cometlint: disable=CLNT009 -- flush_and_sync is the caller-requested fsync point
             self.group.flush_and_sync()
-        if t0:
-            libtrace.event(
-                "wal.fsync",
-                dur_ns=int((time.perf_counter() - t0) * 1e9),
-            )
+        if timed:
+            dur_ns = int((time.perf_counter() - t0) * 1e9)
+            libhealth.record(libhealth.EV_FSYNC, a=dur_ns)
+            if libtrace.enabled():
+                libtrace.event("wal.fsync", dur_ns=dur_ns)
 
     def write_end_height(self, height: int) -> None:
         self.write_sync(EndHeightMessage(height))

@@ -57,6 +57,7 @@ import threading
 import time
 from collections import deque
 
+from ..libs import health as libhealth
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from ..libs import trace as libtrace
@@ -415,6 +416,7 @@ class VerifyCoalescer(BaseService):
             return
         with self._mtx:
             self._tripped_until = 0.0
+        libhealth.note_breaker_rearm()
 
     def _trip(self) -> None:
         """Unroute a wedged coalescer for one breaker cooldown.
@@ -442,6 +444,9 @@ class VerifyCoalescer(BaseService):
                 name="verify-coalescer-rescue",
                 daemon=True,
             ).start()
+        # health hook: the wedged-coalescer watchdog converts this
+        # notice into a trip + black-box bundle (no lock held here)
+        libhealth.note_breaker_trip()
         if self.logger is not None:
             self.logger.error(
                 "verify coalescer unresponsive; unrouted for cooldown",
@@ -816,6 +821,18 @@ def active() -> VerifyCoalescer | None:
         if co.routable():
             return co
     return None
+
+
+def breaker_open() -> bool:
+    """True while ANY pushed coalescer sits inside a breaker cooldown —
+    the health engine's `health_breaker_open` SLI. Pure query (same
+    contract as routable(): never consumes the half-open probe)."""
+    now = time.monotonic()
+    for co in tuple(_ACTIVE):
+        t = co._tripped_until
+        if t and now < t:
+            return True
+    return False
 
 
 def configured_mode() -> str:

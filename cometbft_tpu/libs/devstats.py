@@ -64,6 +64,7 @@ import os
 import time
 from collections import deque
 
+from . import health as libhealth
 from . import metrics as libmetrics
 from . import sync as libsync
 from . import trace as libtrace
@@ -341,6 +342,11 @@ def _drain_compiles() -> None:
             _c["compile_seconds"] += seconds
             if n_prior:
                 _c["recompiles"] += 1
+                # health hook: a steady-state recompile lands in the
+                # flight recorder so the black-box bundle and the
+                # recompile-storm watchdog see it (libhealth.record is
+                # lock-free — _mtx stays a leaf)
+                libhealth.record(libhealth.EV_RECOMPILE, a=bucket)
             if p_hit:
                 _c["pcache_hits"] += 1
             elif cons:
@@ -602,6 +608,9 @@ class PrometheusServer(HTTPService):
         super().__init__("prometheus", addr, logger)
         self.registry = registry
         self._refresh = refresh
+        # scrape self-metric (one shared family definition so the
+        # NodeMetrics registration and this one dedupe to ONE instance)
+        self._scrape_hist = libmetrics.scrape_duration_histogram(registry)
 
     def handle_get(self, path: str, query: dict) -> tuple[str, str]:
         if path == "/":
@@ -612,6 +621,7 @@ class PrometheusServer(HTTPService):
             )
         if path != "/metrics":
             raise KeyError(path)
+        t0 = time.perf_counter()
         if self._refresh is not None:
             try:
                 self._refresh()
@@ -622,12 +632,24 @@ class PrometheusServer(HTTPService):
                     self.logger.error(
                         "metrics refresh failed", err=repr(e)[:200]
                     )
-        return self.CONTENT_TYPE, self.registry.render()
+        body = self.registry.render()
+        # observed BEFORE the final render would be invisible to THIS
+        # scrape; the one-scrape lag on the self-metric is the standard
+        # exporter trade (prometheus client libs do the same)
+        self._scrape_hist.labels("prometheus").observe(
+            time.perf_counter() - t0
+        )
+        return self.CONTENT_TYPE, body
 
 
 def debug_devstats_json() -> str:
     """Body of the pprof server's /debug/devstats route."""
-    return json.dumps(snapshot(), default=str)
+    t0 = time.perf_counter()
+    body = json.dumps(snapshot(), default=str)
+    libmetrics.node_metrics().health_scrape_seconds.labels(
+        "devstats"
+    ).observe(time.perf_counter() - t0)
+    return body
 
 
 # Env-enabled processes (COMETBFT_TPU_DEVSTATS=1 with no node/listener

@@ -1,0 +1,871 @@
+"""Always-on consensus flight recorder, SLO/health engine, watchdogs.
+
+The reference CometBFT treats liveness as *observable state* — consensus
+metrics per height/round/step — but PR 3's tracer and PR 4's devstats
+are opt-in and passive: when a node stalls, wedges its verify executor,
+or enters a recompile storm, nothing notices until a human scrapes
+``/debug/trace``.  This layer closes that loop with three pieces:
+
+* **Flight recorder** (:class:`FlightRecorder`): a bounded ring of
+  structured events — height/round/step transitions, proposal/vote
+  admission, per-height commit latency, coalescer breaker trips, XLA
+  recompiles, WAL fsyncs, watchdog trips — recorded even when
+  ``COMETBFT_TPU_TRACE`` is off.  The black box: when something goes
+  wrong, the last few thousand consensus events are already captured.
+
+* **SLO/health engine** (:func:`sample`, :func:`slis`): derives SLIs
+  from the ring and the existing metrics families (per-height commit
+  latency p50/p99, rounds-per-height, verify-window wait p99, breaker
+  state, WAL fsync lag, step-progress age) into ``health_*`` Prometheus
+  gauges plus one composite ``health_score`` in [0, 1].
+
+* **Watchdogs** (:class:`HealthMonitor`): a consensus **stall**
+  detector (no step progress within a multiple of the commit timeout),
+  a **wedged-coalescer** detector (hooked to crypto/coalesce's
+  half-open breaker via :func:`note_breaker_trip`), and a
+  **recompile-storm** alarm (hooked to the ``xla_recompile_total``
+  ledger in libs/devstats).  Any trip raises
+  ``health_watchdog_trips_total{watchdog}`` and emits a rate-limited
+  **black-box bundle** (flight-recorder ring + devstats snapshot +
+  lock-order held stacks + thread dump + trace tail) into the
+  debug-dump directory, so forensic state is captured at the moment of
+  failure, not minutes later.
+
+Design constraints (stricter than libs/trace — this layer is ON by
+default for every node):
+
+* **Allocation-free steady state.**  The record path writes scalars
+  into preallocated ``array.array`` columns; slot reservation is one
+  GIL-atomic ``itertools.count`` step.  Nothing is retained per record
+  — pinned by the tracemalloc guard in tests/test_observability.py,
+  which also covers the watchdog's no-trip check.  (Temporaries are
+  fine; *retained* allocations are not.)
+
+* **Lock-free record and scrape paths.**  ``record()`` touches no lock
+  (concurrent writers reserve distinct slots; a reader may observe a
+  torn in-progress row, which the decoder skips — same posture as PR
+  4's lock-free compile-record deque).  The one lock here
+  (``libs.health._mtx``) serializes only the bundle rate limit and the
+  monitor registry, is never held across file I/O or another lock, and
+  is asserted edge-free in tests/test_lint_graph.py like
+  ``libs.trace._mtx`` / ``libs.devstats._mtx``.
+
+Knobs (registered in config.ENV_KNOBS, enforced by cometlint CLNT007):
+``COMETBFT_TPU_HEALTH`` (auto: on while a node runs; 1 force; 0 off),
+``COMETBFT_TPU_HEALTH_RING`` (ring capacity),
+``COMETBFT_TPU_HEALTH_STALL_MULT`` (stall window as a multiple of the
+commit+propose timeout), ``COMETBFT_TPU_HEALTH_BUNDLE_DIR`` (black-box
+dump directory override), ``COMETBFT_TPU_HEALTH_BUNDLE_RL_S`` (minimum
+seconds between bundles).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import shutil
+import threading
+import time
+from array import array
+
+from . import metrics as libmetrics
+from . import sync as libsync
+from . import trace as libtrace
+from .service import BaseService
+
+_ENV_HEALTH = "COMETBFT_TPU_HEALTH"
+_ENV_RING = "COMETBFT_TPU_HEALTH_RING"
+_ENV_STALL_MULT = "COMETBFT_TPU_HEALTH_STALL_MULT"
+_ENV_BUNDLE_DIR = "COMETBFT_TPU_HEALTH_BUNDLE_DIR"
+_ENV_BUNDLE_RL = "COMETBFT_TPU_HEALTH_BUNDLE_RL_S"
+
+DEFAULT_RING_SIZE = 4096
+# Stall window = multiplier x (timeout_commit + timeout_propose(0)):
+# one full empty-block cycle is the longest a healthy node legitimately
+# goes between step transitions, so 25 cycles of silence is a wedge,
+# not a slow round (production defaults: ~100 s).
+DEFAULT_STALL_MULT = 25.0
+DEFAULT_BUNDLE_RL_S = 60.0
+# Retention cap: newest bundle directories kept per bundle dir. The
+# rate limit floors the write INTERVAL; this bounds the TOTAL — a node
+# stalled over a weekend must not fill its data volume with thousands
+# of ring dumps.
+DEFAULT_BUNDLE_KEEP = 16
+# Recompile storm: this many steady-state recompiles inside one rolling
+# window is a shape-bucket leak / dtype drift actively destroying
+# throughput (each recompile costs seconds of XLA time on the hot path).
+STORM_RECOMPILES = 3
+STORM_WINDOW_S = 60.0
+
+# -- ring event codes (decoded by _CODE_NAMES / dump()) -----------------
+EV_STEP = 1  # height, round, a=RoundStep int
+EV_PROPOSAL = 2  # height, round, a=1 accepted / 0 rejected
+EV_VOTE = 3  # height, round, a=vote type, b=validator index
+EV_COMMIT = 4  # height, round=commit round, a=height latency ns
+EV_BREAKER = 5  # a=1 trip / 0 re-arm (crypto/coalesce half-open breaker)
+EV_RECOMPILE = 6  # a=shape bucket (libs/devstats steady-state recompile)
+EV_FSYNC = 7  # a=WAL fsync ns
+EV_WATCHDOG = 8  # a=watchdog bit (see _WATCHDOGS)
+
+_N_CODES = 16  # size of the per-code last-seen vector
+
+_CODE_NAMES = {
+    EV_STEP: "consensus.step",
+    EV_PROPOSAL: "consensus.proposal",
+    EV_VOTE: "consensus.vote",
+    EV_COMMIT: "consensus.commit",
+    EV_BREAKER: "coalesce.breaker",
+    EV_RECOMPILE: "xla.recompile",
+    EV_FSYNC: "wal.fsync",
+    EV_WATCHDOG: "health.watchdog",
+}
+# decode the free-form a/b columns per code
+_CODE_FIELDS = {
+    EV_STEP: ("step", None),
+    EV_PROPOSAL: ("accepted", None),
+    EV_VOTE: ("type", "index"),
+    EV_COMMIT: ("dur_ns", None),
+    EV_BREAKER: ("open", None),
+    EV_RECOMPILE: ("bucket", None),
+    EV_FSYNC: ("dur_ns", None),
+    EV_WATCHDOG: ("watchdog", None),
+}
+
+_STEP_NAMES = {
+    1: "NewHeight", 2: "NewRound", 3: "Propose", 4: "Prevote",
+    5: "PrevoteWait", 6: "Precommit", 7: "PrecommitWait", 8: "Commit",
+}
+
+# watchdog name -> trip bitmask returned by HealthMonitor._check
+_WATCHDOGS = (
+    ("consensus_stall", 1),
+    ("verify_breaker", 2),
+    ("recompile_storm", 4),
+)
+_WATCHDOG_NAMES = {bit: name for name, bit in _WATCHDOGS}
+
+_ON_VALUES = ("1", "on", "true", "yes")
+_OFF_VALUES = ("0", "off", "false", "no")
+
+
+def _env_mode() -> str:
+    v = os.environ.get(_ENV_HEALTH, "").lower()
+    if v in _ON_VALUES:
+        return "on"
+    if v in _OFF_VALUES:
+        return "off"
+    return "auto"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _ring_size_from_env() -> int:
+    try:
+        n = int(os.environ.get(_ENV_RING, ""))
+    except ValueError:
+        n = DEFAULT_RING_SIZE
+    return max(64, n)
+
+
+# ------------------------------------------------------- flight recorder
+
+
+class FlightRecorder:
+    """Bounded lock-free ring of fixed-width consensus events.
+
+    Storage is six parallel ``array.array('q')`` columns plus a
+    per-code last-seen ``array('d')`` vector, all preallocated: the
+    record path performs only C-level scalar stores, so steady-state
+    recording retains zero allocations.  Concurrent writers reserve
+    slots through one GIL-atomic ``itertools.count``; a reader racing a
+    writer may see one torn row (skipped by the decoder), never a
+    corrupt structure.
+    """
+
+    __slots__ = (
+        "capacity", "_ts", "_code", "_h", "_r", "_a", "_b",
+        "_seq", "_written", "_last",
+    )
+
+    def __init__(self, capacity: int = DEFAULT_RING_SIZE):
+        self.capacity = max(64, int(capacity))
+        zeros = [0] * self.capacity
+        self._ts = array("q", zeros)
+        self._code = array("q", zeros)
+        self._h = array("q", zeros)
+        self._r = array("q", zeros)
+        self._a = array("q", zeros)
+        self._b = array("q", zeros)
+        self._seq = itertools.count()
+        self._written = array("q", [0])
+        # monotonic last-seen per event code (watchdog math)
+        self._last = array("d", [0.0] * _N_CODES)
+
+    def record(
+        self, code: int, height: int = 0, round_: int = 0,
+        a: int = 0, b: int = 0,
+    ) -> None:
+        seq = next(self._seq)  # GIL-atomic slot reservation
+        i = seq % self.capacity
+        self._code[i] = 0  # mark in-progress: readers skip torn rows
+        self._ts[i] = time.time_ns()
+        self._h[i] = height
+        self._r[i] = round_
+        self._a[i] = a
+        self._b[i] = b
+        self._code[i] = code  # publish last
+        if code == EV_STEP:
+            # the one last-seen the stall watchdog consumes; the other
+            # codes skip the extra clock read on the hot path
+            self._last[EV_STEP] = time.monotonic()
+        if seq >= self._written[0]:
+            self._written[0] = seq + 1
+
+    def last_seen(self, code: int) -> float:
+        """Monotonic time the code was last recorded (0.0 = never;
+        maintained for EV_STEP only — the stall watchdog's signal)."""
+        return self._last[code]
+
+    def _iter_slots(self):
+        """(slot index) oldest-first over the currently-filled window."""
+        w = self._written[0]
+        n = min(w, self.capacity)
+        for k in range(w - n, w):
+            yield k % self.capacity
+
+    def dump(self) -> list[dict]:
+        """Decoded ring contents, oldest first (lock-free snapshot; a
+        row being written concurrently is skipped)."""
+        out = []
+        for i in self._iter_slots():
+            code = self._code[i]
+            name = _CODE_NAMES.get(code)
+            if name is None:
+                continue  # empty or torn slot
+            rec = {
+                "ts": self._ts[i],
+                "event": name,
+                "height": self._h[i],
+                "round": self._r[i],
+            }
+            fa, fb = _CODE_FIELDS[code]
+            if fa is not None:
+                rec[fa] = self._a[i]
+            if fb is not None:
+                rec[fb] = self._b[i]
+            if code == EV_STEP:
+                rec["step_name"] = _STEP_NAMES.get(self._a[i], "?")
+            elif code == EV_WATCHDOG:
+                rec["watchdog_name"] = _WATCHDOG_NAMES.get(self._a[i], "?")
+            out.append(rec)
+        return out
+
+    def slis(self) -> dict:
+        """SLIs derived from the ring: commit-latency quantiles,
+        rounds-per-height, WAL fsync lag, step-progress age."""
+        commits: list[float] = []
+        rounds: list[int] = []
+        fsyncs: list[float] = []
+        for i in self._iter_slots():
+            code = self._code[i]
+            if code == EV_COMMIT:
+                commits.append(self._a[i] / 1e9)
+                rounds.append(self._r[i] + 1)
+            elif code == EV_FSYNC:
+                fsyncs.append(self._a[i] / 1e9)
+        last_step = self._last[EV_STEP]
+        return {
+            "commits": len(commits),
+            "commit_latency_s": {
+                "last": round(commits[-1], 6) if commits else None,
+                "p50": _quantile(commits, 0.50),
+                "p99": _quantile(commits, 0.99),
+            },
+            "rounds_per_height": (
+                round(sum(rounds) / len(rounds), 3) if rounds else None
+            ),
+            "wal_fsync_p99_s": _quantile(fsyncs, 0.99),
+            "step_age_s": (
+                round(time.monotonic() - last_step, 3) if last_step else None
+            ),
+        }
+
+    def status(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "recorded": self._written[0],
+        }
+
+
+def _quantile(values: list[float], q: float) -> float | None:
+    if not values:
+        return None
+    vs = sorted(values)
+    idx = min(len(vs) - 1, int(q * len(vs)))
+    return round(vs[idx], 6)
+
+
+def histogram_quantile(h, q: float) -> float:
+    """Upper-bound quantile estimate from a libs/metrics Histogram's
+    cumulative buckets (the promql-style read).  Unlocked GIL-consistent
+    snapshot: the scrape path must not contend with observers."""
+    counts = list(h._counts)
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        cum += c
+        if cum >= rank:
+            return float(h.buckets[i])
+    return float(h.buckets[-1])  # everything above the top edge
+
+
+# -------------------------------------------------- module-level recorder
+
+_mode = _env_mode()
+_enabled: bool = _mode == "on"
+# reference count of node-lifecycle holders (every booting node acquires
+# unless the env knob pins health off) — "always-on" means on for every
+# running node with zero opt-in, while bare library use stays free
+_acquirers = 0
+
+_REC = FlightRecorder(_ring_size_from_env())
+
+_mtx = libsync.Mutex("libs.health._mtx")  # bundle rate limit + registry only
+
+# breaker-trip notices from crypto/coalesce (module-level so the hook
+# needs no monitor handle; a lost increment under a rare write race
+# costs one duplicate-free notice, never a missed episode — the ring
+# event is recorded regardless)
+_BREAKER_NOTICES = array("q", [0])
+
+
+def enabled() -> bool:
+    """The one check hot paths make before recording."""
+    return _enabled
+
+
+def enable(ring: int | None = None) -> None:
+    """Force the recorder on (tests, bench).  ``ring`` rebuilds the
+    buffer at a new capacity, dropping prior records."""
+    global _enabled, _REC
+    if ring is not None and ring != _REC.capacity:
+        _REC = FlightRecorder(ring)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Drop all buffered records (tests, bench bursts)."""
+    global _REC
+    _REC = FlightRecorder(_REC.capacity)
+
+
+def acquire() -> None:
+    """Reference-counted enable for node lifecycles (the devstats
+    pattern): every booting node acquires, so the recorder is on exactly
+    while a node runs — unless ``COMETBFT_TPU_HEALTH=0`` pins it off."""
+    global _acquirers, _enabled
+    if _env_mode() == "off":
+        return
+    _acquirers += 1
+    _enabled = True
+
+
+def release() -> None:
+    global _acquirers, _enabled
+    _acquirers = max(0, _acquirers - 1)
+    if _acquirers == 0 and _env_mode() != "on":
+        _enabled = False
+
+
+def monitor_enabled() -> bool:
+    """Whether a booting node should start a HealthMonitor (watchdogs
+    ride the same kill switch as the recorder)."""
+    return _env_mode() != "off"
+
+
+def record(
+    code: int, height: int = 0, round_: int = 0, a: int = 0, b: int = 0
+) -> None:
+    """Record one flight event.  Allocation-free and lock-free; a
+    single flag check when the recorder is off."""
+    if not _enabled:
+        return
+    _REC.record(code, height, round_, a, b)
+
+
+def recorder() -> FlightRecorder:
+    return _REC
+
+
+def slis() -> dict:
+    return _REC.slis()
+
+
+def note_breaker_trip() -> None:
+    """crypto/coalesce hook: the half-open breaker tripped (wedged
+    verify executor).  Records the ring event and leaves a notice the
+    wedged-coalescer watchdog converts into a trip on its next check.
+    Takes no lock — the caller may sit close to engine mutexes."""
+    _BREAKER_NOTICES[0] = _BREAKER_NOTICES[0] + 1
+    record(EV_BREAKER, a=1)
+
+
+def note_breaker_rearm() -> None:
+    """crypto/coalesce hook: a successful half-open probe re-armed
+    routing."""
+    record(EV_BREAKER, a=0)
+
+
+# ------------------------------------------------------------- watchdogs
+
+# HealthMonitor._st slot indices (array('d') state vector: the no-trip
+# check path must retain nothing, so every mutable scalar lives in
+# preallocated storage)
+_ST_PROGRESS_BASE = 0  # stall baseline (monotonic)
+_ST_STORM_BASE = 1  # recompile count at the storm window start
+_ST_STORM_T0 = 2  # storm window start (monotonic)
+_ST_BREAKER_SEEN = 3  # breaker notices already converted to trips
+_ST_STORM_TRIP_T = 4  # last storm trip (monotonic; drives storm_active)
+_ST_LAST_BUNDLE = 5  # last bundle write (monotonic; rate limit)
+_ST_STALLED = 6  # 1.0 while the stall detector considers us stalled
+
+
+class HealthMonitor(BaseService):
+    """Background watchdog thread over the flight recorder.
+
+    One instance per node (node/node.py starts it alongside the
+    Prometheus exporter); ``_check()`` is a pure, allocation-free
+    evaluation so tests (and the tracemalloc guard) can drive it
+    directly without the thread.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        stall_base_s: float = 4.0,
+        stall_mult: float | None = None,
+        bundle_dir: str | None = None,
+        bundle_rl_s: float | None = None,
+        bundle_keep: int = DEFAULT_BUNDLE_KEEP,
+        storm_recompiles: int = STORM_RECOMPILES,
+        storm_window_s: float = STORM_WINDOW_S,
+        interval_s: float | None = None,
+        trace_tail: int = 512,
+        idle_ok=None,
+        logger=None,
+    ):
+        super().__init__("HealthMonitor", logger)
+        self.metrics = metrics
+        # idle_ok: zero-arg callable consulted when the stall window
+        # expires — True means the silence is LEGITIMATE (the node is
+        # still block-syncing, or create_empty_blocks=False with an
+        # empty mempool leaves the FSM intentionally parked), so the
+        # window re-baselines without a trip. node/node.py wires this
+        # to its own sync/mempool state; None = every silence is a
+        # stall (bare consensus harnesses, tests).
+        self._idle_ok = idle_ok
+        self.bundle_keep = bundle_keep
+        mult = (
+            stall_mult
+            if stall_mult is not None
+            else _env_float(_ENV_STALL_MULT, DEFAULT_STALL_MULT)
+        )
+        self.stall_after_s = max(0.05, stall_base_s * mult)
+        self.bundle_dir = os.environ.get(_ENV_BUNDLE_DIR) or bundle_dir
+        self.bundle_rl_s = (
+            bundle_rl_s
+            if bundle_rl_s is not None
+            else _env_float(_ENV_BUNDLE_RL, DEFAULT_BUNDLE_RL_S)
+        )
+        self.storm_recompiles = storm_recompiles
+        self.storm_window_s = storm_window_s
+        self.interval_s = (
+            interval_s
+            if interval_s is not None
+            else max(0.05, min(1.0, self.stall_after_s / 4.0))
+        )
+        self.trace_tail = trace_tail
+        # trip tallies per watchdog (trip paths may allocate)
+        self.trips = {name: 0 for name, _ in _WATCHDOGS}
+        self.bundles = 0
+        self._thread: threading.Thread | None = None
+        # preallocated scalar state — see the _ST_* index comments
+        self._st = array("d", [0.0] * 8)
+        now = time.monotonic()
+        self._st[_ST_PROGRESS_BASE] = now
+        self._st[_ST_STORM_T0] = now
+        self._st[_ST_STORM_BASE] = float(self._recompile_total())
+        self._st[_ST_BREAKER_SEEN] = float(_BREAKER_NOTICES[0])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._st[_ST_PROGRESS_BASE] = time.monotonic()
+        t = threading.Thread(
+            target=self._run, name="health-monitor", daemon=True
+        )
+        # the fallible step FIRST: a failed spawn must leak neither the
+        # recorder acquire nor a registry entry
+        t.start()
+        self._thread = t
+        acquire()  # the watchdogs need the recorder's step timeline
+        with _mtx:
+            _MONITORS.append(self)
+
+    def on_stop(self) -> None:
+        with _mtx:
+            for i in range(len(_MONITORS) - 1, -1, -1):
+                if _MONITORS[i] is self:
+                    del _MONITORS[i]
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2)
+        release()
+
+    def _run(self) -> None:
+        quit_ev = self.quit_event()
+        while not quit_ev.is_set():
+            try:
+                mask = self._check()
+                if mask:
+                    self._handle_trips(mask)
+            except Exception:
+                # a watchdog fault must never take the monitor down
+                import traceback
+
+                traceback.print_exc()
+            quit_ev.wait(self.interval_s)
+
+    # -- evaluation --------------------------------------------------------
+
+    def _recompile_total(self) -> int:
+        """Current ``xla_recompile_total`` from the devstats ledger
+        (drains staged compiles — a read path, like every scrape)."""
+        from . import devstats as libdevstats
+
+        return libdevstats.counters()["recompiles"]
+
+    def _check(self) -> int:
+        """One watchdog evaluation; returns a bitmask of FRESH trips.
+
+        Allocation-free on the no-trip path (pinned by the tracemalloc
+        guard): all mutable state lives in the preallocated ``_st``
+        vector, and the mask is a small int.
+        """
+        st = self._st
+        now = time.monotonic()
+        mask = 0
+        # -- consensus stall: no step transition within the window
+        last_step = _REC._last[EV_STEP]
+        base = st[_ST_PROGRESS_BASE]
+        progress = last_step if last_step > base else base
+        if now - progress > self.stall_after_s:
+            # a legitimately idle node (syncing, or intentionally
+            # parked waiting for txs) re-baselines without a trip —
+            # only consulted at window expiry, never on the hot path
+            if self._idle_ok is not None:
+                try:
+                    idle = bool(self._idle_ok())
+                except Exception:
+                    idle = False
+            else:
+                idle = False
+            # re-baseline: one evaluation per window, not per tick
+            st[_ST_PROGRESS_BASE] = now
+            if idle:
+                st[_ST_STALLED] = 0.0
+            else:
+                mask |= 1
+                st[_ST_STALLED] = 1.0
+        elif last_step > base:
+            st[_ST_STALLED] = 0.0  # progress resumed
+        # -- wedged coalescer: breaker notices since the last check
+        notices = _BREAKER_NOTICES[0]
+        if notices > st[_ST_BREAKER_SEEN]:
+            st[_ST_BREAKER_SEEN] = float(notices)
+            mask |= 2
+        # -- recompile storm: ledger delta inside a rolling window
+        cur = self._recompile_total()
+        if now - st[_ST_STORM_T0] > self.storm_window_s:
+            st[_ST_STORM_T0] = now
+            st[_ST_STORM_BASE] = float(cur)
+        elif cur - st[_ST_STORM_BASE] >= self.storm_recompiles:
+            mask |= 4
+            st[_ST_STORM_TRIP_T] = now
+            st[_ST_STORM_T0] = now
+            st[_ST_STORM_BASE] = float(cur)
+        return mask
+
+    def stalled(self) -> bool:
+        return self._st[_ST_STALLED] != 0.0
+
+    def storm_active(self) -> bool:
+        t = self._st[_ST_STORM_TRIP_T]
+        return bool(t) and time.monotonic() - t < self.storm_window_s
+
+    # -- trip handling -----------------------------------------------------
+
+    def _handle_trips(self, mask: int) -> None:
+        m = self.metrics if self.metrics is not None else (
+            libmetrics.node_metrics()
+        )
+        names = [name for name, bit in _WATCHDOGS if mask & bit]
+        for name, bit in _WATCHDOGS:
+            if not mask & bit:
+                continue
+            self.trips[name] += 1
+            m.health_watchdog_trips.labels(name).inc()
+            record(EV_WATCHDOG, a=bit)
+            if self.logger is not None:
+                self.logger.error(
+                    "health watchdog tripped",
+                    watchdog=name,
+                    stall_after_s=round(self.stall_after_s, 3),
+                )
+        path = self._maybe_bundle("-".join(names), m)
+        if path is not None and self.logger is not None:
+            self.logger.error("black-box bundle written", path=path)
+
+    def _maybe_bundle(self, reason: str, m) -> str | None:
+        """Write one black-box bundle unless the rate limit forbids it.
+        The check-and-set runs under ``libs.health._mtx``; all file I/O
+        happens after release (the mutex stays a blocking-free leaf)."""
+        if not self.bundle_dir:
+            return None
+        now = time.monotonic()
+        with _mtx:
+            last = self._st[_ST_LAST_BUNDLE]
+            if last and now - last < self.bundle_rl_s:
+                return None
+            self._st[_ST_LAST_BUNDLE] = now
+        try:
+            path = write_bundle(
+                self.bundle_dir, reason,
+                metrics=self.metrics, trace_tail=self.trace_tail,
+            )
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return None
+        prune_bundles(self.bundle_dir, self.bundle_keep)
+        self.bundles += 1
+        m.health_bundles.inc()
+        return path
+
+    def status(self) -> dict:
+        return {
+            "running": self.is_running(),
+            "stall_after_s": round(self.stall_after_s, 3),
+            "interval_s": round(self.interval_s, 3),
+            "stalled": self.stalled(),
+            "storm_active": self.storm_active(),
+            "trips": dict(self.trips),
+            "bundles": self.bundles,
+            "bundle_dir": self.bundle_dir,
+            "bundle_rl_s": self.bundle_rl_s,
+            "bundle_keep": self.bundle_keep,
+        }
+
+
+# registry of running monitors (stack semantics like libs/metrics'
+# node-metrics stack: the most recent running monitor answers
+# process-wide queries; pops are by identity)
+_MONITORS: list[HealthMonitor] = []
+
+
+def active_monitor() -> HealthMonitor | None:
+    # lock-free read (tuple snapshot, like crypto/coalesce._ACTIVE):
+    # the scrape path consults this and must never touch _mtx — only
+    # the start/stop writers serialize on it
+    mons = tuple(_MONITORS)
+    return mons[-1] if mons else None
+
+
+# --------------------------------------------------------- black-box dump
+
+
+def write_bundle(
+    dir_: str, reason: str, metrics=None, trace_tail: int = 512
+) -> str:
+    """Write one black-box bundle directory and return its path.
+
+    Contents: ``manifest.json`` (reason + SLI snapshot), ``flight.json``
+    (the decoded flight-recorder ring), ``devstats.json`` (the XLA/device
+    telemetry snapshot), ``locks.json`` (deadlock-tier status + every
+    thread's held lock-order stack), ``threads.txt`` (all thread
+    stacks), ``trace.json`` (tracer status + ring tail).
+    """
+    safe = "".join(c if (c.isalnum() or c in "-_") else "-" for c in reason)
+    path = os.path.join(dir_, f"health-{time.time_ns()}-{safe}")
+    os.makedirs(path, exist_ok=True)
+
+    def save(name: str, obj) -> None:
+        try:
+            with open(os.path.join(path, name), "w") as f:
+                if isinstance(obj, str):
+                    f.write(obj)
+                else:
+                    json.dump(obj, f, indent=1, default=str)
+        except Exception as e:
+            try:
+                with open(os.path.join(path, name + ".err"), "w") as f:
+                    f.write(repr(e))
+            except Exception:
+                pass
+
+    save(
+        "manifest.json",
+        {
+            "reason": reason,
+            "ts_ns": time.time_ns(),
+            "slis": _REC.slis(),
+            "ring": _REC.status(),
+        },
+    )
+    save(
+        "flight.json",
+        {"ring": _REC.status(), "events": _REC.dump()},
+    )
+    try:
+        from . import devstats as libdevstats
+
+        save("devstats.json", libdevstats.snapshot())
+    except Exception as e:
+        save("devstats.json.err", repr(e))
+    save(
+        "locks.json",
+        {
+            "deadlock_detection": libsync.enabled(),
+            "lock_order_mode": libsync.lock_order_mode(),
+            "held": {
+                str(tid): stack
+                for tid, stack in libsync.held_locks_snapshot().items()
+            },
+        },
+    )
+    try:
+        from . import pprof as libpprof
+
+        save("threads.txt", libpprof.thread_dump())
+    except Exception as e:
+        save("threads.txt.err", repr(e))
+    save(
+        "trace.json",
+        {
+            "status": libtrace.status(),
+            "events": libtrace.ring_dump()[-trace_tail:],
+        },
+    )
+    return path
+
+
+def prune_bundles(dir_: str, keep: int) -> None:
+    """Bound the ``health-*`` bundle directories in ``dir_`` to ``keep``.
+
+    The rate limit floors the write interval; this bounds the TOTAL on
+    disk. Retention favors forensics: the OLDEST bundle (the original
+    failure edge) is always kept, and the remaining ``keep - 1`` slots
+    hold the newest ones (the still-failing state) — the middle of a
+    days-long stall is the least interesting part. ``keep <= 0``
+    disables pruning. Names embed ``time.time_ns()``, so the
+    lexicographic sort is the chronological one."""
+    if keep <= 0:
+        return
+    try:
+        names = sorted(
+            n for n in os.listdir(dir_) if n.startswith("health-")
+        )
+    except OSError:
+        return
+    if len(names) <= keep:
+        return
+    doomed = names[1:] if keep == 1 else names[1 : -(keep - 1)]
+    for n in doomed:
+        shutil.rmtree(os.path.join(dir_, n), ignore_errors=True)
+
+
+# ------------------------------------------------------ SLO/health engine
+
+
+def sample(metrics=None) -> dict:
+    """Pull-time SLI computation: derive the ``health_*`` gauges and the
+    composite score into ``metrics`` (the scraped node's NodeMetrics) or
+    the process-wide top.  Touches NO flight-recorder lock (there is
+    none) and no engine mutex — safe on every scrape path."""
+    m = metrics if metrics is not None else libmetrics.node_metrics()
+    s = _REC.slis()
+    from ..crypto import coalesce as crypto_coalesce
+
+    breaker_open = crypto_coalesce.breaker_open()
+    mon = active_monitor()
+    stalled = False
+    storm = False
+    if mon is not None:
+        storm = mon.storm_active()
+        age = s["step_age_s"]
+        stalled = mon.stalled() or (
+            age is not None and age > mon.stall_after_s
+        )
+    lat = s["commit_latency_s"]
+    if lat["p50"] is not None:
+        m.health_commit_latency.labels("p50").set(lat["p50"])
+        m.health_commit_latency.labels("p99").set(lat["p99"])
+        m.health_commit_latency.labels("last").set(lat["last"])
+    if s["rounds_per_height"] is not None:
+        m.health_rounds_per_height.set(s["rounds_per_height"])
+    if s["wal_fsync_p99_s"] is not None:
+        m.health_wal_fsync.set(s["wal_fsync_p99_s"])
+    wait_p99 = histogram_quantile(m.coalesce_wait_seconds, 0.99)
+    m.health_verify_wait_p99.set(wait_p99)
+    m.health_breaker_open.set(1.0 if breaker_open else 0.0)
+    if s["step_age_s"] is not None:
+        m.health_stall_seconds.set(s["step_age_s"])
+    # composite score: 1.0 healthy; a stall zeroes it (liveness lost);
+    # an open breaker or an active recompile storm each cost 0.3
+    # (degraded but live) — documented in docs/observability.md
+    if stalled:
+        score = 0.0
+    else:
+        score = 1.0
+        if breaker_open:
+            score -= 0.3
+        if storm:
+            score -= 0.3
+        score = max(0.0, score)
+    m.health_score.set(score)
+    return {
+        "score": round(score, 3),
+        "stalled": stalled,
+        "breaker_open": breaker_open,
+        "recompile_storm": storm,
+        "verify_wait_p99_s": wait_p99,
+        **s,
+    }
+
+
+def debug_health_json(tail: int = 100) -> str:
+    """Body of the pprof server's ``/debug/health`` route."""
+    mon = active_monitor()
+    out = {
+        "enabled": _enabled,
+        "ring": _REC.status(),
+        "health": sample(),
+        "watchdogs": mon.status() if mon is not None else None,
+        "events": _REC.dump()[-tail:],
+    }
+    return json.dumps(out, default=str)

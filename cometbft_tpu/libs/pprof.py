@@ -139,6 +139,7 @@ class PprofServer(HTTPService):
                 "/debug/jax/stop_trace\n"
                 "/debug/locks\n"
                 "/debug/devstats         device/XLA telemetry (JSON)\n"
+                "/debug/health           flight-recorder SLIs + watchdogs (JSON)\n"
                 "/debug/trace            span-tracer ring dump\n"
                 "/debug/trace/start?file=PATH\n"
                 "/debug/trace/stop\n"
@@ -179,6 +180,13 @@ class PprofServer(HTTPService):
             from . import devstats as libdevstats
 
             return libdevstats.debug_devstats_json()
+
+        def health_dump(q):
+            from . import health as libhealth
+
+            return libhealth.debug_health_json(
+                tail=int(q.get("tail", ["100"])[0])
+            )
 
         def trace_dump(q):
             from . import trace as libtrace
@@ -224,6 +232,7 @@ class PprofServer(HTTPService):
             "/debug/jax/stop_trace": jax_stop,
             "/debug/locks": locks,
             "/debug/devstats": devstats_dump,
+            "/debug/health": health_dump,
             "/debug/trace": trace_dump,
             "/debug/trace/start": trace_start,
             "/debug/trace/stop": trace_stop,

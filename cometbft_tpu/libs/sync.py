@@ -82,6 +82,11 @@ if _order_mode not in _LOCK_ORDER_MODES:
 _order_graph_path = os.environ.get("COMETBFT_TPU_LOCK_ORDER_GRAPH") or None
 
 _tls = threading.local()  # .held: list[str] of instrumented-lock names
+# every thread's held stack, keyed by thread id (the SAME list objects
+# the TLS slots hold, registered at first use) — lets the health layer's
+# black-box bundle snapshot which locks every thread held at a watchdog
+# trip without reaching into foreign TLS
+_all_held: dict[int, list] = {}
 # observed (from, to) -> first witness "file:line" of the inner acquire
 _observed: dict[tuple[str, str], str] = {}
 _observed_mtx = threading.Lock()  # tier-internal meta-lock, never exposed
@@ -142,7 +147,23 @@ def _held_stack() -> list:
     stack = getattr(_tls, "held", None)
     if stack is None:
         stack = _tls.held = []
+        with _observed_mtx:
+            _all_held[threading.get_ident()] = stack
     return stack
+
+
+def held_locks_snapshot() -> dict[int, list[str]]:
+    """Per-thread held instrumented-lock names (crash-forensics surface
+    for the health layer's black-box bundle).  Only populated while the
+    lock-order sanitizer runs (``COMETBFT_TPU_LOCK_ORDER``) — plain
+    production locks keep no held stacks.  Dead threads are pruned."""
+    live = set(sys._current_frames())
+    with _observed_mtx:
+        for tid in [t for t in _all_held if t not in live]:
+            del _all_held[tid]
+        return {
+            tid: list(stack) for tid, stack in _all_held.items() if stack
+        }
 
 
 def _acquire_site() -> str:

@@ -435,6 +435,9 @@ class Node(BaseService):
         # on_start because in "auto" mode it probes the jax backend —
         # constructing a Node must stay free of backend init.
         self.verify_coalescer = None
+        # Health monitor (libs/health): started in _finish_start — the
+        # always-on flight recorder + SLO watchdogs + black-box dumps.
+        self.health_monitor = None
         self.switch.logger = self.logger.with_module("p2p")
         self.blocksync_reactor.logger = self.logger.with_module("blocksync")
         self.statesync_reactor.logger = self.logger.with_module("statesync")
@@ -493,6 +496,11 @@ class Node(BaseService):
         # (no-op unless devstats is on; never initializes a jax backend
         # from the scrape path)
         libdevstats.sample(self.metrics)
+        # health SLIs + composite score from the flight recorder (lock-
+        # free ring reads; never touches an engine mutex)
+        from ..libs import health as libhealth
+
+        libhealth.sample(self.metrics)
         out, inb = self.switch.num_peers()
         self.metrics.peers.set(out + inb)
         self.metrics.mempool_size.set(self.mempool.size())
@@ -692,6 +700,55 @@ class Node(BaseService):
                 "prometheus exporter listening",
                 port=self.prometheus_server.bound_port,
             )
+        # Health monitor LAST for the same leak-safety reason as the
+        # exporter: its on_start acquires the flight recorder
+        # (refcounted like devstats), so it must start only after every
+        # fallible boot step. COMETBFT_TPU_HEALTH=0 is the kill switch;
+        # the stall window scales off this node's own consensus
+        # timeouts (one commit+propose cycle is the longest a healthy
+        # node idles between step transitions).
+        from ..libs import health as libhealth
+
+        if libhealth.monitor_enabled():
+            self.health_monitor = libhealth.HealthMonitor(
+                metrics=self.metrics,
+                stall_base_s=(
+                    self.config.consensus.commit_timeout()
+                    + self.config.consensus.propose_timeout(0)
+                ),
+                bundle_dir=self.config.base.resolve("data/health"),
+                # legitimate silences on THIS node: still block-syncing
+                # (consensus parked behind the sync reactors), or
+                # intentionally waiting for transactions — a quiet
+                # chain with create_empty_blocks=false is live, not
+                # stalled, and must not page the operator
+                idle_ok=lambda: (
+                    not self.blocksync_reactor.synced.is_set()
+                    or (
+                        not self.config.consensus.create_empty_blocks
+                        and self.mempool.size() == 0
+                    )
+                ),
+                logger=self.logger.with_module("health"),
+            )
+            try:
+                self.health_monitor.start()
+            except BaseException:
+                # the exporter was already up: a failed boot here would
+                # otherwise leak its devstats acquire (stop() raises
+                # NotStartedError on a half-booted node, so on_stop
+                # never runs)
+                self.health_monitor = None
+                if self.prometheus_server is not None:
+                    from ..libs import devstats as libdevstats
+
+                    try:
+                        if self.prometheus_server.is_running():
+                            self.prometheus_server.stop()
+                    except Exception:
+                        pass
+                    libdevstats.release()
+                raise
 
     def _forward_txs_available(self) -> None:
         ev = self.mempool.txs_available()
@@ -740,6 +797,12 @@ class Node(BaseService):
                 except Exception:
                     pass
             libdevstats.release()
+        if self.health_monitor is not None:
+            try:
+                if self.health_monitor.is_running():
+                    self.health_monitor.stop()
+            except Exception:
+                pass
         for svc in (self.switch, self.event_bus, self.proxy_app):
             try:
                 if svc.is_running():

@@ -1,0 +1,547 @@
+"""libs/health: the always-on consensus flight recorder, the SLO
+engine, the watchdogs, and the black-box bundles.
+
+The acceptance gates of this PR live here: a deliberately stalled
+single-node run (frozen timeout ticker) trips the stall watchdog within
+the configured window and writes a black-box bundle; the same scenario
+with watchdogs disabled writes nothing; and a healthy 4-validator burst
+runs end to end with zero trips and a non-degraded health score.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.libs import health as libhealth
+from cometbft_tpu.libs import metrics as libmetrics
+from cometbft_tpu.libs.metrics import NodeMetrics
+
+import helpers
+
+
+@pytest.fixture
+def health():
+    """Enabled recorder with a clean ring; module state restored."""
+    libhealth.enable(ring=1024)
+    libhealth.reset()
+    yield libhealth
+    libhealth.disable()
+    libhealth.reset()
+
+
+def _wait_until(cond, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+class TestFlightRecorder:
+    def test_disabled_records_nothing(self):
+        assert not libhealth.enabled()
+        libhealth.reset()
+        libhealth.record(libhealth.EV_STEP, 1, 0, 3)
+        assert libhealth.recorder().dump() == []
+
+    def test_record_decode_roundtrip(self, health):
+        libhealth.record(libhealth.EV_STEP, 7, 1, 4)
+        libhealth.record(libhealth.EV_VOTE, 7, 1, 2, 3)
+        libhealth.record(libhealth.EV_COMMIT, 7, 1, 250_000_000)
+        libhealth.record(libhealth.EV_FSYNC, a=4_000_000)
+        libhealth.record(libhealth.EV_BREAKER, a=1)
+        evs = libhealth.recorder().dump()
+        assert [e["event"] for e in evs] == [
+            "consensus.step", "consensus.vote", "consensus.commit",
+            "wal.fsync", "coalesce.breaker",
+        ]
+        step, vote, commit, fsync, breaker = evs
+        assert step["height"] == 7 and step["round"] == 1
+        assert step["step"] == 4 and step["step_name"] == "Prevote"
+        assert vote["type"] == 2 and vote["index"] == 3
+        assert commit["dur_ns"] == 250_000_000
+        assert fsync["dur_ns"] == 4_000_000
+        assert breaker["open"] == 1
+        assert all(e["ts"] > 0 for e in evs)
+
+    def test_ring_is_bounded_and_wraps(self):
+        libhealth.enable(ring=64)
+        try:
+            for i in range(200):
+                libhealth.record(libhealth.EV_VOTE, i, 0, 1, i)
+            evs = libhealth.recorder().dump()
+            assert len(evs) == 64
+            # oldest-first, newest tail preserved
+            assert evs[-1]["height"] == 199
+            assert evs[0]["height"] == 200 - 64
+            assert libhealth.recorder().status()["recorded"] == 200
+        finally:
+            libhealth.enable(ring=libhealth.DEFAULT_RING_SIZE)
+            libhealth.disable()
+            libhealth.reset()
+
+    def test_slis_from_ring(self, health):
+        for h in range(1, 11):
+            libhealth.record(libhealth.EV_STEP, h, 0, 8)
+            # heights at 100 ms except one 300 ms straggler on round 2
+            dur = 300_000_000 if h == 10 else 100_000_000
+            libhealth.record(
+                libhealth.EV_COMMIT, h, 2 if h == 10 else 0, dur
+            )
+        libhealth.record(libhealth.EV_FSYNC, a=2_000_000)
+        s = libhealth.slis()
+        assert s["commits"] == 10
+        assert s["commit_latency_s"]["p50"] == pytest.approx(0.1)
+        assert s["commit_latency_s"]["p99"] == pytest.approx(0.3)
+        assert s["commit_latency_s"]["last"] == pytest.approx(0.3)
+        # nine 1-round heights + one 3-round height
+        assert s["rounds_per_height"] == pytest.approx(1.2)
+        assert s["wal_fsync_p99_s"] == pytest.approx(0.002)
+        assert s["step_age_s"] is not None and s["step_age_s"] < 5
+
+    def test_acquire_release_refcount(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_HEALTH", raising=False)
+        libhealth.disable()
+        assert not libhealth.enabled()
+        libhealth.acquire()
+        libhealth.acquire()
+        assert libhealth.enabled()
+        libhealth.release()
+        assert libhealth.enabled()  # the second node still holds it
+        libhealth.release()
+        assert not libhealth.enabled()
+        # the 0 kill switch wins over acquire
+        monkeypatch.setenv("COMETBFT_TPU_HEALTH", "0")
+        libhealth.acquire()
+        assert not libhealth.enabled()
+        assert not libhealth.monitor_enabled()
+        # force-on pins across release
+        monkeypatch.setenv("COMETBFT_TPU_HEALTH", "1")
+        libhealth.acquire()
+        libhealth.release()
+        assert libhealth.enabled()
+        monkeypatch.delenv("COMETBFT_TPU_HEALTH")
+        libhealth.disable()
+
+    def test_histogram_quantile_estimate(self):
+        from cometbft_tpu.libs.metrics import Histogram
+
+        h = Histogram("t_q_seconds", buckets=(0.001, 0.01, 0.1, 1.0))
+        assert libhealth.histogram_quantile(h, 0.99) == 0.0  # empty
+        for _ in range(99):
+            h.observe(0.005)
+        h.observe(0.5)
+        assert libhealth.histogram_quantile(h, 0.5) == pytest.approx(0.01)
+        assert libhealth.histogram_quantile(h, 0.999) == pytest.approx(1.0)
+
+
+class TestWatchdogUnits:
+    """Each detector in isolation, driven through _check() directly."""
+
+    def _monitor(self, **kw):
+        kw.setdefault("stall_base_s", 1000.0)
+        kw.setdefault("stall_mult", 1.0)
+        kw.setdefault("metrics", NodeMetrics())
+        return libhealth.HealthMonitor(**kw)
+
+    def test_stall_detector_fires_and_rebaselines(self, health):
+        mon = self._monitor(stall_base_s=0.05)
+        libhealth.record(libhealth.EV_STEP, 1, 0, 3)
+        assert mon._check() == 0  # fresh progress
+        time.sleep(0.12)
+        assert mon._check() & 1  # stalled
+        assert mon.stalled()
+        # one trip per stalled window, not one per tick
+        assert mon._check() == 0
+        # progress resumed → re-arms
+        libhealth.record(libhealth.EV_STEP, 1, 0, 4)
+        assert mon._check() == 0
+        assert not mon.stalled()
+
+    def test_idle_ok_suppresses_stall(self, health):
+        """A legitimately idle node (blocksyncing, or waiting for txs
+        with create_empty_blocks=false) must not page: the node-wired
+        idle_ok predicate re-baselines the window without a trip, and
+        a later window with idle_ok False trips normally."""
+        idle = [True]
+        mon = self._monitor(
+            stall_base_s=0.05, idle_ok=lambda: idle[0]
+        )
+        time.sleep(0.12)
+        assert mon._check() == 0  # silence excused
+        assert not mon.stalled()
+        idle[0] = False
+        time.sleep(0.12)  # a fresh full window of inexcusable silence
+        assert mon._check() & 1
+        assert mon.stalled()
+        # a predicate that raises counts as NOT idle (fail toward
+        # alerting, never toward silence)
+        def boom():
+            raise RuntimeError("sync state unavailable")
+
+        mon2 = self._monitor(stall_base_s=0.05, idle_ok=boom)
+        time.sleep(0.12)
+        assert mon2._check() & 1
+
+    def test_bundle_retention_keeps_first_and_newest(
+        self, health, tmp_path
+    ):
+        """Retention bounds the total on disk: the oldest bundle (the
+        original failure edge) is pinned, the remaining slots hold the
+        newest."""
+        paths = []
+        for i in range(5):
+            paths.append(
+                os.path.basename(
+                    libhealth.write_bundle(str(tmp_path), f"r{i}")
+                )
+            )
+            time.sleep(0.002)  # distinct time_ns prefixes
+        libhealth.prune_bundles(str(tmp_path), 3)
+        left = sorted(os.listdir(tmp_path))
+        assert len(left) == 3
+        assert paths[0] in left  # the failure edge survives
+        assert paths[-1] in left and paths[-2] in left  # newest two
+        # keep<=0 disables pruning
+        libhealth.prune_bundles(str(tmp_path), 0)
+        assert len(os.listdir(tmp_path)) == 3
+
+    def test_breaker_hook_fires_on_tripped_coalescer(self, health):
+        from cometbft_tpu.crypto import coalesce as cco
+
+        mon = self._monitor()
+        co = cco.VerifyCoalescer(device=False)
+        co.start()
+        cco.push_active(co)
+        try:
+            assert mon._check() == 0
+            assert not cco.breaker_open()
+            co._trip()
+            assert cco.breaker_open()
+            assert mon._check() & 2
+            evs = [
+                e for e in libhealth.recorder().dump()
+                if e["event"] == "coalesce.breaker"
+            ]
+            assert evs and evs[-1]["open"] == 1
+            # a second check without a new trip stays quiet
+            assert mon._check() == 0
+            co._rearm()
+            assert not cco.breaker_open()
+            evs = [
+                e for e in libhealth.recorder().dump()
+                if e["event"] == "coalesce.breaker"
+            ]
+            assert evs[-1]["open"] == 0
+        finally:
+            cco.pop_active(co)
+            co.stop()
+
+    def test_recompile_alarm_on_synthetic_ledger_entries(self, health):
+        from cometbft_tpu.libs import devstats
+
+        mon = self._monitor(storm_recompiles=3, storm_window_s=60.0)
+        assert mon._check() == 0
+        # snapshot the process-wide ledger: synthetic entries must not
+        # leak into later tests' registries (every fresh NodeMetrics
+        # replays the full compile log from watermark 0)
+        with devstats._mtx:
+            log0 = len(devstats._compile_log)
+            c0 = dict(devstats._c)
+        try:
+            # synthetic ledger entries: stage one cold compile then
+            # three recompiles of the same kernel x bucket through the
+            # real drain (the devstats hook also mirrors each into the
+            # flight ring)
+            devstats._pending_compiles.append(
+                ("syn.health", 8, 0.01, 0, 1, False, False)
+            )
+            devstats._drain_compiles()
+            for i in range(3):
+                devstats._pending_compiles.append(
+                    ("syn.health", 8, 0.01, 1 + i, 2 + i, False, False)
+                )
+                devstats._drain_compiles()
+            assert mon._check() & 4
+            evs = [
+                e for e in libhealth.recorder().dump()
+                if e["event"] == "xla.recompile"
+            ]
+            assert len(evs) == 3 and all(e["bucket"] == 8 for e in evs)
+            # window reset after the trip: no immediate re-trip
+            assert mon._check() == 0
+        finally:
+            with devstats._mtx:
+                del devstats._compile_log[log0:]
+                devstats._c.clear()
+                devstats._c.update(c0)
+                devstats._compiled.pop(("syn.health", 8), None)
+                devstats._jit_sizes.pop("syn.health", None)
+
+    def test_trips_count_and_ring_events(self, health):
+        m = NodeMetrics()
+        mon = self._monitor(metrics=m)
+        mon._handle_trips(1 | 2)
+        assert mon.trips["consensus_stall"] == 1
+        assert mon.trips["verify_breaker"] == 1
+        assert mon.trips["recompile_storm"] == 0
+        assert (
+            m.health_watchdog_trips.labels("consensus_stall").value() == 1
+        )
+        assert (
+            m.health_watchdog_trips.labels("verify_breaker").value() == 1
+        )
+        wd = [
+            e for e in libhealth.recorder().dump()
+            if e["event"] == "health.watchdog"
+        ]
+        assert {e["watchdog_name"] for e in wd} == {
+            "consensus_stall", "verify_breaker"
+        }
+
+    def test_bundle_rate_limiting(self, health, tmp_path):
+        m = NodeMetrics()
+        mon = self._monitor(
+            metrics=m, bundle_dir=str(tmp_path), bundle_rl_s=60.0
+        )
+        mon._handle_trips(2)
+        mon._handle_trips(2)
+        dirs = os.listdir(tmp_path)
+        assert len(dirs) == 1, dirs  # second bundle rate-limited
+        assert mon.trips["verify_breaker"] == 2  # ...but both counted
+        assert mon.bundles == 1
+        assert m.health_bundles.value() == 1
+        # a tiny rate limit lets the next trip write again
+        mon2 = self._monitor(
+            metrics=m, bundle_dir=str(tmp_path), bundle_rl_s=0.01
+        )
+        time.sleep(0.02)
+        mon2._handle_trips(4)
+        assert len(os.listdir(tmp_path)) == 2
+
+    def test_bundle_contents(self, health, tmp_path):
+        libhealth.record(libhealth.EV_STEP, 3, 0, 8)
+        libhealth.record(libhealth.EV_COMMIT, 3, 0, 50_000_000)
+        path = libhealth.write_bundle(str(tmp_path), "unit-test")
+        names = set(os.listdir(path))
+        assert {
+            "manifest.json", "flight.json", "devstats.json",
+            "locks.json", "threads.txt", "trace.json",
+        } <= names, names
+        flight = json.load(open(os.path.join(path, "flight.json")))
+        assert any(
+            e["event"] == "consensus.commit" for e in flight["events"]
+        )
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["reason"] == "unit-test"
+        assert manifest["slis"]["commits"] == 1
+        devstats = json.load(open(os.path.join(path, "devstats.json")))
+        assert "xla" in devstats
+        locks = json.load(open(os.path.join(path, "locks.json")))
+        assert set(locks) == {
+            "deadlock_detection", "lock_order_mode", "held"
+        }
+        trace = json.load(open(os.path.join(path, "trace.json")))
+        assert "status" in trace and "events" in trace
+        threads = open(os.path.join(path, "threads.txt")).read()
+        assert "--- thread" in threads
+
+
+class TestStalledNodeAcceptance:
+    """THE acceptance gate: a frozen timeout ticker stalls a single-node
+    run; the stall watchdog trips within the configured window and
+    writes a black-box bundle — and with the kill switch set, the same
+    scenario writes nothing."""
+
+    def _frozen_node(self, monkeypatch):
+        genesis, pvs = helpers.make_genesis(1)
+        cs, parts = helpers.make_consensus_node(genesis, pvs[0])
+        # the frozen ticker: timeouts are scheduled but never fire, so
+        # the FSM never leaves NEW_HEIGHT — the liveness wedge
+        monkeypatch.setattr(
+            cs.ticker, "schedule_timeout", lambda ti: None
+        )
+        return cs, parts
+
+    def test_stall_trips_and_writes_bundle(
+        self, health, tmp_path, monkeypatch
+    ):
+        m = NodeMetrics()
+        cs, parts = self._frozen_node(monkeypatch)
+        mon = libhealth.HealthMonitor(
+            metrics=m,
+            stall_base_s=0.2,
+            stall_mult=1.0,
+            bundle_dir=str(tmp_path),
+            interval_s=0.02,
+        )
+        try:
+            cs.start()
+            mon.start()
+            assert _wait_until(
+                lambda: mon.trips["consensus_stall"] >= 1, timeout=10
+            ), "stall watchdog never tripped on a frozen ticker"
+            assert _wait_until(
+                lambda: len(os.listdir(tmp_path)) >= 1, timeout=5
+            ), "no black-box bundle written"
+        finally:
+            try:
+                mon.stop()
+            except Exception:
+                pass
+            helpers.stop_node(cs, parts)
+        assert (
+            m.health_watchdog_trips.labels("consensus_stall").value() >= 1
+        )
+        # the bundle carries the forensic set the issue names: the
+        # flight-recorder ring, the devstats snapshot, the trace tail
+        bundle = os.path.join(tmp_path, sorted(os.listdir(tmp_path))[0])
+        names = set(os.listdir(bundle))
+        assert {"flight.json", "devstats.json", "trace.json"} <= names
+        flight = json.load(open(os.path.join(bundle, "flight.json")))
+        events = {e["event"] for e in flight["events"]}
+        assert "health.watchdog" in events
+        # the health engine agrees: score zero while stalled
+        libhealth._MONITORS.append(mon)  # sample() consults the monitor
+        try:
+            out = libhealth.sample(m)
+        finally:
+            libhealth._MONITORS.remove(mon)
+        assert out["stalled"] is True
+        assert out["score"] == 0.0
+        assert m.health_score.value() == 0.0
+
+    def test_disabled_watchdogs_write_nothing(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("COMETBFT_TPU_HEALTH", "0")
+        libhealth.disable()
+        cs, parts = self._frozen_node(monkeypatch)
+        try:
+            cs.start()
+            # the node-boot gate: with the kill switch set no monitor
+            # starts (node/node.py checks exactly this) and acquire()
+            # cannot re-enable the recorder
+            assert not libhealth.monitor_enabled()
+            libhealth.acquire()
+            assert not libhealth.enabled()
+            time.sleep(0.6)  # same window the enabled scenario trips in
+        finally:
+            helpers.stop_node(cs, parts)
+        assert os.listdir(tmp_path) == []
+        assert libhealth.recorder().dump() == []
+
+
+class TestHealthyBurst:
+    """End-to-end: a real 4-validator in-process burst with a live
+    monitor — zero watchdog trips, health score pinned at 1.0."""
+
+    def test_burst_zero_trips_and_perfect_score(self):
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        libhealth.enable(ring=1 << 14)
+        libhealth.reset()
+        genesis, pvs = helpers.make_genesis(4)
+        nodes = [helpers.make_consensus_node(genesis, pv) for pv in pvs]
+        helpers.wire_perfect_gossip(nodes)
+        mon = libhealth.HealthMonitor(
+            metrics=m, stall_base_s=30.0, stall_mult=1.0,
+            interval_s=0.05,
+        )
+        scores = []
+        try:
+            for cs, _ in nodes:
+                cs.start()
+            mon.start()
+            store = nodes[0][1]["block_store"]
+            deadline = time.monotonic() + 120
+            while store.height() < 3 and time.monotonic() < deadline:
+                scores.append(libhealth.sample(m)["score"])
+                time.sleep(0.05)
+            assert store.height() >= 3
+        finally:
+            try:
+                mon.stop()
+            except Exception:
+                pass
+            for cs, parts in nodes:
+                helpers.stop_node(cs, parts)
+            libmetrics.pop_node_metrics(m)
+            final = libhealth.sample(m)
+            events = libhealth.recorder().dump()
+            libhealth.enable(ring=libhealth.DEFAULT_RING_SIZE)
+            libhealth.disable()
+            libhealth.reset()
+
+        # zero trips across every watchdog
+        assert mon.trips == {
+            "consensus_stall": 0,
+            "verify_breaker": 0,
+            "recompile_storm": 0,
+        }
+        assert mon.bundles == 0
+        # monotone non-degraded health: every sample along the way AND
+        # the final one scored a healthy 1.0
+        assert scores and all(s == 1.0 for s in scores), scores
+        assert final["score"] == 1.0
+        assert final["stalled"] is False
+        # the ring captured the burst: steps, votes, commits, fsync-free
+        # MemDB nodes still step/commit
+        names = {e["event"] for e in events}
+        assert {
+            "consensus.step", "consensus.vote", "consensus.commit"
+        } <= names, names
+        commits = [e for e in events if e["event"] == "consensus.commit"]
+        assert len(commits) >= 3 * 4  # >=3 heights on each of 4 nodes
+        assert all(c["dur_ns"] > 0 for c in commits)
+        # the SLI gauges landed in the pushed registry
+        text = m.registry.render()
+        assert "cometbft_tpu_health_score 1.0" in text
+        assert 'cometbft_tpu_health_commit_latency_seconds' in text
+        assert final["commit_latency_s"]["p50"] is not None
+
+
+class TestHealthSample:
+    def test_sample_sets_gauges_and_score_degrades(self, health):
+        from cometbft_tpu.crypto import coalesce as cco
+
+        m = NodeMetrics()
+        libhealth.record(libhealth.EV_STEP, 2, 0, 8)
+        libhealth.record(libhealth.EV_COMMIT, 2, 0, 80_000_000)
+        libhealth.record(libhealth.EV_FSYNC, a=1_500_000)
+        out = libhealth.sample(m)
+        assert out["score"] == 1.0
+        text = m.registry.render()
+        assert "cometbft_tpu_health_score 1.0" in text
+        assert (
+            'cometbft_tpu_health_commit_latency_seconds'
+            '{quantile="p50"} 0.08' in text
+        )
+        assert "cometbft_tpu_health_rounds_per_height 1.0" in text
+        assert "cometbft_tpu_health_wal_fsync_seconds 0.0015" in text
+        assert "cometbft_tpu_health_breaker_open 0.0" in text
+        # an open breaker degrades the score by 0.3
+        co = cco.VerifyCoalescer(device=False)
+        co.start()
+        cco.push_active(co)
+        try:
+            co._trip()
+            out = libhealth.sample(m)
+            assert out["breaker_open"] is True
+            assert out["score"] == pytest.approx(0.7)
+            assert m.health_breaker_open.value() == 1.0
+        finally:
+            cco.pop_active(co)
+            co.stop()
+
+    def test_debug_health_json_shape(self, health):
+        libhealth.record(libhealth.EV_STEP, 1, 0, 3)
+        out = json.loads(libhealth.debug_health_json(tail=10))
+        assert out["enabled"] is True
+        assert out["ring"]["capacity"] >= 64
+        assert "score" in out["health"]
+        assert out["watchdogs"] is None  # no monitor running
+        assert out["events"][-1]["event"] == "consensus.step"

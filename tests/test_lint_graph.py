@@ -517,6 +517,24 @@ class TestEngineWideGate:
         ]
         assert blocked == [], blocked
 
+    def test_health_lock_registered_and_leaf(self, analysis):
+        """libs/health's bundle-rate-limit mutex carries the same
+        contract as the tracer's and devstats': present in the shipped
+        artifact, participating in NO acquisition-order edges. The
+        flight recorder's record path is lock-free BY DESIGN (it runs
+        inside the consensus FSM under 'consensus.state' and inside
+        the devstats drain under 'libs.devstats._mtx'); an edge
+        appearing here means someone made the always-on record path
+        take a lock under an engine mutex."""
+        d = analysis.graph_dict()
+        assert "libs.health._mtx" in {lk["name"] for lk in d["locks"]}
+        health_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "libs.health._mtx" in (e["from"], e["to"])
+        ]
+        assert health_edges == [], health_edges
+
     def test_devstats_lock_registered_and_leaf(self, analysis):
         """libs/devstats' compile-ledger mutex has the same contract as
         the tracer's: present in the shipped artifact, edge-free. The

@@ -508,6 +508,56 @@ class TestTrace:
         assert libtrace.ring_dump() == []
         assert devstats.counters() == c0  # nothing recorded while off
 
+    def test_flight_recorder_steady_state_allocation_free(self):
+        """The health layer's stricter guard: the flight recorder is ON
+        by default for every node, so its ENABLED record path — and the
+        watchdog's no-trip check — must retain zero allocations, not
+        just the disabled fast path. Storage is preallocated
+        array.array columns; temporaries are fine, retention is not."""
+        import time
+        import tracemalloc
+
+        from cometbft_tpu.libs import health as libhealth
+
+        libhealth.enable(ring=512)
+        try:
+            mon = libhealth.HealthMonitor(
+                stall_base_s=1000.0, stall_mult=1.0
+            )
+
+            def hot():
+                for _ in range(400):
+                    libhealth.record(libhealth.EV_STEP, 5, 0, 3)
+                    libhealth.record(libhealth.EV_VOTE, 5, 0, 1, 2)
+                    libhealth.record(
+                        libhealth.EV_COMMIT, 5, 0, 120_000_000
+                    )
+                    libhealth.record(libhealth.EV_FSYNC, a=3_000_000)
+                    assert mon._check() == 0  # the no-trip path
+
+            hot()  # warm interpreter caches outside the measured window
+            tracemalloc.start()
+            try:
+                tracemalloc.clear_traces()
+                hot()
+                snap = tracemalloc.take_snapshot()
+            finally:
+                tracemalloc.stop()
+            stats = snap.filter_traces(
+                [tracemalloc.Filter(True, libhealth.__file__)]
+            ).statistics("lineno")
+            assert sum(s.size for s in stats) == 0, stats
+            # and the ring really recorded through the measured window
+            assert libhealth.recorder().status()["recorded"] >= 3200
+            assert (
+                libhealth.recorder().last_seen(libhealth.EV_STEP)
+                <= time.monotonic()
+            )
+        finally:
+            libhealth.enable(ring=libhealth.DEFAULT_RING_SIZE)
+            libhealth.disable()
+            libhealth.reset()
+
     def test_events_spans_and_nesting(self, tracer):
         with libtrace.span("outer", k="v") as outer:
             libtrace.event("mid", n=1)
@@ -638,6 +688,11 @@ class TestTrace:
             "COMETBFT_TPU_TRACE_RING",
             "COMETBFT_TPU_DEVSTATS",
             "COMETBFT_TPU_PROM_ADDR",
+            "COMETBFT_TPU_HEALTH",
+            "COMETBFT_TPU_HEALTH_RING",
+            "COMETBFT_TPU_HEALTH_STALL_MULT",
+            "COMETBFT_TPU_HEALTH_BUNDLE_DIR",
+            "COMETBFT_TPU_HEALTH_BUNDLE_RL_S",
         ):
             assert knob in ENV_KNOBS, knob
             assert knob in doc, f"{knob} missing from docs/observability.md"
@@ -790,6 +845,32 @@ class TestPprofDebugServer:
         }
         _, index = _get(server + "/debug/pprof/")
         assert "/debug/devstats" in index
+
+    def test_health_route(self, server):
+        """/debug/health: the flight-recorder SLIs + watchdog view,
+        linked from the index and captured into the debug-dump bundle
+        as health.json. The scrape never touches a flight-recorder
+        lock — the ring is lock-free by construction."""
+        from cometbft_tpu.libs import health as libhealth
+
+        libhealth.enable(ring=256)
+        try:
+            libhealth.record(libhealth.EV_STEP, 9, 0, 3)
+            _, body = _get(server + "/debug/health?tail=5")
+            st = json.loads(body)
+            assert st["enabled"] is True
+            assert set(st) >= {
+                "enabled", "ring", "health", "watchdogs", "events"
+            }
+            assert "score" in st["health"]
+            assert st["events"][-1]["event"] == "consensus.step"
+            assert st["events"][-1]["height"] == 9
+            _, index = _get(server + "/debug/pprof/")
+            assert "/debug/health" in index
+        finally:
+            libhealth.enable(ring=libhealth.DEFAULT_RING_SIZE)
+            libhealth.disable()
+            libhealth.reset()
 
     def test_trace_start_sink_failure_leaves_tracing_off(
         self, server, tmp_path
@@ -1143,6 +1224,49 @@ class TestPrometheusServer:
             if srv is not None and srv.is_running():
                 srv.stop()
             devstats.disable()
+            libmetrics.pop_node_metrics(m)
+
+    def test_scrape_self_metric(self):
+        """The exporter reports health_scrape_duration_seconds about
+        itself (observed after render, so scrape N+1's body carries
+        scrape N's sample — the standard client-library lag), and the
+        /debug/devstats JSON path feeds the same family under its own
+        endpoint label."""
+        from cometbft_tpu.libs import devstats
+
+        m = NodeMetrics()
+        srv = devstats.PrometheusServer("tcp://127.0.0.1:0", m.registry)
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.bound_port}/metrics"
+            _get(url)
+            _, text = _get(url)
+            families = assert_exposition_conformant(text)
+            assert (
+                families.get("cometbft_tpu_health_scrape_duration_seconds")
+                == "histogram"
+            )
+            count_lines = [
+                ln
+                for ln in text.splitlines()
+                if ln.startswith(
+                    "cometbft_tpu_health_scrape_duration_seconds_count"
+                )
+                and 'endpoint="prometheus"' in ln
+            ]
+            assert count_lines and float(count_lines[0].split()[-1]) >= 1
+        finally:
+            srv.stop()
+        # the devstats JSON route observes under endpoint="devstats"
+        libmetrics.push_node_metrics(m)
+        try:
+            before = m.health_scrape_seconds.labels("devstats")._n
+            devstats.debug_devstats_json()
+            assert (
+                m.health_scrape_seconds.labels("devstats")._n
+                == before + 1
+            )
+        finally:
             libmetrics.pop_node_metrics(m)
 
     def test_scrape_survives_refresh_failure(self):
