@@ -1455,6 +1455,226 @@ def bench_health_overhead(n_heights: int | None = None):
     }
 
 
+class _LazyLightChain:
+    """Light-block provider over a virtual H-height chain (bench twin of
+    tests/helpers.LazyLightChainProvider): headers hash-chain
+    iteratively, commits are signed only for heights the storm actually
+    touches — a 10k-height chain costs signatures for ~the distinct
+    trust roots, not 40k sign operations up front."""
+
+    def __init__(self, n_heights: int, n_vals: int = 4,
+                 chain_id: str = "bench-light-chain"):
+        import threading as _threading
+
+        from cometbft_tpu.types.block import (
+            BlockID, Header, PartSetHeader, Version,
+        )
+
+        self.n_heights = n_heights
+        self._chain_id = chain_id
+        self._t0 = 1_700_000_000_000_000_000
+        self._vs, self._pvs = _make_valset_and_pvs(n_vals)
+        self._Header, self._Version = Header, Version
+        self._psh = PartSetHeader(total=1, hash=b"\x07" * 32)
+        self._BlockID = BlockID
+        self._lock = _threading.Lock()
+        self._block_ids: list = [BlockID()]
+        self._blocks: dict[int, object] = {}
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def light_block(self, height: int):
+        from cometbft_tpu.light.errors import LightBlockNotFoundError
+        from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+
+        if height == 0:
+            height = self.n_heights
+        if not 1 <= height <= self.n_heights:
+            raise LightBlockNotFoundError(height)
+        with self._lock:
+            while len(self._block_ids) <= height:
+                hh = len(self._block_ids)
+                header = self._Header(
+                    version=self._Version(block=11, app=1),
+                    chain_id=self._chain_id,
+                    height=hh,
+                    time_ns=self._t0 + hh * 1_000_000_000,
+                    last_block_id=self._block_ids[hh - 1],
+                    last_commit_hash=b"\x01" * 32,
+                    data_hash=b"\x02" * 32,
+                    validators_hash=self._vs.hash(),
+                    next_validators_hash=self._vs.hash(),
+                    consensus_hash=b"\x03" * 32,
+                    app_hash=b"\x04" * 32,
+                    last_results_hash=b"\x05" * 32,
+                    evidence_hash=b"\x06" * 32,
+                    proposer_address=self._vs.validators[0].address,
+                )
+                self._block_ids.append(self._BlockID(
+                    hash=header.hash(), part_set_header=self._psh,
+                ))
+                self._blocks[hh] = header
+            cached = self._blocks[height]
+            if isinstance(cached, LightBlock):
+                return cached
+            commit = _sign_commit(
+                self._chain_id, self._vs, self._pvs, height,
+                self._block_ids[height],
+            )
+            lb = LightBlock(
+                signed_header=SignedHeader(header=cached, commit=commit),
+                validator_set=self._vs,
+            )
+            self._blocks[height] = lb
+            return lb
+
+    def report_evidence(self, ev) -> None:
+        pass
+
+
+def bench_light_storm(
+    device: bool | None = None,
+    n_threads: int | None = None,
+    n_heights: int | None = None,
+):
+    """Config 14: sustained many-client skipping-verification storm
+    through the light proof service (light/service.py).
+
+    N client threads each request verification of random targets over a
+    10k-height chain from randomized trust heights — the RPC-facing
+    "millions of users" workload shape. The storm run serves every
+    request through ONE shared LightService (commit-result cache +
+    single-flight + the cross-caller coalescer); the serial baseline
+    runs the IDENTICAL request list through fresh standalone Clients,
+    one at a time, with no cache and no coalescer — the per-client cost
+    the service amortizes. Reports cache hit rate, coalesce window
+    occupancy, and the storm_vs_serial headline.
+    """
+    import threading as _threading
+
+    from cometbft_tpu.crypto import coalesce as cco
+    from cometbft_tpu.libs import metrics as libmetrics
+    from cometbft_tpu.light import LightService, MemStore
+    from cometbft_tpu.light.client import Client, TrustOptions
+
+    if n_threads is None:
+        n_threads = _sz(256, 8)
+    if n_heights is None:
+        n_heights = _sz(10_000, 64)
+    per_thread = _sz(4, 2)  # verification requests per client thread
+    period_ns = 30 * 24 * 3600 * 1_000_000_000
+    now_ns = 1_700_000_000_000_000_000 + (n_heights + 2) * 1_000_000_000
+
+    provider = _LazyLightChain(n_heights)
+    rng = np.random.default_rng(14)
+    # request list: random trust gaps — most clients sync to the tip
+    # (the production shape), some to random interior heights
+    requests = []
+    for _ in range(n_threads * per_thread):
+        trust_h = int(rng.integers(1, n_heights // 2))
+        target = (
+            n_heights
+            if rng.random() < 0.8
+            else int(rng.integers(n_heights // 2, n_heights))
+        )
+        requests.append((trust_h, target))
+
+    # pre-sign every height the request list touches OUTSIDE both
+    # timed windows: the lazy chain's one-time commit signing is test
+    # fixture cost, and whichever run goes first would otherwise absorb
+    # it and bias storm_vs_serial
+    for trust_h, target in requests:
+        provider.light_block(trust_h)
+        provider.light_block(target)
+
+    # serial baseline: fresh standalone Client per request — no shared
+    # cache, no coalescer, the exact work one client pays alone
+    t0 = time.perf_counter()
+    for trust_h, target in requests:
+        root = provider.light_block(trust_h)
+        cl = Client(
+            chain_id=provider.chain_id(),
+            trust_options=TrustOptions(period_ns, trust_h, root.hash()),
+            primary=provider,
+            trusted_store=MemStore(),
+        )
+        lb = cl.verify_light_block_at_height(target, now_ns)
+        assert lb.height == target
+    serial_dt = time.perf_counter() - t0
+    serial_rps = len(requests) / serial_dt
+
+    svc = LightService(
+        provider,
+        provider.chain_id(),
+        trusting_period_ns=period_ns,
+        max_inflight=n_threads,
+        own_coalescer=True,
+        coalescer_device=device,
+    )
+    svc.start()
+    metrics = libmetrics.NodeMetrics()
+    libmetrics.push_node_metrics(metrics)
+    try:
+        barrier = _threading.Barrier(n_threads + 1)
+        fails: list = []
+
+        def worker(tid):
+            my = requests[tid * per_thread : (tid + 1) * per_thread]
+            barrier.wait()
+            for trust_h, target in my:
+                r = svc.verify_at_height(
+                    target, trust_height=trust_h, now_ns=now_ns
+                )
+                if int(r["height"]) != target:
+                    fails.append(tid)
+
+        threads = [
+            _threading.Thread(target=worker, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t1 = time.perf_counter()
+        for t in threads:
+            t.join()
+        storm_dt = time.perf_counter() - t1
+        assert not fails, f"storm verification failed on threads {fails[:5]}"
+        storm_rps = len(requests) / storm_dt
+        cache = svc.cache.stats()
+        lookups = cache["hits"] + cache["misses"] + cache["shared"]
+        co = svc._own_coalescer
+        lanes_hist = metrics.coalesce_window_lanes
+        windows = lanes_hist._n
+        lanes = lanes_hist._sum
+    finally:
+        libmetrics.pop_node_metrics(metrics)
+        svc.stop()
+    return {
+        "threads": n_threads,
+        "chain_heights": n_heights,
+        "requests": len(requests),
+        "serial_requests_per_sec": round(serial_rps, 1),
+        "storm_requests_per_sec": round(storm_rps, 1),
+        "storm_vs_serial": round(storm_rps / serial_rps, 2),
+        "cache_hit_rate": round(
+            (cache["hits"] + cache["shared"]) / max(1, lookups), 3
+        ),
+        "cache": cache,
+        "coalesce_windows": windows,
+        "coalesce_lanes": int(lanes),
+        "coalesce_lanes_per_window": round(lanes / max(1, windows), 2),
+        "coalesce_tickets": co.tickets if co else 0,
+        "coalesce_backend": (
+            "device" if co and co.device_windows else "host-window"
+        ),
+        "note": "identical request lists; serial = fresh standalone "
+        "Client per request (no cache/coalescer), storm = one shared "
+        "LightService",
+    }
+
+
 def _probe_device(timeout_s: float = 60.0, attempts: int = 3) -> bool:
     """Device liveness probe in a killable subprocess, with retries.
 
@@ -1651,6 +1871,21 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "13_health_overhead", "backend": "host",
                      "error": repr(e)[:200]})
+        light_row = None
+        try:
+            # device pinned off: no jit may touch the dead tunnel —
+            # the storm's coalesced windows run host MSMs
+            light_row = bench_light_storm(device=False)
+            _eprint(
+                {
+                    "config": "14_light_storm",
+                    "backend": "host",
+                    **light_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "14_light_storm", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -1678,6 +1913,15 @@ def main() -> None:
                     **(
                         {"health_overhead_pct": health_row["overhead_pct"]}
                         if health_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "light_storm_vs_serial": light_row[
+                                "storm_vs_serial"
+                            ]
+                        }
+                        if light_row
                         else {}
                     ),
                 }
@@ -1793,6 +2037,16 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "13_health_overhead", "error": repr(e)[:200]})
 
+    light_row = None
+    try:
+        # device=None probes the live backend: commits are 4-lane
+        # groups, so windows route by the measured crossover (typically
+        # host MSM) — the row reports which backend actually served
+        light_row = bench_light_storm()
+        _eprint({"config": "14_light_storm", **light_row})
+    except Exception as e:
+        _eprint({"config": "14_light_storm", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -1837,6 +2091,13 @@ def main() -> None:
                 **(
                     {"health_overhead_pct": health_row["overhead_pct"]}
                     if health_row
+                    else {}
+                ),
+                # many-client proof-service storm vs per-client serial
+                # verification (config 14_light_storm)
+                **(
+                    {"light_storm_vs_serial": light_row["storm_vs_serial"]}
+                    if light_row
                     else {}
                 ),
             }

@@ -145,6 +145,34 @@ ENV_KNOBS: dict[str, str] = {
         "minimum seconds between black-box bundles (default 60 — a "
         "flapping watchdog must not fill the disk; libs/health.py)"
     ),
+    "COMETBFT_TPU_LIGHT": (
+        "light-client proof service (light/service.py): 0 (default) | "
+        "1/on — the node serves light_verify/light_status over RPC, "
+        "funnelling concurrent clients' skipping-verification commit "
+        "checks through the shared batch verifiers and coalescer"
+    ),
+    "COMETBFT_TPU_LIGHT_MAX_INFLIGHT": (
+        "light-service requests verifying concurrently before new "
+        "arrivals queue (default 64; light/service.py)"
+    ),
+    "COMETBFT_TPU_LIGHT_MAX_QUEUE": (
+        "light-service requests allowed to wait for an in-flight slot; "
+        "arrivals beyond it are rejected immediately — the queue-depth "
+        "backpressure bound (default 256; light/service.py)"
+    ),
+    "COMETBFT_TPU_LIGHT_DEADLINE_S": (
+        "default per-request deadline in seconds for light_verify; "
+        "propagates into coalescer ticket waits and provider fetches "
+        "(default 10; light/service.py)"
+    ),
+    "COMETBFT_TPU_LIGHT_CACHE_SIZE": (
+        "commit-verification result-cache LRU bound in entries "
+        "(default 4096; light/service.py)"
+    ),
+    "COMETBFT_TPU_LIGHT_CACHE_TTL_S": (
+        "commit-verification result-cache TTL in seconds (default "
+        "600; light/service.py)"
+    ),
     "COMETBFT_TPU_ADAPTIVE_THRESHOLD": (
         "adaptive host/device batch crossover from measured timings: "
         "auto (default, accelerator-only) | 1 force | 0 static seed "

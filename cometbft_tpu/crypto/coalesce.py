@@ -52,6 +52,7 @@ never acquires an engine mutex (asserted by tests/test_lint_graph.py).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -107,6 +108,47 @@ _TRIP_COOLDOWN_S = 30.0
 
 class CoalescerStoppedError(ServiceError):
     """submit() after the drain began — callers fall back to host."""
+
+
+# -- per-request deadline propagation ---------------------------------------
+#
+# Request-scoped callers (the light-client proof service serves thousands
+# of concurrent RPC clients, each with its own deadline) wrap their work
+# in ``request_deadline``; every coalescer ticket wait on that thread is
+# then bounded by the REQUEST's remaining budget, not just the global
+# wedge bound. A deadline-capped timeout is the caller running out of
+# time, not evidence of a wedged executor — it must never trip the
+# breaker (that would unroute a healthy device for every other caller).
+
+_DEADLINE_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def request_deadline(deadline_monotonic: float):
+    """Bound every coalescer wait on this thread by a monotonic deadline.
+
+    Nested scopes tighten, never loosen: an inner deadline later than
+    the enclosing one is clamped to the outer budget.
+    """
+    prev = getattr(_DEADLINE_TLS, "deadline", None)
+    _DEADLINE_TLS.deadline = (
+        deadline_monotonic if prev is None else min(prev, deadline_monotonic)
+    )
+    try:
+        yield
+    finally:
+        _DEADLINE_TLS.deadline = prev
+
+
+def deadline_remaining() -> float | None:
+    """Seconds left in this thread's request deadline (None = unbounded).
+
+    May be negative once the deadline has passed — callers treat <= 0
+    as expired."""
+    d = getattr(_DEADLINE_TLS, "deadline", None)
+    if d is None:
+        return None
+    return d - time.monotonic()
 
 
 def _env_int(name: str, default: int) -> int:
@@ -269,9 +311,13 @@ class VerifyCoalescer(BaseService):
         # single-writer mirror, so an executor wedged mid-dispatch
         # cannot take these tickets beyond the rescues' reach
         self._staging: list[tuple] | None = None
-        # windows flushed / lanes coalesced, for tests and /debug dumps
+        # windows flushed / tickets accepted, for tests and /debug
+        # dumps: windows < tickets means at least one window carried
+        # lanes from more than one submitter — the sharing the module
+        # exists for
         self.windows = 0
         self.device_windows = 0
+        self.tickets = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -329,11 +375,31 @@ class VerifyCoalescer(BaseService):
         Raises :class:`CoalescerStoppedError` once the drain has begun
         (callers fall back to their unrouted verify).
         """
-        n = len(pubkeys)
-        ticket = _Ticket(n)
-        if n == 0:
-            ticket.resolve([])
-            return ticket
+        return self.submit_many([(pubkeys, msgs, sigs)])[0]
+
+    def submit_many(self, groups) -> list[_Ticket]:
+        """Batch-submit several lane groups as ONE queue transaction.
+
+        ``groups`` is a sequence of ``(pubkeys, msgs, sigs)`` triples;
+        returns one ticket per group, in order. All groups land in the
+        pending queue under a single mutex acquisition with a single
+        executor wake-up, so a multi-window caller (an oversized batch
+        chunked by :meth:`try_verify`, or the light service issuing a
+        whole commit's lanes) cannot interleave with other submitters
+        mid-batch — its chunks pack into consecutive windows. Raises
+        :class:`CoalescerStoppedError` once the drain has begun.
+        """
+        tickets: list[_Ticket] = []
+        staged: list[tuple] = []
+        for pks, ms, ss in groups:
+            t = _Ticket(len(pks))
+            tickets.append(t)
+            if t.n == 0:
+                t.resolve([])
+            else:
+                staged.append((t, pks, ms, ss))
+        if not staged:
+            return tickets
         with self._mtx:
             # the breaker gates ROUTING (active()/_claim_probe), not
             # direct submits: a tripped-but-alive executor still
@@ -341,47 +407,75 @@ class VerifyCoalescer(BaseService):
             # trip's host rescue, so accepted lanes never leak
             if self._draining or not self._accepting:
                 raise CoalescerStoppedError(self._name)
-            self._pending.append((ticket, pubkeys, msgs, sigs))
-            self._pending_lanes += n
+            for g in staged:
+                self._pending.append(g)
+                self._pending_lanes += g[0].n
+            self.tickets += len(staged)
             self._cv.notify_all()
-        return ticket
+        return tickets
 
     def try_verify(self, pubkeys, msgs, sigs) -> list[bool] | None:
         """submit + wait with a clean not-routed signal.
 
         Returns the per-lane bits, or None when the coalescer cannot
-        serve the request (stopped, oversized, ticket failed, wait
-        expired) — the caller then runs its unrouted path, so routing
-        through here never changes a verdict.
+        serve the request (stopped, ticket failed, wait expired) — the
+        caller then runs its unrouted path, so routing through here
+        never changes a verdict. Groups larger than one window are
+        chunked into ``max_lanes``-sized tickets submitted as one batch
+        (:meth:`submit_many`) and reassembled in order. Waits honor the
+        thread's :func:`request_deadline` budget when one is set; a
+        deadline-capped expiry returns None WITHOUT tripping the
+        breaker — the caller ran out of time, the executor is fine.
         """
-        if len(pubkeys) > self.max_lanes:
+        rem = deadline_remaining()
+        if rem is not None and rem <= 0:
             return None
         if not self._claim_probe():
             # breaker cooldown in force (or another caller holds the
             # half-open probe): fall back without queueing anything
             return None
+        n = len(pubkeys)
+        if n <= self.max_lanes:
+            groups = [(pubkeys, msgs, sigs)]
+        else:
+            groups = [
+                (pubkeys[i : i + self.max_lanes],
+                 msgs[i : i + self.max_lanes],
+                 sigs[i : i + self.max_lanes])
+                for i in range(0, n, self.max_lanes)
+            ]
         try:
-            ticket = self.submit(pubkeys, msgs, sigs)
+            tickets = self.submit_many(groups)
         except ServiceError:
             return None
-        try:
-            bits = ticket.result(_RESULT_TIMEOUT_S)
-            self._rearm()
-            return bits
-        except TimeoutError:
-            # A ticket outliving the result bound means the executor is
-            # wedged (dead tunnel, stuck dispatch) or a transient
-            # outlasted the bound. Trip the cooldown breaker so
-            # subsequent callers fall back to host instantly instead of
-            # each paying the full bound under engine mutexes — one
-            # wedged device must degrade throughput, not freeze
-            # consensus. Already-queued callers wait at most one more
-            # bound; stop()'s safety net still drains every ticket; a
-            # recovered device re-routes after the cooldown.
-            self._trip()
-            return None
-        except Exception:
-            return None
+        bits: list[bool] = []
+        for ticket in tickets:
+            wait_s = _RESULT_TIMEOUT_S
+            capped = False
+            rem = deadline_remaining()
+            if rem is not None and rem < wait_s:
+                wait_s, capped = max(rem, 0.0), True
+            try:
+                bits.extend(ticket.result(wait_s))
+            except TimeoutError:
+                # A ticket outliving the FULL result bound means the
+                # executor is wedged (dead tunnel, stuck dispatch) or a
+                # transient outlasted the bound. Trip the cooldown
+                # breaker so subsequent callers fall back to host
+                # instantly instead of each paying the full bound under
+                # engine mutexes — one wedged device must degrade
+                # throughput, not freeze consensus. Already-queued
+                # callers wait at most one more bound; stop()'s safety
+                # net still drains every ticket; a recovered device
+                # re-routes after the cooldown. A deadline-capped wait
+                # expiring is NOT executor evidence: no trip.
+                if not capped:
+                    self._trip()
+                return None
+            except Exception:
+                return None
+        self._rearm()
+        return bits
 
     def routable(self) -> bool:
         """Accepting submits and not inside a breaker cooldown (an

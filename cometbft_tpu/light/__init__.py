@@ -18,8 +18,17 @@ from .errors import (
     VerificationFailedError,
 )
 from .provider import Provider, StoreBackedProvider
-from .store import Store
+from .service import (
+    CachedCommitVerifier,
+    CommitResultCache,
+    DeadlineExceededError,
+    LightService,
+    ServiceBusyError,
+    ServiceStoppedError,
+)
+from .store import MemStore, Store
 from .verifier import (
+    CommitVerifier,
     header_expired,
     validate_trust_level,
     verify,
@@ -35,6 +44,14 @@ __all__ = [
     "Provider",
     "StoreBackedProvider",
     "Store",
+    "MemStore",
+    "LightService",
+    "CommitResultCache",
+    "CachedCommitVerifier",
+    "CommitVerifier",
+    "ServiceBusyError",
+    "ServiceStoppedError",
+    "DeadlineExceededError",
     "header_expired",
     "validate_trust_level",
     "verify",

@@ -65,6 +65,10 @@ class Client:
     max_clock_drift_ns: int = verifier.DEFAULT_MAX_CLOCK_DRIFT_NS
     # verification trace of the latest skipping run: fed to the detector
     latest_trace: list[LightBlock] = field(default_factory=list)
+    # pluggable commit-verification plane (light/verifier.CommitVerifier);
+    # None = the default batched verifiers. The proof service injects a
+    # caching/deadline-aware plane here — planes never change verdicts.
+    commit_verifier: object | None = None
 
     def __post_init__(self) -> None:
         verifier.validate_trust_level(self.trust_level)
@@ -92,10 +96,10 @@ class Client:
             )
         lb.validate_basic(self.chain_id)
         # 2/3 of the block's own validator set must have signed it
-        # (initializeWithTrustOptions, client.go:362-401).
-        from ..types.validation import verify_commit_light
-
-        verify_commit_light(
+        # (initializeWithTrustOptions, client.go:362-401) — through the
+        # plane, so the proof service's root checks cache/dedupe too.
+        cv = self.commit_verifier or verifier.DEFAULT_COMMIT_VERIFIER
+        cv.verify_commit_light(
             self.chain_id,
             lb.validator_set,
             lb.signed_header.commit.block_id,
@@ -187,6 +191,7 @@ class Client:
                 self.trust_options.period_ns,
                 now_ns,
                 self.max_clock_drift_ns,
+                self.commit_verifier,
             )
             self.trusted_store.save_light_block(target)
             self.latest_trace = [trusted, target]
@@ -206,6 +211,7 @@ class Client:
                     now_ns,
                     self.max_clock_drift_ns,
                     self.trust_level,
+                    self.commit_verifier,
                 )
             except NewValSetCantBeTrustedError:
                 # pivot deeper: fetch an intermediate block

@@ -3,9 +3,17 @@
 Fetches /commit + /validators from a full node's RPC and reconstructs the
 typed LightBlock. Paginates the validator set so 10k-validator chains
 (the BASELINE light-replay scale) work within the per_page cap.
+
+Transport faults are retried with exponential backoff under a per-call
+timeout: a slow or flapping witness must stall ONE fetch for at most
+``timeout * (retries + 1)`` plus the backoff sleeps, never the whole
+bisection (the reference's http provider carries the same
+timeout-per-request posture, provider/http/http.go).
 """
 
 from __future__ import annotations
+
+import time
 
 from ..rpc import decoding as dec
 from ..rpc.client import HTTPClient, RPCError
@@ -15,17 +23,54 @@ from .provider import Provider
 
 
 class RPCProvider(Provider):
-    def __init__(self, address: str, chain_id: str, timeout: float = 10.0):
+    def __init__(
+        self,
+        address: str,
+        chain_id: str,
+        timeout: float = 10.0,
+        retries: int = 2,
+        backoff_s: float = 0.25,
+    ):
         self._client = HTTPClient(address, timeout=timeout)
         self._chain_id = chain_id
+        self._retries = max(0, int(retries))
+        self._backoff_s = max(0.0, backoff_s)
 
     def chain_id(self) -> str:
         return self._chain_id
 
+    def _call(self, method: str, **params):
+        """RPC call with per-call timeout + retry-with-backoff.
+
+        An :class:`RPCError` is the NODE answering (method error, height
+        pruned, ...) — retrying cannot change it, so it propagates
+        immediately. Anything else (connect refused, socket timeout,
+        short read) is transport noise: retried ``retries`` times with
+        exponential backoff, then the last fault propagates for the
+        caller's provider-replacement logic.
+        """
+        last: Exception | None = None
+        for attempt in range(self._retries + 1):
+            try:
+                return self._client.call(method, **params)
+            except RPCError:
+                raise
+            except Exception as e:
+                last = e
+                if attempt < self._retries:
+                    self._sleep(self._backoff_s * (2 ** attempt))
+        raise last  # type: ignore[misc]
+
+    @staticmethod
+    def _sleep(seconds: float) -> None:
+        """Backoff between retries (split out so tests fake it)."""
+        if seconds > 0:
+            time.sleep(seconds)  # cometlint: disable=CLNT009 -- bounded retry backoff on a provider fetch: light-client bisection runs on RPC/service request threads, never under an engine mutex
+
     def light_block(self, height: int) -> LightBlock:
         params = {} if height == 0 else {"height": str(height)}
         try:
-            commit_res = self._client.call("commit", **params)
+            commit_res = self._call("commit", **params)
         except RPCError as e:
             raise LightBlockNotFoundError(height) from e
         sh_json = commit_res["signed_header"]
@@ -47,7 +92,7 @@ class RPCProvider(Provider):
         page = 1
         while True:
             try:
-                res = self._client.call(
+                res = self._call(
                     "validators",
                     height=str(height),
                     page=str(page),
@@ -70,7 +115,7 @@ class RPCProvider(Provider):
         from ..types import serialization as ser
 
         try:
-            self._client.call(
+            self._call(
                 "broadcast_evidence",
                 evidence=base64.b64encode(ser.dumps(ev)).decode(),
             )

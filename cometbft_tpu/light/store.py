@@ -105,3 +105,68 @@ class Store:
 
     def _bump_size(self, delta: int) -> None:
         self._db.set(_SIZE_KEY, b"%d" % (self._size() + delta))
+
+
+class MemStore:
+    """Ephemeral trusted-block store with the same contract as Store.
+
+    The light proof service (light/service.py) builds one per request:
+    each client verifies relative to ITS OWN trust root, so request
+    stores are short-lived and thrown away — paying the KV store's
+    serialization round trip (ser.dumps/loads per save and load) for
+    every bisection pivot of every request would dominate the service's
+    host cost. This keeps the typed LightBlock objects directly.
+    """
+
+    def __init__(self):
+        self._mtx = libsync.Mutex("light.store.MemStore._mtx")
+        self._blocks: dict[int, LightBlock] = {}
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        if lb.height <= 0:
+            raise ValueError("height must be positive")
+        with self._mtx:
+            self._blocks[lb.height] = lb
+
+    def delete_light_block(self, height: int) -> None:
+        if height <= 0:
+            raise ValueError("height must be positive")
+        with self._mtx:
+            self._blocks.pop(height, None)
+
+    def prune(self, size: int) -> None:
+        with self._mtx:
+            excess = len(self._blocks) - size
+            for h in sorted(self._blocks):
+                if excess <= 0:
+                    break
+                del self._blocks[h]
+                excess -= 1
+
+    def light_block(self, height: int) -> LightBlock:
+        if height <= 0:
+            raise ValueError("height must be positive")
+        with self._mtx:
+            lb = self._blocks.get(height)
+        if lb is None:
+            raise LightBlockNotFoundError(height)
+        return lb
+
+    def last_light_block_height(self) -> int:
+        with self._mtx:
+            return max(self._blocks) if self._blocks else -1
+
+    def first_light_block_height(self) -> int:
+        with self._mtx:
+            return min(self._blocks) if self._blocks else -1
+
+    def light_block_before(self, height: int) -> LightBlock:
+        with self._mtx:
+            below = [h for h in self._blocks if h < height]
+            if below:
+                return self._blocks[max(below)]
+        raise LightBlockNotFoundError(height)
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._blocks)

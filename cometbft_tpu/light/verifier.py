@@ -29,6 +29,34 @@ SECOND_NS = 1_000_000_000
 DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * SECOND_NS
 
 
+class CommitVerifier:
+    """Pluggable commit-verification plane for the light checks.
+
+    The default plane delegates straight to types/validation — i.e. the
+    batched commit verifiers (crypto/batch.create_commit_batch_verifier
+    under the hood: one device launch or one host MSM per commit, with
+    sub-crossover batches riding the cross-caller coalescer when one is
+    routed). light/service.py substitutes a caching + single-flight +
+    deadline-aware plane so thousands of concurrent proof requests
+    share one verification of each (height, valset, commit) triple.
+    Any plane MUST be verdict-identical to this default — planes may
+    dedupe or reroute the work, never change an answer.
+    """
+
+    def verify_commit_light(
+        self, chain_id, vals, block_id, height, commit
+    ) -> None:
+        verify_commit_light(chain_id, vals, block_id, height, commit)
+
+    def verify_commit_light_trusting(
+        self, chain_id, vals, commit, trust_level
+    ) -> None:
+        verify_commit_light_trusting(chain_id, vals, commit, trust_level)
+
+
+DEFAULT_COMMIT_VERIFIER = CommitVerifier()
+
+
 def validate_trust_level(lvl: Fraction) -> None:
     """Trust level must lie in [1/3, 1] (verifier.go:197-205)."""
     if (
@@ -83,8 +111,11 @@ def verify_adjacent(
     trusting_period_ns: int,
     now_ns: int,
     max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+    commit_verifier: CommitVerifier | None = None,
 ) -> None:
     """Hash-chain + 2/3 check for adjacent headers (verifier.go:93-132)."""
+    cv = commit_verifier if commit_verifier is not None \
+        else DEFAULT_COMMIT_VERIFIER
     if untrusted_header.height != trusted_header.height + 1:
         raise LightClientError("headers must be adjacent in height")
     if header_expired(trusted_header, trusting_period_ns, now_ns):
@@ -107,7 +138,7 @@ def verify_adjacent(
             "header"
         )
     try:
-        verify_commit_light(
+        cv.verify_commit_light(
             trusted_header.chain_id,
             untrusted_vals,
             untrusted_header.commit.block_id,
@@ -127,6 +158,7 @@ def verify_non_adjacent(
     now_ns: int,
     max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    commit_verifier: CommitVerifier | None = None,
 ) -> None:
     """Skipping verification (verifier.go:32-80): trust-level fraction of
     the TRUSTED set plus 2/3 of the NEW set must have signed.
@@ -134,6 +166,8 @@ def verify_non_adjacent(
     The order of the two commit checks matters: the trusted-set check runs
     first because untrusted_vals can be made arbitrarily large to DoS the
     client (verifier.go:69-72)."""
+    cv = commit_verifier if commit_verifier is not None \
+        else DEFAULT_COMMIT_VERIFIER
     if untrusted_header.height == trusted_header.height + 1:
         raise LightClientError("headers must be non adjacent in height")
     if header_expired(trusted_header, trusting_period_ns, now_ns):
@@ -149,7 +183,7 @@ def verify_non_adjacent(
         raise InvalidHeaderError(e) from e
 
     try:
-        verify_commit_light_trusting(
+        cv.verify_commit_light_trusting(
             trusted_header.chain_id,
             trusted_vals,
             untrusted_header.commit,
@@ -159,7 +193,7 @@ def verify_non_adjacent(
         raise NewValSetCantBeTrustedError(e) from e
 
     try:
-        verify_commit_light(
+        cv.verify_commit_light(
             trusted_header.chain_id,
             untrusted_vals,
             untrusted_header.commit.block_id,
@@ -179,17 +213,20 @@ def verify(
     now_ns: int,
     max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    commit_verifier: CommitVerifier | None = None,
 ) -> None:
     """Dispatch adjacent/non-adjacent (verifier.go:135-151)."""
     if untrusted_header.height != trusted_header.height + 1:
         verify_non_adjacent(
             trusted_header, trusted_vals, untrusted_header, untrusted_vals,
             trusting_period_ns, now_ns, max_clock_drift_ns, trust_level,
+            commit_verifier,
         )
     else:
         verify_adjacent(
             trusted_header, untrusted_header, untrusted_vals,
             trusting_period_ns, now_ns, max_clock_drift_ns,
+            commit_verifier,
         )
 
 

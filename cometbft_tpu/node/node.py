@@ -438,6 +438,13 @@ class Node(BaseService):
         # Health monitor (libs/health): started in _finish_start — the
         # always-on flight recorder + SLO watchdogs + black-box dumps.
         self.health_monitor = None
+        # Light-client proof service (light/service.py): serves
+        # light_verify/light_status over the RPC server, funnelling
+        # thousands of clients' skipping-verification commit checks
+        # through the shared verifiers (and the coalescer, when one is
+        # routed). Knob-gated (COMETBFT_TPU_LIGHT); started LAST in
+        # _finish_start with leak-safe unwind like the health monitor.
+        self.light_service = None
         self.switch.logger = self.logger.with_module("p2p")
         self.blocksync_reactor.logger = self.logger.with_module("blocksync")
         self.statesync_reactor.logger = self.logger.with_module("statesync")
@@ -739,16 +746,57 @@ class Node(BaseService):
                 # NotStartedError on a half-booted node, so on_stop
                 # never runs)
                 self.health_monitor = None
-                if self.prometheus_server is not None:
-                    from ..libs import devstats as libdevstats
+                self._unwind_late_boot()
+                raise
+        # Light-client proof service LAST, same leak-safety posture:
+        # everything it depends on (stores, RPC env, metrics, the
+        # routed coalescer) is already up, and a failure here unwinds
+        # the health monitor + exporter acquires that on_stop would
+        # never release on a half-booted node.
+        from ..light import service as light_service_mod
 
+        if light_service_mod.node_wants_light_service():
+            from ..light.provider import StoreBackedProvider
+
+            try:
+                self.light_service = light_service_mod.LightService(
+                    provider=StoreBackedProvider(
+                        self.block_store, self.state_store,
+                        self.genesis.chain_id,
+                    ),
+                    chain_id=self.genesis.chain_id,
+                    logger=self.logger.with_module("light"),
+                )
+                self.light_service.start()
+            except BaseException:
+                self.light_service = None
+                if self.health_monitor is not None:
                     try:
-                        if self.prometheus_server.is_running():
-                            self.prometheus_server.stop()
+                        if self.health_monitor.is_running():
+                            self.health_monitor.stop()
                     except Exception:
                         pass
-                    libdevstats.release()
+                    self.health_monitor = None
+                self._unwind_late_boot()
                 raise
+            self.rpc_env.extra["light_service"] = self.light_service
+            self.logger.with_module("light").info(
+                "light proof service serving light_verify/light_status"
+            )
+
+    def _unwind_late_boot(self) -> None:
+        """Release the Prometheus exporter's devstats acquire after a
+        late _finish_start failure (a half-booted node never runs
+        on_stop, so the unwind must happen at the failure site)."""
+        if self.prometheus_server is not None:
+            from ..libs import devstats as libdevstats
+
+            try:
+                if self.prometheus_server.is_running():
+                    self.prometheus_server.stop()
+            except Exception:
+                pass
+            libdevstats.release()
 
     def _forward_txs_available(self) -> None:
         ev = self.mempool.txs_available()
@@ -781,6 +829,16 @@ class Node(BaseService):
         if self.rpc_server is not None and self.rpc_server.is_running():
             try:
                 self.rpc_server.stop()
+            except Exception:
+                pass
+        # Light service right after the RPC listener: no new requests
+        # can arrive, queued waiters are rejected, and stop() drains
+        # every in-flight verification before the verifiers below it
+        # (coalescer, stores) unwind.
+        if getattr(self, "light_service", None) is not None:
+            try:
+                if self.light_service.is_running():
+                    self.light_service.stop()
             except Exception:
                 pass
         if self.pprof_server is not None and self.pprof_server.is_running():

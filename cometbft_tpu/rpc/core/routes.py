@@ -686,6 +686,79 @@ def broadcast_evidence(env, evidence=None) -> dict:
     return {"hash": ev.hash().hex().upper()}
 
 
+# ---------------------------------------------------------------------------
+# light-client proof service (light/service.py LightService)
+# ---------------------------------------------------------------------------
+
+
+def _light_service(env):
+    svc = env.extra.get("light_service")
+    if svc is None:
+        raise RPCError(
+            "light service is disabled (set COMETBFT_TPU_LIGHT=1)",
+            code=-32601,
+        )
+    return svc
+
+
+def light_verify(
+    env, height=None, trust_height=None, trust_hash=None, deadline=None
+) -> dict:
+    """Skipping-verification proof: verify the block at ``height``
+    relative to ``trust_height`` (the service's own root when omitted)
+    and return its verified identity + bisection trace. Backpressure
+    and deadline rejections map to distinct JSON-RPC error codes so
+    clients can tell "retry later" (-32005) from "took too long"
+    (-32004) from "bad request / failed verification"."""
+    from ...light import service as light_service_mod
+
+    svc = _light_service(env)
+    h = _int(height, "height")
+    if h is None or h <= 0:
+        raise RPCError("height must be a positive integer", code=-32602)
+    th = _int(trust_height, "trust_height")
+    raw_hash = None
+    if trust_hash is not None and trust_hash != "":
+        # hex string only: bytes(<int>) would silently mint a zeroed
+        # root and anything else belongs in a -32602, not a TypeError
+        if not isinstance(trust_hash, str):
+            raise RPCError("trust_hash must be a hex string", code=-32602)
+        try:
+            raw_hash = bytes.fromhex(trust_hash)
+        except ValueError:
+            raise RPCError("invalid trust_hash hex", code=-32602)
+    dl = None
+    if deadline is not None and deadline != "":
+        try:
+            dl = float(deadline)
+        except (TypeError, ValueError):
+            raise RPCError(f"invalid deadline: {deadline!r}", code=-32602)
+    try:
+        result = svc.verify_at_height(
+            h, trust_height=th, trust_hash=raw_hash, deadline_s=dl
+        )
+    except light_service_mod.DeadlineExceededError as e:
+        raise RPCError(str(e), code=-32004)
+    except (
+        light_service_mod.ServiceBusyError,
+        light_service_mod.ServiceStoppedError,
+    ) as e:
+        raise RPCError(str(e), code=-32005)
+    except Exception as e:
+        raise RPCError(f"light verification failed: {e}")
+    result["verified_heights"] = [
+        str(x) for x in result.get("verified_heights", [])
+    ]
+    return result
+
+
+def light_status(env) -> dict:
+    """Observability surface of the light proof service: admission
+    counters, cache occupancy/hit tallies, coalescer window counts."""
+    svc = _light_service(env)
+    return svc.status()
+
+
 def unsafe_flush_mempool(env) -> dict:
     """Drop every pending tx (rpc/core/mempool.go UnsafeFlushMempool;
     registered only with unsafe routes enabled)."""
@@ -759,6 +832,8 @@ ROUTES = {
     "broadcast_evidence": broadcast_evidence,
     "genesis_chunked": genesis_chunked,
     "header_by_hash": header_by_hash,
+    "light_verify": light_verify,
+    "light_status": light_status,
 }
 
 # Operator-only routes, merged in when config.rpc.unsafe is set

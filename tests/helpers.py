@@ -395,3 +395,112 @@ def make_light_chain(n_heights: int, n_vals: int = 4, rotate: int = 0,
 def _order_pvs(vs, pv_list):
     by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pv_list}
     return [by_addr[v.address] for v in vs.validators]
+
+
+class LazyLightChainProvider:
+    """Light-block provider over a VIRTUAL n-height chain.
+
+    Headers are hash-chained iteratively (cheap — no signing) but each
+    height's commit is signed only when that height is first fetched,
+    so a 10k-height chain costs ed25519 signatures only for the
+    handful of roots/targets/pivots a test or bench actually touches.
+    Constant validator set (the rotate=0 shape), deterministic keys —
+    two providers over the same parameters serve identical chains.
+    Thread-safe: the light service fetches from many request threads.
+    """
+
+    def __init__(self, n_heights: int, n_vals: int = 4,
+                 chain_id: str = CHAIN_ID, t0_ns: int | None = None):
+        import threading
+
+        from cometbft_tpu.types.block import Header, PartSetHeader, Version
+        from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+        self.n_heights = n_heights
+        self._chain_id = chain_id
+        self._t0 = (
+            t0_ns if t0_ns is not None else 1_700_000_000_000_000_000
+        )
+        pvs = [
+            MockPV(
+                Ed25519PrivKey.from_seed((9100 + i).to_bytes(2, "big") * 16)
+            )
+            for i in range(n_vals)
+        ]
+        self._vs = ValidatorSet(
+            [Validator(
+                address=bytes(pv.get_pub_key().address()),
+                pub_key=pv.get_pub_key(),
+                voting_power=10,
+            ) for pv in pvs]
+        )
+        self._pvs = _order_pvs(self._vs, pvs)
+        self._Header, self._PartSetHeader, self._Version = (
+            Header, PartSetHeader, Version,
+        )
+        self._lock = threading.Lock()
+        self._block_ids: list = [BlockID()]  # index h = block id OF h
+        self._blocks: dict[int, object] = {}
+        self.fetches = 0
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def _extend_headers(self, h: int):
+        """Grow the hash chain to height h; returns header h's fields.
+        Caller holds the lock."""
+        while len(self._block_ids) <= h:
+            hh = len(self._block_ids)
+            header = self._Header(
+                version=self._Version(block=11, app=1),
+                chain_id=self._chain_id,
+                height=hh,
+                time_ns=self._t0 + hh * 1_000_000_000,
+                last_block_id=self._block_ids[hh - 1],
+                last_commit_hash=b"\x01" * 32,
+                data_hash=b"\x02" * 32,
+                validators_hash=self._vs.hash(),
+                next_validators_hash=self._vs.hash(),
+                consensus_hash=b"\x03" * 32,
+                app_hash=b"\x04" * 32,
+                last_results_hash=b"\x05" * 32,
+                evidence_hash=b"\x06" * 32,
+                proposer_address=self._vs.validators[0].address,
+            )
+            self._block_ids.append(BlockID(
+                hash=header.hash(),
+                part_set_header=self._PartSetHeader(
+                    total=1, hash=b"\x07" * 32
+                ),
+            ))
+            self._blocks[hh] = header  # header only; commit signed lazily
+
+    def light_block(self, height: int):
+        from cometbft_tpu.light.errors import LightBlockNotFoundError
+        from cometbft_tpu.types.light_block import LightBlock, SignedHeader
+
+        if height == 0:
+            height = self.n_heights
+        if not 1 <= height <= self.n_heights:
+            raise LightBlockNotFoundError(height)
+        with self._lock:
+            self.fetches += 1
+            self._extend_headers(height)
+            cached = self._blocks[height]
+            if isinstance(cached, LightBlock):
+                return cached
+            header = cached
+            commit = sign_commit(
+                self._chain_id, self._vs, self._pvs, height, 0,
+                self._block_ids[height],
+                time_ns=self._t0 + height * 1_000_000_000,
+            )
+            lb = LightBlock(
+                signed_header=SignedHeader(header=header, commit=commit),
+                validator_set=self._vs,
+            )
+            self._blocks[height] = lb
+            return lb
+
+    def report_evidence(self, ev) -> None:
+        pass

@@ -535,6 +535,28 @@ class TestEngineWideGate:
         ]
         assert health_edges == [], health_edges
 
+    def test_light_cache_lock_registered_and_leaf(self, analysis):
+        """The light proof service's commit-result cache lock carries
+        the same contract as libs.trace._mtx: present in the shipped
+        artifact, participating in NO acquisition-order edges. The
+        cache sits on every proof request's commit-check path and its
+        bodies are pure dict bookkeeping BY DESIGN — the single-flight
+        leader verifies outside it, metrics are incremented outside it,
+        waiters block on a flight event outside it. An edge appearing
+        here means someone made a cache body take a lock (or a lock
+        holder enter the cache) and the thousands-of-clients hot path
+        grew a contention point."""
+        d = analysis.graph_dict()
+        assert "light.service._cache_mtx" in {
+            lk["name"] for lk in d["locks"]
+        }
+        cache_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "light.service._cache_mtx" in (e["from"], e["to"])
+        ]
+        assert cache_edges == [], cache_edges
+
     def test_devstats_lock_registered_and_leaf(self, analysis):
         """libs/devstats' compile-ledger mutex has the same contract as
         the tracer's: present in the shipped artifact, edge-free. The
