@@ -1455,6 +1455,153 @@ def bench_health_overhead(n_heights: int | None = None):
     }
 
 
+def bench_net_propagation(n_heights: int | None = None):
+    """Config 15: per-phase gossip propagation over a real TCP net.
+
+    Boots FOUR full nodes (real sockets, real reactors, provenance
+    stamps negotiated at handshake) in one process, commits a burst of
+    heights, and reports one-hop propagation quantiles per consensus
+    phase (proposal/prevote/precommit/commit, from the
+    ``p2p_propagation_seconds{phase}`` histogram the stamps feed) plus
+    the peak send-queue depth any peer's channel reached — the baseline
+    the thousand-validator scenario harness will be judged against.
+    In-process nodes share one clock, so the stamp wall hints carry no
+    skew and the quantiles are true one-hop latencies.
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from cometbft_tpu.config import default_config
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.libs import metrics as libmetrics
+    from cometbft_tpu.libs import netstats as libnetstats
+    from cometbft_tpu.node import Node, init_files
+    from cometbft_tpu.types import GenesisDoc, GenesisValidator, MockPV
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+    if n_heights is None:
+        n_heights = _sz(8, 2)
+
+    def net_config(home):
+        cfg = default_config()
+        cfg.base.home = home
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=800 * 1_000_000,
+            timeout_propose_delta_ns=100 * 1_000_000,
+            timeout_prevote_ns=400 * 1_000_000,
+            timeout_prevote_delta_ns=100 * 1_000_000,
+            timeout_precommit_ns=400 * 1_000_000,
+            timeout_precommit_delta_ns=100 * 1_000_000,
+            timeout_commit_ns=200 * 1_000_000,
+            skip_timeout_commit=True,
+            peer_gossip_sleep_duration_ns=20 * 1_000_000,
+        )
+        return cfg
+
+    pvs = [
+        MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
+        for i in range(4)
+    ]
+    doc = GenesisDoc(
+        chain_id="bench-netprop",
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=pv.get_pub_key(), power=10)
+            for pv in pvs
+        ],
+    )
+    doc.validate_and_complete()
+
+    tmp = tempfile.mkdtemp(prefix="bench-netprop-")
+    libnetstats.reset()
+    nodes = []
+    peak_depth = 0
+    drops = 0
+    stamped = 0
+    try:
+        for i, pv in enumerate(pvs):
+            cfg = net_config(f"{tmp}/node{i}")
+            init_files(cfg)
+            nodes.append(Node(cfg, doc, pv))
+        nodes[0].start()
+        seed_addr = (
+            f"{nodes[0].node_key.node_id}@"
+            f"{nodes[0].transport.listen_addr[len('tcp://'):]}"
+        )
+        for node in nodes[1:]:
+            node.config.p2p.persistent_peers = seed_addr
+            node.start()
+        # observations land on the node-metrics stack top = the node
+        # started LAST; its histogram aggregates every stamped hop it
+        # receives (the other nodes' hops land on... the same top, so
+        # the quantiles cover the whole net)
+        m = libmetrics.node_metrics()
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if all(n.block_store.height() >= n_heights for n in nodes):
+                break
+            time.sleep(0.05)
+        wall_s = time.perf_counter() - t0
+        heights = min(n.block_store.height() for n in nodes)
+        if heights < 1:
+            raise RuntimeError("net never committed a height")
+        # harvest BEFORE stopping: connection stats deregister on stop
+        snap = libnetstats.snapshot()
+        for peer in snap["peers"]:
+            for row in peer["channels"]:
+                if int(row["chID"], 16) in libnetstats.CONSENSUS_CHANNELS:
+                    peak_depth = max(peak_depth, row["queue_highwater"])
+                    drops += row["send_queue_full"]
+            stamped = max(stamped, peer["stamp"]["rx_seq"])
+        phases = {}
+        for phase in ("proposal", "block_part", "prevote", "precommit",
+                      "commit", "tx"):
+            h = m.p2p_propagation.labels(phase)
+            if h._n == 0:
+                continue
+            phases[phase] = {
+                "count": h._n,
+                "mean_ms": round(h._sum / h._n * 1e3, 3),
+                "p50_ms": round(
+                    libhealth.histogram_quantile(h, 0.50) * 1e3, 3
+                ),
+                "p99_ms": round(
+                    libhealth.histogram_quantile(h, 0.99) * 1e3, 3
+                ),
+            }
+    finally:
+        for node in nodes:
+            try:
+                if node.is_running():
+                    node.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+    for required in ("proposal", "prevote", "precommit"):
+        if required not in phases:
+            raise RuntimeError(
+                f"no stamped {required} propagation observed: {phases}"
+            )
+    return {
+        "validators": 4,
+        "heights": heights,
+        "wall_s": round(wall_s, 2),
+        "stamped_msgs_max_seq": stamped,
+        "propagation_ms": phases,
+        "peak_send_queue_depth": peak_depth,
+        "send_queue_full_total": drops,
+        "gossip_lag_p99_ms": round(snap["gossip_lag_p99_s"] * 1e3, 3),
+        "note": "real TCP p2p, provenance stamps negotiated at "
+        "handshake; quantiles are promql-style bucket upper bounds "
+        "from p2p_propagation_seconds on the shared in-process clock",
+    }
+
+
 class _LazyLightChain:
     """Light-block provider over a virtual H-height chain (bench twin of
     tests/helpers.LazyLightChainProvider): headers hash-chain
@@ -1886,6 +2033,20 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "14_light_storm", "backend": "host",
                      "error": repr(e)[:200]})
+        net_row = None
+        try:
+            # pure host/TCP workload: no device dependence at all
+            net_row = bench_net_propagation()
+            _eprint(
+                {
+                    "config": "15_net_propagation",
+                    "backend": "host",
+                    **net_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "15_net_propagation", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -1922,6 +2083,15 @@ def main() -> None:
                             ]
                         }
                         if light_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "net_prevote_prop_p50_ms": net_row[
+                                "propagation_ms"
+                            ]["prevote"]["p50_ms"]
+                        }
+                        if net_row
                         else {}
                     ),
                 }
@@ -2047,6 +2217,15 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "14_light_storm", "error": repr(e)[:200]})
 
+    net_row = None
+    try:
+        # real-TCP 4-validator burst: per-phase gossip propagation
+        # quantiles + peak send-queue depth (the large-N harness baseline)
+        net_row = bench_net_propagation()
+        _eprint({"config": "15_net_propagation", **net_row})
+    except Exception as e:
+        _eprint({"config": "15_net_propagation", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -2098,6 +2277,17 @@ def main() -> None:
                 **(
                     {"light_storm_vs_serial": light_row["storm_vs_serial"]}
                     if light_row
+                    else {}
+                ),
+                # one-hop prevote gossip latency over real TCP
+                # (config 15_net_propagation)
+                **(
+                    {
+                        "net_prevote_prop_p50_ms": net_row[
+                            "propagation_ms"
+                        ]["prevote"]["p50_ms"]
+                    }
+                    if net_row
                     else {}
                 ),
             }

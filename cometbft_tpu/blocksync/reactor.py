@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..libs import netstats as libnetstats
 from ..libs import trace as libtrace
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
 from ..types import serialization as ser
@@ -139,6 +140,8 @@ class BlocksyncReactor(Reactor):
                 ser.dumps(BlockResponseMessage(block=block, ext_commit=ext)),
             )
         elif isinstance(msg, BlockResponseMessage):
+            # one-hop serve latency of a synced block (provenance stamp)
+            libnetstats.observe_propagation("block", msg.block.header.height)
             self.pool.add_block(
                 peer.id, msg.block, msg.ext_commit, size=len(msg_bytes)
             )

@@ -415,6 +415,7 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
             ("locks.json", "/debug/locks"),
             ("devstats.json", "/debug/devstats"),
             ("health.json", "/debug/health"),
+            ("net.json", "/debug/net"),
             ("trace.json", "/debug/trace"),
         ):
             try:

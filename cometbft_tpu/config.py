@@ -173,6 +173,26 @@ ENV_KNOBS: dict[str, str] = {
         "commit-verification result-cache TTL in seconds (default "
         "600; light/service.py)"
     ),
+    "COMETBFT_TPU_NET": (
+        "network-plane telemetry (libs/netstats): auto (default — on "
+        "while a node runs, refcounted like devstats/health) | 1 "
+        "force-on process-wide | 0 off (per-peer/per-channel stats, "
+        "queue gauges, gossip-lag SLI all dark; the disabled path is "
+        "allocation-free)"
+    ),
+    "COMETBFT_TPU_NET_STAMP": (
+        "provenance stamping of p2p messages (libs/netstats): 1 "
+        "(default — the node advertises the netstamp capability and "
+        "stamps toward peers that advertise it back) | 0 withdraws "
+        "the advertisement; wire compat with unstamped peers is "
+        "negotiated, never sniffed"
+    ),
+    "COMETBFT_TPU_NET_TOPK": (
+        "peers exported with their own p2p_peer_rate_bytes{peer} "
+        "label value, ranked by traffic, before aggregating into "
+        "'other' (default 8 — bounds scrape cardinality; "
+        "libs/netstats.py)"
+    ),
     "COMETBFT_TPU_ADAPTIVE_THRESHOLD": (
         "adaptive host/device batch crossover from measured timings: "
         "auto (default, accelerator-only) | 1 force | 0 static seed "

@@ -16,6 +16,7 @@ from ..libs import sync as libsync
 import time
 
 from ..libs import log as _log
+from ..libs import netstats as libnetstats
 from ..libs.bits import BitArray
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
 from ..types import canonical
@@ -349,8 +350,19 @@ class ConsensusReactor(Reactor):
             return
         if ch_id == STATE_CHANNEL:
             if isinstance(msg, NewRoundStepMessage):
+                if msg.step == int(RoundStep.COMMIT):
+                    # the peer's step broadcast entering COMMIT is the
+                    # reliable per-height commit announcement (the
+                    # NewValidBlock is_commit path below only fires on
+                    # catch-up edges) — the commit leg of the
+                    # proposal→prevote→precommit→commit chain
+                    libnetstats.observe_propagation("commit", msg.height)
                 ps.apply_new_round_step(msg)
             elif isinstance(msg, NewValidBlockMessage):
+                if msg.is_commit:
+                    # the peer announced a committed block: the commit
+                    # leg of the proposal→…→commit propagation chain
+                    libnetstats.observe_propagation("commit", msg.height)
                 ps.apply_new_valid_block(msg)
             elif isinstance(msg, HasVoteMessage):
                 ps.set_has_vote(
@@ -363,6 +375,9 @@ class ConsensusReactor(Reactor):
             if self.wait_sync:
                 return
             if isinstance(msg, ProposalMessage):
+                libnetstats.observe_propagation(
+                    "proposal", msg.proposal.height
+                )
                 ps.set_has_proposal(msg.proposal)
                 self.cs.set_proposal_from_peer(msg.proposal, peer.id)
             elif isinstance(msg, ProposalPOLMessage):
@@ -371,6 +386,7 @@ class ConsensusReactor(Reactor):
                         ps.proposal_pol_round = msg.proposal_pol_round
                         ps.proposal_pol = msg.proposal_pol
             elif isinstance(msg, BlockPartMessage):
+                libnetstats.observe_propagation("block_part", msg.height)
                 ps.set_has_block_part(msg.height, msg.round, msg.part.index)
                 self.cs.add_block_part_from_peer(
                     msg.height, msg.round, msg.part, peer.id
@@ -379,6 +395,12 @@ class ConsensusReactor(Reactor):
             if self.wait_sync:
                 return
             if isinstance(msg, VoteMessage):
+                libnetstats.observe_propagation(
+                    "prevote"
+                    if msg.vote.msg_type == canonical.PREVOTE_TYPE
+                    else "precommit",
+                    msg.vote.height,
+                )
                 rs = self.cs.get_round_state()
                 ps.set_has_vote(
                     msg.vote.height, msg.vote.round, msg.vote.msg_type,

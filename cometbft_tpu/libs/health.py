@@ -70,6 +70,7 @@ import time
 from array import array
 
 from . import metrics as libmetrics
+from . import netstats as libnetstats
 from . import sync as libsync
 from . import trace as libtrace
 from .service import BaseService
@@ -107,6 +108,7 @@ EV_BREAKER = 5  # a=1 trip / 0 re-arm (crypto/coalesce half-open breaker)
 EV_RECOMPILE = 6  # a=shape bucket (libs/devstats steady-state recompile)
 EV_FSYNC = 7  # a=WAL fsync ns
 EV_WATCHDOG = 8  # a=watchdog bit (see _WATCHDOGS)
+EV_GOSSIP = 9  # a=propagation phase code (netstats.PHASE_NAMES), b=lag ns
 
 _N_CODES = 16  # size of the per-code last-seen vector
 
@@ -119,6 +121,7 @@ _CODE_NAMES = {
     EV_RECOMPILE: "xla.recompile",
     EV_FSYNC: "wal.fsync",
     EV_WATCHDOG: "health.watchdog",
+    EV_GOSSIP: "p2p.gossip",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -130,6 +133,7 @@ _CODE_FIELDS = {
     EV_RECOMPILE: ("bucket", None),
     EV_FSYNC: ("dur_ns", None),
     EV_WATCHDOG: ("watchdog", None),
+    EV_GOSSIP: ("phase", "lag_ns"),
 }
 
 _STEP_NAMES = {
@@ -142,7 +146,12 @@ _WATCHDOGS = (
     ("consensus_stall", 1),
     ("verify_breaker", 2),
     ("recompile_storm", 4),
+    ("send_queue_saturated", 8),
 )
+# send_queue_saturated: this many CONSECUTIVE checks each observing
+# fresh MConnection.send drops on a consensus channel = sustained
+# backpressure (a one-off burst drop re-baselines without a trip)
+SATURATION_STREAK = 3
 _WATCHDOG_NAMES = {bit: name for name, bit in _WATCHDOGS}
 
 _ON_VALUES = ("1", "on", "true", "yes")
@@ -263,6 +272,10 @@ class FlightRecorder:
                 rec["step_name"] = _STEP_NAMES.get(self._a[i], "?")
             elif code == EV_WATCHDOG:
                 rec["watchdog_name"] = _WATCHDOG_NAMES.get(self._a[i], "?")
+            elif code == EV_GOSSIP:
+                rec["phase_name"] = libnetstats.PHASE_NAMES.get(
+                    self._a[i], "?"
+                )
             out.append(rec)
         return out
 
@@ -442,6 +455,14 @@ _ST_BREAKER_SEEN = 3  # breaker notices already converted to trips
 _ST_STORM_TRIP_T = 4  # last storm trip (monotonic; drives storm_active)
 _ST_LAST_BUNDLE = 5  # last bundle write (monotonic; rate limit)
 _ST_STALLED = 6  # 1.0 while the stall detector considers us stalled
+# the saturation watchdog's counters live in a separate int vector
+# (``_qfull``: [drops already seen, consecutive-fresh-drop streak]) —
+# keeping them out of the float ``_st`` array matters: float temporaries
+# land on CPython's float free-list, which tracemalloc counts as LIVE
+# blocks attributed to the arithmetic line, tripping the pinned
+# allocation-free guard whenever an earlier test perturbed the free-list
+_QF_SEEN = 0
+_QF_STREAK = 1
 
 
 class HealthMonitor(BaseService):
@@ -463,6 +484,7 @@ class HealthMonitor(BaseService):
         bundle_keep: int = DEFAULT_BUNDLE_KEEP,
         storm_recompiles: int = STORM_RECOMPILES,
         storm_window_s: float = STORM_WINDOW_S,
+        saturation_streak: int = SATURATION_STREAK,
         interval_s: float | None = None,
         trace_tail: int = 512,
         idle_ok=None,
@@ -493,6 +515,7 @@ class HealthMonitor(BaseService):
         )
         self.storm_recompiles = storm_recompiles
         self.storm_window_s = storm_window_s
+        self.saturation_streak = max(1, saturation_streak)
         self.interval_s = (
             interval_s
             if interval_s is not None
@@ -510,6 +533,9 @@ class HealthMonitor(BaseService):
         self._st[_ST_STORM_T0] = now
         self._st[_ST_STORM_BASE] = float(self._recompile_total())
         self._st[_ST_BREAKER_SEEN] = float(_BREAKER_NOTICES[0])
+        # drops that predate this monitor must not count toward a streak
+        self._qfull = array("q", [0, 0])
+        self._qfull[_QF_SEEN] = libnetstats.consensus_queue_full_total()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -608,6 +634,21 @@ class HealthMonitor(BaseService):
             st[_ST_STORM_TRIP_T] = now
             st[_ST_STORM_T0] = now
             st[_ST_STORM_BASE] = float(cur)
+        # -- saturated consensus send queue: MConnection.send drops on
+        # a consensus channel in SATURATION_STREAK consecutive checks —
+        # a full queue that stays full is a peer that stopped draining
+        # (or a reactor wedged behind it), not a burst (int-only math:
+        # see the _qfull vector comment above)
+        qf = self._qfull
+        qfull = libnetstats.consensus_queue_full_total()
+        if qfull > qf[_QF_SEEN]:
+            qf[_QF_STREAK] += 1
+            if qf[_QF_STREAK] >= self.saturation_streak:
+                mask |= 8
+                qf[_QF_STREAK] = 0
+        else:
+            qf[_QF_STREAK] = 0
+        qf[_QF_SEEN] = qfull
         return mask
 
     def stalled(self) -> bool:
@@ -759,6 +800,10 @@ def write_bundle(
         },
     )
     try:
+        save("net.json", libnetstats.snapshot())
+    except Exception as e:
+        save("net.json.err", repr(e))
+    try:
         from . import pprof as libpprof
 
         save("threads.txt", libpprof.thread_dump())
@@ -835,6 +880,8 @@ def sample(metrics=None) -> dict:
     m.health_breaker_open.set(1.0 if breaker_open else 0.0)
     if s["step_age_s"] is not None:
         m.health_stall_seconds.set(s["step_age_s"])
+    gossip_lag = libnetstats.gossip_lag_s()
+    m.health_gossip_lag.set(gossip_lag)
     # composite score: 1.0 healthy; a stall zeroes it (liveness lost);
     # an open breaker or an active recompile storm each cost 0.3
     # (degraded but live) — documented in docs/observability.md
@@ -854,6 +901,7 @@ def sample(metrics=None) -> dict:
         "breaker_open": breaker_open,
         "recompile_storm": storm,
         "verify_wait_p99_s": wait_p99,
+        "gossip_lag_p99_s": round(gossip_lag, 6),
         **s,
     }
 

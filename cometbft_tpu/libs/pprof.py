@@ -140,6 +140,7 @@ class PprofServer(HTTPService):
                 "/debug/locks\n"
                 "/debug/devstats         device/XLA telemetry (JSON)\n"
                 "/debug/health           flight-recorder SLIs + watchdogs (JSON)\n"
+                "/debug/net              per-peer/per-channel p2p telemetry (JSON)\n"
                 "/debug/trace            span-tracer ring dump\n"
                 "/debug/trace/start?file=PATH\n"
                 "/debug/trace/stop\n"
@@ -188,6 +189,11 @@ class PprofServer(HTTPService):
                 tail=int(q.get("tail", ["100"])[0])
             )
 
+        def net_dump(q):
+            from . import netstats as libnetstats
+
+            return libnetstats.debug_net_json()
+
         def trace_dump(q):
             from . import trace as libtrace
 
@@ -233,6 +239,7 @@ class PprofServer(HTTPService):
             "/debug/locks": locks,
             "/debug/devstats": devstats_dump,
             "/debug/health": health_dump,
+            "/debug/net": net_dump,
             "/debug/trace": trace_dump,
             "/debug/trace/start": trace_start,
             "/debug/trace/stop": trace_stop,

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import threading
 
+from ..libs import netstats as libnetstats
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
 from .clist_mempool import CListMempool, MempoolError
 
@@ -39,6 +40,10 @@ class MempoolReactor(Reactor):
         ).start()
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        # tx gossip rides the stamped mempool channel: one-hop lag is
+        # attributed under phase="tx" (raw tx payloads are safe to
+        # stamp because stamping is negotiated, never sniffed)
+        libnetstats.observe_propagation("tx")
         try:
             self.mempool.check_tx(msg_bytes, sender=peer.id)
         except MempoolError:

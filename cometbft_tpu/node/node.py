@@ -256,6 +256,8 @@ class Node(BaseService):
         # RPC/p2p while staying protocol-compatible.
         from ..state.state import SOFTWARE_VERSION
 
+        from ..libs import netstats as libnetstats
+
         self.node_info = NodeInfo(
             node_id=self.node_key.node_id,
             listen_addr="",
@@ -263,6 +265,15 @@ class Node(BaseService):
             moniker=config.base.moniker,
             version=os.environ.get(
                 "COMETBFT_TPU_SOFTWARE_VERSION", SOFTWARE_VERSION
+            ),
+            # advertise the provenance-stamp capability: messages are
+            # stamped only toward peers that advertise it back, so an
+            # unstamped peer sees byte-identical wire traffic
+            # (COMETBFT_TPU_NET_STAMP=0 withdraws the advertisement)
+            other=(
+                {libnetstats.NODEINFO_STAMP_KEY: 1}
+                if libnetstats.stamping_wanted()
+                else {}
             ),
         )
         self.transport = MultiplexTransport(
@@ -508,6 +519,11 @@ class Node(BaseService):
         from ..libs import health as libhealth
 
         libhealth.sample(self.metrics)
+        # network-plane gauges: per-channel queue depth/high-watermark,
+        # top-K peer rates (lock-free connection snapshot)
+        from ..libs import netstats as libnetstats
+
+        libnetstats.sample(self.metrics)
         out, inb = self.switch.num_peers()
         self.metrics.peers.set(out + inb)
         self.metrics.mempool_size.set(self.mempool.size())
@@ -614,47 +630,62 @@ class Node(BaseService):
     def on_start(self) -> None:
         # boot order (node.go:364): pprof → RPC → transport listen → switch
         # (starts reactors, which start consensus) → dial persistent peers
-        if self.pprof_server is not None:
-            self.pprof_server.start()
-            self.logger.with_module("pprof").info(
-                "pprof server listening", port=self.pprof_server.bound_port
-            )
-        if self.rpc_server is not None:
-            self.rpc_server.start()
-            self.logger.with_module("rpc").info(
-                "RPC server listening", addr=self.rpc_server.bound_addr
-            )
-        self.transport.listen(self.config.p2p.laddr)
-        self.logger.with_module("p2p").info(
-            "p2p transport listening", addr=self.transport.listen_addr
-        )
-        self.node_info.listen_addr = self.transport.listen_addr
-        # The verify coalescer starts after every other fallible boot
-        # step but before the switch (which starts consensus), so the
-        # very first admitted votes coalesce and an earlier boot
-        # failure — pprof/RPC/listen — can't leak a routed coalescer
-        # that Node.stop() (NotStartedError) would never unwind. "auto"
-        # starts one only when an accelerator backend is live, so
-        # host-only deployments keep their unrouted paths untouched.
-        from ..crypto import coalesce as crypto_coalesce
+        #
+        # Network-plane telemetry first (refcounted like devstats /
+        # health; COMETBFT_TPU_NET=0 pins it off): it must be live
+        # before the switch accepts the first connection, and the boot
+        # unwind below releases it on any failure.
+        from ..libs import netstats as libnetstats
 
-        if crypto_coalesce.node_wants_coalescer():
-            self.verify_coalescer = crypto_coalesce.VerifyCoalescer(
-                logger=self.logger.with_module("coalesce")
-            )
-            self.verify_coalescer.start()
-            crypto_coalesce.push_active(self.verify_coalescer)
+        libnetstats.acquire()
         try:
-            self._finish_start()
+            if self.pprof_server is not None:
+                self.pprof_server.start()
+                self.logger.with_module("pprof").info(
+                    "pprof server listening",
+                    port=self.pprof_server.bound_port,
+                )
+            if self.rpc_server is not None:
+                self.rpc_server.start()
+                self.logger.with_module("rpc").info(
+                    "RPC server listening", addr=self.rpc_server.bound_addr
+                )
+            self.transport.listen(self.config.p2p.laddr)
+            self.logger.with_module("p2p").info(
+                "p2p transport listening", addr=self.transport.listen_addr
+            )
+            self.node_info.listen_addr = self.transport.listen_addr
+            # The verify coalescer starts after every other fallible boot
+            # step but before the switch (which starts consensus), so the
+            # very first admitted votes coalesce and an earlier boot
+            # failure — pprof/RPC/listen — can't leak a routed coalescer
+            # that Node.stop() (NotStartedError) would never unwind. "auto"
+            # starts one only when an accelerator backend is live, so
+            # host-only deployments keep their unrouted paths untouched.
+            from ..crypto import coalesce as crypto_coalesce
+
+            if crypto_coalesce.node_wants_coalescer():
+                self.verify_coalescer = crypto_coalesce.VerifyCoalescer(
+                    logger=self.logger.with_module("coalesce")
+                )
+                self.verify_coalescer.start()
+                crypto_coalesce.push_active(self.verify_coalescer)
+            try:
+                self._finish_start()
+            except BaseException:
+                # a failed boot leaves _started unset, so Node.stop() would
+                # raise NotStartedError and on_stop would never unroute the
+                # coalescer — unwind it here or the orphan stays atop the
+                # process-wide routing stack with its executor running
+                if self.verify_coalescer is not None:
+                    crypto_coalesce.pop_active(self.verify_coalescer)
+                    self.verify_coalescer.stop()
+                    self.verify_coalescer = None
+                raise
         except BaseException:
-            # a failed boot leaves _started unset, so Node.stop() would
-            # raise NotStartedError and on_stop would never unroute the
-            # coalescer — unwind it here or the orphan stays atop the
-            # process-wide routing stack with its executor running
-            if self.verify_coalescer is not None:
-                crypto_coalesce.pop_active(self.verify_coalescer)
-                self.verify_coalescer.stop()
-                self.verify_coalescer = None
+            # ANY boot failure: release the netstats acquire (on_stop
+            # never runs on a half-booted node)
+            libnetstats.release()
             raise
 
     def _finish_start(self) -> None:
@@ -867,6 +898,11 @@ class Node(BaseService):
                     svc.stop()
             except Exception:
                 pass
+        # after the switch (its peers deregister their stats blocks on
+        # connection stop): release this node's netstats acquire
+        from ..libs import netstats as libnetstats
+
+        libnetstats.release()
         # Coalescer after consensus is down: unroute first (new callers
         # fall back to host instantly), then drain — stop() resolves
         # every pending ticket, so no verifier thread is left hanging.

@@ -16,6 +16,7 @@ from __future__ import annotations
 import struct
 
 from ...libs import metrics as libmetrics
+from ...libs import netstats as libnetstats
 from ...libs import trace as libtrace
 import threading
 from ...libs import sync as libsync
@@ -115,8 +116,11 @@ class MConnection(BaseService):
         on_receive,  # f(channel_id, msg_bytes)
         on_error,  # f(exception)
         config: MConnConfig | None = None,
+        peer_id: str = "",
+        outbound: bool = False,
+        logger=None,
     ):
-        super().__init__("mconnection")
+        super().__init__("mconnection", logger)
         self.conn = conn
         self.config = config or MConnConfig()
         self.channels = {d.id: _Channel(d) for d in channels}
@@ -131,10 +135,28 @@ class MConnection(BaseService):
         self._recv_ctr = {
             d.id: m.p2p_recv_bytes.labels(f"{d.id:#04x}") for d in channels
         }
+        self._msg_send_ctr = {
+            d.id: m.p2p_msgs_sent.labels(f"{d.id:#04x}") for d in channels
+        }
+        self._msg_recv_ctr = {
+            d.id: m.p2p_msgs_recv.labels(f"{d.id:#04x}") for d in channels
+        }
+        self._drop_ctr = {
+            d.id: m.p2p_send_queue_full.labels(f"{d.id:#04x}")
+            for d in channels
+        }
         self.on_receive = on_receive
         self.on_error = on_error
         self.send_monitor = Monitor()
         self.recv_monitor = Monitor()
+        # Per-peer/per-channel stats block (libs/netstats): constructed
+        # unconditionally (setup path, not hot), registered for the
+        # connection's lifetime in on_start; the per-packet record
+        # calls below are one enabled() flag check when the layer is
+        # off.
+        self.stats = libnetstats.ConnStats(
+            peer_id, [d.id for d in channels], self, outbound=outbound
+        )
         self._send_signal = threading.Event()
         self._pong_pending = threading.Event()
         self._last_pong = time.monotonic()
@@ -144,14 +166,47 @@ class MConnection(BaseService):
 
     def send(self, ch_id: int, msg: bytes, timeout: float = 10.0) -> bool:
         """Queue a message; blocks up to ``timeout`` when the channel queue
-        is full (connection.go Send)."""
+        is full (connection.go Send).  A timeout is a DROP the caller
+        must handle — it is logged, counted in
+        ``p2p_send_queue_full_total{chID}`` and trace-attributed, never
+        a silent False."""
         ch = self.channels.get(ch_id)
         if ch is None or not self.is_running():
             return False
         ok = ch.enqueue(msg, timeout)
         if ok:
             self._send_signal.set()
+            if libnetstats.enabled():
+                self.stats.note_depth(
+                    self.stats.slots[ch_id], len(ch._queue)
+                )
+        else:
+            self._note_drop(ch_id, len(msg), timeout)
         return ok
+
+    def _note_drop(self, ch_id: int, nbytes: int, timeout: float) -> None:
+        """Account one send() timeout on a full bounded queue."""
+        ctr = self._drop_ctr.get(ch_id)
+        if ctr is not None:
+            ctr.inc()
+        if libnetstats.enabled():
+            self.stats.note_queue_full(self.stats.slots[ch_id])
+        if libtrace.enabled():
+            libtrace.event(
+                "p2p.drop",
+                ch=ch_id,
+                bytes=nbytes,
+                timeout_s=timeout,
+                peer=self.stats.peer_id,
+            )
+        if self.logger is not None:
+            self.logger.debug(
+                "send queue full; message dropped",
+                ch=f"{ch_id:#04x}",
+                bytes=nbytes,
+                peer=self.stats.peer_id,
+                timeout_s=timeout,
+            )
 
     def try_send(self, ch_id: int, msg: bytes) -> bool:
         ch = self.channels.get(ch_id)
@@ -160,12 +215,22 @@ class MConnection(BaseService):
         ok = ch.try_enqueue(msg)
         if ok:
             self._send_signal.set()
+            if libnetstats.enabled():
+                self.stats.note_depth(
+                    self.stats.slots[ch_id], len(ch._queue)
+                )
+        elif libnetstats.enabled():
+            # an immediate-full miss is normal backpressure (broadcast
+            # paths retry) — tallied per channel, surfaced in
+            # /debug/net, not in the drop counter
+            self.stats.note_try_full(self.stats.slots[ch_id])
         return ok
 
     # -- lifecycle ---------------------------------------------------------
 
     def on_start(self) -> None:
         self._last_pong = time.monotonic()
+        libnetstats.register(self.stats)
         threading.Thread(
             target=self._send_routine, name="mconn-send", daemon=True
         ).start()
@@ -174,6 +239,7 @@ class MConnection(BaseService):
         ).start()
 
     def on_stop(self) -> None:
+        libnetstats.deregister(self.stats)
         self._send_signal.set()
         try:
             self.conn.close()
@@ -262,6 +328,12 @@ class MConnection(BaseService):
         )
         self.send_monitor.update(len(chunk) + 5)
         self._send_ctr[best.desc.id].inc(len(chunk) + 5)
+        if eof:
+            self._msg_send_ctr[best.desc.id].inc()
+        if libnetstats.enabled():
+            self.stats.note_sent(
+                self.stats.slots[best.desc.id], len(chunk) + 5, eof
+            )
         if libtrace.enabled():
             libtrace.event(
                 "p2p.send", ch=best.desc.id, bytes=len(chunk) + 5, eof=eof
@@ -303,6 +375,10 @@ class MConnection(BaseService):
                 ctr = self._recv_ctr.get(ch_id)
                 if ctr is not None:
                     ctr.inc(length + 5)
+                if libnetstats.enabled():
+                    slot = self.stats.slots.get(ch_id)
+                    if slot is not None:
+                        self.stats.note_recv_bytes(slot, length + 5)
                 self.recv_monitor.limit(length + 5, self.config.recv_rate)
                 self.recv_monitor.update(length + 5)
                 ch = self.channels.get(ch_id)
@@ -315,6 +391,9 @@ class MConnection(BaseService):
                     )
                 if eof:
                     msg, ch.recving = ch.recving, b""
+                    self._msg_recv_ctr[ch_id].inc()
+                    if libnetstats.enabled():
+                        self.stats.note_recv_msg(self.stats.slots[ch_id])
                     if libtrace.enabled():
                         libtrace.event(
                             "p2p.recv", ch=ch_id, bytes=len(msg)

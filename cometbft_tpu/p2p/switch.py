@@ -180,8 +180,19 @@ class Switch(BaseService):
             persistent=persistent,
             socket_addr=up.socket_addr,
             mconn_config=self.mconn_config,
+            # our side of the provenance-stamp negotiation + the origin
+            # id stamped onto outbound messages (libs/netstats)
+            our_node_info=self.transport.node_info,
+            logger=self.logger,
         )
         with self._peers_mtx:
+            # A handshake that completed as (or after) on_stop snapshotted
+            # the peer table would admit a peer nobody ever stops — its
+            # connection (and netstats block) would outlive the switch.
+            # stop() flips is_running() BEFORE on_stop runs, so peers in
+            # the table at snapshot time are exactly the peers stopped.
+            if not self.is_running():
+                raise SwitchError("switch is stopping")
             if peer.id in self._peers:
                 raise SwitchError(f"duplicate peer {peer.id[:10]}")
             self._peers[peer.id] = peer

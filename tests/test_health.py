@@ -281,6 +281,98 @@ class TestWatchdogUnits:
                 devstats._compiled.pop(("syn.health", 8), None)
                 devstats._jit_sizes.pop("syn.health", None)
 
+    def test_send_queue_saturation_needs_a_sustained_streak(self, health):
+        """The saturated-send-queue watchdog: fresh MConnection.send
+        drops on a consensus channel in SATURATION_STREAK consecutive
+        checks trip it; a one-off burst drop re-baselines quietly."""
+        from cometbft_tpu.libs import netstats as libnetstats
+
+        libnetstats.enable()
+        stats = libnetstats.ConnStats("satpeer", [0x22, 0x30])
+        libnetstats.register(stats)
+        try:
+            mon = self._monitor(saturation_streak=3)
+            assert mon._check() == 0
+            # one burst of drops, then silence: streak resets, no trip
+            stats.note_queue_full(stats.slots[0x22])
+            assert mon._check() == 0  # streak 1
+            assert mon._check() == 0  # no fresh drops -> reset
+            # sustained: fresh drops on three consecutive checks
+            for i in range(2):
+                stats.note_queue_full(stats.slots[0x22])
+                assert mon._check() == 0, i  # streak 1, 2
+            stats.note_queue_full(stats.slots[0x22])
+            assert mon._check() & 8  # streak 3 -> trip
+            # the streak restarts after a trip
+            assert mon._check() == 0
+            # drops on a NON-consensus channel never count
+            mon2 = self._monitor(saturation_streak=1)
+            stats.note_queue_full(stats.slots[0x30])
+            assert mon2._check() == 0
+        finally:
+            libnetstats.deregister(stats)
+            libnetstats.disable()
+            libnetstats.reset()
+
+    def test_gossip_event_decodes_with_phase_name(self, health):
+        from cometbft_tpu.libs import netstats as libnetstats
+
+        libhealth.record(
+            libhealth.EV_GOSSIP, 12,
+            a=libnetstats.PHASE_CODES["prevote"], b=1_500_000,
+        )
+        evs = [
+            e for e in libhealth.recorder().dump()
+            if e["event"] == "p2p.gossip"
+        ]
+        assert evs == [
+            {
+                "ts": evs[0]["ts"],
+                "event": "p2p.gossip",
+                "height": 12,
+                "round": 0,
+                "phase": libnetstats.PHASE_CODES["prevote"],
+                "lag_ns": 1_500_000,
+                "phase_name": "prevote",
+            }
+        ]
+
+    def test_observe_propagation_feeds_ring_histogram_and_sli(
+        self, health
+    ):
+        """netstats.observe_propagation is the one fan-out point: the
+        parked stamp becomes a histogram observation, an EV_GOSSIP
+        ring event, and a gossip-lag sample the SLI engine reads."""
+        from cometbft_tpu.libs import netstats as libnetstats
+
+        libnetstats.enable()
+        libnetstats.reset()
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        try:
+            wall = time.time_ns() - 2_000_000  # stamped 2 ms ago
+            libnetstats.set_current_stamp(("aabbccdd" * 2, 5, wall))
+            libnetstats.observe_propagation("proposal", 9)
+            libnetstats.clear_current_stamp()
+            # unstamped dispatch: no observation
+            libnetstats.observe_propagation("proposal", 10)
+            h = m.p2p_propagation.labels("proposal")
+            assert h._n == 1 and 0.001 < h._sum < 1.0
+            evs = [
+                e for e in libhealth.recorder().dump()
+                if e["event"] == "p2p.gossip"
+            ]
+            assert len(evs) == 1 and evs[0]["height"] == 9
+            assert evs[0]["phase_name"] == "proposal"
+            assert libnetstats.gossip_lag_s() > 0.0
+            out = libhealth.sample(m)
+            assert out["gossip_lag_p99_s"] > 0.0
+            assert m.health_gossip_lag.value() > 0.0
+        finally:
+            libmetrics.pop_node_metrics(m)
+            libnetstats.disable()
+            libnetstats.reset()
+
     def test_trips_count_and_ring_events(self, health):
         m = NodeMetrics()
         mon = self._monitor(metrics=m)
@@ -329,8 +421,13 @@ class TestWatchdogUnits:
         names = set(os.listdir(path))
         assert {
             "manifest.json", "flight.json", "devstats.json",
-            "locks.json", "threads.txt", "trace.json",
+            "locks.json", "net.json", "threads.txt", "trace.json",
         } <= names, names
+        net = json.load(open(os.path.join(path, "net.json")))
+        assert set(net) >= {
+            "enabled", "stamping", "peers", "gossip_lag_p99_s",
+            "consensus_send_queue_full",
+        }
         flight = json.load(open(os.path.join(path, "flight.json")))
         assert any(
             e["event"] == "consensus.commit" for e in flight["events"]
@@ -456,12 +553,18 @@ class TestHealthyBurst:
             for cs, _ in nodes:
                 cs.start()
             mon.start()
-            store = nodes[0][1]["block_store"]
+            stores = [parts["block_store"] for _, parts in nodes]
             deadline = time.monotonic() + 120
-            while store.height() < 3 and time.monotonic() < deadline:
+            # EVERY node must reach height 3: the assertion below counts
+            # 3 commits x 4 nodes in the ring, and stopping as soon as
+            # ONE node commits h3 races the laggards' commit events
+            while (
+                min(s.height() for s in stores) < 3
+                and time.monotonic() < deadline
+            ):
                 scores.append(libhealth.sample(m)["score"])
                 time.sleep(0.05)
-            assert store.height() >= 3
+            assert min(s.height() for s in stores) >= 3
         finally:
             try:
                 mon.stop()
@@ -481,6 +584,7 @@ class TestHealthyBurst:
             "consensus_stall": 0,
             "verify_breaker": 0,
             "recompile_storm": 0,
+            "send_queue_saturated": 0,
         }
         assert mon.bundles == 0
         # monotone non-degraded health: every sample along the way AND

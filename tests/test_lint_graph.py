@@ -557,6 +557,23 @@ class TestEngineWideGate:
         ]
         assert cache_edges == [], cache_edges
 
+    def test_netstats_lock_registered_and_leaf(self, analysis):
+        """libs/netstats' connection-registry mutex carries the same
+        contract as the tracer's: present in the shipped artifact,
+        participating in NO acquisition-order edges. The per-packet
+        record path is lock-free BY DESIGN (single-writer array
+        columns inside the wire routines; registration happens only at
+        connection start/stop) — an edge appearing here means someone
+        made the packet path take a lock."""
+        d = analysis.graph_dict()
+        assert "libs.netstats._mtx" in {lk["name"] for lk in d["locks"]}
+        net_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "libs.netstats._mtx" in (e["from"], e["to"])
+        ]
+        assert net_edges == [], net_edges
+
     def test_devstats_lock_registered_and_leaf(self, analysis):
         """libs/devstats' compile-ledger mutex has the same contract as
         the tracer's: present in the shipped artifact, edge-free. The

@@ -157,6 +157,89 @@ def test_mconnection_peer_death_triggers_error():
             m.stop()
 
 
+def test_mconnection_send_timeout_is_counted_logged_and_traced():
+    """A send() timeout on a full bounded queue is never a silent False:
+    it counts in p2p_send_queue_full_total{chID}, the per-connection
+    stats block, and emits a p2p.drop trace event (ISSUE 8 satellite —
+    drops must be attributable)."""
+    from cometbft_tpu.libs import metrics as libmetrics
+    from cometbft_tpu.libs import netstats as libnetstats
+    from cometbft_tpu.libs import trace as libtrace
+
+    class WedgedConn:
+        """write blocks forever (a peer that stopped draining);
+        read blocks forever (no inbound traffic)."""
+
+        def __init__(self):
+            self._never = threading.Event()
+
+        def write(self, data):
+            self._never.wait()
+
+        def read(self, n):
+            self._never.wait()
+            return b""
+
+        def close(self):
+            self._never.set()
+
+    m = libmetrics.NodeMetrics()
+    libmetrics.push_node_metrics(m)
+    libnetstats.enable()
+    libtrace.reset()
+    libtrace.enable()
+    ch = 0x22
+    conn = MConnection(
+        WedgedConn(),
+        [ChannelDescriptor(id=ch, send_queue_capacity=1)],
+        lambda c, msg: None,
+        lambda e: None,
+        peer_id="wedgedpeer",
+    )
+    conn.start()
+    try:
+        # first message: picked up by the send routine, wedged in write;
+        # second fills the 1-slot queue; third must time out
+        assert conn.send(ch, b"in-flight", timeout=5.0)
+        assert _wait_for(
+            lambda: conn.channels[ch].sending is not None
+            or len(conn.channels[ch]._queue) == 0
+        )
+        assert conn.send(ch, b"queued", timeout=5.0)
+        t0 = time.monotonic()
+        assert not conn.send(ch, b"dropped", timeout=0.1)
+        assert time.monotonic() - t0 < 3.0  # timed out, didn't hang
+        lbl = f"{ch:#04x}"
+        assert m.p2p_send_queue_full.labels(lbl).value() == 1
+        slot = conn.stats.slots[ch]
+        assert conn.stats._cols[4][slot] == 1  # _C_QUEUE_FULL
+        # the drop feeds the saturated-send-queue watchdog's aggregate
+        # (0x22 is a consensus channel; the conn registered at start)
+        assert libnetstats.consensus_queue_full_total() == 1
+        drops = [
+            e for e in libtrace.ring_dump() if e["name"] == "p2p.drop"
+        ]
+        assert len(drops) == 1
+        assert drops[0]["ch"] == ch
+        assert drops[0]["bytes"] == len(b"dropped")
+        assert drops[0]["peer"] == "wedgedpeer"
+        # try_send full is tallied separately (backpressure, not a drop)
+        assert not conn.try_send(ch, b"try-miss")
+        assert conn.stats._cols[5][slot] == 1  # _C_TRY_FULL
+        assert m.p2p_send_queue_full.labels(lbl).value() == 1  # unchanged
+    finally:
+        try:
+            conn.stop()
+        except Exception:
+            pass
+        libtrace.disable()
+        libtrace.reset()
+        libnetstats.disable()
+        libmetrics.pop_node_metrics(m)
+    # stop deregistered the stats block: the watchdog aggregate drops
+    assert libnetstats.consensus_queue_full_total() == 0
+
+
 # -- transport + switch ----------------------------------------------------
 
 
