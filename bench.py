@@ -1861,6 +1861,102 @@ def _probe_device(timeout_s: float = 60.0, attempts: int = 3) -> bool:
     return False
 
 
+def bench_fault_matrix(n_heights: int | None = None):
+    """Config 16: commit latency + rounds-per-height across a fault grid
+    on the deterministic simnet plane (cometbft_tpu/simnet).
+
+    Each cell is a 4-validator net under one fault mix — clean links,
+    20ms latency with jitter, 5%/10% drop, and a mid-run partition/heal
+    cycle — run to the same height from the same seed, so the grid is
+    bit-reproducible and cross-round comparable: quantiles are VIRTUAL
+    time (the protocol's cost under that fault), wall_s is what the
+    simulation itself cost.  Pure host workload; runs identically on
+    dead-tunnel rounds.
+    """
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.simnet import LinkConfig, SimNet
+    from cometbft_tpu.simnet.scenarios import commit_metrics
+
+    import dataclasses
+
+    from cometbft_tpu.config import test_config
+
+    if n_heights is None:
+        n_heights = _sz(6, 3)
+    ms = 1_000_000
+    # one config for every cell, with timeouts sized to tolerate the
+    # grid's worst link latency: rounds-per-height then measures the
+    # FAULTS (drops, partitions), not a timeout-vs-RTT mismatch
+    cfg = test_config()
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=150 * ms,
+        timeout_propose_delta_ns=50 * ms,
+        timeout_prevote_ns=80 * ms,
+        timeout_prevote_delta_ns=40 * ms,
+        timeout_precommit_ns=80 * ms,
+        timeout_precommit_delta_ns=40 * ms,
+        timeout_commit_ns=20 * ms,
+    )
+    cells = [
+        ("clean", LinkConfig(), None),
+        (
+            "lat20_jit10",
+            LinkConfig(latency_ns=20 * ms, jitter_ns=10 * ms),
+            None,
+        ),
+        ("drop05", LinkConfig(drop_p=0.05, jitter_ns=3 * ms), None),
+        (
+            "drop10_lat20",
+            LinkConfig(
+                drop_p=0.10, latency_ns=20 * ms, jitter_ns=10 * ms
+            ),
+            None,
+        ),
+        ("partition_heal", LinkConfig(), "partition"),
+    ]
+    t0 = time.perf_counter()
+    grid = {}
+    for name, link, special in cells:
+        was_enabled = libhealth.enabled()
+        libhealth.reset()
+        libhealth.enable()
+        net = SimNet(4, seed=16, config=cfg, default_link=link)
+        try:
+            net.start()
+            if special == "partition":
+                net.run_until_height(2, max_virtual_ms=60_000)
+                net.partition([0, 1], [2, 3])
+                net.run(max_virtual_ms=1_500)
+                net.heal()
+            ok = net.run_until_height(n_heights, max_virtual_ms=600_000)
+            net.assert_no_fork()
+            m = commit_metrics()
+            grid[name] = {
+                "ok": ok,
+                "virtual_ms": round(net.clock.now_ns / 1e6, 1),
+                "events": net._events_run,
+                "dropped": net.stats.get("dropped", 0),
+                "commit_ms_p50": m["commit_ms"]["p50"],
+                "commit_ms_p99": m["commit_ms"]["p99"],
+                "rounds_mean": m["rounds_per_height"]["mean"],
+                "rounds_p99": m["rounds_per_height"]["p99"],
+            }
+        finally:
+            net.stop()
+            if not was_enabled:
+                libhealth.disable()
+    return {
+        "n_nodes": 4,
+        "heights": n_heights,
+        "seed": 16,
+        "grid": grid,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "note": "virtual-time quantiles from the seeded simnet; the "
+        "same (seed, grid) reproduces identical numbers",
+    }
+
+
 def main() -> None:
     _pin_cpu_if_requested()
     if not _probe_device():
@@ -2047,6 +2143,20 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "15_net_propagation", "backend": "host",
                      "error": repr(e)[:200]})
+        fault_row = None
+        try:
+            # deterministic simnet grid: no sockets, no device
+            fault_row = bench_fault_matrix()
+            _eprint(
+                {
+                    "config": "16_fault_matrix",
+                    "backend": "host",
+                    **fault_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "16_fault_matrix", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -2092,6 +2202,15 @@ def main() -> None:
                             ]["prevote"]["p50_ms"]
                         }
                         if net_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "fault_drop05_commit_p50_ms": fault_row[
+                                "grid"
+                            ]["drop05"]["commit_ms_p50"]
+                        }
+                        if fault_row
                         else {}
                     ),
                 }
@@ -2226,6 +2345,16 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "15_net_propagation", "error": repr(e)[:200]})
 
+    fault_row = None
+    try:
+        # deterministic simnet fault grid (host-only; same numbers with
+        # or without a chip — recorded in the device round for the
+        # round-over-round trend)
+        fault_row = bench_fault_matrix()
+        _eprint({"config": "16_fault_matrix", **fault_row})
+    except Exception as e:
+        _eprint({"config": "16_fault_matrix", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -2288,6 +2417,17 @@ def main() -> None:
                         ]["prevote"]["p50_ms"]
                     }
                     if net_row
+                    else {}
+                ),
+                # virtual-time commit latency under 5% message loss on
+                # the deterministic simnet (config 16_fault_matrix)
+                **(
+                    {
+                        "fault_drop05_commit_p50_ms": fault_row[
+                            "grid"
+                        ]["drop05"]["commit_ms_p50"]
+                    }
+                    if fault_row
                     else {}
                 ),
             }

@@ -30,13 +30,15 @@ class _Peer:
         self.recv_monitor = None  # armed while requests are pending
         self.monitor_start = 0.0
 
-    def arm_monitor(self) -> None:
+    def arm_monitor(self, now: float) -> None:
         """(Re)start rate tracking when pending goes 0 -> 1
-        (pool.go resetMonitor)."""
+        (pool.go resetMonitor). ``now`` comes from the pool's clock so
+        the grace window stays on ONE timeline (the simnet drives the
+        pool on virtual time)."""
         from ..libs.flowrate import Monitor
 
         self.recv_monitor = Monitor(window=5.0)
-        self.monitor_start = time.monotonic()
+        self.monitor_start = now
 
 
 class _Requester:
@@ -51,12 +53,15 @@ class _Requester:
 
 class BlockPool:
     def __init__(self, start_height: int, send_request, on_peer_error=None,
-                 min_recv_rate: int | None = None):
+                 min_recv_rate: int | None = None, now_fn=None):
         """``send_request(height, peer_id)`` dispatches a BlockRequest;
         ``on_peer_error(peer_id, reason)`` reports misbehaving peers.
         ``min_recv_rate``: B/s floor for peers with pending requests
-        (0 disables; default MIN_RECV_RATE)."""
+        (0 disables; default MIN_RECV_RATE). ``now_fn``: monotonic
+        seconds source for request timeouts (the simnet passes its
+        virtual clock; default wall clock)."""
         self._mtx = libsync.RLock("blocksync.pool._mtx")
+        self._now = now_fn if now_fn is not None else time.monotonic
         self.height = start_height  # next height to apply
         self.send_request = send_request
         self.on_peer_error = on_peer_error or (lambda pid, r: None)
@@ -134,7 +139,7 @@ class BlockPool:
         with self._mtx:
             if not self._running:
                 return
-            self._evict_slow_peers(time.monotonic())
+            self._evict_slow_peers(self._now())
             for h in range(self.height, self.height + REQUEST_WINDOW):
                 if self.max_peer_height and h > self.max_peer_height:
                     break
@@ -144,7 +149,7 @@ class BlockPool:
                     self.requesters[h] = r
                 if r.block is not None:
                     continue
-                now = time.monotonic()
+                now = self._now()
                 if r.peer_id is not None:
                     if now - r.request_time < REQUEST_TIMEOUT:
                         continue
@@ -165,7 +170,7 @@ class BlockPool:
                 r.request_time = now
                 peer.num_pending += 1
                 if peer.num_pending == 1:
-                    peer.arm_monitor()
+                    peer.arm_monitor(now)
                 self.send_request(h, peer.id)
 
     # -- block ingest ------------------------------------------------------

@@ -39,6 +39,7 @@ class BlocksyncReactor(Reactor):
         block_sync: bool,
         consensus_reactor=None,  # for switch_to_consensus
         min_recv_rate: int | None = None,
+        now_fn=None,
     ):
         super().__init__("blocksync-reactor")
         self.initial_state = state
@@ -48,14 +49,26 @@ class BlocksyncReactor(Reactor):
         self.block_sync = block_sync
         self.consensus_reactor = consensus_reactor
         self.min_recv_rate = min_recv_rate
+        # monotonic-seconds source for the pool loop's status/timeout
+        # cadence; the simnet substitutes its virtual clock and drives
+        # _pool_step from its scheduler instead of the pool thread
+        self._now = now_fn if now_fn is not None else time.monotonic
+        self.sim_driven = False
         self.pool = BlockPool(
             block_store.height() + 1,
             send_request=self._send_block_request,
             on_peer_error=self._on_pool_peer_error,
             min_recv_rate=min_recv_rate,
+            now_fn=now_fn,
         )
         self.synced = threading.Event()
         self._n_synced = 0
+        # _pool_step cadence state (locals of the reference's
+        # poolRoutine; -inf = the first step broadcasts/checks
+        # immediately on ANY clock, including the sim clock at t~0)
+        self._last_status = float("-inf")
+        self._last_switch_check = float("-inf")
+        self._caught_up_since: float | None = None
         if not block_sync:
             self.synced.set()
 
@@ -70,7 +83,7 @@ class BlocksyncReactor(Reactor):
         ]
 
     def on_start(self) -> None:
-        if self.block_sync:
+        if self.block_sync and not self.sim_driven:
             threading.Thread(
                 target=self._pool_routine, name="blocksync-pool", daemon=True
             ).start()
@@ -82,17 +95,22 @@ class BlocksyncReactor(Reactor):
         self.state = state
         self.block_sync = True
         self.synced.clear()
+        self._last_status = float("-inf")
+        self._last_switch_check = float("-inf")
+        self._caught_up_since = None
         self.pool = BlockPool(
             state.last_block_height + 1,
             send_request=self._send_block_request,
             on_peer_error=self._on_pool_peer_error,
             min_recv_rate=self.min_recv_rate,
+            now_fn=None if self._now is time.monotonic else self._now,
         )
         # re-announce status so peers learn we now need blocks
         self._broadcast_status_request()
-        threading.Thread(
-            target=self._pool_routine, name="blocksync-pool", daemon=True
-        ).start()
+        if not self.sim_driven:
+            threading.Thread(
+                target=self._pool_routine, name="blocksync-pool", daemon=True
+            ).start()
 
     # -- peer lifecycle ----------------------------------------------------
 
@@ -174,41 +192,54 @@ class BlocksyncReactor(Reactor):
 
     # -- the sync loop (reactor.go:272 poolRoutine) ------------------------
 
+    # _pool_step outcomes
+    STEP_IDLE = 0  # nothing applied; caller may sleep a beat
+    STEP_APPLIED = 1  # a block landed; step again immediately
+    STEP_SWITCHED = 2  # handed off to consensus; the loop is done
+
     def _pool_routine(self) -> None:
-        last_status = 0.0
-        last_switch_check = 0.0
-        caught_up_since = None
         while not self.quit_event().is_set():
-            now = time.monotonic()
-            if now - last_status > STATUS_INTERVAL:
-                self._broadcast_status_request()
-                last_status = now
-            self.pool.make_requests()
+            outcome = self._pool_step(self._now())
+            if outcome == self.STEP_SWITCHED:
+                return
+            if outcome == self.STEP_IDLE:
+                time.sleep(0.05)
 
-            # Try to verify+apply the next block.
-            first, first_ext, second = self.pool.peek_two_blocks()
-            if first is not None and second is not None:
-                try:
-                    self._apply_first(first, first_ext, second)
-                except Exception:
-                    import traceback
+    def _pool_step(self, now: float) -> int:
+        """One iteration of the sync loop (also the simnet tick: the
+        scheduler calls it with virtual ``now``)."""
+        if now - self._last_status > STATUS_INTERVAL:
+            self._broadcast_status_request()
+            self._last_status = now
+        self.pool.make_requests()
 
-                    traceback.print_exc()
-                    raise  # local apply failure: fail-stop (reference panics)
-                continue
+        # Try to verify+apply the next block.
+        first, first_ext, second = self.pool.peek_two_blocks()
+        if first is not None and second is not None:
+            try:
+                self._apply_first(first, first_ext, second)
+            except Exception:
+                import traceback
 
-            # Caught up? Need a stable signal before switching.
-            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
-                last_switch_check = now
-                if self.pool.is_caught_up():
-                    if caught_up_since is None:
-                        caught_up_since = now
-                    elif now - caught_up_since > SWITCH_TO_CONSENSUS_INTERVAL:
-                        self._switch_to_consensus()
-                        return
-                else:
-                    caught_up_since = None
-            time.sleep(0.05)
+                traceback.print_exc()
+                raise  # local apply failure: fail-stop (reference panics)
+            return self.STEP_APPLIED
+
+        # Caught up? Need a stable signal before switching.
+        if now - self._last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+            self._last_switch_check = now
+            if self.pool.is_caught_up():
+                if self._caught_up_since is None:
+                    self._caught_up_since = now
+                elif (
+                    now - self._caught_up_since
+                    > SWITCH_TO_CONSENSUS_INTERVAL
+                ):
+                    self._switch_to_consensus()
+                    return self.STEP_SWITCHED
+            else:
+                self._caught_up_since = None
+        return self.STEP_IDLE
 
     def _apply_first(self, first, first_ext, second) -> None:
         """reactor.go:447: first's validity is proven by second.LastCommit."""

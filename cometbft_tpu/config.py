@@ -193,6 +193,16 @@ ENV_KNOBS: dict[str, str] = {
         "'other' (default 8 — bounds scrape cardinality; "
         "libs/netstats.py)"
     ),
+    "COMETBFT_TPU_SIMNET_SEED": (
+        "default schedule seed for simnet scenario runs (`python -m "
+        "cometbft_tpu.simnet`, e2e --simnet); a run's seed replays it "
+        "bit-identically (cometbft_tpu/simnet)"
+    ),
+    "COMETBFT_TPU_SIMNET_LOG": (
+        "1 prints every simnet fault event (partitions, drops, churn, "
+        "crash points) to stderr as it fires — scenario debugging "
+        "(cometbft_tpu/simnet/net.py)"
+    ),
     "COMETBFT_TPU_ADAPTIVE_THRESHOLD": (
         "adaptive host/device batch crossover from measured timings: "
         "auto (default, accelerator-only) | 1 force | 0 static seed "

@@ -52,9 +52,15 @@ VOTE_SET_BITS_CHANNEL = 0x23
 
 
 class PeerState:
-    """Mirror of a peer's round state (reactor.go PeerState)."""
+    """Mirror of a peer's round state (reactor.go PeerState).
 
-    def __init__(self):
+    ``rng`` seeds the vote-pick draw: the simnet plane injects a
+    per-peer child rng so gossip schedules are reproducible from one
+    seed; the default (module ``random``) keeps live-net behavior.
+    """
+
+    def __init__(self, rng=None):
+        self._rng = rng if rng is not None else random
         self.mtx = libsync.RLock("consensus.reactor.mtx")
         self.height = 0
         self.round = -1
@@ -199,7 +205,7 @@ class PeerState:
             ]
             if not candidates:
                 return None
-            return votes.get_by_index(random.choice(candidates))
+            return votes.get_by_index(self._rng.choice(candidates))
 
 
 class ConsensusReactor(Reactor):
@@ -275,7 +281,7 @@ class ConsensusReactor(Reactor):
             round=rs.round,
             step=int(rs.step),
             seconds_since_start_time=max(
-                0, int((time.time_ns() - rs.start_time_ns) / 1e9)
+                0, int((self.cs._clock.time_ns() - rs.start_time_ns) / 1e9)
             ),
             last_commit_round=(
                 rs.last_commit.round if rs.last_commit is not None else -1
@@ -314,7 +320,10 @@ class ConsensusReactor(Reactor):
     # -- peer lifecycle ----------------------------------------------------
 
     def init_peer(self, peer) -> None:
-        peer.set("consensus_peer_state", PeerState())
+        peer.set(
+            "consensus_peer_state",
+            PeerState(rng=getattr(peer, "gossip_rng", None)),
+        )
 
     def add_peer(self, peer) -> None:
         ps = peer.get("consensus_peer_state")
@@ -328,6 +337,13 @@ class ConsensusReactor(Reactor):
         if not self.wait_sync:
             rs = self.cs.get_round_state()
             peer.try_send(STATE_CHANNEL, ser.dumps(self._round_step_msg(rs)))
+        if getattr(peer, "sim_driven", False):
+            # simnet peers: the scheduler drives the three per-peer
+            # routines as virtual-time ticks (_gossip_data_once /
+            # _gossip_votes_once / _query_maj23_once) — spawning the
+            # thread-per-peer loops here would reintroduce wall-clock
+            # nondeterminism and break at N=100+ nodes
+            return
         for fn, name in (
             (self._gossip_data_routine, "gossip-data"),
             (self._gossip_votes_routine, "gossip-votes"),
@@ -635,55 +651,7 @@ class ConsensusReactor(Reactor):
         while peer.is_running() and self.is_running():
             rs = self.cs.get_round_state()
             try:
-                if rs.votes is not None and ps.height == rs.height:
-                    for msg_type, vs in (
-                        (canonical.PREVOTE_TYPE, rs.votes.prevotes(rs.round)),
-                        (
-                            canonical.PRECOMMIT_TYPE,
-                            rs.votes.precommits(rs.round),
-                        ),
-                    ):
-                        if vs is None:
-                            continue
-                        maj = vs.two_thirds_majority()
-                        if maj is not None:
-                            peer.try_send(
-                                STATE_CHANNEL,
-                                ser.dumps(
-                                    VoteSetMaj23Message(
-                                        height=rs.height,
-                                        round=rs.round,
-                                        msg_type=msg_type,
-                                        block_id=maj,
-                                    )
-                                ),
-                            )
-                # Catch-up query (reactor.go:938-960): a peer stuck on an
-                # OLDER height is asked against our STORED commit. Its
-                # VoteSetBits reply exposes which precommits it actually
-                # holds, clearing stale has-vote marks (votes we sent
-                # while it was syncing were dropped but stayed marked) so
-                # the last-commit/catch-up gossip resends them — without
-                # this, a validator that restarts during its own commit
-                # wedges one height behind forever.
-                elif (
-                    ps.height > 0
-                    and ps.height < rs.height
-                    and self.cs.block_store is not None
-                ):
-                    commit = self.cs.block_store.load_block_commit(ps.height)
-                    if commit is not None:
-                        peer.try_send(
-                            STATE_CHANNEL,
-                            ser.dumps(
-                                VoteSetMaj23Message(
-                                    height=ps.height,
-                                    round=commit.round,
-                                    msg_type=canonical.PRECOMMIT_TYPE,
-                                    block_id=commit.block_id,
-                                )
-                            ),
-                        )
+                self._query_maj23_once(peer, ps, rs)
             except Exception as e:  # CLNT006: keep querying, but say why
                 _gossip_log().debug(
                     "maj23 query failed; retrying after sleep",
@@ -691,3 +659,56 @@ class ConsensusReactor(Reactor):
                     err=repr(e)[:120],
                 )
             time.sleep(self._maj23_sleep)
+
+    def _query_maj23_once(self, peer, ps: PeerState, rs) -> None:
+        """One maj23 probe toward ``peer`` (the routine's body; also the
+        simnet tick)."""
+        if rs.votes is not None and ps.height == rs.height:
+            for msg_type, vs in (
+                (canonical.PREVOTE_TYPE, rs.votes.prevotes(rs.round)),
+                (
+                    canonical.PRECOMMIT_TYPE,
+                    rs.votes.precommits(rs.round),
+                ),
+            ):
+                if vs is None:
+                    continue
+                maj = vs.two_thirds_majority()
+                if maj is not None:
+                    peer.try_send(
+                        STATE_CHANNEL,
+                        ser.dumps(
+                            VoteSetMaj23Message(
+                                height=rs.height,
+                                round=rs.round,
+                                msg_type=msg_type,
+                                block_id=maj,
+                            )
+                        ),
+                    )
+        # Catch-up query (reactor.go:938-960): a peer stuck on an
+        # OLDER height is asked against our STORED commit. Its
+        # VoteSetBits reply exposes which precommits it actually
+        # holds, clearing stale has-vote marks (votes we sent
+        # while it was syncing were dropped but stayed marked) so
+        # the last-commit/catch-up gossip resends them — without
+        # this, a validator that restarts during its own commit
+        # wedges one height behind forever.
+        elif (
+            ps.height > 0
+            and ps.height < rs.height
+            and self.cs.block_store is not None
+        ):
+            commit = self.cs.block_store.load_block_commit(ps.height)
+            if commit is not None:
+                peer.try_send(
+                    STATE_CHANNEL,
+                    ser.dumps(
+                        VoteSetMaj23Message(
+                            height=ps.height,
+                            round=commit.round,
+                            msg_type=canonical.PRECOMMIT_TYPE,
+                            block_id=commit.block_id,
+                        )
+                    ),
+                )

@@ -156,6 +156,7 @@ class ConsensusState(BaseService):
         event_bus=None,
         wal=None,
         options=None,
+        clock=None,
     ):
         super().__init__("consensus")
         self.config = config
@@ -175,6 +176,20 @@ class ConsensusState(BaseService):
         # guards rs reads from other threads; libs.sync so the deadlock
         # tier (COMETBFT_TPU_DEADLOCK=1) instruments the consensus mutex
         self._mtx = libsync.RLock("consensus.state")
+
+        # Time source. Every wall/monotonic read the FSM makes goes
+        # through this seam so the simnet plane (cometbft_tpu/simnet)
+        # can substitute its virtual clock — the determinism guarantee
+        # ("same (seed, scenario) => same heights/rounds/events") needs
+        # round-0 sleeps, timeouts and commit latencies derived from
+        # simulated time, not from however long the host took. A ctor
+        # parameter (not a post-hoc setattr) because update_to_state —
+        # called below — already stamps _height_started from it.
+        self._clock = clock if clock is not None else time
+        # True when a simnet driver owns this FSM: on_start skips the
+        # receive/ticker-forwarder threads and the driver pumps the
+        # inbox via process_pending() from its scheduler thread.
+        self.sim_driven = False
 
         # merged inbox: ("peer"|"internal"|"timeout", payload)
         self._queue: queue.Queue = queue.Queue(maxsize=1000)
@@ -271,6 +286,11 @@ class ConsensusState(BaseService):
         if self.do_wal_catchup and not isinstance(self.wal, NopWAL):
             self._catchup_replay()
         self.ticker.start()
+        if self.sim_driven:
+            # the simnet scheduler pumps the inbox (process_pending) and
+            # its SimTicker enqueues tocks directly — no threads
+            self._schedule_round0()
+            return
         threading.Thread(
             target=self._tock_forwarder, name="cs-tock", daemon=True
         ).start()
@@ -312,7 +332,9 @@ class ConsensusState(BaseService):
             self._queue.put(("timeout", ti))
 
     def _schedule_round0(self) -> None:
-        sleep_s = max(0.0, (self.rs.start_time_ns - time.time_ns()) / 1e9)
+        sleep_s = max(
+            0.0, (self.rs.start_time_ns - self._clock.time_ns()) / 1e9
+        )
         self._schedule_timeout(
             sleep_s, self.rs.height, 0, RoundStep.NEW_HEIGHT
         )
@@ -347,59 +369,88 @@ class ConsensusState(BaseService):
                     items.append(self._queue.get_nowait())
             except queue.Empty:
                 pass
-            memo = None
+            if self._process_batch(items):
+                return
+
+    def process_pending(self, max_batches: int = 64) -> int:
+        """Drain queued inbox items WITHOUT blocking — the simnet
+        driver's pump (one call per scheduler event, on the scheduler
+        thread).  Internal messages a batch generates are picked up by
+        the next batch in the same call; ``max_batches`` bounds a
+        pathological self-feeding loop.  Returns items processed."""
+        done = 0
+        for _ in range(max_batches):
+            items: list = []
             try:
-                memo = self._preverify_queued_votes(items)
-            except Exception as e:
-                # Preverification is an optimization only — votes fall back
-                # to per-signature host verification — but a persistent
-                # failure here erases the batching win, so surface it once
-                # per distinct failure type (a one-shot flag would let a
-                # transient relay hiccup permanently mask a later bug).
-                if type(e).__name__ not in self._preverify_warned_types:
-                    self._preverify_warned_types.add(type(e).__name__)
+                while len(items) < self._DRAIN_WINDOW:
+                    items.append(self._queue.get_nowait())
+            except queue.Empty:
+                pass
+            if not items:
+                break
+            done += len(items)
+            if self._process_batch(items):
+                break
+        return done
+
+    def _process_batch(self, items: list) -> bool:
+        """WAL-log + dispatch one drained batch (the single-writer body
+        shared by the receive thread and the simnet pump).  Returns True
+        on the quit sentinel."""
+        memo = None
+        try:
+            memo = self._preverify_queued_votes(items)
+        except Exception as e:
+            # Preverification is an optimization only — votes fall back
+            # to per-signature host verification — but a persistent
+            # failure here erases the batching win, so surface it once
+            # per distinct failure type (a one-shot flag would let a
+            # transient relay hiccup permanently mask a later bug).
+            if type(e).__name__ not in self._preverify_warned_types:
+                self._preverify_warned_types.add(type(e).__name__)
+                import traceback
+
+                traceback.print_exc()
+        try:
+            for kind, payload in items:
+                if kind == "quit":
+                    return True
+                try:
+                    if kind == "peer":
+                        self.wal.write(payload)
+                    elif kind == "internal":
+                        self.wal.write_sync(payload)
+                    elif kind == "timeout":
+                        self.wal.write(payload)
+                    self._locked_dispatch(kind, payload)
+                except FatalConsensusError as e:
+                    # Fail-stop (state.go finalizeCommit panics): the
+                    # node must not keep running on a half-applied
+                    # height. The on_fatal hook (node wiring) stops
+                    # the whole node; without one, kill the process —
+                    # a dead consensus thread with a live node would
+                    # be the silent wedge this guards against.
                     import traceback
 
                     traceback.print_exc()
-            try:
-                for kind, payload in items:
-                    if kind == "quit":
-                        return
-                    try:
-                        if kind == "peer":
-                            self.wal.write(payload)
-                        elif kind == "internal":
-                            self.wal.write_sync(payload)
-                        elif kind == "timeout":
-                            self.wal.write(payload)
-                        self._locked_dispatch(kind, payload)
-                    except FatalConsensusError as e:
-                        # Fail-stop (state.go finalizeCommit panics): the
-                        # node must not keep running on a half-applied
-                        # height. The on_fatal hook (node wiring) stops
-                        # the whole node; without one, kill the process —
-                        # a dead consensus thread with a live node would
-                        # be the silent wedge this guards against.
-                        import traceback
+                    if self.on_fatal is not None:
+                        self.on_fatal(e)
+                        return True
+                    os._exit(1)
+                except Exception:
+                    if self.replay_mode:
+                        raise
+                    import traceback
 
-                        traceback.print_exc()
-                        if self.on_fatal is not None:
-                            self.on_fatal(e)
-                            return
-                        os._exit(1)
-                    except Exception:
-                        if self.replay_mode:
-                            raise
-                        import traceback
-
-                        traceback.print_exc()
-            finally:
-                if memo:
-                    # Memo entries are scoped to THIS drain window: votes
-                    # dropped before reaching signature verification (bad
-                    # rounds, failed pre-checks) must not let peer-
-                    # controlled entries accumulate for the height.
-                    memo.clear()
+                    traceback.print_exc()
+        finally:
+            if memo:
+                # Memo entries are scoped to THIS drain window: votes
+                # dropped before reaching signature verification (bad
+                # rounds, failed pre-checks) must not let peer-
+                # controlled entries accumulate for the height.
+                memo.clear()
+        return False
 
     def _locked_dispatch(self, kind: str, payload) -> None:
         """One FSM step under the state mutex, with event delivery
@@ -583,7 +634,7 @@ class ConsensusState(BaseService):
             # Still inside the timeout_commit window: arm a NEW_ROUND
             # timeout for when it expires instead of dropping the signal.
             remaining = max(
-                0.001, (rs.start_time_ns - time.time_ns()) / 1e9 + 0.001
+                0.001, (rs.start_time_ns - self._clock.time_ns()) / 1e9 + 0.001
             )
             self._schedule_timeout(
                 remaining, rs.height, 0, RoundStep.NEW_ROUND
@@ -626,7 +677,7 @@ class ConsensusState(BaseService):
 
         rs.height = height
         # flight-recorder anchor for the per-height commit-latency SLI
-        self._height_started = time.monotonic()
+        self._height_started = self._clock.monotonic()
         if libtrace.enabled():
             for attr in ("_tr_step", "_tr_round", "_tr_height"):
                 sp = getattr(self, attr, None)
@@ -723,7 +774,7 @@ class ConsensusState(BaseService):
     def _set_step(self, rs, step) -> None:
         """Step transition + per-step timing
         (consensus/metrics.go StepDurationSeconds)."""
-        now = time.monotonic()
+        now = self._clock.monotonic()
         started = getattr(self, "_step_started", None)
         if started is not None:
             libmetrics.node_metrics().step_duration.labels(
@@ -760,7 +811,7 @@ class ConsensusState(BaseService):
         ):
             return
         m = libmetrics.node_metrics()
-        now_mono = time.monotonic()
+        now_mono = self._clock.monotonic()
         if getattr(self, "_round_started", None) is not None:
             m.round_duration.observe(now_mono - self._round_started)
         self._round_started = now_mono
@@ -901,7 +952,7 @@ class ConsensusState(BaseService):
             round=round_,
             pol_round=rs.valid_round,
             block_id=block_id,
-            timestamp_ns=time.time_ns(),
+            timestamp_ns=self._clock.time_ns(),
         )
         try:
             self.priv_validator.sign_proposal(self.state.chain_id, proposal)
@@ -926,7 +977,8 @@ class ConsensusState(BaseService):
             return None  # don't have the commit for the last block
         proposer = bytes(self.priv_validator_pub_key.address())
         return self.block_exec.create_proposal_block(
-            height, self.state, last_ext_commit, proposer
+            height, self.state, last_ext_commit, proposer,
+            time_ns=self._clock.time_ns(),
         )
 
     # -- proposal ingest ---------------------------------------------------
@@ -1210,7 +1262,7 @@ class ConsensusState(BaseService):
             raise ConsensusError("enterCommit without +2/3 for a block")
         self._set_step(rs, RoundStep.COMMIT)
         rs.commit_round = commit_round
-        rs.commit_time_ns = time.time_ns()
+        rs.commit_time_ns = self._clock.time_ns()
         self._new_step()
 
         if rs.locked_block is not None and rs.locked_block.hash() == maj23.hash:
@@ -1287,8 +1339,10 @@ class ConsensusState(BaseService):
             libhealth.EV_COMMIT, height, rs.commit_round,
             int(
                 (
-                    time.monotonic()
-                    - getattr(self, "_height_started", time.monotonic())
+                    self._clock.monotonic()
+                    - getattr(
+                        self, "_height_started", self._clock.monotonic()
+                    )
                 ) * 1e9
             ),
         )
@@ -1297,7 +1351,7 @@ class ConsensusState(BaseService):
             hook(height)
 
         # Next height.
-        rs.commit_time_ns = time.time_ns()
+        rs.commit_time_ns = self._clock.time_ns()
         self.update_to_state(new_state)
         self._schedule_round0()
 
@@ -1511,7 +1565,7 @@ class ConsensusState(BaseService):
             height=rs.height,
             round=rs.round,
             block_id=block_id,
-            timestamp_ns=time.time_ns(),
+            timestamp_ns=self._clock.time_ns(),
             validator_address=addr,
             validator_index=idx,
         )

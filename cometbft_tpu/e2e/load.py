@@ -23,8 +23,14 @@ from ..rpc.decoding import parse_rfc3339
 TX_PREFIX = b"load:"
 
 
-def make_tx(run_id: str, seq: int, size: int = 64) -> bytes:
-    body = b"load:%s:%d:%d:" % (run_id.encode(), seq, time.time_ns())
+def make_tx(
+    run_id: str, seq: int, size: int = 64, now_ns: int | None = None
+) -> bytes:
+    """``now_ns`` overrides the embedded send stamp (the simnet tier
+    stamps virtual time so latency math stays on one clock)."""
+    if now_ns is None:
+        now_ns = time.time_ns()
+    body = b"load:%s:%d:%d:" % (run_id.encode(), seq, now_ns)
     pad = max(0, size - len(body))
     # kvstore txs are key=value; key must be unique per tx so each lands
     return body + b"x" * pad + b"=1"
@@ -251,6 +257,82 @@ class EventLoadMonitor:
         except Exception:
             pass
         return self._report
+
+
+class SimLoadGenerator:
+    """Load generation for the ``--simnet`` tier: txs are pushed into
+    the sim nodes' mempools on VIRTUAL-time ticks (no sockets, no
+    threads), stamped with the net's virtual clock, at ``rate`` tx/s of
+    virtual time round-robined across ``targets``.  Deterministic under
+    the net's seed like everything else on the scheduler."""
+
+    def __init__(self, net, rate: int = 100, tx_size: int = 64,
+                 run_id: str = "simload", targets: list[int] | None = None):
+        self.net = net
+        self.rate = max(1, rate)
+        self.tx_size = tx_size
+        self.run_id = run_id
+        self.targets = (
+            list(targets) if targets is not None
+            else [n.idx for n in net.nodes]
+        )
+        self.sent = 0
+        self._seq = 0
+        self._stopped = False
+        self._interval_ns = int(1e9 / self.rate)
+
+    def start(self) -> None:
+        self._stopped = False
+        self.net.sched.call_after(self._interval_ns, self._tick)
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _tick(self) -> None:
+        if self._stopped:
+            return
+        # rotate past dead targets: a killed node must cost ITS txs,
+        # not wedge the whole generator on one round-robin slot
+        for _ in range(len(self.targets)):
+            idx = self.targets[self._seq % len(self.targets)]
+            self._seq += 1
+            node = self.net.nodes[idx]
+            if node.alive and node.core is not None:
+                node.core["mempool"].push_tx(
+                    make_tx(
+                        self.run_id, self._seq, self.tx_size,
+                        now_ns=self.net.clock.time_ns(),
+                    )
+                )
+                self.sent += 1
+                break
+        self.net.sched.call_after(self._interval_ns, self._tick)
+
+
+def sim_load_report(net, run_id: str, node_idx: int = 0) -> LoadReport:
+    """Block-walk latency report over a sim node's store (the
+    :func:`load_report` method without RPC: block time − send time,
+    both on the net's virtual clock)."""
+    store = net.nodes[node_idx].block_store
+    rep = LoadReport(run_id=run_id)
+    for h in range(1, store.height() + 1):
+        blk = store.load_block(h)
+        if blk is None:
+            continue
+        counted = False
+        for tx in blk.data.txs:
+            parsed = parse_tx(tx)
+            if parsed is None or parsed[0] != run_id:
+                continue
+            rep.txs += 1
+            counted = True
+            rep.latencies_s.append((blk.header.time_ns - parsed[2]) / 1e9)
+        if counted:
+            rep.blocks += 1
+            rep.last_height = h
+            if not rep.first_height:
+                rep.first_height = h
+    return rep
 
 
 def load_report(

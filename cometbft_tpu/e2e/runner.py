@@ -481,3 +481,93 @@ class Testnet:
                 f"--- slowest node log tail ({lagger.home}) ---\n"
                 f"{lagger.log_tail(3000)}"
             )
+
+
+# -- simnet mode (no sockets, no subprocesses) ---------------------------
+#
+# The process tier above runs REAL nodes and real TCP — slow,
+# wall-clock, nondeterministic. `--simnet` runs the same scenario
+# intents on the deterministic in-process plane (cometbft_tpu/simnet):
+# seeded virtual links, scripted faults, bit-reproducible runs. A
+# failing CI run prints its seed; `--seed N` replays that exact
+# schedule locally. Default seed: COMETBFT_TPU_SIMNET_SEED.
+
+
+def run_simnet_load(
+    seed: int, n_nodes: int = 4, rate: int = 200, heights: int = 6
+) -> dict:
+    """Scenario-less simnet load run: N validators, a virtual-rate tx
+    stream, a block-walk latency report — the loadtime shape without a
+    socket in sight."""
+    from ..simnet import SimNet
+    from .load import SimLoadGenerator, sim_load_report
+
+    net = SimNet(n_nodes, seed=seed)
+    try:
+        net.start()
+        gen = SimLoadGenerator(net, rate=rate, run_id=f"sim{seed}")
+        gen.start()
+        ok = net.run_until_height(heights, max_virtual_ms=240_000)
+        gen.stop()
+        net.run(max_virtual_ms=500)  # let in-flight commits land
+        net.assert_no_fork()
+        rep = sim_load_report(net, gen.run_id)
+        return {
+            "ok": ok and rep.txs > 0,
+            "seed": seed,
+            "node_heights": net.heights(),
+            "sent": gen.sent,
+            # rep.summary()'s "heights" = [first, last] height carrying
+            # load txs (the loadtime report shape), NOT node heights
+            **rep.summary(),
+        }
+    finally:
+        net.stop()
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m cometbft_tpu.e2e.runner --simnet ...``."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="python -m cometbft_tpu.e2e.runner")
+    ap.add_argument(
+        "--simnet", action="store_true",
+        help="run on the deterministic in-process simnet plane",
+    )
+    ap.add_argument("--scenario", default="healthy")
+    ap.add_argument(
+        "--seed", type=int,
+        default=int(os.environ.get("COMETBFT_TPU_SIMNET_SEED", "0") or "0"),
+        help="schedule seed — reproduces a failing run bit-identically",
+    )
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument(
+        "--load", type=int, default=0, metavar="RATE",
+        help="simnet load mode: tx/s of virtual time instead of a "
+        "fault scenario",
+    )
+    args = ap.parse_args(argv)
+    if not args.simnet:
+        ap.error(
+            "the process tier is driven from pytest "
+            "(tests/test_e2e_harness.py); the CLI runs --simnet only"
+        )
+    if args.load:
+        out = run_simnet_load(
+            args.seed, n_nodes=args.nodes or 4, rate=args.load
+        )
+        print(json.dumps(out, default=str, indent=1))
+        return 0 if out["ok"] else 1
+    from ..simnet.scenarios import run_scenario
+
+    kw = {}
+    if args.nodes is not None:
+        kw["n_nodes"] = args.nodes
+    result = run_scenario(args.scenario, args.seed, **kw)
+    print(json.dumps(result.summary(), default=str, indent=1))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,12 +29,45 @@ class EvidenceReactor(Reactor):
         ]
 
     def add_peer(self, peer) -> None:
+        if getattr(peer, "sim_driven", False):
+            # simnet peers: the scheduler calls gossip_step on virtual
+            # ticks instead of a clist-tailing thread per peer
+            return
         threading.Thread(
             target=self._broadcast_routine,
             args=(peer,),
             name=f"evidence-bcast-{peer.id[:8]}",
             daemon=True,
         ).start()
+
+    # virtual-ns interval after which still-pending evidence is offered
+    # again (simnet links may silently eat a send — unlike TCP, where a
+    # True send is delivered or the conn dies and a reconnect resets the
+    # gossip cursor — so a one-shot offer could lose the only copy)
+    REOFFER_NS = 1_000_000_000
+
+    def gossip_step(self, peer, now_ns: int = 0) -> int:
+        """Simnet tick: send every pending evidence this peer hasn't
+        been offered recently (the clist cursor of the thread path,
+        without blocking waits, plus periodic re-offers while the item
+        stays pending).  Returns the number of items sent."""
+        sent = peer.get("evidence_sent")
+        if sent is None:
+            sent = {}  # evidence hash -> virtual ns of last offer
+            peer.set("evidence_sent", sent)
+        n = 0
+        for el in self.pool.evidence_list:
+            if el.removed:
+                continue
+            ev = el.value
+            h = ev.hash()
+            last = sent.get(h)
+            if last is not None and now_ns - last < self.REOFFER_NS:
+                continue
+            if peer.send(EVIDENCE_CHANNEL, ser.dumps(ev)):
+                sent[h] = now_ns
+                n += 1
+        return n
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         try:

@@ -18,11 +18,20 @@ import sys
 ENV_VAR = "COMETBFT_TPU_FAIL"
 
 _target = os.environ.get(ENV_VAR, "")
+_handler = None
 
 
 def fail_point(name: str) -> None:
     """Die hard if this named point is the injection target."""
     if _target and name == _target:
+        if _handler is not None:
+            # In-process crash simulation (the simnet scenario engine):
+            # the handler either raises — "this node just died" without
+            # taking down the whole multi-node process — or returns to
+            # skip (e.g. the armed point belongs to a different sim
+            # node). Subprocess tests keep the os._exit semantics.
+            _handler(name)
+            return
         sys.stderr.write(f"FAIL POINT HIT: {name} — crashing\n")
         sys.stderr.flush()
         os._exit(99)
@@ -32,3 +41,10 @@ def set_target(name: str) -> None:
     """Test helper: arm a point in-process (subprocess tests use the env)."""
     global _target
     _target = name
+
+
+def set_handler(fn) -> None:
+    """Install (or clear, with None) the in-process crash handler used
+    by simnet scenarios; see :func:`fail_point`."""
+    global _handler
+    _handler = fn

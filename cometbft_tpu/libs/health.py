@@ -109,8 +109,30 @@ EV_RECOMPILE = 6  # a=shape bucket (libs/devstats steady-state recompile)
 EV_FSYNC = 7  # a=WAL fsync ns
 EV_WATCHDOG = 8  # a=watchdog bit (see _WATCHDOGS)
 EV_GOSSIP = 9  # a=propagation phase code (netstats.PHASE_NAMES), b=lag ns
+EV_FAULT = 10  # simnet fault plane: h=src node, r=dst node, a=kind, b=detail
 
 _N_CODES = 16  # size of the per-code last-seen vector
+
+# EV_FAULT kinds (recorded by cometbft_tpu/simnet): the black-box ring
+# explains WHICH fault was live when a scenario failed — a partition
+# forming, a link dropping a message class, a node crashing mid-height.
+FAULT_PARTITION = 1  # partition formed (detail = group count)
+FAULT_HEAL = 2  # partition healed
+FAULT_KILL = 3  # node killed (churn)
+FAULT_RESTART = 4  # node restarted (churn)
+FAULT_DROP = 5  # one message eaten by link faults (detail = channel)
+FAULT_LINK = 6  # link fault parameters changed
+FAULT_CRASH = 7  # armed COMETBFT_TPU_FAIL crash point fired in-process
+
+_FAULT_NAMES = {
+    FAULT_PARTITION: "partition",
+    FAULT_HEAL: "heal",
+    FAULT_KILL: "kill",
+    FAULT_RESTART: "restart",
+    FAULT_DROP: "drop",
+    FAULT_LINK: "link_change",
+    FAULT_CRASH: "crash_point",
+}
 
 _CODE_NAMES = {
     EV_STEP: "consensus.step",
@@ -122,6 +144,7 @@ _CODE_NAMES = {
     EV_FSYNC: "wal.fsync",
     EV_WATCHDOG: "health.watchdog",
     EV_GOSSIP: "p2p.gossip",
+    EV_FAULT: "simnet.fault",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -134,6 +157,7 @@ _CODE_FIELDS = {
     EV_FSYNC: ("dur_ns", None),
     EV_WATCHDOG: ("watchdog", None),
     EV_GOSSIP: ("phase", "lag_ns"),
+    EV_FAULT: ("kind", "detail"),
 }
 
 _STEP_NAMES = {
@@ -276,6 +300,8 @@ class FlightRecorder:
                 rec["phase_name"] = libnetstats.PHASE_NAMES.get(
                     self._a[i], "?"
                 )
+            elif code == EV_FAULT:
+                rec["fault_name"] = _FAULT_NAMES.get(self._a[i], "?")
             out.append(rec)
         return out
 

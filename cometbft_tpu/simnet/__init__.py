@@ -1,0 +1,11 @@
+"""Deterministic fault-injection network simulator (simnet).
+
+N full in-process nodes — real consensus/evidence/blocksync reactors —
+over seeded virtual links with programmable faults, driven by one
+discrete-event scheduler in virtual time: every run is a pure function
+of ``(seed, scenario)``.  See docs/simnet.md.
+"""
+
+from .link import Link, LinkConfig  # noqa: F401
+from .net import SimNet, make_genesis  # noqa: F401
+from .sched import SimClock, SimScheduler  # noqa: F401

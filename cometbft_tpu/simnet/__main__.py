@@ -1,0 +1,56 @@
+"""CLI: run a simnet scenario and print its JSON summary.
+
+    python -m cometbft_tpu.simnet --scenario byzantine_double_sign --seed 7
+
+``--seed N`` makes the run bit-reproducible (same heights, rounds and
+flight-recorder sequence every time) — the seed printed by a failing
+CI/e2e run replays that exact schedule locally.  The default seed comes
+from ``COMETBFT_TPU_SIMNET_SEED`` (0 if unset).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .scenarios import SCENARIOS, run_scenario
+
+_ENV_SEED = "COMETBFT_TPU_SIMNET_SEED"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cometbft_tpu.simnet",
+        description="deterministic fault-injection scenario runner",
+    )
+    ap.add_argument(
+        "--scenario", default="healthy", choices=sorted(SCENARIOS),
+    )
+    ap.add_argument(
+        "--seed", type=int,
+        default=int(os.environ.get(_ENV_SEED, "0") or "0"),
+        help="schedule seed; a failing run's seed reproduces it exactly",
+    )
+    ap.add_argument(
+        "--nodes", type=int, default=None, help="node-count override"
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    args = ap.parse_args(argv)
+    if args.list:
+        for name in sorted(SCENARIOS):
+            print(name)
+        return 0
+    kw = {}
+    if args.nodes is not None:
+        kw["n_nodes"] = args.nodes
+    result = run_scenario(args.scenario, args.seed, **kw)
+    print(json.dumps(result.summary(), default=str, indent=1))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Virtual links: the programmable fault vocabulary of the simnet.
+
+Each DIRECTED node pair gets one :class:`Link` carrying a
+:class:`LinkConfig` — per-link latency/jitter, drop and reorder
+probability, a bandwidth cap, and message-class filters — plus its own
+child rng, so editing one link's faults never perturbs another link's
+random schedule (scenario events stay composable under one seed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class LinkConfig:
+    """Fault parameters for one directed link (all virtual-time ns).
+
+    ``drop_p``/``reorder_p`` are per-message probabilities;
+    ``bandwidth_bps`` of 0 means uncapped; ``drop_channels`` silently
+    eats whole p2p channels (e.g. blocksync 0x40); ``drop_classes``
+    eats decoded message classes by name (e.g. "VoteMessage") — the
+    scalpel for scenarios like "lose only block parts".
+    """
+
+    latency_ns: int = 2_000_000  # 2 ms one-hop base
+    jitter_ns: int = 500_000
+    drop_p: float = 0.0
+    dup_p: float = 0.0
+    reorder_p: float = 0.0
+    reorder_window_ns: int = 20_000_000
+    bandwidth_bps: int = 0
+    drop_channels: frozenset[int] = field(default_factory=frozenset)
+    drop_classes: frozenset[str] = field(default_factory=frozenset)
+
+    def with_(self, **kw) -> "LinkConfig":
+        return replace(self, **kw)
+
+
+# delivery-plan outcomes (stats keys + EV_FAULT detail codes)
+DROP_RANDOM = "drop_random"
+DROP_CHANNEL = "drop_channel"
+DROP_CLASS = "drop_class"
+DROP_PARTITION = "drop_partition"
+DROP_DEAD = "drop_dead"
+
+
+class Link:
+    """One directed link's live state: config + bandwidth busy horizon."""
+
+    __slots__ = ("cfg", "rng", "busy_until_ns")
+
+    def __init__(self, cfg: LinkConfig, rng):
+        self.cfg = cfg
+        self.rng = rng
+        self.busy_until_ns = 0
+
+    def plan(self, now_ns: int, ch_id: int, size: int):
+        """Decide one message's fate.  Returns ``(deliver_at_ns,
+        dup_at_ns | None, None)`` or ``(None, None, drop_reason)`` —
+        ``dup_at_ns`` is a second delivery time when the link duplicated
+        the message.  Consumes rng draws in a FIXED order regardless of
+        outcome, so one dropped message doesn't shift the random
+        schedule of every later one."""
+        cfg = self.cfg
+        r_drop = self.rng.random() if cfg.drop_p > 0 else 1.0
+        r_dup = self.rng.random() if cfg.dup_p > 0 else 1.0
+        r_jit = self.rng.random() if cfg.jitter_ns > 0 else 0.0
+        r_reord = self.rng.random() if cfg.reorder_p > 0 else 1.0
+        r_win = self.rng.random() if cfg.reorder_p > 0 else 0.0
+        if ch_id in cfg.drop_channels:
+            return None, None, DROP_CHANNEL
+        if r_drop < cfg.drop_p:
+            return None, None, DROP_RANDOM
+        start = max(now_ns, self.busy_until_ns)
+        if cfg.bandwidth_bps > 0:
+            tx_ns = int(size * 8 * 1e9 / cfg.bandwidth_bps)
+            self.busy_until_ns = start + tx_ns
+            start += tx_ns
+        deliver = start + cfg.latency_ns + int(r_jit * cfg.jitter_ns)
+        if r_reord < cfg.reorder_p:
+            deliver += int(r_win * cfg.reorder_window_ns)
+        dup_at = None
+        if r_dup < cfg.dup_p:
+            # the copy trails the original by up to one reorder window
+            dup_at = deliver + int(
+                (r_dup / max(cfg.dup_p, 1e-12)) * cfg.reorder_window_ns
+            )
+        return deliver, dup_at, None

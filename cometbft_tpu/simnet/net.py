@@ -1,0 +1,941 @@
+"""SimNet: the deterministic in-process network plane.
+
+N full node cores (simnet/node.py) run REAL consensus/evidence/
+blocksync reactors over seeded virtual links instead of TCP.  One
+scheduler thread executes everything — deliveries, timeouts, gossip
+ticks, scenario fault events — in virtual time, so a run is a pure
+function of ``(seed, scenario)``: same commit heights, same round
+counts, same flight-recorder event sequence, every time.
+
+The plane implements the p2p peer/switch contract the reactors already
+program against (:class:`SimPeer` ~ p2p.peer.Peer, :class:`SimHub` ~
+p2p.switch.Switch), which is what buys catch-up gossip for free: the
+consensus reactor's data/vote/maj23 catch-up paths — the machinery the
+old ``wire_perfect_gossip`` test harness lacked, and whose absence was
+the 2/16 byzantine-net liveness flake — run unmodified as virtual-time
+ticks.
+
+Fault vocabulary (scenario-drivable at any virtual time): per-link
+latency/jitter/drop/reorder/bandwidth (simnet/link.py), partitions
+(form/heal), peer churn (kill/restart mid-height with WAL replay),
+message-class filters, and armed ``COMETBFT_TPU_FAIL`` crash points.
+Every fault emits an ``EV_FAULT`` flight-recorder event, so a watchdog
+black-box bundle from a scenario failure names the fault that was live.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+
+from ..libs import health as libhealth
+from ..libs import fail as libfail
+from ..types import serialization as ser
+from .link import (
+    DROP_CHANNEL,
+    DROP_CLASS,
+    DROP_DEAD,
+    DROP_PARTITION,
+    DROP_RANDOM,
+    Link,
+    LinkConfig,
+)
+from .node import SimTicker, build_core, drain_inbox
+from .sched import SimClock, SimScheduler
+
+_ENV_LOG = "COMETBFT_TPU_SIMNET_LOG"
+
+# virtual cadence of the sim-driven reactor routines
+_BUSY_NS = 500_000  # re-tick delay after a productive gossip step
+_GOSSIP_BURST = 16  # max productive gossip steps per tick event
+_EVIDENCE_TICK_NS = 50_000_000
+_BLOCKSYNC_TICK_NS = 50_000_000
+_BLOCKSYNC_APPLIED_NS = 1_000_000
+
+def _sim_log():
+    """Logger for the sim-driven reactor ticks (lazy: honors whatever
+    default logger was configured after import — the CLNT006 posture of
+    the thread routines they replace)."""
+    from ..libs import log as _log
+
+    return _log.default_logger().with_module("simnet")
+
+
+_DROP_TO_FAULT_DETAIL = {
+    DROP_RANDOM: 0,
+    DROP_CHANNEL: 1,
+    DROP_CLASS: 2,
+    DROP_PARTITION: 3,
+    DROP_DEAD: 4,
+}
+
+
+def make_genesis(n_vals: int, chain_id: str = "simnet-chain",
+                 power: int = 10):
+    """Deterministic genesis + priv-vals ordered to the ValidatorSet
+    (the tests/helpers.make_genesis shape, packaged so the e2e harness
+    and bench can build simnets without the test tree)."""
+    from ..crypto.keys import Ed25519PrivKey
+    from ..types import GenesisDoc, GenesisValidator, MockPV
+
+    pvs = [
+        MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
+        for i in range(n_vals)
+    ]
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=pv.get_pub_key(), power=power)
+            for pv in pvs
+        ],
+    )
+    vs = doc.validator_set()
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return doc, ordered
+
+
+class SimPeer:
+    """One directed peer handle: node ``owner``'s view of node
+    ``remote``.  Implements the peer contract the reactors use
+    (id/send/try_send/get/set/is_running) over the net's links."""
+
+    sim_driven = True  # reactors skip their thread-per-peer routines
+    outbound = True
+    persistent = True
+
+    __slots__ = ("net", "owner", "remote", "gossip_rng", "_data", "_running")
+
+    def __init__(self, net: "SimNet", owner: int, remote: int, gossip_rng):
+        self.net = net
+        self.owner = owner
+        self.remote = remote
+        self.gossip_rng = gossip_rng
+        self._data: dict[str, object] = {}
+        self._running = True
+
+    @property
+    def id(self) -> str:
+        return self.net.node_id(self.remote)
+
+    def is_running(self) -> bool:
+        return self._running and self.net.nodes[self.remote].alive
+
+    def stop(self) -> None:
+        self._running = False
+
+    def send(self, ch_id: int, msg: bytes) -> bool:
+        if not self._running:
+            return False
+        return self.net._send(self.owner, self.remote, ch_id, msg)
+
+    def try_send(self, ch_id: int, msg: bytes) -> bool:
+        return self.send(ch_id, msg)
+
+    def set(self, key: str, value) -> None:
+        self._data[key] = value
+
+    def get(self, key: str):
+        return self._data.get(key)
+
+    def __repr__(self) -> str:
+        return f"SimPeer<{self.owner}->{self.remote}>"
+
+
+class SimHub:
+    """The switch stand-in one node's reactors are wired to: channel
+    routing, peer table, broadcast fan-out (p2p/switch.go's surface,
+    minus transports/threads)."""
+
+    def __init__(self, net: "SimNet", idx: int):
+        self.net = net
+        self.idx = idx
+        self.logger = None
+        self.reactors: dict[str, object] = {}
+        self._channel_to_reactor: dict[int, object] = {}
+        self._peers: dict[str, SimPeer] = {}
+        self._running = False
+
+    def add_reactor(self, name: str, reactor) -> None:
+        for desc in reactor.get_channels():
+            self._channel_to_reactor[desc.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+
+    def start(self) -> None:
+        self._running = True
+        for reactor in self.reactors.values():
+            reactor.start()
+
+    def stop(self) -> None:
+        self._running = False
+        for peer in list(self._peers.values()):
+            peer.stop()
+        self._peers.clear()
+        for reactor in self.reactors.values():
+            if reactor.is_running():
+                try:
+                    reactor.stop()
+                except Exception:
+                    pass
+
+    def is_running(self) -> bool:
+        return self._running
+
+    # -- peer table --------------------------------------------------------
+
+    def admit(self, peer: SimPeer) -> None:
+        self._peers[peer.id] = peer
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+
+    def drop(self, remote_id: str, reason) -> SimPeer | None:
+        peer = self._peers.pop(remote_id, None)
+        if peer is None:
+            return None
+        peer.stop()
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, reason)
+            except Exception:
+                pass
+        return peer
+
+    def peers(self) -> list[SimPeer]:
+        return list(self._peers.values())
+
+    def num_peers(self) -> tuple[int, int]:
+        return len(self._peers), 0
+
+    def get_peer(self, peer_id: str) -> SimPeer | None:
+        return self._peers.get(peer_id)
+
+    # -- routing (Switch._on_peer_receive semantics) -----------------------
+
+    def dispatch(self, ch_id: int, peer: SimPeer, msg: bytes) -> None:
+        reactor = self._channel_to_reactor.get(ch_id)
+        if reactor is None:
+            self.stop_and_remove_peer(peer, f"unclaimed channel {ch_id:#x}")
+            return
+        try:
+            reactor.receive(ch_id, peer, msg)
+        except Exception as e:
+            self.stop_and_remove_peer(peer, e)
+
+    def stop_and_remove_peer(self, peer: SimPeer, reason) -> None:
+        self.net._disconnect_pair(self.idx, peer.remote, reason)
+
+    # -- broadcast ---------------------------------------------------------
+
+    def broadcast(self, ch_id: int, msg: bytes) -> None:
+        for peer in self._peers.values():
+            peer.send(ch_id, msg)
+
+    def try_broadcast(self, ch_id: int, msg: bytes) -> None:
+        self.broadcast(ch_id, msg)
+
+
+class SimNode:
+    """One node slot: core (rebuilt across restarts), hub, liveness."""
+
+    def __init__(self, net: "SimNet", idx: int, home: str | None):
+        self.net = net
+        self.idx = idx
+        self.home = home
+        self.alive = False
+        self.core: dict | None = None
+        self.hub: SimHub | None = None
+        self.restarts = 0
+
+    @property
+    def cs(self):
+        return self.core["cs"] if self.core else None
+
+    @property
+    def block_store(self):
+        return self.core["block_store"] if self.core else None
+
+    def height(self) -> int:
+        return self.core["block_store"].height() if self.core else 0
+
+    def boot(self, block_sync: bool = False, app=None) -> None:
+        net = self.net
+        if app is None and net.app_factory is not None:
+            app = net.app_factory(self.idx)
+        self.core = build_core(
+            net.genesis,
+            net.pvs[self.idx] if self.idx < len(net.pvs) else None,
+            net.config,
+            home=self.home,
+            app=app,
+            with_evidence=net.with_evidence,
+            block_sync=block_sync,
+            now_fn=net.clock.monotonic,
+            clock=net.clock,
+        )
+        cs = self.core["cs"]
+        cs.ticker = SimTicker(
+            net.sched, lambda ti, idx=self.idx: net._tock(idx, ti)
+        )
+        cs.on_fatal = lambda e, idx=self.idx: net._on_node_fatal(idx, e)
+        self.hub = SimHub(net, self.idx)
+        for name, reactor in self.core["reactors"].items():
+            self.hub.add_reactor(name, reactor)
+        self.alive = True
+
+    def start(self) -> None:
+        self.hub.start()
+        bsr = self.core["reactors"].get("blocksync")
+        if bsr is not None and bsr.block_sync:
+            self.net._schedule_blocksync_tick(self.idx, _BLOCKSYNC_TICK_NS)
+
+    def shutdown(self, crash: bool) -> None:
+        """Take the node down.  ``crash=True`` abandons the FSM where it
+        stands (inbox dropped, no clean WAL close beyond the per-write
+        flushes) — the restart path then exercises WAL catchup replay,
+        the same recovery the crash-point subprocess tests pin."""
+        self.alive = False
+        if self.core is None:
+            return
+        cs = self.core["cs"]
+        if crash:
+            drain_inbox(cs)
+        if self.hub is not None:
+            self.hub.stop()  # stops reactors; consensus reactor stops cs
+        for stopper in ("bus", "conns"):
+            try:
+                self.core[stopper].stop()
+            except Exception:
+                pass
+        for db in self.core.get("dbs", ()):
+            try:
+                db.close()
+            except Exception:
+                pass
+        if cs.wal is not None:
+            try:
+                cs.wal.close()
+            except Exception:
+                pass
+
+
+class SimNet:
+    """The deterministic N-node net + fault plane + run loop."""
+
+    def __init__(
+        self,
+        n_nodes: int,
+        seed: int = 0,
+        config=None,
+        genesis=None,
+        pvs=None,
+        home_root: str | None = None,
+        with_evidence: bool = True,
+        default_link: LinkConfig | None = None,
+        topology: str | int = "mesh",
+        reconnect_delay_ns: int = 500_000_000,
+        app_factory=None,  # f(idx) -> ABCI app (None = per-node kvstore)
+    ):
+        from ..config import test_config
+
+        self.n = n_nodes
+        self.seed = seed
+        self.config = config if config is not None else test_config()
+        if genesis is None:
+            genesis, gen_pvs = make_genesis(n_nodes)
+            pvs = pvs if pvs is not None else gen_pvs
+        self.genesis = genesis
+        self.pvs = pvs or []
+        self.with_evidence = with_evidence
+        self.clock = SimClock(base_wall_ns=genesis.genesis_time_ns)
+        self.sched = SimScheduler(seed, self.clock)
+        self.default_link = (
+            default_link if default_link is not None else LinkConfig()
+        )
+        self.topology = topology
+        self.reconnect_delay_ns = reconnect_delay_ns
+        self.home_root = home_root
+        self.app_factory = app_factory
+        self.nodes = [
+            SimNode(
+                self, i,
+                None if home_root is None else f"{home_root}/node{i}",
+            )
+            for i in range(n_nodes)
+        ]
+        self._links: dict[tuple[int, int], Link] = {}
+        self._adj: set[tuple[int, int]] = set()
+        self._partition: dict[int, int] | None = None
+        self.stats = collections.Counter()
+        self._log = os.environ.get(_ENV_LOG, "") in ("1", "on", "true")
+        self._events_run = 0
+        self._stopped = False
+
+    # -- identity ----------------------------------------------------------
+
+    def node_id(self, idx: int) -> str:
+        return "%040x" % (idx + 1)
+
+    def _idx_of(self, node_id: str) -> int:
+        return int(node_id, 16) - 1
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Boot every node and connect the topology."""
+        self._install_sig_cache()
+        for node in self.nodes:
+            node.boot()
+        for node in self.nodes:
+            node.start()
+        for i, j in self._topology_edges():
+            self.connect(i, j)
+
+    _SIG_CACHE_CAP = 200_000
+
+    def _install_sig_cache(self) -> None:
+        """Share single-signature verify verdicts across the N co-located
+        nodes for the run's lifetime.  Verification is a pure function of
+        (pubkey, message, signature), but every node independently
+        verifies the SAME gossiped vote bytes — at N=100 that's 100
+        identical ~ms-scale verifies per vote, and it dominates the
+        simulation's wall clock.  Verdict-identical by construction;
+        uninstalled in stop()."""
+        from ..crypto import coalesce as crypto_coalesce
+
+        cache: dict = {}
+        self._sig_cache = cache
+        orig = crypto_coalesce.verify_signature
+        self._orig_verify_signature = orig
+        cap = self._SIG_CACHE_CAP
+
+        def cached_verify(pub_key, msg: bytes, sig: bytes) -> bool:
+            key = (pub_key.bytes(), msg, sig)
+            v = cache.get(key)
+            if v is None:
+                v = orig(pub_key, msg, sig)
+                if len(cache) >= cap:
+                    cache.clear()
+                cache[key] = v
+            return v
+
+        crypto_coalesce.verify_signature = cached_verify
+
+    def _topology_edges(self):
+        n = self.n
+        if self.topology == "mesh" or (
+            isinstance(self.topology, int) and self.topology >= n - 1
+        ):
+            return [(i, j) for i in range(n) for j in range(i + 1, n)]
+        k = 2 if self.topology == "ring" else max(1, int(self.topology))
+        edges = set()
+        for i in range(n):
+            for d in range(1, k // 2 + 1):
+                edges.add(tuple(sorted((i, (i + d) % n))))
+            if k % 2:
+                edges.add(tuple(sorted((i, (i + 1 + k // 2) % n))))
+        return sorted(edges)
+
+    def neighbors(self, i: int) -> list[int]:
+        return sorted(
+            {b for a, b in self._adj if a == i}
+        )
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for node in self.nodes:
+            if node.alive:
+                node.shutdown(crash=False)
+        if getattr(self, "_orig_verify_signature", None) is not None:
+            from ..crypto import coalesce as crypto_coalesce
+
+            crypto_coalesce.verify_signature = self._orig_verify_signature
+            self._orig_verify_signature = None
+
+    # -- links & topology --------------------------------------------------
+
+    def _link(self, i: int, j: int) -> Link:
+        link = self._links.get((i, j))
+        if link is None:
+            link = Link(
+                self.default_link, self.sched.sub_rng(f"link-{i}-{j}")
+            )
+            self._links[(i, j)] = link
+        return link
+
+    def set_link(self, i: int, j: int, symmetric: bool = True, **kw) -> None:
+        """Reconfigure the (i→j) link's faults (and j→i when
+        ``symmetric``)."""
+        pairs = [(i, j), (j, i)] if symmetric else [(i, j)]
+        for a, b in pairs:
+            link = self._link(a, b)
+            link.cfg = link.cfg.with_(**kw)
+        self._fault(libhealth.FAULT_LINK, i, j)
+
+    def set_all_links(self, **kw) -> None:
+        """Reconfigure the default link AND every live link."""
+        self.default_link = self.default_link.with_(**kw)
+        for link in self._links.values():
+            link.cfg = link.cfg.with_(**kw)
+        self._fault(libhealth.FAULT_LINK, 0, 0, detail=1)
+
+    def connect(self, i: int, j: int) -> None:
+        if i == j:
+            return
+        if self._partition is not None and (
+            self._partition.get(i) != self._partition.get(j)
+        ):
+            return  # no tunneling under a partition; heal() reconnects
+        for a, b in ((i, j), (j, i)):
+            if (a, b) in self._adj:
+                continue
+            if not (self.nodes[a].alive and self.nodes[b].alive):
+                continue
+            self._adj.add((a, b))
+            self._link(a, b)  # materialize link state
+            peer = SimPeer(
+                self, a, b, self.sched.sub_rng(f"gossip-{a}-{b}")
+            )
+            self.nodes[a].hub.admit(peer)
+            self._schedule_consensus_ticks(a, peer)
+            if "evidence" in self.nodes[a].hub.reactors:
+                self._stagger_call(
+                    f"ev-{a}-{b}", _EVIDENCE_TICK_NS,
+                    self._evidence_tick, a, peer,
+                )
+
+    def _disconnect_pair(self, i: int, j: int, reason) -> None:
+        """Peer eviction (reactor-initiated or scenario): both directions
+        drop; persistent-peer semantics reconnect after a delay while
+        both ends live."""
+        dropped = False
+        for a, b in ((i, j), (j, i)):
+            if (a, b) in self._adj:
+                self._adj.discard((a, b))
+                node = self.nodes[a]
+                if node.hub is not None:
+                    node.hub.drop(self.node_id(b), reason)
+                dropped = True
+        if dropped and self.reconnect_delay_ns > 0:
+            self.sched.call_after(
+                self.reconnect_delay_ns, self._maybe_reconnect, i, j
+            )
+
+    def _maybe_reconnect(self, i: int, j: int) -> None:
+        if self.nodes[i].alive and self.nodes[j].alive:
+            self.connect(i, j)
+
+    # -- faults ------------------------------------------------------------
+
+    def _fault(self, kind: int, src: int = 0, dst: int = 0,
+               detail: int = 0) -> None:
+        libhealth.record(
+            libhealth.EV_FAULT, height=src, round_=dst, a=kind, b=detail
+        )
+        if self._log:
+            import sys
+
+            print(
+                f"[simnet t={self.clock.now_ns / 1e6:.1f}ms] fault "
+                f"kind={kind} {src}->{dst} detail={detail}",
+                file=sys.stderr,
+            )
+
+    def partition(self, *groups) -> None:
+        """Split the net.  Cross-boundary CONNECTIONS are severed (a
+        real partition kills the TCP link, and with it the peer's
+        gossip mark state — the self-heal on reconnect depends on
+        that), in-flight cross-boundary messages die, and no new
+        connection forms across the boundary until :meth:`heal`.
+        Nodes in no listed group land in their own singleton islands."""
+        mapping: dict[int, int] = {}
+        for g, members in enumerate(groups):
+            for m in members:
+                mapping[m] = g
+        for i in range(self.n):
+            if i not in mapping:
+                mapping[i] = len(groups) + i
+        self._partition = mapping
+        for a, b in sorted(self._adj):
+            if a < b and mapping.get(a) != mapping.get(b):
+                self._sever_pair(a, b, "partitioned")
+        self.stats["partitions"] += 1
+        self._fault(libhealth.FAULT_PARTITION, detail=len(groups))
+
+    def _sever_pair(self, i: int, j: int, reason) -> None:
+        """Drop both directions with NO reconnect schedule (partition
+        semantics; reactor-driven evictions use _disconnect_pair)."""
+        for a, b in ((i, j), (j, i)):
+            if (a, b) in self._adj:
+                self._adj.discard((a, b))
+                node = self.nodes[a]
+                if node.hub is not None:
+                    node.hub.drop(self.node_id(b), reason)
+
+    def heal(self) -> None:
+        """End the partition and re-form the base topology (fresh peers,
+        fresh gossip state — the reconnect a healed TCP net performs)."""
+        self._partition = None
+        self._fault(libhealth.FAULT_HEAL)
+        for a, b in self._topology_edges():
+            if self.nodes[a].alive and self.nodes[b].alive:
+                self.connect(a, b)
+
+    def kill(self, idx: int, crash: bool = True) -> None:
+        """Churn: take node ``idx`` down mid-whatever.  In-flight
+        messages to it die; links drop; a later :meth:`restart` replays
+        its WAL (requires a ``home_root`` net)."""
+        node = self.nodes[idx]
+        if not node.alive:
+            return
+        for j in list(self.neighbors(idx)):
+            for a, b in ((idx, j), (j, idx)):
+                self._adj.discard((a, b))
+                other = self.nodes[a]
+                if other.hub is not None:
+                    other.hub.drop(self.node_id(b), "peer killed")
+        node.shutdown(crash=crash)
+        self.stats["kills"] += 1
+        self._fault(libhealth.FAULT_KILL, src=idx)
+
+    def restart(self, idx: int, block_sync: bool = False) -> None:
+        """Churn: bring a killed node back over its on-disk state (WAL
+        catchup replay runs inside consensus start).  ``block_sync``
+        reboots it in catching-up mode — the blocksync reactor fetches
+        the missed blocks from peers before consensus takes over."""
+        node = self.nodes[idx]
+        if node.alive:
+            return
+        node.restarts += 1
+        node.boot(block_sync=block_sync)
+        node.start()
+        for j in range(self.n):
+            if j != idx and self.nodes[j].alive and (
+                (idx, j) in self._base_edges()
+            ):
+                self.connect(idx, j)
+        self.stats["restarts"] += 1
+        self._fault(libhealth.FAULT_RESTART, src=idx)
+        self.nodes[idx].cs.process_pending()
+
+    def _base_edges(self) -> set[tuple[int, int]]:
+        out = set()
+        for a, b in self._topology_edges():
+            out.add((a, b))
+            out.add((b, a))
+        return out
+
+    def arm_crash_point(self, idx: int, point: str) -> None:
+        """Arm a COMETBFT_TPU_FAIL crash point for ONE sim node: when
+        node ``idx``'s FSM reaches it, the node dies in-process (the
+        commit-chain fail-stop path) instead of killing the pytest
+        process.  Disarm with :meth:`disarm_crash_point`."""
+        net = self
+
+        class _SimCrash(Exception):
+            pass
+
+        def handler(name: str) -> None:
+            cur = net._current_node
+            if cur == idx:
+                net._fault(libhealth.FAULT_CRASH, src=idx)
+                raise _SimCrash(f"crash point {name} on node {idx}")
+
+        libfail.set_target(point)
+        libfail.set_handler(handler)
+
+    def disarm_crash_point(self) -> None:
+        libfail.set_target("")
+        libfail.set_handler(None)
+
+    # -- message plane -----------------------------------------------------
+
+    _current_node: int = -1
+
+    def _send(self, src: int, dst: int, ch: int, msg: bytes) -> bool:
+        if self._stopped:
+            return False
+        if not (self.nodes[src].alive and self.nodes[dst].alive):
+            return False
+        if (src, dst) not in self._adj:
+            return False
+        # no cross-partition branch here: partition() SEVERS adjacency,
+        # so a partitioned pair already failed the _adj check above;
+        # in-flight messages racing a fresh partition are classified at
+        # delivery time (_deliver)
+        link = self._link(src, dst)
+        if link.cfg.drop_classes:
+            try:
+                cls = type(ser.loads(msg)).__name__
+            except Exception:
+                cls = "?"
+            if cls in link.cfg.drop_classes:
+                self._drop(DROP_CLASS, src, dst, ch)
+                return True  # the wire ate it; the sender can't tell
+        deliver_at, dup_at, reason = link.plan(
+            self.clock.now_ns, ch, len(msg)
+        )
+        if reason is not None:
+            self._drop(reason, src, dst, ch)
+            return True
+        self.stats["sent"] += 1
+        self.sched.call_at(deliver_at, self._deliver, src, dst, ch, msg)
+        if dup_at is not None:
+            self.stats["duplicated"] += 1
+            self.sched.call_at(dup_at, self._deliver, src, dst, ch, msg)
+        return True
+
+    def _drop(self, reason: str, src: int, dst: int, ch: int) -> None:
+        self.stats[reason] += 1
+        self.stats["dropped"] += 1
+        self._fault(
+            libhealth.FAULT_DROP, src, dst,
+            detail=(_DROP_TO_FAULT_DETAIL.get(reason, 0) << 8) | ch,
+        )
+
+    def _in_flight_drop_reason(self, src: int, dst: int) -> str:
+        """An undeliverable in-flight message died either to a partition
+        that formed under it or to endpoint churn/eviction."""
+        if self._partition is not None and (
+            self._partition.get(src) != self._partition.get(dst)
+        ):
+            return DROP_PARTITION
+        return DROP_DEAD
+
+    def _deliver(self, src: int, dst: int, ch: int, msg: bytes) -> None:
+        node = self.nodes[dst]
+        if self._stopped or not node.alive:
+            self._drop(self._in_flight_drop_reason(src, dst), src, dst, ch)
+            return
+        peer = node.hub.get_peer(self.node_id(src))
+        if peer is None or not peer.is_running():
+            self._drop(self._in_flight_drop_reason(src, dst), src, dst, ch)
+            return
+        self.stats["delivered"] += 1
+        self.stats[f"delivered_ch_{ch:#04x}"] += 1
+        prev, self._current_node = self._current_node, dst
+        try:
+            node.hub.dispatch(ch, peer, msg)
+            if node.alive:
+                node.cs.process_pending()
+        finally:
+            self._current_node = prev
+
+    def inject(self, src: int, dst: int, ch: int, msg_bytes: bytes) -> bool:
+        """Scenario-level send AS node ``src`` (byzantine behaviors):
+        rides the same links/faults as organic traffic."""
+        return self._send(src, dst, ch, msg_bytes)
+
+    def _tock(self, idx: int, ti) -> None:
+        node = self.nodes[idx]
+        if self._stopped or not node.alive:
+            return
+        cs = node.cs
+        try:
+            cs._queue.put_nowait(("timeout", ti))
+        except queue.Full:
+            cs.process_pending()
+            cs._queue.put_nowait(("timeout", ti))
+        prev, self._current_node = self._current_node, idx
+        try:
+            cs.process_pending()
+        finally:
+            self._current_node = prev
+
+    # -- sim-driven reactor routines ---------------------------------------
+
+    def _stagger_call(self, name: str, period_ns: int, fn, *args) -> None:
+        """First tick lands at a deterministic per-routine offset so N
+        nodes' routines don't all fire on the same virtual instant."""
+        offset = self.sched.sub_rng(f"stagger-{name}").randrange(
+            max(1, period_ns)
+        )
+        self.sched.call_after(offset, fn, *args)
+
+    def _schedule_consensus_ticks(self, idx: int, peer: SimPeer) -> None:
+        cs_cfg = self.config.consensus
+        gossip_ns = cs_cfg.peer_gossip_sleep_duration_ns
+        maj23_ns = cs_cfg.peer_query_maj23_sleep_duration_ns
+        for kind, period in ((0, gossip_ns), (1, gossip_ns), (2, maj23_ns)):
+            self._stagger_call(
+                f"cons-{idx}-{peer.remote}-{kind}", period,
+                self._consensus_tick, idx, peer, kind,
+            )
+
+    def _consensus_tick(self, idx: int, peer: SimPeer, kind: int) -> None:
+        node = self.nodes[idx]
+        if self._stopped or not node.alive or not peer.is_running():
+            return
+        reactor = node.hub.reactors.get("consensus")
+        if reactor is None or not reactor.is_running():
+            return
+        ps = peer.get("consensus_peer_state")
+        busy = False
+        if ps is not None:
+            try:
+                if kind == 2:
+                    reactor._query_maj23_once(
+                        peer, ps, reactor.cs.get_round_state()
+                    )
+                else:
+                    # The thread routine loops back IMMEDIATELY after a
+                    # productive step ('continue', no sleep) — one
+                    # scheduler event per message would drown large nets,
+                    # so a tick drains a burst before yielding.
+                    step = (
+                        reactor._gossip_data_once
+                        if kind == 0
+                        else reactor._gossip_votes_once
+                    )
+                    for _ in range(_GOSSIP_BURST):
+                        if not step(peer, ps, reactor.cs.get_round_state()):
+                            break
+                        busy = True
+            except Exception as e:
+                # keep ticking, but say why (the thread routines log
+                # these failures for the same reason — a persistent
+                # exception here silently stalls gossip)
+                _sim_log().debug(
+                    "gossip tick failed; retrying",
+                    node=idx, peer=peer.remote, kind=kind,
+                    err=repr(e)[:120],
+                )
+        cs_cfg = self.config.consensus
+        period = (
+            cs_cfg.peer_gossip_sleep_duration_ns
+            if kind < 2
+            else cs_cfg.peer_query_maj23_sleep_duration_ns
+        )
+        self.sched.call_after(
+            _BUSY_NS if busy else period,
+            self._consensus_tick, idx, peer, kind,
+        )
+
+    def _evidence_tick(self, idx: int, peer: SimPeer) -> None:
+        node = self.nodes[idx]
+        if self._stopped or not node.alive or not peer.is_running():
+            return
+        reactor = node.hub.reactors.get("evidence")
+        if reactor is None or not reactor.is_running():
+            return
+        try:
+            reactor.gossip_step(peer, now_ns=self.clock.now_ns)
+        except Exception as e:
+            _sim_log().debug(
+                "evidence gossip step failed; retrying next tick",
+                node=idx, peer=peer.remote, err=repr(e)[:120],
+            )
+        self.sched.call_after(
+            _EVIDENCE_TICK_NS, self._evidence_tick, idx, peer
+        )
+
+    def _schedule_blocksync_tick(self, idx: int, delay_ns: int) -> None:
+        self.sched.call_after(delay_ns, self._blocksync_tick, idx)
+
+    def _blocksync_tick(self, idx: int) -> None:
+        node = self.nodes[idx]
+        if self._stopped or not node.alive:
+            return
+        reactor = node.hub.reactors.get("blocksync")
+        if reactor is None or not reactor.is_running():
+            return
+        prev, self._current_node = self._current_node, idx
+        try:
+            outcome = reactor._pool_step(self.clock.monotonic())
+            node.cs.process_pending()
+        except Exception as e:
+            # local apply failure: the reference panics — fail-stop this
+            # node only
+            self._on_node_fatal(idx, e)
+            return
+        finally:
+            self._current_node = prev
+        if outcome == reactor.STEP_SWITCHED:
+            return
+        self._schedule_blocksync_tick(
+            idx,
+            _BLOCKSYNC_APPLIED_NS
+            if outcome == reactor.STEP_APPLIED
+            else _BLOCKSYNC_TICK_NS,
+        )
+
+    def _on_node_fatal(self, idx: int, err) -> None:
+        self.stats["fatal"] += 1
+        if self._log:
+            import sys
+
+            print(f"[simnet] node {idx} fail-stop: {err!r}", file=sys.stderr)
+        self.kill(idx, crash=True)
+
+    # -- run loop ----------------------------------------------------------
+
+    def run(
+        self,
+        until=None,
+        max_virtual_ms: float = 60_000.0,
+        max_events: int = 5_000_000,
+        check_every: int = 16,
+    ) -> bool:
+        """Execute events until ``until()`` is true or the virtual
+        budget runs out.  Returns whether the condition was met."""
+        deadline_ns = self.clock.now_ns + int(max_virtual_ms * 1e6)
+        since_check = 0
+        while True:
+            if until is not None and since_check == 0 and until():
+                return True
+            due = self.sched.next_due_ns()
+            if due is None or due > deadline_ns:
+                self.clock.advance_to(deadline_ns)
+                return bool(until()) if until is not None else False
+            popped = self.sched.pop_due()
+            if popped is None:
+                continue
+            fn, args = popped
+            self._events_run += 1
+            if self._events_run > max_events:
+                raise RuntimeError(
+                    f"simnet runaway: >{max_events} events executed"
+                )
+            fn(*args)
+            since_check = (since_check + 1) % check_every
+        # unreachable
+
+    def run_until_height(
+        self, height: int, nodes=None, max_virtual_ms: float = 60_000.0,
+    ) -> bool:
+        idxs = list(nodes) if nodes is not None else [
+            n.idx for n in self.nodes
+        ]
+
+        def caught_up() -> bool:
+            return all(
+                self.nodes[i].alive and self.nodes[i].height() >= height
+                for i in idxs
+            )
+
+        return self.run(until=caught_up, max_virtual_ms=max_virtual_ms)
+
+    def heights(self) -> list[int]:
+        return [n.height() for n in self.nodes]
+
+    def assert_no_fork(self) -> None:
+        """Safety invariant: every pair of nodes agrees at every common
+        height (block id AND app hash)."""
+        live = [n for n in self.nodes if n.core is not None]
+        if len(live) < 2:
+            return
+        common = min(n.height() for n in live)
+        for h in range(1, common + 1):
+            metas = [n.block_store.load_block_meta(h) for n in live]
+            ids = {m.block_id.hash for m in metas if m is not None}
+            assert len(ids) == 1, f"FORK at height {h}: {ids}"
+            hashes = {
+                m.header.app_hash for m in metas if m is not None
+            }
+            assert len(hashes) == 1, f"app-hash fork at height {h}"

@@ -1,0 +1,224 @@
+"""Simnet node core: one full in-process node wired for the sim plane.
+
+Mirrors the reference's node assembly (node/node.go) at test scale —
+kvstore app, stores, executor, evidence pool, consensus state and the
+consensus/evidence/blocksync reactors — but with every thread seam
+closed: the consensus FSM is ``sim_driven`` (the scheduler pumps its
+inbox), its ticker is the scheduler-backed :class:`SimTicker`, and the
+reactors' per-peer routines run as virtual-time ticks (simnet/net.py).
+
+``home=None`` keeps everything in memory (no WAL).  With a ``home``
+the node gets FileDBs and a real consensus WAL, so churn scenarios can
+kill a node hard and restart it through WAL catchup replay — the same
+recovery path the crash-point subprocess tests exercise.
+"""
+
+from __future__ import annotations
+
+import queue
+
+from ..libs.service import BaseService
+
+
+class SimTicker(BaseService):
+    """Scheduler-backed TimeoutTicker: one pending timeout, newer
+    (H,R,S) replaces older (ticker.go:95 semantics), fire enqueues the
+    tock straight into the FSM inbox — no ticker/forwarder threads."""
+
+    def __init__(self, sched, deliver):
+        super().__init__("sim-ticker")
+        self.sched = sched
+        self._deliver = deliver
+        self._pending = None
+        self._gen = 0
+
+    def schedule_timeout(self, ti) -> None:
+        p = self._pending
+        if p is not None and (ti.height, ti.round, ti.step) < (
+            p.height, p.round, p.step
+        ):
+            return
+        self._gen += 1
+        self._pending = ti
+        self.sched.call_after(
+            int(ti.duration_s * 1e9), self._fire, self._gen
+        )
+
+    def _fire(self, gen: int) -> None:
+        if gen != self._gen or self._pending is None:
+            return  # superseded by a newer schedule
+        if not self.is_running():
+            # the owning FSM stopped (kill/crash): a stale tock must not
+            # leak into a restarted node's fresh inbox
+            return
+        ti, self._pending = self._pending, None
+        self._deliver(ti)
+
+
+class SimListMempool:
+    """Minimal reap-list mempool for tx injection (validator churn, the
+    e2e ``--simnet`` load mode).  Implements exactly the
+    BlockExecutor-facing slice of the mempool contract."""
+
+    def __init__(self):
+        self._txs: list[bytes] = []
+
+    def push_tx(self, tx: bytes) -> None:
+        self._txs.append(tx)
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int):
+        out, total = [], 0
+        for tx in self._txs:
+            if max_bytes >= 0 and total + len(tx) > max_bytes:
+                break
+            out.append(tx)
+            total += len(tx)
+        return out
+
+    def lock(self) -> None:
+        pass
+
+    def unlock(self) -> None:
+        pass
+
+    def update(self, height, txs, tx_results, *a, **k) -> None:
+        committed = set(txs)
+        self._txs = [t for t in self._txs if t not in committed]
+
+
+def build_core(
+    genesis,
+    pv,
+    config,
+    home: str | None = None,
+    app=None,
+    with_evidence: bool = True,
+    block_sync: bool = False,
+    now_fn=None,
+    clock=None,
+):
+    """Assemble one node core.  Returns a dict of parts (the shape
+    tests/helpers.make_consensus_node established, plus reactors).
+
+    ``block_sync=True`` builds the node in catching-up mode: the
+    consensus reactor starts with ``wait_sync`` and a BlocksyncReactor
+    drives the pool until it switches to consensus.
+    """
+    from .. import proxy
+    from ..abci.kvstore import KVStoreApplication
+    from ..blocksync.reactor import BlocksyncReactor
+    from ..consensus import ConsensusState
+    from ..consensus.reactor import ConsensusReactor
+    from ..consensus.wal import WAL
+    from ..evidence import EvidencePool
+    from ..evidence.reactor import EvidenceReactor
+    from ..libs import db as dbm
+    from ..state import BlockExecutor, Store, make_genesis_state
+    from ..store import BlockStore
+    from ..types.event_bus import EventBus
+
+    app_db = None
+    if home is None:
+        if app is None:
+            app_db = dbm.MemDB()
+        state_db = dbm.MemDB()
+        block_db = dbm.MemDB()
+        wal = None
+    else:
+        import os
+
+        os.makedirs(home, exist_ok=True)
+        if app is None:
+            app_db = dbm.FileDB(f"{home}/app.db")
+        state_db = dbm.FileDB(f"{home}/state.db")
+        block_db = dbm.FileDB(f"{home}/blocks.db")
+        os.makedirs(f"{home}/cs.wal", exist_ok=True)
+        wal = WAL(f"{home}/cs.wal/wal")
+    app = app if app is not None else KVStoreApplication(app_db)
+    conns = proxy.AppConns(proxy.local_client_creator(app))
+    conns.start()
+    state_store = Store(state_db)
+    block_store = BlockStore(block_db)
+    bus = EventBus()
+    bus.start()
+    state = state_store.load()
+    if state is None:
+        state = make_genesis_state(genesis)
+        state_store.save(state)
+    evidence_pool = None
+    if with_evidence:
+        evidence_db = dbm.MemDB() if home is None else dbm.FileDB(
+            f"{home}/evidence.db"
+        )
+        evidence_pool = EvidencePool(evidence_db, state_store, block_store)
+    mempool = SimListMempool()
+    executor = BlockExecutor(
+        state_store,
+        conns.consensus,
+        block_store=block_store,
+        event_bus=bus,
+        evidence_pool=evidence_pool,
+        mempool=mempool,
+    )
+    cs = ConsensusState(
+        config.consensus,
+        state,
+        executor,
+        block_store,
+        event_bus=bus,
+        evidence_pool=evidence_pool,
+        wal=wal,
+        clock=clock,
+    )
+    cs.set_priv_validator(pv)
+    cs.sim_driven = True
+
+    consensus_reactor = ConsensusReactor(cs, wait_sync=block_sync)
+    reactors: dict[str, object] = {"consensus": consensus_reactor}
+    if evidence_pool is not None:
+        reactors["evidence"] = EvidenceReactor(evidence_pool)
+    # Every node carries a blocksync reactor — serving stored blocks to
+    # catching-up peers even when it isn't syncing itself (node.go does
+    # the same); only a ``block_sync=True`` node runs the pool.
+    bsr = BlocksyncReactor(
+        state,
+        executor,
+        block_store,
+        block_sync=block_sync,
+        consensus_reactor=consensus_reactor,
+        min_recv_rate=0,  # virtual links have no byte clock to judge
+        now_fn=now_fn,
+    )
+    bsr.sim_driven = True
+    reactors["blocksync"] = bsr
+    return dict(
+        app=app,
+        conns=conns,
+        state_store=state_store,
+        block_store=block_store,
+        bus=bus,
+        executor=executor,
+        mempool=mempool,
+        evidence_pool=evidence_pool,
+        config=config,
+        cs=cs,
+        reactors=reactors,
+        dbs=tuple(
+            db
+            for db in (app_db, state_db, block_db)
+            if db is not None
+        ),
+    )
+
+
+def drain_inbox(cs) -> None:
+    """Drop everything queued for a killed node's FSM so a later
+    restart starts from its WAL, not from stale in-memory messages."""
+    try:
+        while True:
+            cs._queue.get_nowait()
+    except queue.Empty:
+        pass

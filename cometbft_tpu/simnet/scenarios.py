@@ -1,0 +1,577 @@
+"""Scenario engine: scripted fault schedules + assertions over a SimNet.
+
+A scenario is a seeded, self-checking run: it builds a net, arms timed
+fault events (partitions, churn, byzantine behaviors, crash points),
+runs the scheduler until its conditions hold (or the virtual budget
+dies), and returns a :class:`ScenarioResult` carrying the evidence —
+final heights, per-height commit latency/rounds, the fault-annotated
+flight-recorder ring, and a determinism signature: two runs with the
+same ``(seed, scenario)`` produce identical signatures (pinned by
+tests/test_simnet.py).
+
+Registry (``SCENARIOS`` / :func:`run_scenario` / ``python -m
+cometbft_tpu.simnet``):
+
+* ``healthy`` — clean-net baseline;
+* ``byzantine_double_sign`` — a validator equivocates toward ONE honest
+  peer; the resulting DuplicateVoteEvidence must travel the evidence
+  reactor, re-verify on every pool, and land in a committed block;
+* ``partition_heal`` — full split (liveness lost, rounds spin), heal,
+  converge; then a minority split whose healed minority catches up
+  through the reactor's catch-up gossip (the old perfect-gossip
+  harness' missing piece — the 2/16 byzantine-net flake);
+* ``crash_restart`` — an armed COMETBFT_TPU_FAIL crash point kills a
+  node mid-commit; restart replays its WAL and rejoins;
+* ``valset_churn`` — ``val:<pk>!<power>`` txs add a standby node to the
+  validator set mid-run, then evict a genesis validator;
+* ``blocksync_catchup`` — a churned node rejoins via blocksync while a
+  serving peer dies mid-sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shutil
+import tempfile
+
+from ..libs import health as libhealth
+from .link import LinkConfig
+from .net import SimNet, make_genesis
+
+# ring events whose (order, payload) must be bit-identical across runs
+# of one (seed, scenario); wall-stamped codes (wal.fsync) are excluded
+DETERMINISM_CODES = (
+    "consensus.step",
+    "consensus.proposal",
+    "consensus.vote",
+    "consensus.commit",
+    "simnet.fault",
+)
+
+
+def ring_signature() -> tuple:
+    rows = []
+    for r in libhealth.recorder().dump():
+        if r["event"] not in DETERMINISM_CODES:
+            continue
+        d = dict(r)
+        d.pop("ts", None)
+        rows.append(tuple(sorted(d.items())))
+    return tuple(rows)
+
+
+def commit_metrics() -> dict:
+    """Per-height commit latency + rounds-per-height quantiles from the
+    ring's EV_COMMIT rows (all nodes interleaved)."""
+    lat_ms, rounds = [], []
+    for r in libhealth.recorder().dump():
+        if r["event"] != "consensus.commit":
+            continue
+        lat_ms.append(r["dur_ns"] / 1e6)
+        rounds.append(r["round"] + 1)
+
+    def q(xs, p):
+        if not xs:
+            return None
+        ys = sorted(xs)
+        return round(ys[min(len(ys) - 1, int(p * len(ys)))], 3)
+
+    return {
+        "commits": len(lat_ms),
+        "commit_ms": {"p50": q(lat_ms, 0.5), "p99": q(lat_ms, 0.99)},
+        "rounds_per_height": {
+            "mean": round(sum(rounds) / len(rounds), 3) if rounds else None,
+            "p99": q(rounds, 0.99),
+        },
+    }
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    seed: int
+    ok: bool
+    heights: list
+    virtual_ms: float
+    events_run: int
+    stats: dict
+    metrics: dict
+    signature: tuple
+    failures: list
+    notes: dict
+
+    def summary(self) -> dict:
+        return {
+            "scenario": self.name,
+            "seed": self.seed,
+            "ok": self.ok,
+            "heights": self.heights,
+            "virtual_ms": round(self.virtual_ms, 3),
+            "events": self.events_run,
+            "dropped": self.stats.get("dropped", 0),
+            "failures": self.failures,
+            **self.metrics,
+            **self.notes,
+        }
+
+
+class _Run:
+    """Shared scaffolding: recorder reset/enable, optional home root,
+    net teardown, result assembly."""
+
+    def __init__(self, name: str, seed: int, homes: bool = False):
+        self.name = name
+        self.seed = seed
+        self.failures: list[str] = []
+        self.notes: dict = {}
+        self.home_root = (
+            tempfile.mkdtemp(prefix=f"simnet-{name}-") if homes else None
+        )
+        self._prev_enabled = libhealth.enabled()
+        libhealth.reset()
+        libhealth.enable()
+        self.net: SimNet | None = None
+
+    def check(self, cond: bool, what: str) -> bool:
+        if not cond:
+            self.failures.append(what)
+        return cond
+
+    def finish(self) -> ScenarioResult:
+        net = self.net
+        try:
+            if net is not None:
+                try:
+                    net.assert_no_fork()
+                except AssertionError as e:
+                    self.failures.append(str(e))
+            res = ScenarioResult(
+                name=self.name,
+                seed=self.seed,
+                ok=not self.failures,
+                heights=net.heights() if net is not None else [],
+                virtual_ms=(net.clock.now_ns / 1e6) if net is not None else 0,
+                events_run=net._events_run if net is not None else 0,
+                stats=dict(net.stats) if net is not None else {},
+                metrics=commit_metrics(),
+                signature=(
+                    tuple(net.heights()) if net is not None else (),
+                    ring_signature(),
+                ),
+                failures=self.failures,
+                notes=self.notes,
+            )
+        finally:
+            if net is not None:
+                net.stop()
+            from ..libs import fail as libfail
+
+            libfail.set_target("")
+            libfail.set_handler(None)
+            if not self._prev_enabled:
+                libhealth.disable()
+            if self.home_root is not None:
+                shutil.rmtree(self.home_root, ignore_errors=True)
+        return res
+
+
+# -------------------------------------------------------------- behaviors
+
+
+def equivocate(net: SimNet, byz_idx: int, targets: list[int]) -> None:
+    """Make node ``byz_idx`` double-sign: every non-nil prevote it emits
+    is shadowed by a validly-signed CONFLICTING prevote delivered to
+    ``targets`` only (so the rest of the net can learn of the
+    equivocation only through evidence gossip)."""
+    import copy
+
+    from ..consensus.messages import VoteMessage
+    from ..consensus.reactor import VOTE_CHANNEL
+    from ..types import canonical
+    from ..types import serialization as ser
+    from ..types.block import BlockID, PartSetHeader
+
+    cs = net.nodes[byz_idx].cs
+    pv = cs.priv_validator
+    orig = cs._send_internal
+
+    def send(msg, orig=orig):
+        orig(msg)
+        if not isinstance(msg, VoteMessage):
+            return
+        vote = msg.vote
+        if vote.msg_type != canonical.PREVOTE_TYPE or vote.block_id.is_nil():
+            return
+        evil = copy.copy(vote)
+        evil.block_id = BlockID(
+            b"\xEE" * 32, PartSetHeader(total=1, hash=b"\xDD" * 32)
+        )
+        evil.signature = b""
+        pv.sign_vote(cs.state.chain_id, evil, sign_extension=False)
+        raw = ser.dumps(VoteMessage(evil))
+        for j in targets:
+            net.inject(byz_idx, j, VOTE_CHANNEL, raw)
+
+    cs._send_internal = send
+
+
+def flood_invalid_votes(net: SimNet, byz_idx: int) -> None:
+    """consensus/invalid_test.go behavior: shadow every own vote with
+    malformed variants (garbage signature, out-of-set index, far-future
+    round) toward every peer."""
+    import copy
+
+    from ..consensus.messages import VoteMessage
+    from ..consensus.reactor import VOTE_CHANNEL
+    from ..types import serialization as ser
+
+    cs = net.nodes[byz_idx].cs
+    orig = cs._send_internal
+
+    def send(msg, orig=orig):
+        orig(msg)
+        if not isinstance(msg, VoteMessage):
+            return
+        base = msg.vote
+        variants = []
+        v1 = copy.copy(base)
+        v1.signature = b"\xAB" * 64
+        variants.append(v1)
+        v2 = copy.copy(base)
+        v2.validator_index = 99
+        variants.append(v2)
+        v3 = copy.copy(base)
+        v3.round = base.round + 7
+        variants.append(v3)
+        for j in range(net.n):
+            if j == byz_idx:
+                continue
+            for v in variants:
+                net.inject(byz_idx, j, VOTE_CHANNEL, ser.dumps(VoteMessage(v)))
+
+    cs._send_internal = send
+
+
+def find_committed_evidence(net: SimNet, node_idx: int):
+    """-> (height, [evidence]) of the first committed block carrying
+    evidence on ``node_idx``, or None."""
+    store = net.nodes[node_idx].block_store
+    for h in range(2, store.height() + 1):
+        blk = store.load_block(h)
+        if blk is not None and blk.evidence:
+            return h, list(blk.evidence)
+    return None
+
+
+# -------------------------------------------------------------- scenarios
+
+
+def scenario_healthy(seed: int, n_nodes: int = 4, heights: int = 5,
+                     link: LinkConfig | None = None,
+                     topology="mesh", max_virtual_ms: float = 120_000.0,
+                     **_):
+    run = _Run("healthy", seed)
+    net = run.net = SimNet(
+        n_nodes, seed=seed, topology=topology,
+        default_link=link if link is not None else LinkConfig(),
+    )
+    net.start()
+    ok = net.run_until_height(heights, max_virtual_ms=max_virtual_ms)
+    run.check(ok, f"net never reached height {heights}: {net.heights()}")
+    return run.finish()
+
+
+def scenario_byzantine_double_sign(seed: int, n_nodes: int = 4,
+                                   heights: int = 5, **_):
+    from ..evidence.reactor import EVIDENCE_CHANNEL
+    from ..types.evidence import DuplicateVoteEvidence
+
+    run = _Run("byzantine_double_sign", seed)
+    net = run.net = SimNet(n_nodes, seed=seed)
+    net.start()
+    byz = n_nodes - 1
+    witness = 1  # the only honest node shown the conflicting votes
+    equivocate(net, byz, [witness])
+    honest = [i for i in range(n_nodes) if i != byz]
+
+    def done() -> bool:
+        if not all(net.nodes[i].height() >= heights for i in honest):
+            return False
+        return find_committed_evidence(net, honest[0]) is not None
+
+    ok = net.run_until_height(2, nodes=honest, max_virtual_ms=60_000)
+    ok = net.run(until=done, max_virtual_ms=240_000) and ok
+    run.check(ok, f"no evidence committed by {net.heights()}")
+    found = find_committed_evidence(net, honest[0])
+    if run.check(found is not None, "no committed evidence block"):
+        h, evs = found
+        ev = evs[0]
+        byz_addr = bytes(net.pvs[byz].get_pub_key().address())
+        run.check(
+            isinstance(ev, DuplicateVoteEvidence), f"wrong type {type(ev)}"
+        )
+        run.check(
+            bytes(ev.vote_a.validator_address) == byz_addr,
+            "evidence names the wrong validator",
+        )
+        run.check(
+            ev.vote_a.block_id != ev.vote_b.block_id,
+            "votes do not conflict",
+        )
+        # the pool marks evidence committed on EVERY node that applied
+        # the block — the end of the gossip->verify->commit pipeline
+        committed_on = [
+            i for i in honest
+            if net.nodes[i].core["evidence_pool"].is_committed(ev)
+        ]
+        run.check(
+            len(committed_on) == len(honest),
+            f"evidence committed only on {committed_on}",
+        )
+        run.notes["evidence_height"] = h
+    # the non-witness nodes can ONLY have learned via evidence/reactor
+    ev_hops = net.stats.get(f"delivered_ch_{EVIDENCE_CHANNEL:#04x}", 0)
+    run.check(ev_hops > 0, "evidence channel never carried a message")
+    run.notes["evidence_channel_msgs"] = ev_hops
+    return run.finish()
+
+
+def scenario_partition_heal(seed: int, n_nodes: int = 4, **_):
+    run = _Run("partition_heal", seed)
+    net = run.net = SimNet(n_nodes, seed=seed)
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    # phase 1: full split — BOTH halves lose quorum; rounds must spin
+    # without a commit, and no fork may form
+    h_split = max(net.heights())
+    half = n_nodes // 2
+    net.partition(range(half), range(half, n_nodes))
+    net.run(max_virtual_ms=3_000)
+    run.check(
+        max(net.heights()) <= h_split + 1,
+        f"committed through a full partition: {net.heights()}",
+    )
+    net.heal()
+    target = h_split + 2
+    run.check(
+        net.run_until_height(target, max_virtual_ms=120_000),
+        f"no convergence after full-split heal: {net.heights()}",
+    )
+    # phase 2: minority split — the majority side keeps committing; the
+    # healed minority must CATCH UP (the reactor's catch-up gossip, the
+    # machinery the perfect-gossip harness lacked)
+    minority = 0
+    net.partition([minority], range(1, n_nodes))
+    h_before = net.nodes[minority].height()
+    majority = list(range(1, n_nodes))
+    run.check(
+        net.run_until_height(
+            h_before + 3, nodes=majority, max_virtual_ms=120_000
+        ),
+        f"majority stalled under minority split: {net.heights()}",
+    )
+    net.heal()
+    target = max(net.heights()) + 1
+    run.check(
+        net.run_until_height(target, max_virtual_ms=120_000),
+        f"minority never caught up after heal: {net.heights()}",
+    )
+    run.notes["minority_caught_up_from"] = h_before
+    return run.finish()
+
+
+def scenario_crash_restart(seed: int, n_nodes: int = 4,
+                           crash_point: str = "cs-after-save-block", **_):
+    run = _Run("crash_restart", seed, homes=True)
+    net = run.net = SimNet(n_nodes, seed=seed, home_root=run.home_root)
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    victim = 2
+    net.arm_crash_point(victim, crash_point)
+    died = net.run(
+        until=lambda: not net.nodes[victim].alive, max_virtual_ms=60_000
+    )
+    run.check(died, f"crash point {crash_point} never fired")
+    net.disarm_crash_point()
+    h_dead = net.nodes[victim].height()
+    survivors = [i for i in range(n_nodes) if i != victim]
+    net.run_until_height(
+        h_dead + 2, nodes=survivors, max_virtual_ms=120_000
+    )
+    net.restart(victim)  # WAL catchup replay inside consensus start
+    target = max(net.heights()) + 2
+    run.check(
+        net.run_until_height(target, max_virtual_ms=240_000),
+        f"crashed node never rejoined: {net.heights()}",
+    )
+    run.check(net.nodes[victim].restarts == 1, "restart not recorded")
+    run.notes["crashed_at_height"] = h_dead
+    return run.finish()
+
+
+def scenario_valset_churn(seed: int, heights_after: int = 4, **_):
+    """4 genesis validators + 1 standby full node; a val-update tx adds
+    the standby to the set (the 8_valset_update path end-to-end: tx →
+    FinalizeBlock validator_updates → ValidatorSet churn → the new
+    validator signs), then a second tx evicts a genesis validator."""
+    from ..crypto.keys import Ed25519PrivKey
+    from ..types import MockPV
+
+    run = _Run("valset_churn", seed)
+    genesis, pvs = make_genesis(4)
+    standby_pv = MockPV(Ed25519PrivKey.from_seed(bytes([99]) * 32))
+    net = run.net = SimNet(
+        5, seed=seed, genesis=genesis, pvs=pvs + [standby_pv]
+    )
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    standby_pk = standby_pv.get_pub_key()
+    add_tx = b"val:%s!10" % standby_pk.bytes().hex().encode()
+    net.nodes[0].core["mempool"].push_tx(add_tx)
+
+    def joined() -> bool:
+        st = net.nodes[0].cs.state
+        return st is not None and st.validators.has_address(
+            bytes(standby_pk.address())
+        )
+
+    run.check(
+        net.run(until=joined, max_virtual_ms=120_000),
+        f"standby never joined the validator set: {net.heights()}",
+    )
+    h_joined = max(net.heights())
+    run.notes["joined_at_height"] = h_joined
+    # the chain must keep committing WITH the 5-validator set — the new
+    # validator's signatures now count toward quorum
+    run.check(
+        net.run_until_height(h_joined + heights_after,
+                             max_virtual_ms=240_000),
+        f"stall after valset grew: {net.heights()}",
+    )
+    # the standby must actually be SIGNING now, not just listed: some
+    # committed block's last_commit carries its signature
+    standby_addr = bytes(standby_pk.address())
+    store = net.nodes[0].block_store
+
+    def standby_signed() -> bool:
+        for h in range(h_joined, store.height() + 1):
+            blk = store.load_block(h)
+            if blk is None or blk.last_commit is None:
+                continue
+            for sig in blk.last_commit.signatures:
+                if (
+                    sig.signature
+                    and bytes(sig.validator_address) == standby_addr
+                ):
+                    return True
+        return False
+
+    run.check(standby_signed(), "standby listed but never signed a commit")
+    # now evict genesis validator 3 (power 0 = removal)
+    evict_pk = pvs[3].get_pub_key()
+    net.nodes[0].core["mempool"].push_tx(
+        b"val:%s!0" % evict_pk.bytes().hex().encode()
+    )
+
+    def evicted() -> bool:
+        st = net.nodes[0].cs.state
+        return st is not None and not st.validators.has_address(
+            bytes(evict_pk.address())
+        )
+
+    run.check(
+        net.run(until=evicted, max_virtual_ms=120_000),
+        "genesis validator never evicted",
+    )
+    h_evict = max(net.heights())
+    run.check(
+        net.run_until_height(h_evict + 2, max_virtual_ms=120_000),
+        f"stall after eviction: {net.heights()}",
+    )
+    run.notes["evicted_at_height"] = h_evict
+    run.notes["final_valset_size"] = len(
+        net.nodes[0].cs.state.validators.validators
+    )
+    return run.finish()
+
+
+def scenario_blocksync_catchup(seed: int, n_nodes: int = 4, **_):
+    """Churn + blocksync: a killed node rejoins via the blocksync pool,
+    losing one serving peer mid-sync, then switches to consensus and
+    restores quorum."""
+    run = _Run("blocksync_catchup", seed, homes=True)
+    net = run.net = SimNet(n_nodes, seed=seed, home_root=run.home_root)
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    straggler, lost_peer = 3, 1
+    net.kill(straggler)
+    survivors = [i for i in range(n_nodes) if i != straggler]
+    run.check(
+        net.run_until_height(7, nodes=survivors, max_virtual_ms=240_000),
+        f"survivors stalled: {net.heights()}",
+    )
+    net.restart(straggler, block_sync=True)
+
+    def mid_sync() -> bool:
+        return net.nodes[straggler].height() >= 4
+
+    run.check(
+        net.run(until=mid_sync, max_virtual_ms=120_000),
+        f"blocksync never progressed: {net.heights()}",
+    )
+    # peer loss mid-sync: 2 validators left — consensus halts, but the
+    # pool re-picks and finishes from the remaining stores
+    net.kill(lost_peer)
+    bsr = net.nodes[straggler].core["reactors"]["blocksync"]
+    run.check(
+        net.run(until=lambda: bsr.synced.is_set(), max_virtual_ms=240_000),
+        f"blocksync never switched to consensus: {net.heights()}",
+    )
+    run.notes["blocks_synced"] = bsr._n_synced
+    run.check(bsr._n_synced > 0, "pool applied no blocks")
+    # straggler back in consensus restores quorum (3/4) — the chain
+    # must advance again
+    live = [i for i in range(n_nodes) if net.nodes[i].alive]
+    target = max(net.heights()) + 2
+    run.check(
+        net.run_until_height(target, nodes=live, max_virtual_ms=240_000),
+        f"no progress after straggler rejoined: {net.heights()}",
+    )
+    net.restart(lost_peer)
+    run.check(
+        net.run_until_height(target, max_virtual_ms=240_000),
+        f"lost peer never converged after restart: {net.heights()}",
+    )
+    return run.finish()
+
+
+SCENARIOS = {
+    "healthy": scenario_healthy,
+    "byzantine_double_sign": scenario_byzantine_double_sign,
+    "partition_heal": scenario_partition_heal,
+    "crash_restart": scenario_crash_restart,
+    "valset_churn": scenario_valset_churn,
+    "blocksync_catchup": scenario_blocksync_catchup,
+}
+
+
+def run_scenario(name: str, seed: int, **kw) -> ScenarioResult:
+    try:
+        fn = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; have {sorted(SCENARIOS)}"
+        ) from None
+    return fn(seed, **kw)

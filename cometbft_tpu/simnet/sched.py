@@ -1,0 +1,143 @@
+"""Deterministic discrete-event scheduler + virtual clock.
+
+The simnet plane runs EVERY moving part of an N-node net — message
+deliveries, consensus timeouts, gossip ticks, blocksync pool steps,
+scenario fault events — as events on ONE priority queue executed by ONE
+thread in virtual time.  Determinism falls out of three rules:
+
+* ordering: events execute by ``(due_ns, seq)`` — the monotone ``seq``
+  breaks virtual-time ties in scheduling order, so two runs that
+  schedule the same events execute them identically;
+* randomness: every random draw (jitter, drops, reorder, vote pick)
+  comes from a named child of one master ``random.Random(seed)`` —
+  names hash through :func:`crc32`, never Python's per-process
+  randomized ``hash()``, so ``--seed N`` reproduces across processes;
+* time: components read the :class:`SimClock`, never the wall clock, so
+  a timeout scheduled for +40 virtual ms fires after exactly the events
+  that precede it, however long the host actually took.
+
+``simnet.sched._mtx`` guards only heap push/pop (scenario authors may
+arm events from the test thread before the run loop starts); it is
+never held across a callback or another lock and is asserted edge-free
+in tests/test_lint_graph.py like ``libs.trace._mtx``.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import zlib
+
+from ..libs import sync as libsync
+
+
+class SimClock:
+    """Virtual time: monotonic ns since simulation start, plus a wall
+    view anchored at ``base_wall_ns`` (so signed vote/proposal
+    timestamps stay in the chain's epoch).  Duck-types the slice of the
+    ``time`` module the consensus FSM reads (``time_ns``,
+    ``monotonic``), so it drops into ``ConsensusState._clock``."""
+
+    __slots__ = ("_now_ns", "base_wall_ns")
+
+    def __init__(self, base_wall_ns: int = 1_700_000_000_000_000_000):
+        self._now_ns = 0
+        self.base_wall_ns = base_wall_ns
+
+    @property
+    def now_ns(self) -> int:
+        return self._now_ns
+
+    def advance_to(self, t_ns: int) -> None:
+        if t_ns > self._now_ns:
+            self._now_ns = t_ns
+
+    # -- the time-module view ---------------------------------------------
+
+    def time_ns(self) -> int:
+        return self.base_wall_ns + self._now_ns
+
+    def monotonic(self) -> float:
+        return self._now_ns / 1e9
+
+    def monotonic_ns(self) -> int:
+        return self._now_ns
+
+    def perf_counter(self) -> float:
+        return self._now_ns / 1e9
+
+
+def crc32(name: str) -> int:
+    """Process-stable string hash for child-rng derivation (Python's
+    ``hash(str)`` is salted per process and would break ``--seed``
+    reproduction across runs)."""
+    return zlib.crc32(name.encode())
+
+
+class SimScheduler:
+    """Seeded discrete-event loop core: a heap of ``(due_ns, seq, fn,
+    args)``.  :meth:`pop_due` advances the clock to each event; the run
+    loop (simnet/net.py) owns execution so it can interleave node inbox
+    drains deterministically."""
+
+    def __init__(self, seed: int, clock: SimClock | None = None):
+        self.seed = seed
+        self.clock = clock if clock is not None else SimClock()
+        self.rng = random.Random(seed)
+        self._heap: list[tuple[int, int, object, tuple]] = []
+        self._seq = itertools.count()
+        self._cancelled: set[int] = set()
+        # heap push/pop only; never held across a callback or any other
+        # lock (edge-free in lockorder.json)
+        self._mtx = libsync.Mutex("simnet.sched._mtx")
+
+    def sub_rng(self, name: str) -> random.Random:
+        """A named child rng, stable across processes for one seed."""
+        return random.Random((self.seed << 32) ^ crc32(name))
+
+    # -- scheduling --------------------------------------------------------
+
+    def call_at(self, t_ns: int, fn, *args) -> int:
+        """Arm ``fn(*args)`` at virtual ``t_ns`` (clamped to now);
+        returns a token usable with :meth:`cancel`."""
+        with self._mtx:
+            seq = next(self._seq)
+            heapq.heappush(
+                self._heap, (max(t_ns, self.clock.now_ns), seq, fn, args)
+            )
+            return seq
+
+    def call_after(self, delay_ns: int, fn, *args) -> int:
+        return self.call_at(self.clock.now_ns + max(0, int(delay_ns)), fn, *args)
+
+    def cancel(self, token: int) -> None:
+        """Lazy cancellation: the event stays heaped but is skipped."""
+        with self._mtx:
+            self._cancelled.add(token)
+
+    # -- consumption (run loop in simnet/net.py) ---------------------------
+
+    def pending(self) -> int:
+        with self._mtx:
+            return len(self._heap) - len(self._cancelled)
+
+    def next_due_ns(self) -> int | None:
+        with self._mtx:
+            while self._heap and self._heap[0][1] in self._cancelled:
+                _, seq, _, _ = heapq.heappop(self._heap)
+                self._cancelled.discard(seq)
+            return self._heap[0][0] if self._heap else None
+
+    def pop_due(self) -> tuple[object, tuple] | None:
+        """Pop the next live event, advancing the clock to its due
+        time.  Returns ``(fn, args)`` or None when the heap is empty."""
+        with self._mtx:
+            while self._heap:
+                due, seq, fn, args = heapq.heappop(self._heap)
+                if seq in self._cancelled:
+                    self._cancelled.discard(seq)
+                    continue
+                self.clock.advance_to(due)
+                return fn, args
+            return None

@@ -2,22 +2,23 @@
 consensus/byzantine_test.go — a decorated validator double-signs;
 honest nodes must keep committing, build DuplicateVoteEvidence, include
 it in a later block, and deliver it to the app as misbehavior).
-"""
 
-import copy
-import time
+Runs on the simnet plane (cometbft_tpu/simnet): real reactors over
+seeded virtual links WITH catch-up gossip — the old perfect-gossip
+harness had none, which stranded the byzantine node mid-height and was
+the documented 2/16 liveness flake.  Simnet runs are deterministic from
+the seed, so these cases cannot flake by schedule.
+"""
 
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.abci.kvstore import KVStoreApplication
-from cometbft_tpu.types import canonical
-from cometbft_tpu.types.evidence import DuplicateVoteEvidence
-
-from helpers import (
-    make_consensus_node,
-    make_genesis,
-    stop_node,
-    wire_perfect_gossip,
+from cometbft_tpu.simnet import SimNet
+from cometbft_tpu.simnet.scenarios import (
+    equivocate,
+    find_committed_evidence,
+    flood_invalid_votes,
 )
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
 
 
 class MisbehaviorApp(KVStoreApplication):
@@ -33,171 +34,64 @@ class MisbehaviorApp(KVStoreApplication):
         return super().finalize_block(req)
 
 
-def _equivocate(byz_idx, nodes, css):
-    """Intercept the byzantine node's own votes: honest peers receive a
-    CONFLICTING duplicate (same H/R/type, different block id) alongside
-    the real vote — the double-sign a byzantine validator would emit."""
-    byz_cs = css[byz_idx]
-    byz_pv = byz_cs.priv_validator
-    orig = byz_cs._send_internal  # already wrapped by perfect gossip
-
-    def send(msg, orig=orig):
-        from cometbft_tpu.consensus.messages import VoteMessage
-        from cometbft_tpu.types.block import BlockID, PartSetHeader
-
-        orig(msg)
-        if not isinstance(msg, VoteMessage):
-            return
-        vote = msg.vote
-        if vote.msg_type != canonical.PREVOTE_TYPE or vote.block_id.is_nil():
-            return
-        evil = copy.copy(vote)
-        evil.block_id = BlockID(
-            b"\xEE" * 32, PartSetHeader(total=1, hash=b"\xDD" * 32)
-        )
-        evil.signature = b""
-        byz_pv.sign_vote(byz_cs.state.chain_id, evil, sign_extension=False)
-        for j, other in enumerate(css):
-            if j != byz_idx:
-                other.add_vote_from_peer(evil, f"byz{byz_idx}")
-
-    byz_cs._send_internal = send
-
-
-def _send_invalid_votes(byz_idx, css):
-    """consensus/invalid_test.go: a byzantine validator floods peers with
-    malformed precommits — garbage signature, wrong validator index,
-    absurd round. Honest vote sets must reject them all without crashing
-    or stalling."""
-    import copy as _copy
-
-    byz_cs = css[byz_idx]
-    orig = byz_cs._send_internal
-
-    def send(msg, orig=orig):
-        from cometbft_tpu.consensus.messages import VoteMessage
-
-        orig(msg)
-        if not isinstance(msg, VoteMessage):
-            return
-        base = msg.vote
-        variants = []
-        v1 = _copy.copy(base)
-        v1.signature = b"\xAB" * 64  # garbage signature
-        variants.append(v1)
-        v2 = _copy.copy(base)
-        v2.validator_index = 99  # index out of set
-        variants.append(v2)
-        v3 = _copy.copy(base)
-        v3.round = base.round + 7  # vote for a far-future round
-        variants.append(v3)
-        for j, other in enumerate(css):
-            if j == byz_idx:
-                continue
-            for v in variants:
-                other.add_vote_from_peer(v, f"byz{byz_idx}")
-
-    byz_cs._send_internal = send
-
-
 def test_invalid_votes_do_not_stall_the_net():
-    genesis, pvs = make_genesis(4)
-    nodes = [make_consensus_node(genesis, pvs[i]) for i in range(4)]
-    css = [cs for cs, _ in nodes]
+    """consensus/invalid_test.go: malformed precommit floods (garbage
+    signature, out-of-set index, absurd round) must not stall or fork
+    the honest majority."""
+    net = SimNet(4, seed=21)
     try:
-        wire_perfect_gossip(nodes)
-        _send_invalid_votes(3, css)
-        for cs in css:
-            cs.start()
-        target = 4
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
-            if min(p["block_store"].height() for _, p in nodes) >= target:
-                break
-            time.sleep(0.05)
-        heights = [p["block_store"].height() for _, p in nodes]
-        assert min(heights) >= target, f"stalled under invalid votes: {heights}"
-        # and no fork
-        for h in range(1, min(heights) + 1):
-            ids = {
-                p["block_store"].load_block_meta(h).block_id.hash
-                for _, p in nodes
-            }
-            assert len(ids) == 1, f"fork at {h}"
+        net.start()
+        flood_invalid_votes(net, 3)
+        assert net.run_until_height(4, max_virtual_ms=240_000), (
+            f"stalled under invalid votes: {net.heights()}"
+        )
+        net.assert_no_fork()
     finally:
-        for cs, parts in nodes:
-            stop_node(cs, parts)
+        net.stop()
 
 
 def test_byzantine_double_sign_becomes_block_evidence():
-    genesis, pvs = make_genesis(4)
     apps = [MisbehaviorApp() for _ in range(4)]
-    nodes = [
-        make_consensus_node(
-            genesis, pvs[i], app=apps[i], with_evidence=True
-        )
-        for i in range(4)
-    ]
-    css = [cs for cs, _ in nodes]
+    net = SimNet(4, seed=22, app_factory=lambda i: apps[i])
     byz_idx = 3
     try:
-        wire_perfect_gossip(nodes)
-        _equivocate(byz_idx, nodes, css)
-        for cs in css:
-            cs.start()
+        net.start()
+        # every honest node sees the conflicting pair directly (the
+        # byzantine_test.go shape; the reactor-gossip-only variant is
+        # tests/test_simnet.py::test_scenario_byzantine_double_sign)
+        equivocate(net, byz_idx, [0, 1, 2])
 
-        # HONEST nodes must keep committing despite the equivocation.
-        # (The byzantine node may strand itself mid-height: the perfect-
-        # gossip harness has no catch-up gossip, and its fate is not the
-        # test's subject — byzantine_test.go likewise waits on honest
-        # nodes only.)
-        honest = [p for i, (_, p) in enumerate(nodes) if i != byz_idx]
-        target = 4
-        deadline = time.monotonic() + 90
-        evidenced = None
-        while time.monotonic() < deadline:
-            heights = [p["block_store"].height() for p in honest]
-            if min(heights) >= target:
-                # look for a block carrying the duplicate-vote evidence
-                for parts in honest:
-                    store = parts["block_store"]
-                    for h in range(2, store.height() + 1):
-                        blk = store.load_block(h)
-                        if blk and blk.evidence:
-                            evidenced = (h, blk.evidence)
-                            break
-                    if evidenced:
-                        break
-                if evidenced:
-                    break
-            time.sleep(0.05)
+        # ALL nodes must keep committing: simnet's catch-up gossip means
+        # the byzantine node cannot strand itself mid-height (the old
+        # perfect-gossip harness flake).
+        def evidenced():
+            if min(net.heights()) < 4:
+                return False
+            return find_committed_evidence(net, 0) is not None
 
-        heights = [p["block_store"].height() for p in honest]
-        assert min(heights) >= target, f"no progress: {heights}"
-        assert evidenced, "duplicate-vote evidence never entered a block"
-        h, evs = evidenced
+        assert net.run(until=evidenced, max_virtual_ms=240_000), (
+            f"no evidence committed: {net.heights()}"
+        )
+        net.assert_no_fork()
+        h, evs = find_committed_evidence(net, 0)
         ev = evs[0]
         assert isinstance(ev, DuplicateVoteEvidence)
-        byz_addr = bytes(pvs[byz_idx].get_pub_key().address())
+        byz_addr = bytes(net.pvs[byz_idx].get_pub_key().address())
         assert bytes(ev.vote_a.validator_address) == byz_addr
         assert ev.vote_a.block_id != ev.vote_b.block_id
 
         # the app learned about it as misbehavior (state/execution.go
         # buildLastCommitInfo + misbehavior conversion)
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not any(
-            a.misbehavior for a in apps
-        ):
-            time.sleep(0.05)
-        reported = [a.misbehavior for a in apps if a.misbehavior]
-        assert reported, "no app received misbehavior"
-        _, mbs = reported[0][0]
-        assert any(
-            bytes(mb.validator.address) == byz_addr for mb in mbs
+        def reported():
+            return any(a.misbehavior for a in apps)
+
+        assert net.run(until=reported, max_virtual_ms=120_000), (
+            "no app received misbehavior"
         )
+        _, mbs = next(a for a in apps if a.misbehavior).misbehavior[0]
+        assert any(bytes(mb.validator.address) == byz_addr for mb in mbs)
         assert all(
             mb.type == abci.MisbehaviorType.DUPLICATE_VOTE for mb in mbs
         )
     finally:
-        for cs, parts in nodes:
-            stop_node(cs, parts)
+        net.stop()

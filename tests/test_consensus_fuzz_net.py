@@ -1,23 +1,22 @@
-"""Randomized-schedule consensus net: seeded message drops, delays, and
-duplication over the in-process gossip mesh (reference analog: the e2e
-generator's randomized perturbation manifests + FuzzedConnection, and
-consensus invalid/byzantine randomized tiers).
+"""Randomized-schedule consensus net on the simnet plane: seeded
+message drops, jitter, and reordering over real reactors (reference
+analog: the e2e generator's randomized perturbation manifests +
+FuzzedConnection, and consensus invalid/byzantine randomized tiers).
 
 Safety is the invariant that must hold under ANY schedule: nodes may
 stall (liveness needs timeouts to win eventually) but two nodes must
-never commit different blocks at the same height.
+never commit different blocks at the same height.  The old harness
+hand-rolled a lossy perfect-gossip mesh plus a catch-up pump thread;
+simnet's reactors carry their own catch-up gossip, and the whole run
+is reproducible from the seed — a failing seed IS the repro.
 """
 
 import dataclasses
-import random
-import threading
-import time
 
 import pytest
 
 from cometbft_tpu.config import test_config as make_test_config
-
-from helpers import make_consensus_node, make_genesis, stop_node
+from cometbft_tpu.simnet import LinkConfig, SimNet
 
 _MS = 1_000_000
 
@@ -39,194 +38,65 @@ def _lossy_config():
     )
     return cfg
 
+
 SEEDS = [7, 21, 1234, 5150]
 
-
-def wire_lossy_gossip(nodes, rng, drop=0.06, dup=0.05, max_delay=0.05):
-    """Perfect gossip, degraded: each delivery may be dropped, duplicated,
-    or delayed on a timer thread (seeded, reproducible)."""
-    from cometbft_tpu.consensus.messages import (
-        BlockPartMessage,
-        ProposalMessage,
-        VoteMessage,
-    )
-
-    css = [cs for cs, _ in nodes]
-
-    def deliver(other, msg, me):
-        if isinstance(msg, VoteMessage):
-            other.add_vote_from_peer(msg.vote, f"node{me}")
-        elif isinstance(msg, ProposalMessage):
-            other.set_proposal_from_peer(msg.proposal, f"node{me}")
-        elif isinstance(msg, BlockPartMessage):
-            other.add_block_part_from_peer(
-                msg.height, msg.round, msg.part, f"node{me}"
-            )
-
-    for i, cs in enumerate(css):
-        orig = cs._send_internal
-
-        def send(msg, cs=cs, orig=orig, me=i):
-            orig(msg)
-            for j, other in enumerate(css):
-                if j == me:
-                    continue
-                r = rng.random()
-                if r < drop:
-                    continue  # lost on the wire
-                copies = 2 if r < drop + dup else 1
-                delay = rng.random() * max_delay
-                for _ in range(copies):
-                    if delay < 0.005:
-                        deliver(other, msg, me)
-                    else:
-                        t = threading.Timer(
-                            delay, deliver, args=(other, msg, me)
-                        )
-                        t.daemon = True
-                        t.start()
-
-        cs._send_internal = send
-
-
-def start_catchup_pump(nodes, stop_evt):
-    """Emulate the consensus reactor's catch-up gossip
-    (consensus/reactor.go gossipDataForCatchup + vote catchup): the lossy
-    mesh drops messages forever, but the real reactor re-gossips decided
-    blocks and commit votes to lagging peers, so a dropped commit is a
-    delay, not a death sentence."""
-    from cometbft_tpu.types import canonical
-    from cometbft_tpu.types.vote import Vote
-
-    def regossip_votes(ai, acs):
-        """The reactor's gossipVotesRoutine role: a vote dropped by the
-        lossy mesh is retransmitted from the sender's vote sets until
-        the round moves on — without this, one unlucky drop wedges the
-        round forever (receivers dedup by validator index)."""
-        rs = acs.rs
-        votes = rs.votes
-        if votes is None:
-            return
-        for r in range(max(0, rs.round - 1), rs.round + 1):
-            for vs in (votes.prevotes(r), votes.precommits(r)):
-                if vs is None:
-                    continue
-                for v in list(vs.votes):
-                    if v is None:
-                        continue
-                    for bi, (bcs, _) in enumerate(nodes):
-                        if bi != ai:
-                            bcs.add_vote_from_peer(v, f"regossip{ai}")
-
-    def pump():
-        while not stop_evt.is_set():
-            time.sleep(0.2)
-            for ai, (acs, aparts) in enumerate(nodes):
-                try:
-                    regossip_votes(ai, acs)
-                except Exception:
-                    pass
-                astore = aparts["block_store"]
-                ah = astore.height()
-                for bi, (bcs, bparts) in enumerate(nodes):
-                    if bi == ai:
-                        continue
-                    try:
-                        bh = bcs.rs.height
-                        if bh > ah:
-                            continue
-                        blk = astore.load_block(bh)
-                        meta = astore.load_block_meta(bh)
-                        # the commit FOR height bh: from block bh+1 when
-                        # stored, else the tip's seen commit
-                        commit = astore.load_block_commit(bh)
-                        if commit is None and bh == ah:
-                            commit = astore.load_seen_commit()
-                        if (
-                            blk is None
-                            or meta is None
-                            or commit is None
-                            or commit.height != bh
-                        ):
-                            continue
-                        # decided precommits FIRST: +2/3 moves B into
-                        # COMMIT, which initializes proposal_block_parts
-                        # from the majority part-set header so the parts
-                        # below are accepted (enterCommit semantics)
-                        for idx, cs_sig in enumerate(commit.signatures):
-                            if not cs_sig.signature:
-                                continue
-                            v = Vote(
-                                msg_type=canonical.PRECOMMIT_TYPE,
-                                height=bh,
-                                round=commit.round,
-                                block_id=commit.block_id,
-                                timestamp_ns=cs_sig.timestamp_ns,
-                                validator_address=cs_sig.validator_address,
-                                validator_index=idx,
-                                signature=cs_sig.signature,
-                            )
-                            bcs.add_vote_from_peer(v, f"catchup{ai}")
-                        # then the decided block's parts
-                        from cometbft_tpu.types import serialization as ser
-                        from cometbft_tpu.types.part_set import PartSet
-
-                        parts = PartSet.from_data(ser.dumps(blk))
-                        for i in range(parts.header.total):
-                            bcs.add_block_part_from_peer(
-                                bh, commit.round, parts.get_part(i),
-                                f"catchup{ai}",
-                            )
-                    except Exception:
-                        pass  # lossy world; try again next tick
-
-    t = threading.Thread(target=pump, daemon=True, name="catchup-pump")
-    t.start()
-    return t
+_LOSSY_LINK = LinkConfig(
+    latency_ns=2 * _MS,
+    jitter_ns=20 * _MS,
+    drop_p=0.06,
+    dup_p=0.05,  # the old harness duplicated 5% of deliveries too
+    reorder_p=0.10,
+    reorder_window_ns=30 * _MS,
+)
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", SEEDS)
 def test_no_fork_under_lossy_random_schedule(seed):
-    rng = random.Random(seed)
-    genesis, pvs = make_genesis(4)
-    nodes = [
-        make_consensus_node(genesis, pvs[i], config=_lossy_config())
-        for i in range(4)
-    ]
+    net = SimNet(
+        4, seed=seed, config=_lossy_config(), default_link=_LOSSY_LINK
+    )
     try:
-        wire_lossy_gossip(nodes, rng)
-        stop_evt = threading.Event()
-        start_catchup_pump(nodes, stop_evt)
-        for cs, _ in nodes:
-            cs.start()
-
-        # run under fire for a fixed wall budget
-        deadline = time.monotonic() + 45
-        while time.monotonic() < deadline:
-            if min(p["block_store"].height() for _, p in nodes) >= 6:
-                break
-            time.sleep(0.1)
-
-        heights = [p["block_store"].height() for _, p in nodes]
+        net.start()
+        # run under fire for a fixed VIRTUAL budget (45 simulated
+        # seconds — the old wall-clock budget, now deterministic)
+        net.run_until_height(6, max_virtual_ms=45_000)
+        heights = net.heights()
         # liveness: the net as a whole made progress through the loss
         assert max(heights) >= 2, f"nothing committed: {heights}"
-
-        # SAFETY: no two nodes disagree at any common height
-        for h in range(1, min(heights) + 1):
-            ids = {
-                p["block_store"].load_block_meta(h).block_id.hash
-                for _, p in nodes
-                if p["block_store"].height() >= h
-            }
-            assert len(ids) == 1, f"FORK at height {h} (seed {seed})"
-            hashes = {
-                p["block_store"].load_block_meta(h).header.app_hash
-                for _, p in nodes
-                if p["block_store"].height() >= h
-            }
-            assert len(hashes) == 1, f"app-hash fork at {h} (seed {seed})"
+        assert net.stats.get("drop_random", 0) > 0, (
+            "fuzz run never exercised a drop"
+        )
+        assert net.stats.get("duplicated", 0) > 0, (
+            "fuzz run never exercised a duplicate delivery"
+        )
+        # SAFETY: no two nodes disagree at any common height (block id
+        # AND app hash)
+        net.assert_no_fork()
     finally:
-        stop_evt.set()
-        for cs, parts in nodes:
-            stop_node(cs, parts)
+        net.stop()
+
+
+def test_lossy_schedule_reproducible_from_seed():
+    """A fuzz failure's seed is its repro: the same seed replays the
+    same drops, the same deliveries, the same commits (quick tier —
+    the per-seed safety runs above are slow-tier)."""
+
+    def run(seed):
+        net = SimNet(
+            4, seed=seed, config=_lossy_config(),
+            default_link=_LOSSY_LINK,
+        )
+        try:
+            net.start()
+            net.run_until_height(3, max_virtual_ms=20_000)
+            return (
+                tuple(net.heights()),
+                net.stats.get("drop_random", 0),
+                net.stats.get("delivered", 0),
+            )
+        finally:
+            net.stop()
+
+    assert run(1234) == run(1234)

@@ -290,7 +290,12 @@ class TestFuzzedConnection:
                 node.config.p2p.persistent_peers = ",".join(peers)
                 node.switch.set_persistent_peers(peers)
                 node.switch.dial_peers_async(peers)
-            deadline = time.monotonic() + 90
+            # 150s: the drops are UNSEEDED, so the reconnect storms are
+            # tail-lucky — at 90s this failed ~2/15 isolated runs on the
+            # SEED tree too (stalled at height 2 near t=94s), on a
+            # shared single-core container. The budget is slack for the
+            # liveness claim, not part of it.
+            deadline = time.monotonic() + 150
             while time.monotonic() < deadline:
                 if min(n.block_store.height() for n in nodes) >= 3:
                     break

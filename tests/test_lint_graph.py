@@ -574,6 +574,25 @@ class TestEngineWideGate:
         ]
         assert net_edges == [], net_edges
 
+    def test_simnet_scheduler_lock_registered_and_leaf(self, analysis):
+        """The simnet scheduler's heap mutex carries the tracer-lock
+        contract: present in the shipped artifact, participating in NO
+        acquisition-order edges.  Every event callback — consensus FSM
+        steps under 'consensus.state', reactor receives, WAL writes —
+        runs AFTER pop_due releases the heap lock; an edge appearing
+        here means a scheduler body started executing engine code (or
+        an engine path started scheduling while holding its own lock
+        THROUGH a callback), which would let the deterministic run loop
+        deadlock against the very components it drives."""
+        d = analysis.graph_dict()
+        assert "simnet.sched._mtx" in {lk["name"] for lk in d["locks"]}
+        sched_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "simnet.sched._mtx" in (e["from"], e["to"])
+        ]
+        assert sched_edges == [], sched_edges
+
     def test_devstats_lock_registered_and_leaf(self, analysis):
         """libs/devstats' compile-ledger mutex has the same contract as
         the tracer's: present in the shipped artifact, edge-free. The

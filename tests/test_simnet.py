@@ -1,0 +1,387 @@
+"""The deterministic fault-injection network simulator (cometbft_tpu/
+simnet): scheduler/link units, the determinism acceptance pin (same
+(seed, scenario) => identical heights, rounds and flight-recorder
+sequence), and the scenario engine end-to-end — byzantine double-sign
+through the evidence pipeline, partition form/heal with catch-up
+gossip, crash-point churn with WAL replay, validator-set churn, and
+blocksync under peer loss."""
+
+import dataclasses
+
+import pytest
+
+from cometbft_tpu.libs import health as libhealth
+from cometbft_tpu.simnet import LinkConfig, SimNet
+from cometbft_tpu.simnet.link import DROP_CHANNEL, DROP_RANDOM, Link
+from cometbft_tpu.simnet.sched import SimClock, SimScheduler
+from cometbft_tpu.simnet.scenarios import (
+    ring_signature,
+    run_scenario,
+)
+
+
+# ---------------------------------------------------------------- units
+
+
+def test_scheduler_orders_by_time_then_seq():
+    sched = SimScheduler(seed=1)
+    out = []
+    sched.call_at(500, out.append, "b")
+    sched.call_at(100, out.append, "a")
+    sched.call_at(500, out.append, "c")  # same due: scheduling order
+    while True:
+        ev = sched.pop_due()
+        if ev is None:
+            break
+        fn, args = ev
+        fn(*args)
+    assert out == ["a", "b", "c"]
+    assert sched.clock.now_ns == 500
+
+
+def test_scheduler_cancel_and_clock_monotonic():
+    sched = SimScheduler(seed=1)
+    out = []
+    tok = sched.call_at(100, out.append, "x")
+    sched.call_at(200, out.append, "y")
+    sched.cancel(tok)
+    fn, args = sched.pop_due()
+    fn(*args)
+    assert out == ["y"] and sched.clock.now_ns == 200
+    # scheduling in the past clamps to now
+    sched.call_at(50, out.append, "z")
+    fn, args = sched.pop_due()
+    fn(*args)
+    assert sched.clock.now_ns == 200
+
+
+def test_sub_rng_stable_across_processes():
+    """Child rngs hash names via crc32, not salted hash() — the --seed
+    reproduction contract across processes."""
+    a = SimScheduler(seed=9).sub_rng("link-0-1")
+    b = SimScheduler(seed=9).sub_rng("link-0-1")
+    assert [a.random() for _ in range(5)] == [b.random() for _ in range(5)]
+    c = SimScheduler(seed=9).sub_rng("link-0-2")
+    assert a.random() != c.random()
+
+
+def test_sim_clock_views():
+    clk = SimClock(base_wall_ns=1_000)
+    clk.advance_to(2_500_000_000)
+    assert clk.time_ns() == 1_000 + 2_500_000_000
+    assert clk.monotonic() == pytest.approx(2.5)
+    clk.advance_to(1)  # never goes backward
+    assert clk.now_ns == 2_500_000_000
+
+
+def test_link_fault_vocabulary():
+    import random
+
+    # deterministic drop: same rng seed, same plan sequence
+    l1 = Link(LinkConfig(drop_p=0.5, latency_ns=10, jitter_ns=0),
+              random.Random(3))
+    l2 = Link(LinkConfig(drop_p=0.5, latency_ns=10, jitter_ns=0),
+              random.Random(3))
+    plans1 = [l1.plan(0, 0x22, 100) for _ in range(64)]
+    assert plans1 == [l2.plan(0, 0x22, 100) for _ in range(64)]
+    assert any(r == DROP_RANDOM for _, _, r in plans1)
+    assert any(r is None for _, _, r in plans1)
+    # channel filter beats everything
+    lc = Link(LinkConfig(drop_channels=frozenset({0x40})), random.Random(1))
+    assert lc.plan(0, 0x40, 10)[2] == DROP_CHANNEL
+    assert lc.plan(0, 0x22, 10)[2] is None
+    # bandwidth cap serializes transmissions
+    lb = Link(
+        LinkConfig(latency_ns=0, jitter_ns=0, bandwidth_bps=8_000),
+        random.Random(1),
+    )  # 1000 bytes/s
+    t1, _, _ = lb.plan(0, 0x22, 100)  # 100 B = 0.1 s
+    t2, _, _ = lb.plan(0, 0x22, 100)
+    assert t1 == pytest.approx(1e8) and t2 == pytest.approx(2e8)
+    # reorder adds bounded extra delay
+    lr = Link(
+        LinkConfig(latency_ns=1000, jitter_ns=0, reorder_p=1.0,
+                   reorder_window_ns=10_000),
+        random.Random(1),
+    )
+    t, _, r = lr.plan(0, 0x22, 10)
+    assert r is None and 1000 <= t <= 11_000
+    # duplication yields a trailing second delivery
+    ld = Link(
+        LinkConfig(latency_ns=1000, jitter_ns=0, dup_p=1.0,
+                   reorder_window_ns=10_000),
+        random.Random(1),
+    )
+    t, dup, r = ld.plan(0, 0x22, 10)
+    assert r is None and dup is not None and dup >= t
+
+
+def test_sim_ticker_newer_hrs_replaces_pending():
+    from cometbft_tpu.consensus.wal import TimeoutInfo
+    from cometbft_tpu.simnet.node import SimTicker
+
+    sched = SimScheduler(seed=1)
+    fired = []
+    ticker = SimTicker(sched, fired.append)
+    ticker.start()
+    ticker.schedule_timeout(TimeoutInfo(0.5, 1, 0, 3))
+    ticker.schedule_timeout(TimeoutInfo(0.01, 1, 1, 1))  # newer: replaces
+    ticker.schedule_timeout(TimeoutInfo(9.9, 1, 0, 2))  # older: ignored
+    while True:
+        ev = sched.pop_due()
+        if ev is None:
+            break
+        fn, args = ev
+        fn(*args)
+    # only the newest (H,R,S) fired, and exactly once
+    assert [(ti.height, ti.round, ti.step) for ti in fired] == [(1, 1, 1)]
+
+
+# -------------------------------------------------------- net basics
+
+
+def test_clean_net_commits_and_agrees():
+    net = SimNet(4, seed=11)
+    try:
+        net.start()
+        assert net.run_until_height(3, max_virtual_ms=60_000), net.heights()
+        net.assert_no_fork()
+        assert min(net.heights()) >= 3
+    finally:
+        net.stop()
+
+
+def test_partition_severs_and_heal_reconnects():
+    net = SimNet(4, seed=5)
+    try:
+        net.start()
+        assert net.run_until_height(1, max_virtual_ms=30_000)
+        net.partition([0, 1], [2, 3])
+        # cross-boundary connections are gone; same-side stay
+        assert 1 in net.neighbors(0) and 2 not in net.neighbors(0)
+        h = max(net.heights())
+        net.run(max_virtual_ms=2_000)
+        assert max(net.heights()) <= h + 1  # no quorum anywhere
+        net.heal()
+        assert 2 in net.neighbors(0)
+        assert net.run_until_height(h + 2, max_virtual_ms=60_000), (
+            net.heights()
+        )
+        net.assert_no_fork()
+    finally:
+        net.stop()
+
+
+def test_sixteen_node_smoke():
+    """Tier-1 upper smoke bound (the ISSUE's 4-16 band)."""
+    net = SimNet(16, seed=2)
+    try:
+        net.start()
+        assert net.run_until_height(2, max_virtual_ms=60_000), net.heights()
+        net.assert_no_fork()
+    finally:
+        net.stop()
+
+
+@pytest.mark.slow
+def test_hundred_node_net_commits():
+    """Slow tier: 100 validators on a k=8 graph — relayed gossip, not a
+    mesh — must commit and agree.  Timeouts are sized for multi-hop
+    relay propagation (a proposal crosses ~4 hops before everyone has
+    it; test_config's 40ms propose timeout would spin rounds forever at
+    this scale)."""
+    from cometbft_tpu.config import test_config
+
+    ms = 1_000_000
+    cfg = test_config()
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=300 * ms,
+        timeout_propose_delta_ns=100 * ms,
+        timeout_prevote_ns=150 * ms,
+        timeout_prevote_delta_ns=50 * ms,
+        timeout_precommit_ns=150 * ms,
+        timeout_precommit_delta_ns=50 * ms,
+        timeout_commit_ns=50 * ms,
+        peer_query_maj23_sleep_duration_ns=500 * ms,
+    )
+    net = SimNet(100, seed=2, topology=8, with_evidence=False, config=cfg)
+    try:
+        net.start()
+        assert net.run_until_height(2, max_virtual_ms=2_000), (
+            min(net.heights()), max(net.heights()),
+        )
+        net.assert_no_fork()
+    finally:
+        net.stop()
+
+
+# ----------------------------------------------------- determinism pin
+
+
+def _faulty_run(seed: int):
+    libhealth.reset()
+    libhealth.enable()
+    net = SimNet(
+        4, seed=seed,
+        default_link=LinkConfig(
+            drop_p=0.05, jitter_ns=3_000_000, reorder_p=0.1
+        ),
+    )
+    try:
+        net.start()
+        ok = net.run_until_height(4, max_virtual_ms=240_000)
+        rounds = [
+            r["round"]
+            for r in libhealth.recorder().dump()
+            if r["event"] == "consensus.commit"
+        ]
+        return ok, tuple(net.heights()), tuple(rounds), ring_signature()
+    finally:
+        net.stop()
+        libhealth.disable()
+
+
+def test_determinism_same_seed_bit_identical():
+    """THE acceptance pin: one (seed, scenario) → identical commit
+    heights, commit rounds AND the full flight-recorder event sequence
+    (steps, proposals, votes, commits, faults — payloads included),
+    across two runs under active link faults."""
+    a = _faulty_run(977)
+    b = _faulty_run(977)
+    assert a[0] and b[0]
+    assert a == b
+    # and the seed actually matters: a different schedule exists
+    c = _faulty_run(978)
+    assert c[3] != a[3]
+
+
+def test_scenario_determinism_with_churn():
+    """Same pin through the scenario engine, covering kill/restart and
+    WAL replay (the crash_restart scenario's fault schedule)."""
+    r1 = run_scenario("crash_restart", 41)
+    r2 = run_scenario("crash_restart", 41)
+    assert r1.ok, r1.failures
+    assert r1.signature == r2.signature
+    assert r1.heights == r2.heights
+
+
+# ------------------------------------------------------- scenarios
+
+
+def test_scenario_byzantine_double_sign():
+    """Double-sign → DuplicateVoteEvidence → evidence-reactor gossip →
+    pool verify → committed block, on every honest node (the evidence
+    pipeline's first multi-node commit-path coverage)."""
+    r = run_scenario("byzantine_double_sign", 7)
+    assert r.ok, r.failures
+    assert r.notes["evidence_channel_msgs"] > 0
+    assert r.notes["evidence_height"] >= 2
+
+
+def test_scenario_partition_heal():
+    r = run_scenario("partition_heal", 7)
+    assert r.ok, r.failures
+    # the stalled heights needed extra rounds — the partition showed up
+    # in round counts, not just wall time
+    assert r.metrics["rounds_per_height"]["p99"] >= 2
+
+
+def test_scenario_crash_restart():
+    r = run_scenario("crash_restart", 7)
+    assert r.ok, r.failures
+    assert r.notes["crashed_at_height"] >= 2
+
+
+def test_scenario_valset_churn():
+    r = run_scenario("valset_churn", 7)
+    assert r.ok, r.failures
+    assert r.notes["final_valset_size"] == 4  # 4 +1 standby -1 evicted
+
+
+def test_scenario_blocksync_catchup():
+    r = run_scenario("blocksync_catchup", 7)
+    assert r.ok, r.failures
+    assert r.notes["blocks_synced"] > 0
+
+
+def test_fault_events_reach_flight_recorder():
+    """Partitions, drops and churn emit EV_FAULT ring events — the
+    black-box bundle's 'which fault was live' annotation."""
+    libhealth.reset()
+    libhealth.enable()
+    net = SimNet(4, seed=3, home_root=None,
+                 default_link=LinkConfig(drop_p=0.3))
+    try:
+        net.start()
+        net.run_until_height(1, max_virtual_ms=60_000)
+        net.partition([0], [1, 2, 3])
+        net.run(max_virtual_ms=200)
+        net.heal()
+        net.run(max_virtual_ms=200)
+        faults = [
+            r for r in libhealth.recorder().dump()
+            if r["event"] == "simnet.fault"
+        ]
+        names = {r["fault_name"] for r in faults}
+        assert "partition" in names and "heal" in names
+        assert "drop" in names  # probabilistic drops at 30% must appear
+    finally:
+        net.stop()
+        libhealth.disable()
+
+
+def test_fault_kill_restart_recorded():
+    import tempfile
+    import shutil
+
+    libhealth.reset()
+    libhealth.enable()
+    tmp = tempfile.mkdtemp(prefix="simnet-churn-")
+    net = SimNet(4, seed=3, home_root=tmp)
+    try:
+        net.start()
+        assert net.run_until_height(1, max_virtual_ms=60_000)
+        net.kill(2)
+        net.run(max_virtual_ms=100)
+        net.restart(2)
+        net.run(max_virtual_ms=100)
+        names = [
+            r["fault_name"]
+            for r in libhealth.recorder().dump()
+            if r["event"] == "simnet.fault"
+        ]
+        assert "kill" in names and "restart" in names
+    finally:
+        net.stop()
+        libhealth.disable()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ------------------------------------------------ e2e --simnet harness
+
+
+def test_e2e_simnet_load_mode():
+    from cometbft_tpu.e2e.runner import run_simnet_load
+
+    out = run_simnet_load(5, n_nodes=4, rate=300, heights=4)
+    assert out["ok"], out
+    assert out["txs"] > 0
+    # one virtual clock end to end: latencies are sane commit latencies
+    assert 0 < out["latency_p50_s"] < 5.0
+
+
+def test_e2e_runner_simnet_cli():
+    from cometbft_tpu.e2e import runner
+
+    rc = runner.main(
+        ["--simnet", "--scenario", "healthy", "--seed", "4"]
+    )
+    assert rc == 0
+
+
+def test_simnet_module_cli():
+    from cometbft_tpu.simnet.__main__ import main
+
+    assert main(["--list"]) == 0
+    assert main(["--scenario", "healthy", "--seed", "4"]) == 0
