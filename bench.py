@@ -1873,32 +1873,41 @@ def bench_fault_matrix(n_heights: int | None = None):
     simulation itself cost.  Pure host workload; runs identically on
     dead-tunnel rounds.
     """
-    from cometbft_tpu.libs import health as libhealth
-    from cometbft_tpu.simnet import LinkConfig, SimNet
-    from cometbft_tpu.simnet.scenarios import commit_metrics
-
-    import dataclasses
-
-    from cometbft_tpu.config import test_config
-
     if n_heights is None:
         n_heights = _sz(6, 3)
+    t0 = time.perf_counter()
+    grid = {}
+    for name, link, special in _fault_matrix_cells():
+        cell, _export = _run_fault_cell(
+            name, link, special, n_heights
+        )
+        m = cell.pop("_commit_metrics")
+        grid[name] = {
+            **cell,
+            "commit_ms_p50": m["commit_ms"]["p50"],
+            "commit_ms_p99": m["commit_ms"]["p99"],
+            "rounds_mean": m["rounds_per_height"]["mean"],
+            "rounds_p99": m["rounds_per_height"]["p99"],
+        }
+    return {
+        "n_nodes": 4,
+        "heights": n_heights,
+        "seed": 16,
+        "grid": grid,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "note": "virtual-time quantiles from the seeded simnet; the "
+        "same (seed, grid) reproduces identical numbers",
+    }
+
+
+def _fault_matrix_cells():
+    """The shared fault grid (configs 16 + 17): one LinkConfig mix per
+    cell, same seed, so both benches and the postmortem acceptance test
+    read the identical deterministic runs."""
+    from cometbft_tpu.simnet import LinkConfig
+
     ms = 1_000_000
-    # one config for every cell, with timeouts sized to tolerate the
-    # grid's worst link latency: rounds-per-height then measures the
-    # FAULTS (drops, partitions), not a timeout-vs-RTT mismatch
-    cfg = test_config()
-    cfg.consensus = dataclasses.replace(
-        cfg.consensus,
-        timeout_propose_ns=150 * ms,
-        timeout_propose_delta_ns=50 * ms,
-        timeout_prevote_ns=80 * ms,
-        timeout_prevote_delta_ns=40 * ms,
-        timeout_precommit_ns=80 * ms,
-        timeout_precommit_delta_ns=40 * ms,
-        timeout_commit_ns=20 * ms,
-    )
-    cells = [
+    return [
         ("clean", LinkConfig(), None),
         (
             "lat20_jit10",
@@ -1915,45 +1924,138 @@ def bench_fault_matrix(n_heights: int | None = None):
         ),
         ("partition_heal", LinkConfig(), "partition"),
     ]
+
+
+# (seed, n_heights, cell) -> (cell_row, ring export): configs 16 and
+# 17 read the IDENTICAL deterministic runs, so the second config reuses
+# the first's results instead of re-simulating the whole grid
+_FAULT_CELL_CACHE: dict = {}
+
+
+def _run_fault_cell(name, link, special, n_heights, seed=16):
+    """Run ONE fault cell to ``n_heights``; returns (cell_row,
+    flight-ring export).  Timeouts are sized to tolerate the grid's
+    worst link latency, so rounds-per-height measures the FAULTS
+    (drops, partitions), not a timeout-vs-RTT mismatch.  Results are
+    memoized per (seed, heights, cell) — the runs are bit-deterministic
+    by construction, so the cache is an identity, not an approximation."""
+    key = (seed, n_heights, name)
+    hit = _FAULT_CELL_CACHE.get(key)
+    if hit is not None:
+        cell, export = hit
+        return dict(cell), export
+    import dataclasses
+
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.simnet import SimNet
+    from cometbft_tpu.simnet.scenarios import SCENARIO_RING, commit_metrics
+
+    ms = 1_000_000
+    cfg = test_config()
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=150 * ms,
+        timeout_propose_delta_ns=50 * ms,
+        timeout_prevote_ns=80 * ms,
+        timeout_prevote_delta_ns=40 * ms,
+        timeout_precommit_ns=80 * ms,
+        timeout_precommit_delta_ns=40 * ms,
+        timeout_commit_ns=20 * ms,
+    )
+    was_enabled = libhealth.enabled()
+    prev_ring = libhealth.recorder().capacity
+    libhealth.set_ring_capacity(SCENARIO_RING)
+    libhealth.reset()
+    libhealth.enable()
+    net = SimNet(4, seed=seed, config=cfg, default_link=link)
+    try:
+        net.start()
+        if special == "partition":
+            net.run_until_height(2, max_virtual_ms=60_000)
+            net.partition([0, 1], [2, 3])
+            net.run(max_virtual_ms=1_500)
+            net.heal()
+        ok = net.run_until_height(n_heights, max_virtual_ms=600_000)
+        net.assert_no_fork()
+        cell = {
+            "ok": ok,
+            "virtual_ms": round(net.clock.now_ns / 1e6, 1),
+            "events": net._events_run,
+            "dropped": net.stats.get("dropped", 0),
+            "_commit_metrics": commit_metrics(),
+        }
+        export = libhealth.export_ring()
+    finally:
+        net.stop()
+        if not was_enabled:
+            libhealth.disable()
+        libhealth.set_ring_capacity(prev_ring)
+    _FAULT_CELL_CACHE[key] = (dict(cell), export)
+    return cell, export
+
+
+# faulty cell -> the cause set the attributor must top-rank (config 17
+# + the acceptance test in tests/test_postmortem.py); the combined
+# drop+latency cell accepts either of its two injected faults
+_FAULT_CELL_EXPECTED = {
+    "lat20_jit10": ("injected_latency",),
+    "drop05": ("injected_drop",),
+    "drop10_lat20": ("injected_drop", "injected_latency"),
+    "partition_heal": ("injected_partition",),
+}
+
+
+def bench_postmortem_attribution(n_heights: int | None = None):
+    """Config 17: the cross-node postmortem attributor over the
+    16_fault_matrix grid — each cell's flight ring is merged into a
+    per-height timeline (cometbft_tpu/postmortem) and the run verdict
+    scored against the fault that was actually injected.
+
+    Headline ``postmortem_attribution_rate`` = fraction of FAULTY cells
+    whose top-ranked root cause names the injected fault; the healthy
+    cell must stay silent (no verdict above the report threshold).
+    Deterministic per (seed, grid); host-only workload."""
+    from cometbft_tpu.postmortem import report_from_ring
+
+    if n_heights is None:
+        n_heights = _sz(6, 3)
     t0 = time.perf_counter()
-    grid = {}
-    for name, link, special in cells:
-        was_enabled = libhealth.enabled()
-        libhealth.reset()
-        libhealth.enable()
-        net = SimNet(4, seed=16, config=cfg, default_link=link)
-        try:
-            net.start()
-            if special == "partition":
-                net.run_until_height(2, max_virtual_ms=60_000)
-                net.partition([0, 1], [2, 3])
-                net.run(max_virtual_ms=1_500)
-                net.heal()
-            ok = net.run_until_height(n_heights, max_virtual_ms=600_000)
-            net.assert_no_fork()
-            m = commit_metrics()
-            grid[name] = {
-                "ok": ok,
-                "virtual_ms": round(net.clock.now_ns / 1e6, 1),
-                "events": net._events_run,
-                "dropped": net.stats.get("dropped", 0),
-                "commit_ms_p50": m["commit_ms"]["p50"],
-                "commit_ms_p99": m["commit_ms"]["p99"],
-                "rounds_mean": m["rounds_per_height"]["mean"],
-                "rounds_p99": m["rounds_per_height"]["p99"],
-            }
-        finally:
-            net.stop()
-            if not was_enabled:
-                libhealth.disable()
+    cells = {}
+    matched = 0
+    healthy_clean = None
+    for name, link, special in _fault_matrix_cells():
+        _cell, export = _run_fault_cell(name, link, special, n_heights)
+        _tl, rep = report_from_ring(export)
+        top = rep.run.verdict
+        expected = _FAULT_CELL_EXPECTED.get(name)
+        row = {
+            "top_cause": top.cause if top else None,
+            "top_score": round(top.score, 3) if top else None,
+            "slow_heights": len(rep.slow_heights),
+            "attributed_heights": sum(
+                1 for w in rep.slow_heights if w.verdict is not None
+            ),
+        }
+        if expected is None:
+            healthy_clean = top is None
+            row["expected"] = None
+        else:
+            row["expected"] = list(expected)
+            row["match"] = top is not None and top.cause in expected
+            matched += bool(row["match"])
+        cells[name] = row
+    n_faulty = len(_FAULT_CELL_EXPECTED)
     return {
         "n_nodes": 4,
         "heights": n_heights,
         "seed": 16,
-        "grid": grid,
+        "cells": cells,
+        "postmortem_attribution_rate": round(matched / n_faulty, 3),
+        "healthy_clean": healthy_clean,
         "wall_s": round(time.perf_counter() - t0, 2),
-        "note": "virtual-time quantiles from the seeded simnet; the "
-        "same (seed, grid) reproduces identical numbers",
+        "note": "run-verdict top cause vs the injected fault, per "
+        "16_fault_matrix cell; deterministic per (seed, grid)",
     }
 
 
@@ -2157,6 +2259,20 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "16_fault_matrix", "backend": "host",
                      "error": repr(e)[:200]})
+        pm_row = None
+        try:
+            # postmortem attribution over the same grid (host-only)
+            pm_row = bench_postmortem_attribution()
+            _eprint(
+                {
+                    "config": "17_postmortem_attribution",
+                    "backend": "host",
+                    **pm_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "17_postmortem_attribution",
+                     "backend": "host", "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -2211,6 +2327,15 @@ def main() -> None:
                             ]["drop05"]["commit_ms_p50"]
                         }
                         if fault_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "postmortem_attribution_rate": pm_row[
+                                "postmortem_attribution_rate"
+                            ]
+                        }
+                        if pm_row
                         else {}
                     ),
                 }
@@ -2355,6 +2480,16 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "16_fault_matrix", "error": repr(e)[:200]})
 
+    pm_row = None
+    try:
+        # cross-node postmortem attribution over the same grid (host-
+        # only simnet workload; identical with or without a chip)
+        pm_row = bench_postmortem_attribution()
+        _eprint({"config": "17_postmortem_attribution", **pm_row})
+    except Exception as e:
+        _eprint({"config": "17_postmortem_attribution",
+                 "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -2428,6 +2563,18 @@ def main() -> None:
                         ]["drop05"]["commit_ms_p50"]
                     }
                     if fault_row
+                    else {}
+                ),
+                # fraction of faulty simnet cells whose postmortem
+                # run verdict names the injected fault (config
+                # 17_postmortem_attribution)
+                **(
+                    {
+                        "postmortem_attribution_rate": pm_row[
+                            "postmortem_attribution_rate"
+                        ]
+                    }
+                    if pm_row
                     else {}
                 ),
             }

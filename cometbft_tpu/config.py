@@ -209,6 +209,19 @@ ENV_KNOBS: dict[str, str] = {
         "only; a COMETBFT_TPU_HOST_THRESHOLD pin always wins "
         "(crypto/batch.py AdaptiveCrossover)"
     ),
+    "COMETBFT_TPU_POSTMORTEM": (
+        "timeline.json in watchdog black-box bundles — the merged "
+        "cross-node timeline + root-cause verdicts "
+        "(cometbft_tpu/postmortem): auto/1 on (default; merges peers "
+        "named by COMETBFT_TPU_POSTMORTEM_PEERS when reachable, "
+        "local-only otherwise) | 0 skip the pass"
+    ),
+    "COMETBFT_TPU_POSTMORTEM_PEERS": (
+        "comma-separated peer flight-ring URLs (host:port or full "
+        "http://host:port/debug/flight) merged into bundle timelines; "
+        "unreachable peers degrade to the local view "
+        "(cometbft_tpu/postmortem.bundle_timeline)"
+    ),
 }
 
 

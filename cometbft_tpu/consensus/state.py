@@ -190,6 +190,10 @@ class ConsensusState(BaseService):
         # receive/ticker-forwarder threads and the driver pumps the
         # inbox via process_pending() from its scheduler thread.
         self.sim_driven = False
+        # flight-ring origin id (libs/health.register_origin) the
+        # receive routine declares for its thread; node/node.py sets it
+        # to the node-id prefix so ring rows are node-attributed
+        self.health_origin = 0
 
         # merged inbox: ("peer"|"internal"|"timeout", payload)
         self._queue: queue.Queue = queue.Queue(maxsize=1000)
@@ -356,6 +360,11 @@ class ConsensusState(BaseService):
     _DRAIN_WINDOW = 1024
 
     def _receive_routine(self) -> None:
+        # this thread owns the FSM: every flight-ring row it records
+        # (steps, proposals, votes, commits, fsyncs) belongs to the
+        # node that built this state — declare it once so in-process
+        # multi-node harnesses decode per-node timelines (0 = default)
+        libhealth.set_thread_origin(self.health_origin)
         while True:
             items = [self._queue.get()]
             # Micro-batch window (SURVEY §7(d)): drain whatever is ALREADY
@@ -1334,7 +1343,9 @@ class ConsensusState(BaseService):
         fail_point("cs-after-apply-block")
 
         # per-height commit latency into the flight recorder (the
-        # health engine's commit SLI; commit_round+1 = rounds needed)
+        # health engine's commit SLI; commit_round+1 = rounds needed;
+        # b = tx count, so timelines and SLIs can correlate commit
+        # latency with block fullness)
         libhealth.record(
             libhealth.EV_COMMIT, height, rs.commit_round,
             int(
@@ -1345,6 +1356,7 @@ class ConsensusState(BaseService):
                     )
                 ) * 1e9
             ),
+            len(block.data.txs),
         )
 
         for hook in self._on_block_committed:

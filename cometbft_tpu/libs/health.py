@@ -80,6 +80,7 @@ _ENV_RING = "COMETBFT_TPU_HEALTH_RING"
 _ENV_STALL_MULT = "COMETBFT_TPU_HEALTH_STALL_MULT"
 _ENV_BUNDLE_DIR = "COMETBFT_TPU_HEALTH_BUNDLE_DIR"
 _ENV_BUNDLE_RL = "COMETBFT_TPU_HEALTH_BUNDLE_RL_S"
+_ENV_POSTMORTEM = "COMETBFT_TPU_POSTMORTEM"
 
 DEFAULT_RING_SIZE = 4096
 # Stall window = multiplier x (timeout_commit + timeout_propose(0)):
@@ -151,7 +152,7 @@ _CODE_FIELDS = {
     EV_STEP: ("step", None),
     EV_PROPOSAL: ("accepted", None),
     EV_VOTE: ("type", "index"),
-    EV_COMMIT: ("dur_ns", None),
+    EV_COMMIT: ("dur_ns", "txs"),
     EV_BREAKER: ("open", None),
     EV_RECOMPILE: ("bucket", None),
     EV_FSYNC: ("dur_ns", None),
@@ -159,6 +160,22 @@ _CODE_FIELDS = {
     EV_GOSSIP: ("phase", "lag_ns"),
     EV_FAULT: ("kind", "detail"),
 }
+
+# codes whose payload is a wall-clock-measured duration: meaningless in
+# a virtual-time (simnet) ring, so the cross-node timeline merge drops
+# them from virtual-domain sources (cometbft_tpu/postmortem)
+WALL_DURATION_CODES = frozenset({EV_FSYNC})
+
+
+def ring_event_codes() -> dict[str, int]:
+    """Every ``EV_*`` code this module defines, by constant name — the
+    registry the decoder-completeness tier-1 test walks, so a new event
+    code cannot ship without a decode path and a docs entry."""
+    return {
+        name: value
+        for name, value in globals().items()
+        if name.startswith("EV_") and isinstance(value, int)
+    }
 
 _STEP_NAMES = {
     1: "NewHeight", 2: "NewRound", 3: "Propose", 4: "Prevote",
@@ -206,6 +223,72 @@ def _ring_size_from_env() -> int:
     return max(64, n)
 
 
+# ----------------------------------------------- ring clock + origins
+
+# Injectable ring timestamp source: the simnet plane swaps in its
+# virtual clock (SimClock.time_ns) for the run's lifetime, so every
+# ring row of an N-node simulation carries EXACT shared virtual time —
+# the property that makes the cross-node timeline merge lossless there.
+# Live nodes keep the wall clock and the merge tags cross-node edges
+# with a netstamp-derived skew bound instead.
+_now_ns = time.time_ns
+_clock_domain = "wall"  # "wall" | "virtual" — exported with the ring
+
+
+def set_clock(fn, domain: str = "wall"):
+    """Swap the ring timestamp source; returns the previous
+    ``(fn, domain)`` pair so the caller can restore it."""
+    global _now_ns, _clock_domain
+    prev = (_now_ns, _clock_domain)
+    _now_ns = fn
+    _clock_domain = domain
+    return prev
+
+
+def clock_domain() -> str:
+    return _clock_domain
+
+
+# Origin attribution: which NODE a ring row belongs to.  One process
+# usually hosts one node (origin = its node-id prefix, registered at
+# boot), but the simnet plane and the in-process test nets host N — the
+# recording THREAD declares its origin (simnet sets it per scheduler
+# event; live nodes set it on the cs-receive and mconn-recv threads
+# they own), and the decoder emits it as the row's ``node`` field.  The
+# record-path read is one thread-local getattr: allocation- and
+# lock-free, covered by the tracemalloc guard.
+_ORIGIN_NAMES: list[str] = ["local"]  # id 0 = unattributed/this-process
+_ORIGIN_IDS: dict[str, int] = {"local": 0}
+_origin_tls = threading.local()
+
+
+def register_origin(name: str) -> int:
+    """Intern an origin name -> id (dedupes, so re-registration across
+    node restarts and repeated simnet runs is stable).  Registration is
+    a setup-path operation (node boot, peer admit) under ``_mtx``."""
+    with _mtx:
+        oid = _ORIGIN_IDS.get(name)
+        if oid is None:
+            oid = len(_ORIGIN_NAMES)
+            _ORIGIN_NAMES.append(name)
+            _ORIGIN_IDS[name] = oid
+        return oid
+
+
+def origin_name(oid: int) -> str:
+    names = _ORIGIN_NAMES
+    return names[oid] if 0 <= oid < len(names) else "?"
+
+
+def set_thread_origin(oid: int) -> None:
+    """Declare the node whose events this thread records (0 clears)."""
+    _origin_tls.oid = oid
+
+
+def current_thread_origin() -> int:
+    return getattr(_origin_tls, "oid", 0)
+
+
 # ------------------------------------------------------- flight recorder
 
 
@@ -222,7 +305,7 @@ class FlightRecorder:
     """
 
     __slots__ = (
-        "capacity", "_ts", "_code", "_h", "_r", "_a", "_b",
+        "capacity", "_ts", "_code", "_h", "_r", "_a", "_b", "_o",
         "_seq", "_written", "_last",
     )
 
@@ -235,6 +318,7 @@ class FlightRecorder:
         self._r = array("q", zeros)
         self._a = array("q", zeros)
         self._b = array("q", zeros)
+        self._o = array("q", zeros)  # recording thread's origin id
         self._seq = itertools.count()
         self._written = array("q", [0])
         # monotonic last-seen per event code (watchdog math)
@@ -247,11 +331,12 @@ class FlightRecorder:
         seq = next(self._seq)  # GIL-atomic slot reservation
         i = seq % self.capacity
         self._code[i] = 0  # mark in-progress: readers skip torn rows
-        self._ts[i] = time.time_ns()
+        self._ts[i] = _now_ns()
         self._h[i] = height
         self._r[i] = round_
         self._a[i] = a
         self._b[i] = b
+        self._o[i] = getattr(_origin_tls, "oid", 0)
         self._code[i] = code  # publish last
         if code == EV_STEP:
             # the one last-seen the stall watchdog consumes; the other
@@ -287,7 +372,11 @@ class FlightRecorder:
                 "height": self._h[i],
                 "round": self._r[i],
             }
-            fa, fb = _CODE_FIELDS[code]
+            # .get with a null default, not [code]: a code registered in
+            # _CODE_NAMES but missing its field entry must decode (as
+            # raw a/b-less row), never KeyError a scrape/bundle path —
+            # the completeness test still flags the gap
+            fa, fb = _CODE_FIELDS.get(code, (None, None))
             if fa is not None:
                 rec[fa] = self._a[i]
             if fb is not None:
@@ -300,8 +389,15 @@ class FlightRecorder:
                 rec["phase_name"] = libnetstats.PHASE_NAMES.get(
                     self._a[i], "?"
                 )
+                if self._r[i] > 0:
+                    # simnet delivery rows park the SENDING node's
+                    # origin id in the round column (live rows leave 0)
+                    rec["src"] = origin_name(self._r[i])
             elif code == EV_FAULT:
                 rec["fault_name"] = _FAULT_NAMES.get(self._a[i], "?")
+            o = self._o[i]
+            if o:
+                rec["node"] = origin_name(o)
             out.append(rec)
         return out
 
@@ -378,7 +474,9 @@ _acquirers = 0
 
 _REC = FlightRecorder(_ring_size_from_env())
 
-_mtx = libsync.Mutex("libs.health._mtx")  # bundle rate limit + registry only
+# bundle rate limit + monitor registry + origin interning only (all
+# setup/trip paths — never the record path)
+_mtx = libsync.Mutex("libs.health._mtx")
 
 # breaker-trip notices from crypto/coalesce (module-level so the hook
 # needs no monitor handle; a lost increment under a rare write race
@@ -410,6 +508,38 @@ def reset() -> None:
     """Drop all buffered records (tests, bench bursts)."""
     global _REC
     _REC = FlightRecorder(_REC.capacity)
+
+
+def set_ring_capacity(n: int) -> None:
+    """Rebuild the ring at a new capacity WITHOUT touching the enabled
+    flag (simnet scenario runs size the ring to hold a whole run's
+    gossip-annotated event stream, then restore the prior capacity)."""
+    global _REC
+    n = max(64, int(n))
+    if n != _REC.capacity:
+        _REC = FlightRecorder(n)
+
+
+def export_ring(node: str | None = None) -> dict:
+    """The portable flight-ring export: the ``flight.json`` bundle
+    artifact, the ``/debug/flight`` pprof body, and the input shape the
+    cross-node timeline merge (cometbft_tpu/postmortem) consumes.
+
+    ``domain`` says which clock stamped the rows ("wall" for live
+    nodes, "virtual" for simnet rings — where the shared clock makes a
+    cross-node merge exact); ``origins`` is the interned origin-name
+    table the per-row ``node``/``src`` fields were decoded from."""
+    return {
+        "schema": 1,
+        "node": node,
+        "domain": _clock_domain,
+        "origins": list(_ORIGIN_NAMES),
+        # measured per-peer clock-skew bounds (netstamp round trips):
+        # the merge tags this ring's cross-node edges with them
+        "skews": libnetstats.skew_table(),
+        "ring": _REC.status(),
+        "events": _REC.dump(),
+    }
 
 
 def acquire() -> None:
@@ -804,10 +934,18 @@ def write_bundle(
             "ring": _REC.status(),
         },
     )
-    save(
-        "flight.json",
-        {"ring": _REC.status(), "events": _REC.dump()},
-    )
+    save("flight.json", export_ring())
+    # merged cross-node timeline + root-cause attribution: peers' rings
+    # are pulled over RPC when COMETBFT_TPU_POSTMORTEM_PEERS names them
+    # (reachable or not, the local view is always written) — the knob
+    # COMETBFT_TPU_POSTMORTEM=0 skips the pass entirely
+    if os.environ.get(_ENV_POSTMORTEM, "").lower() not in _OFF_VALUES:
+        try:
+            from .. import postmortem as _pm
+
+            save("timeline.json", _pm.bundle_timeline())
+        except Exception as e:
+            save("timeline.json.err", repr(e))
     try:
         from . import devstats as libdevstats
 

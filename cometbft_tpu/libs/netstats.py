@@ -108,10 +108,13 @@ STAMP_LEN = struct.calcsize(_STAMP_FMT)  # 23 bytes
 NODEINFO_STAMP_KEY = "netstamp"
 
 # propagation phase codes (EV_GOSSIP ``a`` column; names are the
-# ``phase`` label of p2p_propagation_seconds)
+# ``phase`` label of p2p_propagation_seconds).  The tail three are
+# channel-grain phases the simnet delivery plane records (one EV_GOSSIP
+# per delivered message, attributed by channel: 0x20/0x23 state,
+# 0x22 vote, 0x38 evidence) — appended so existing codes never move.
 PHASES = (
     "proposal", "block_part", "prevote", "precommit", "commit",
-    "block", "tx",
+    "block", "tx", "state", "vote", "evidence",
 )
 PHASE_CODES = {name: i + 1 for i, name in enumerate(PHASES)}
 PHASE_NAMES = {i + 1: name for i, name in enumerate(PHASES)}
@@ -242,6 +245,7 @@ class ConnStats:
     __slots__ = (
         "peer_id", "outbound", "created_mono", "slots", "ch_ids",
         "_cols", "stamp_tx_seq", "stamp_rx_seq", "stamp_rx_lag_ns",
+        "stamp_tx_wall", "skew",
         "_channels", "_send_monitor", "_recv_monitor",
     )
 
@@ -258,6 +262,16 @@ class ConnStats:
         self.stamp_tx_seq = array("q", [0])
         self.stamp_rx_seq = array("q", [0])
         self.stamp_rx_lag_ns = array("q", [0])
+        # clock-skew estimator state: wall ns of our last stamped send
+        # (written by whichever thread stamps; one slot, GIL-atomic)
+        # and the best NTP-style round-trip pair so far — see
+        # _note_skew_pair for the estimate's semantics.
+        # skew slots: [off_ns, bound_ns, rt_ns, pairs, lb_ns, lb_set]
+        # where lb_ns is the always-sound lower bound on the offset
+        # (max over inbound stamps of t2 - t3: a message cannot arrive
+        # before it was sent, whatever the clocks say)
+        self.stamp_tx_wall = array("q", [0])
+        self.skew = array("q", [0, 0, 0, 0, 0, 0])
         self._channels = mconn.channels if mconn is not None else {}
         self._send_monitor = mconn.send_monitor if mconn is not None else None
         self._recv_monitor = mconn.recv_monitor if mconn is not None else None
@@ -318,6 +332,71 @@ class ConnStats:
                 total += col[i]
         return total
 
+    def _note_skew_pair(self, peer_wall_ns: int, now_ns: int) -> None:
+        """Fold one (our last stamped send t1, peer stamp t2, our
+        receive t3) triple into the NTP-style skew estimate.
+
+        offset = t2 - (t1 + t3)/2 with a ±rt/2 bound (rt = t3 - t1) —
+        valid when the paired inbound was emitted AFTER our send, the
+        NTP causality assumption.  Under continuous bidirectional
+        gossip a CROSSED message (emitted before our send, arriving
+        just after it) can produce an artificially tiny rt and an
+        offset understated by up to a one-way delay, and a naive
+        minimum-rt rule would lock exactly those pairs in.  Two
+        defenses: (1) every inbound stamp yields the always-sound
+        lower bound ``offset >= t2 - t3`` (a message cannot arrive
+        before it was sent — no causality assumption at all), tracked
+        as the running max; (2) a candidate pair whose offset+bound
+        falls BELOW that sound bound is provably crossed and is
+        rejected, and a stored pair a later sound bound invalidates is
+        evicted so the next consistent pair replaces it.  Among the
+        consistent pairs, minimum rt gives the tightest bound.  Runs
+        on the recv routine; ``stamp_tx_wall`` is written by the
+        sender side (one-slot cross-thread read, GIL-atomic, the
+        ConnStats lost-increment posture)."""
+        sk = self.skew
+        # sound lower bound from EVERY inbound stamp (t2 - t3)
+        lb = peer_wall_ns - now_ns
+        if not sk[5] or lb > sk[4]:
+            sk[4] = lb
+            sk[5] = 1
+            # a tighter sound bound can expose the stored pair as
+            # crossed after the fact: evict it
+            if sk[2] and sk[0] + sk[1] < sk[4]:
+                sk[0] = sk[1] = sk[2] = 0
+        t1 = self.stamp_tx_wall[0]
+        if t1 == 0:
+            return
+        rt = now_ns - t1
+        if rt < 0:
+            return  # racing writer moved t1 past our read; skip
+        sk[3] += 1
+        off = peer_wall_ns - (t1 + now_ns) // 2
+        bound = max(1, rt // 2)
+        if off + bound < sk[4]:
+            return  # provably crossed pairing: offset range excludes
+            # the sound lower bound
+        if sk[2] == 0 or rt < sk[2]:
+            sk[2] = rt
+            sk[0] = off
+            sk[1] = bound
+
+    def skew_row(self) -> dict | None:
+        """The peer's clock-skew estimate, or None before any
+        round-trip pair completed."""
+        sk = self.skew
+        if sk[3] == 0 or sk[2] == 0:
+            return None
+        return {
+            "offset_s": round(sk[0] / 1e9, 9),
+            "bound_s": round(sk[1] / 1e9, 9),
+            "rt_s": round(sk[2] / 1e9, 9),
+            "pairs": sk[3],
+            # the causality-free floor: offset >= this, whatever the
+            # message interleaving was
+            "floor_s": round(sk[4] / 1e9, 9) if sk[5] else None,
+        }
+
     def rates(self) -> tuple[float, float]:
         sm, rm = self._send_monitor, self._recv_monitor
         return (
@@ -363,6 +442,7 @@ class ConnStats:
                 "tx_seq": self.stamp_tx_seq[0],
                 "rx_seq": self.stamp_rx_seq[0],
                 "rx_lag_last_s": round(self.stamp_rx_lag_ns[0] / 1e9, 6),
+                "clock_skew": self.skew_row(),
             },
             "channels": [
                 self.channel_row(ch) for ch in self.ch_ids
@@ -388,6 +468,22 @@ def connections() -> tuple:
     """Lock-free snapshot of the registered connections (scrape paths
     must never touch ``_mtx`` — same posture as health.active_monitor)."""
     return tuple(_CONNS)
+
+
+def skew_table() -> dict:
+    """Per-peer clock-skew estimates (tightest-bound connection wins
+    when a peer has several) — exported with the flight ring so the
+    cross-node timeline merge can tag live cross-node edges with a
+    measured bound instead of a warning."""
+    out: dict[str, dict] = {}
+    for c in connections():
+        row = c.skew_row()
+        if row is None or not c.peer_id:
+            continue
+        prev = out.get(c.peer_id)
+        if prev is None or row["bound_s"] < prev["bound_s"]:
+            out[c.peer_id] = row
+    return out
 
 
 def consensus_queue_full_total() -> int:
@@ -443,8 +539,12 @@ def set_current_stamp(stamp, stats: ConnStats | None = None) -> None:
     _tls.stamp = stamp
     if stamp is not None and stats is not None:
         stats.stamp_rx_seq[0] = stamp[1]
-        lag = time.time_ns() - stamp[2]
+        now = time.time_ns()
+        lag = now - stamp[2]
         stats.stamp_rx_lag_ns[0] = lag if lag > 0 else 0
+        # every inbound stamp that follows one of our stamped sends is
+        # a round-trip pair for the per-peer clock-skew estimator
+        stats._note_skew_pair(stamp[2], now)
 
 
 def current_stamp():
@@ -549,6 +649,16 @@ def sample(metrics=None) -> dict:
             live.add(c.peer_id)
             m.p2p_peer_rate.labels(c.peer_id, "send").set(send_rate)
             m.p2p_peer_rate.labels(c.peer_id, "recv").set(recv_rate)
+            # measured clock-skew bound to the stamped top-K peers
+            # (netstamp round-trip pairs; no pair yet = no series)
+            srow = c.skew_row()
+            if srow is not None:
+                m.p2p_peer_clock_skew.labels(c.peer_id).set(
+                    srow["offset_s"]
+                )
+                m.p2p_peer_clock_skew_bound.labels(c.peer_id).set(
+                    srow["bound_s"]
+                )
         else:
             other_send += send_rate
             other_recv += recv_rate
@@ -558,6 +668,10 @@ def sample(metrics=None) -> dict:
     for key in list(m.p2p_peer_rate._children):
         if key[0] != "other" and key[0] not in live:
             m.p2p_peer_rate.remove(*key)
+    for gauge in (m.p2p_peer_clock_skew, m.p2p_peer_clock_skew_bound):
+        for key in list(gauge._children):
+            if key[0] not in live:
+                gauge.remove(*key)
     # (health_gossip_lag_seconds is set by libhealth.sample — the SLI
     # engine owns it; setting it here too would sort the lag window
     # twice per scrape)
@@ -581,6 +695,7 @@ def snapshot() -> dict:
         "gossip_lag_p50_s": round(gossip_lag_s(0.50), 6),
         "gossip_lag_p99_s": round(gossip_lag_s(0.99), 6),
         "consensus_send_queue_full": consensus_queue_full_total(),
+        "clock_skew": skew_table(),
         "peers": [c.row() for c in conns],
     }
 

@@ -141,6 +141,10 @@ class PprofServer(HTTPService):
                 "/debug/devstats         device/XLA telemetry (JSON)\n"
                 "/debug/health           flight-recorder SLIs + watchdogs (JSON)\n"
                 "/debug/net              per-peer/per-channel p2p telemetry (JSON)\n"
+                "/debug/flight           raw flight-ring export (JSON; the\n"
+                "                        cross-node merge input peers pull)\n"
+                "/debug/timeline         merged height timelines + root-cause\n"
+                "                        verdicts (JSON; ?peer=URL fans in)\n"
                 "/debug/trace            span-tracer ring dump\n"
                 "/debug/trace/start?file=PATH\n"
                 "/debug/trace/stop\n"
@@ -194,6 +198,21 @@ class PprofServer(HTTPService):
 
             return libnetstats.debug_net_json()
 
+        def flight_dump(q):
+            from . import health as libhealth
+
+            return json.dumps(libhealth.export_ring(), default=str)
+
+        def timeline_dump(q):
+            # the local node's per-height timelines + attribution;
+            # ?peer=URL (repeatable) merges reachable peers' rings in
+            from .. import postmortem
+
+            return json.dumps(
+                postmortem.debug_timeline(peers=q.get("peer", [])),
+                default=str,
+            )
+
         def trace_dump(q):
             from . import trace as libtrace
 
@@ -240,6 +259,8 @@ class PprofServer(HTTPService):
             "/debug/devstats": devstats_dump,
             "/debug/health": health_dump,
             "/debug/net": net_dump,
+            "/debug/flight": flight_dump,
+            "/debug/timeline": timeline_dump,
             "/debug/trace": trace_dump,
             "/debug/trace/start": trace_start,
             "/debug/trace/stop": trace_stop,

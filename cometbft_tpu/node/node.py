@@ -218,6 +218,15 @@ class Node(BaseService):
         self.node_key = NodeKey.load_or_generate(
             config.base.resolve(config.base.node_key_file)
         )
+        # Flight-ring origin: every row the consensus receive routine
+        # records carries this node's id prefix, so per-node timelines
+        # decode even when several nodes share one process (the same
+        # prefix the netstats peer label uses on the remote side).
+        from ..libs import health as libhealth
+
+        self.consensus.health_origin = libhealth.register_origin(
+            self.node_key.node_id[:10]
+        )
         # Blocksync only when it can help: enabled in config and we're not
         # the sole validator (node.go onlyValidatorIsUs check).
         only_us = (

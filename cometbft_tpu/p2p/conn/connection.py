@@ -118,10 +118,15 @@ class MConnection(BaseService):
         config: MConnConfig | None = None,
         peer_id: str = "",
         outbound: bool = False,
+        origin_id: int = 0,
         logger=None,
     ):
         super().__init__("mconnection", logger)
         self.conn = conn
+        # flight-ring origin of the node that OWNS this connection: the
+        # recv routine dispatches reactors synchronously, so rows they
+        # record (p2p.gossip) are attributed to this node (libs/health)
+        self.origin_id = origin_id
         self.config = config or MConnConfig()
         self.channels = {d.id: _Channel(d) for d in channels}
         # Labeled-counter children resolved ONCE per channel: the wire
@@ -232,11 +237,20 @@ class MConnection(BaseService):
         self._last_pong = time.monotonic()
         libnetstats.register(self.stats)
         threading.Thread(
-            target=self._send_routine, name="mconn-send", daemon=True
+            target=self._routine_entry, args=(self._send_routine,),
+            name="mconn-send", daemon=True,
         ).start()
         threading.Thread(
-            target=self._recv_routine, name="mconn-recv", daemon=True
+            target=self._routine_entry, args=(self._recv_routine,),
+            name="mconn-recv", daemon=True,
         ).start()
+
+    def _routine_entry(self, routine) -> None:
+        if self.origin_id:
+            from ...libs import health as libhealth
+
+            libhealth.set_thread_origin(self.origin_id)
+        routine()
 
     def on_stop(self) -> None:
         libnetstats.deregister(self.stats)

@@ -16,6 +16,7 @@ wire traffic, so wire compat never depends on payload sniffing.
 from __future__ import annotations
 
 import itertools
+import time
 
 from ..libs import netstats as libnetstats
 from ..libs import sync as libsync
@@ -37,6 +38,7 @@ class Peer(BaseService):
         socket_addr: str = "",
         mconn_config=None,
         our_node_info: NodeInfo | None = None,
+        origin_id: int = 0,  # libs/health flight-ring origin of OUR node
         logger=None,
     ):
         super().__init__(f"peer-{node_info.node_id[:10]}", logger)
@@ -70,6 +72,7 @@ class Peer(BaseService):
             config=mconn_config,
             peer_id=node_info.node_id,
             outbound=outbound,
+            origin_id=origin_id,
             logger=logger,
         )
 
@@ -98,8 +101,13 @@ class Peer(BaseService):
     def _maybe_stamp(self, ch_id: int, msg: bytes) -> bytes:
         if self._stamp and ch_id in libnetstats.STAMPED_CHANNELS:
             seq = next(self._stamp_seq)
-            self.mconn.stats.stamp_tx_seq[0] = seq
-            return libnetstats.make_stamp(self._origin8, seq) + msg
+            wall = time.time_ns()
+            stats = self.mconn.stats
+            stats.stamp_tx_seq[0] = seq
+            # the skew estimator pairs this send with the next inbound
+            # stamp from the peer (NTP-style round trip)
+            stats.stamp_tx_wall[0] = wall
+            return libnetstats.make_stamp(self._origin8, seq, wall) + msg
         return msg
 
     def on_start(self) -> None:

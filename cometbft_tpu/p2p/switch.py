@@ -46,6 +46,7 @@ class Switch(BaseService):
         self._peers_mtx = libsync.RLock("p2p.switch.peers")
         self._persistent_addrs: list[str] = []
         self._dialing: set[str] = set()
+        self._health_origin = 0  # interned on first peer admit
 
     # -- wiring ------------------------------------------------------------
 
@@ -170,6 +171,16 @@ class Switch(BaseService):
                 self._dialing.discard(addr)
 
     def _add_peer(self, up, persistent: bool, addr: str = "") -> Peer:
+        # flight-ring origin for this node's recv threads: rows they
+        # record (gossip-lag events) decode with our node-id prefix, so
+        # in-process multi-node rings split into per-node timelines
+        # (register_origin dedupes — one interning per switch lifetime)
+        if not self._health_origin:
+            from ..libs import health as libhealth
+
+            self._health_origin = libhealth.register_origin(
+                self.transport.node_info.node_id[:10]
+            )
         peer = Peer(
             up.secret_conn,
             up.node_info,
@@ -183,6 +194,7 @@ class Switch(BaseService):
             # our side of the provenance-stamp negotiation + the origin
             # id stamped onto outbound messages (libs/netstats)
             our_node_info=self.transport.node_info,
+            origin_id=self._health_origin,
             logger=self.logger,
         )
         with self._peers_mtx:

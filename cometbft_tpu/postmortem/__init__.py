@@ -1,0 +1,146 @@
+"""Cross-node causal timeline + automated root-cause attribution.
+
+The diagnostic layer over the observability stack: merge N flight
+rings (live rings over RPC, ``flight.json`` from black-box bundles, or
+a completed simnet run) into one globally ordered per-height timeline
+(timeline.py), then name the dominant cause of every slow height
+(attribute.py).  Exposed as:
+
+* ``python -m cometbft_tpu.postmortem`` — merge files/URLs or attach
+  to a simnet scenario run (``__main__.py``);
+* ``/debug/timeline`` on the pprof server — the local node's merged
+  height timelines + verdicts (``debug_timeline``), with ``?peer=``
+  fan-in; ``/debug/flight`` serves the raw ring export peers pull;
+* ``timeline.json`` in watchdog black-box bundles
+  (``bundle_timeline``, called by libs/health.write_bundle) — merged
+  across ``COMETBFT_TPU_POSTMORTEM_PEERS`` when those rings answer,
+  local-only otherwise;
+* ``--postmortem`` on ``python -m cometbft_tpu.simnet`` — the
+  attribution table for a scenario run.
+
+docs/observability.md "Cross-node timelines" documents the merge
+semantics, the skew model, and the attribution vocabulary.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .attribute import (
+    DEFAULT_BASELINE_LAG_S,
+    Finding,
+    REPORT_THRESHOLD,
+    Report,
+    WindowVerdict,
+    attribute,
+)
+from .timeline import (
+    Source,
+    Timeline,
+    fetch_ring,
+    load_sources,
+    merge,
+    merge_ring_export,
+    sources_from_obj,
+)
+
+_ENV_PEERS = "COMETBFT_TPU_POSTMORTEM_PEERS"
+
+
+def report_from_ring(
+    export: dict,
+    baseline_lag_s: float = DEFAULT_BASELINE_LAG_S,
+    threshold: float = REPORT_THRESHOLD,
+) -> tuple[Timeline, Report]:
+    """One ring export (e.g. a ScenarioResult.ring) -> (Timeline,
+    Report)."""
+    tl = merge_ring_export(export)
+    return tl, attribute(
+        tl, baseline_lag_s=baseline_lag_s, threshold=threshold
+    )
+
+
+def debug_timeline(peers=(), fetch_timeout: float = 2.0) -> dict:
+    """The ``/debug/timeline`` pprof body: the local ring (split per
+    origin when several nodes share the process) merged with any
+    ``peers`` ring URLs that answer, plus the attribution report.
+    Unreachable peers degrade to the local view, never an error.
+    Peers are fetched CONCURRENTLY with one shared deadline — a bundle
+    written during a partition must pay ~one timeout total, not one
+    per dead peer."""
+    import threading
+    import time
+
+    from ..libs import health as libhealth
+
+    sources = sources_from_obj(libhealth.export_ring())
+    peers = list(peers)
+    results: list = [None] * len(peers)
+
+    def _fetch(i: int, url: str) -> None:
+        try:
+            results[i] = ("ok", fetch_ring(url, timeout=fetch_timeout))
+        except Exception as e:
+            results[i] = ("err", repr(e)[:160])
+
+    threads = [
+        threading.Thread(
+            target=_fetch, args=(i, url),
+            name=f"pm-fetch-{i}", daemon=True,
+        )
+        for i, url in enumerate(peers)
+    ]
+    for t in threads:
+        t.start()
+    end = time.monotonic() + fetch_timeout + 0.5  # one SHARED deadline
+    for t in threads:
+        t.join(timeout=max(0.0, end - time.monotonic()))
+    fetched, errors = [], {}
+    for i, url in enumerate(peers):
+        res = results[i]
+        if res is None:
+            errors[url] = "fetch timed out"
+        elif res[0] == "ok":
+            sources.extend(sources_from_obj(res[1], name=f"peer{i}"))
+            fetched.append(url)
+        else:
+            errors[url] = res[1]
+    tl = merge(sources)
+    rep = attribute(tl)
+    return {
+        "timeline": tl.data,
+        "report": rep.to_dict(),
+        "peers_merged": fetched,
+        "peer_errors": errors,
+    }
+
+
+def bundle_timeline() -> dict:
+    """The ``timeline.json`` black-box-bundle artifact: merged across
+    the operator-configured peer ring URLs when reachable, local-only
+    otherwise (libs/health.write_bundle calls this under the
+    COMETBFT_TPU_POSTMORTEM gate).  Short fetch timeout — a bundle
+    write happens DURING an incident and must not hang on dead peers."""
+    raw = os.environ.get(_ENV_PEERS, "")
+    urls = [u.strip() for u in raw.split(",") if u.strip()]
+    return debug_timeline(peers=urls, fetch_timeout=1.5)
+
+
+__all__ = [
+    "DEFAULT_BASELINE_LAG_S",
+    "Finding",
+    "REPORT_THRESHOLD",
+    "Report",
+    "Source",
+    "Timeline",
+    "WindowVerdict",
+    "attribute",
+    "bundle_timeline",
+    "debug_timeline",
+    "fetch_ring",
+    "load_sources",
+    "merge",
+    "merge_ring_export",
+    "report_from_ring",
+    "sources_from_obj",
+]

@@ -1,0 +1,119 @@
+"""CLI: merge flight rings into a cross-node timeline + verdicts.
+
+    # post-mortem over bundle artifacts / exported rings
+    python -m cometbft_tpu.postmortem merge node0/flight.json node1/flight.json
+
+    # live nodes: pull /debug/flight over RPC and merge
+    python -m cometbft_tpu.postmortem merge http://127.0.0.1:6060 10.0.0.2:6060
+
+    # attach to a deterministic simnet scenario run
+    python -m cometbft_tpu.postmortem scenario partition_heal --seed 7
+
+Prints the attribution table (one line per slow height, top-ranked
+cause + evidence); ``--json`` emits the full merged timeline + report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (
+    DEFAULT_BASELINE_LAG_S,
+    REPORT_THRESHOLD,
+    attribute,
+    fetch_ring,
+    merge,
+    sources_from_obj,
+)
+
+
+def _common(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the full timeline + report as JSON",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=REPORT_THRESHOLD,
+        help="minimum score a cause needs to make the verdict",
+    )
+    ap.add_argument(
+        "--baseline-lag-ms", type=float,
+        default=DEFAULT_BASELINE_LAG_S * 1e3,
+        help="healthy one-hop gossip lag floor for the latency detector",
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cometbft_tpu.postmortem",
+        description="cross-node flight-ring post-mortems",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    mp = sub.add_parser(
+        "merge", help="merge flight.json files and/or /debug/flight URLs"
+    )
+    mp.add_argument(
+        "inputs", nargs="+",
+        help="flight.json paths, or node addresses/URLs to pull live",
+    )
+    _common(mp)
+
+    sp = sub.add_parser(
+        "scenario", help="run a simnet scenario and attribute it"
+    )
+    sp.add_argument("name")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--nodes", type=int, default=None)
+    _common(sp)
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "merge":
+        sources = []
+        for i, inp in enumerate(args.inputs):
+            if "://" in inp or (":" in inp and not _is_path(inp)):
+                obj = fetch_ring(inp)
+            else:
+                with open(inp) as f:
+                    obj = json.load(f)
+            sources.extend(sources_from_obj(obj, name=f"src{i}:{inp}"))
+        tl = merge(sources)
+    else:
+        from ..simnet.scenarios import run_scenario
+
+        kw = {}
+        if args.nodes is not None:
+            kw["n_nodes"] = args.nodes
+        result = run_scenario(args.name, args.seed, **kw)
+        print(json.dumps(result.summary(), default=str), file=sys.stderr)
+        from . import merge_ring_export
+
+        tl = merge_ring_export(result.ring)
+
+    rep = attribute(
+        tl,
+        baseline_lag_s=args.baseline_lag_ms / 1e3,
+        threshold=args.threshold,
+    )
+    if args.json:
+        print(json.dumps(
+            {"timeline": tl.data, "report": rep.to_dict()},
+            indent=1, default=str,
+        ))
+    else:
+        print(json.dumps(tl.summary(), default=str))
+        print(rep.table())
+    return 0
+
+
+def _is_path(s: str) -> bool:
+    import os
+
+    return os.path.exists(s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

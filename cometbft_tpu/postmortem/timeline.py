@@ -1,0 +1,511 @@
+"""Cross-node causal timeline: merge N flight rings into one story.
+
+Every node already records a flight ring (libs/health): step
+transitions, proposal/vote admission, per-height commit latency,
+per-hop gossip lag, and the fault/breaker/recompile/watchdog overlay.
+What no single ring answers is the operator's actual question — *why
+did height H take 4 rounds across the network?* — because each ring is
+one node's view.  This module merges N rings (live rings over RPC,
+``flight.json`` from black-box bundles, or a completed simnet run) into
+one globally ordered **per-height timeline**:
+
+    proposal -> per-node prevote/precommit admission -> per-hop gossip
+    lag -> per-node commit
+
+with ``simnet.fault`` / ``coalesce.breaker`` / ``xla.recompile`` /
+``health.watchdog`` / ``wal.fsync`` rows overlaid as annotations on the
+height window they land in.
+
+Clock semantics (the part that decides whether the merge is exact):
+
+* **virtual** domain — simnet rings are stamped from ONE shared
+  virtual clock (libs/health.set_clock), so cross-node ordering is
+  exact by construction and skew bounds are zero.  Wall-measured
+  durations (``wal.fsync``) are dropped: real disk time is meaningless
+  on a virtual axis and would break byte-reproducibility.
+* **wall** domain — live rings are stamped from each node's wall
+  clock.  The merge does NOT rewrite timestamps; instead every
+  cross-node edge (commit spread, gossip hops) is tagged with the
+  measured per-peer skew bound from the netstamp round-trip estimator
+  (libs/netstats.skew_table, exported with the ring), so a reader
+  knows exactly how much of an apparent lag could be clock, not
+  network.
+
+``Timeline.to_json()`` is a canonical serialization: same sources in,
+same bytes out — the determinism contract tests/test_postmortem.py
+pins for simnet runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.request
+
+from ..libs import health as libhealth
+
+# event names (mirrors libs/health._CODE_NAMES; names, not codes, so
+# the merge accepts rings from bundles written by other versions)
+_EV_STEP = "consensus.step"
+_EV_PROPOSAL = "consensus.proposal"
+_EV_VOTE = "consensus.vote"
+_EV_COMMIT = "consensus.commit"
+_EV_GOSSIP = "p2p.gossip"
+
+_HEIGHT_EVENTS = frozenset(
+    {_EV_STEP, _EV_PROPOSAL, _EV_VOTE, _EV_COMMIT}
+)
+# wall-duration rows dropped from virtual-domain sources — derived
+# from the recorder's own registry so a future wall-measured code
+# cannot be dropped from one side and kept by the other
+_WALL_ONLY = frozenset(
+    libhealth._CODE_NAMES[c] for c in libhealth.WALL_DURATION_CODES
+)
+
+# vote types (types/canonical)
+_PREVOTE = 1
+_PRECOMMIT = 2
+
+_NEW_ROUND_STEP = 2  # RoundStep.NEW_ROUND in the EV_STEP ``step`` column
+
+
+@dataclasses.dataclass
+class Source:
+    """One node's decoded flight ring + its clock metadata.
+
+    ``attributed`` = the rows named their node explicitly (origin
+    attribution).  A multi-node ring's fallback group — origin-0 rows
+    like watchdog trips, breaker notices, simnet fault-plane events —
+    is NOT a node: it merges as annotations but is excluded from the
+    node list and the skew pair enumeration, so a phantom "local"
+    cannot drag ``skew.complete`` to False on an otherwise
+    fully-measured merge."""
+
+    name: str
+    events: list
+    domain: str = "wall"  # "wall" | "virtual"
+    skews: dict = dataclasses.field(default_factory=dict)
+    attributed: bool = True
+
+
+def sources_from_obj(obj, name: str | None = None) -> list[Source]:
+    """Split one ring export (``flight.json`` / ``/debug/flight`` body,
+    or a bare ``{"events": [...]}``) into per-node sources.
+
+    Rows carry their origin in the decoded ``node`` field (simnet and
+    in-process multi-node rings interleave several nodes in one ring);
+    rows without one fall back to the export's ``node`` / the caller's
+    ``name`` — so a single-node live ring becomes one source and a
+    simnet ring becomes N, with no flag to pass."""
+    if isinstance(obj, dict):
+        events = obj.get("events", [])
+        domain = obj.get("domain", "wall")
+        base = obj.get("node") or name or "local"
+        skews = obj.get("skews") or {}
+    else:
+        events, domain, base, skews = list(obj), "wall", name or "local", {}
+    groups: dict[str, list] = {}
+    order: list[str] = []
+    explicit: set[str] = set()  # names that came from row-level origins
+    for ev in events:
+        node = ev.get("node")
+        if node:
+            explicit.add(node)
+        else:
+            node = base
+        bucket = groups.get(node)
+        if bucket is None:
+            bucket = groups[node] = []
+            order.append(node)
+        bucket.append(ev)
+    if not order:
+        order.append(base)
+        groups[base] = []
+    # the export's skew table describes the PROCESS's stamped
+    # connections (keyed by remote node-id prefix) — every source split
+    # out of this export shares it, which is also correct for the
+    # in-process multi-node case where one table holds all pairs.
+    # The fallback group counts as a node only when it is the whole
+    # export (single-node ring with no origin wiring): alongside
+    # origin-attributed groups it is the unattributed remainder.
+    return [
+        Source(
+            n, groups[n], domain, skews,
+            attributed=(n in explicit or len(order) == 1),
+        )
+        for n in order
+    ]
+
+
+def load_sources(paths) -> list[Source]:
+    """Sources from ``flight.json`` files on disk (bundle post-mortem)."""
+    out: list[Source] = []
+    for p in paths:
+        with open(p) as f:
+            obj = json.load(f)
+        out.extend(sources_from_obj(obj, name=str(p)))
+    return out
+
+
+def fetch_ring(url: str, timeout: float = 2.0) -> dict:
+    """GET one peer's ring export.  A bare ``host:port`` / node address
+    is completed to its pprof ``/debug/flight`` route."""
+    if "://" not in url:
+        url = "http://" + url
+    if "/debug/" not in url:
+        url = url.rstrip("/") + "/debug/flight"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+# --------------------------------------------------------------- merge
+
+
+def _round9(x: float) -> float:
+    return round(float(x), 9)
+
+
+def _quantile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+def _lag_stats(lags: list) -> dict | None:
+    if not lags:
+        return None
+    vs = sorted(lags)
+    return {
+        "count": len(vs),
+        "p50_s": _round9(_quantile(vs, 0.50)),
+        "p90_s": _round9(_quantile(vs, 0.90)),
+        "max_s": _round9(vs[-1]),
+    }
+
+
+class Timeline:
+    """The merged view: ``data`` is a plain JSON-able dict;
+    ``lag_samples`` keeps the raw per-window gossip-lag samples for the
+    attribution pass (aggregates only go to JSON — a 50k-hop run must
+    not serialize 50k floats)."""
+
+    def __init__(self, data: dict, lag_samples: dict):
+        self.data = data
+        self.lag_samples = lag_samples
+
+    @property
+    def domain(self) -> str:
+        return self.data["domain"]
+
+    @property
+    def heights(self) -> list[dict]:
+        return self.data["heights"]
+
+    @property
+    def run(self) -> dict:
+        return self.data["run"]
+
+    def to_json(self) -> str:
+        """Canonical bytes: sorted keys, no whitespace — the
+        determinism pin for virtual-domain merges."""
+        return json.dumps(
+            self.data, sort_keys=True, separators=(",", ":"),
+            default=str,
+        )
+
+    def summary(self) -> dict:
+        d = self.data
+        return {
+            "domain": d["domain"],
+            "nodes": d["nodes"],
+            "heights": len(d["heights"]),
+            "events": d["n_events"],
+            "skew_max_bound_s": d["skew"].get("max_bound_s"),
+        }
+
+
+def _pair_skew_bound(a: Source, b: Source):
+    """Tightest available bound between two live sources, looking from
+    both ends (skew tables are keyed by 10-char peer-id prefixes — the
+    same prefix live source names use)."""
+    bounds = []
+    ra = a.skews.get(b.name[:10])
+    if ra:
+        bounds.append(ra.get("bound_s"))
+    rb = b.skews.get(a.name[:10])
+    if rb:
+        bounds.append(rb.get("bound_s"))
+    bounds = [x for x in bounds if x is not None]
+    return min(bounds) if bounds else None
+
+
+def merge(sources: list[Source]) -> Timeline:
+    """Merge N sources into one globally ordered per-height timeline.
+
+    Virtual-domain sources merge exactly (shared clock); any wall
+    source makes the whole merge wall-domain and cross-node rows carry
+    ``skew_bound_s`` tags (None = no measured bound for that pair)."""
+    sources = list(sources)
+    domain = (
+        "virtual"
+        if sources and all(s.domain == "virtual" for s in sources)
+        else "wall"
+    )
+    # node identity comes from attributed sources; an unattributed
+    # remainder group (origin-0 watchdog/breaker/fault rows) merges as
+    # annotations but is not a node
+    attributed = [s for s in sources if s.attributed]
+    if not attributed:
+        attributed = sources
+    nodes = [s.name for s in attributed]
+
+    # pairwise skew edges (wall domain, >= 2 nodes)
+    skew_edges: dict[str, dict] = {}
+    bounds_all: list[float] = []
+    complete = domain == "virtual"
+    if domain == "wall" and len(attributed) > 1:
+        complete = True
+        for i, a in enumerate(attributed):
+            for b in attributed[i + 1:]:
+                bound = _pair_skew_bound(a, b)
+                skew_edges[f"{a.name}|{b.name}"] = {"bound_s": bound}
+                if bound is None:
+                    complete = False
+                else:
+                    bounds_all.append(bound)
+
+    # one globally ordered row stream; ties break by (source, slot) so
+    # equal-timestamp rows (common under the virtual clock) order
+    # deterministically
+    rows = []
+    for si, s in enumerate(sources):
+        for k, ev in enumerate(s.events):
+            if domain == "virtual" and ev.get("event") in _WALL_ONLY:
+                continue
+            rows.append((ev.get("ts", 0), si, k, ev))
+    rows.sort(key=lambda t: (t[0], t[1], t[2]))
+
+    heights: dict[int, dict] = {}
+    votes_acc: dict[int, dict] = {}
+    loose: list[tuple[int, int, dict]] = []  # (ts, si, ev) to place later
+
+    for ts, si, _k, ev in rows:
+        name = ev.get("event")
+        h = ev.get("height", 0)
+        node = ev.get("node") or sources[si].name
+        if name in _HEIGHT_EVENTS and h > 0:
+            hv = heights.get(h)
+            if hv is None:
+                hv = heights[h] = {
+                    "height": h,
+                    "t0_ns": ts,
+                    "end_ns": ts,
+                    "rounds": 1,
+                    "proposal": None,
+                    "proposal_rejects": 0,
+                    "round_starts": {},
+                    "commits": {},
+                }
+                votes_acc[h] = {}
+            hv["end_ns"] = max(hv["end_ns"], ts)
+            r = ev.get("round", 0)
+            hv["rounds"] = max(hv["rounds"], r + 1)
+            if name == _EV_STEP:
+                if (
+                    ev.get("step") == _NEW_ROUND_STEP
+                    and r not in hv["round_starts"]
+                ):
+                    hv["round_starts"][r] = ts
+            elif name == _EV_PROPOSAL:
+                if ev.get("accepted"):
+                    if hv["proposal"] is None or ts < hv["proposal"]["ts_ns"]:
+                        hv["proposal"] = {
+                            "node": node, "ts_ns": ts, "round": r,
+                        }
+                else:
+                    hv["proposal_rejects"] += 1
+            elif name == _EV_VOTE:
+                va = votes_acc[h].setdefault(
+                    node,
+                    {
+                        "prevote_ns": None, "prevotes": 0,
+                        "precommit_ns": None, "precommits": 0,
+                    },
+                )
+                if ev.get("type") == _PREVOTE:
+                    va["prevotes"] += 1
+                    if va["prevote_ns"] is None:
+                        va["prevote_ns"] = ts
+                elif ev.get("type") == _PRECOMMIT:
+                    va["precommits"] += 1
+                    if va["precommit_ns"] is None:
+                        va["precommit_ns"] = ts
+            elif name == _EV_COMMIT:
+                hv["commits"][node] = {
+                    "ts_ns": ts,
+                    "round": r,
+                    "latency_s": _round9(ev.get("dur_ns", 0) / 1e9),
+                    "txs": ev.get("txs", 0),
+                }
+        else:
+            loose.append((ts, si, ev))
+
+    ordered = [heights[h] for h in sorted(heights)]
+    for hv in ordered:
+        h = hv["height"]
+        hv["votes"] = votes_acc[h]
+        commits = hv["commits"]
+        if commits:
+            tss = [c["ts_ns"] for c in commits.values()]
+            hv["first_commit_ns"] = min(tss)
+            hv["commit_spread_s"] = _round9((max(tss) - min(tss)) / 1e9)
+        else:
+            hv["first_commit_ns"] = None
+            hv["commit_spread_s"] = None
+        hv["round_starts"] = {
+            str(r): t for r, t in sorted(hv["round_starts"].items())
+        }
+
+    # window assignment for gossip + annotations: a loose row belongs
+    # to the first height whose window END it precedes — a fault in
+    # the gap between commits delays the NEXT height
+    lag_samples: dict = {"run": [], "heights": {}}
+    run_ann: list[dict] = []
+    gossip_acc: dict = {}
+
+    def _height_for(ts: int):
+        for hv in ordered:
+            if ts <= hv["end_ns"]:
+                return hv["height"]
+        return None
+
+    def _gossip_bucket(key):
+        b = gossip_acc.get(key)
+        if b is None:
+            b = gossip_acc[key] = {"lags": [], "by_phase": {}, "worst": None}
+        return b
+
+    for ts, si, ev in loose:
+        name = ev.get("event")
+        node = ev.get("node") or sources[si].name
+        if name == _EV_GOSSIP:
+            h = ev.get("height", 0) or _height_for(ts)
+            lag_s = ev.get("lag_ns", 0) / 1e9
+            phase = ev.get("phase_name", "?")
+            for key in ("run", h):
+                if key is None:
+                    continue
+                b = _gossip_bucket(key)
+                b["lags"].append(lag_s)
+                ph = b["by_phase"].setdefault(phase, [])
+                ph.append(lag_s)
+                worst = b["worst"]
+                if worst is None or lag_s > worst["lag_s"]:
+                    b["worst"] = {
+                        "lag_s": _round9(lag_s),
+                        "phase": phase,
+                        "node": node,
+                        "src": ev.get("src"),
+                    }
+        else:
+            ann = dict(ev)
+            ann.pop("node", None)
+            ann["node"] = node
+            h = ev.get("height", 0)
+            if name in _HEIGHT_EVENTS and h:
+                target = h if h in heights else _height_for(ts)
+            else:
+                target = _height_for(ts)
+            ann["assigned_height"] = target
+            run_ann.append(ann)
+
+    def _gossip_view(key):
+        b = gossip_acc.get(key)
+        if b is None:
+            return None
+        stats = _lag_stats(b["lags"])
+        stats["by_phase"] = {
+            ph: _lag_stats(ls) for ph, ls in sorted(b["by_phase"].items())
+        }
+        stats["worst"] = b["worst"]
+        return stats
+
+    for hv in ordered:
+        h = hv["height"]
+        hv["gossip"] = _gossip_view(h)
+        hv["annotations"] = [
+            a for a in run_ann if a["assigned_height"] == h
+        ]
+        b = gossip_acc.get(h)
+        lag_samples["heights"][h] = b["lags"] if b else []
+        # cross-node edge tag: how much of any apparent cross-node lag
+        # in this height could be clock skew, not network/protocol
+        if domain == "virtual":
+            hv["skew_bound_s"] = 0.0
+            hv["skew_complete"] = True
+        else:
+            involved = sorted(set(hv["commits"]) | set(hv["votes"]))
+            hb: list[float] = []
+            comp = True
+            for i, a in enumerate(involved):
+                for bn in involved[i + 1:]:
+                    e = skew_edges.get(f"{a}|{bn}") or skew_edges.get(
+                        f"{bn}|{a}"
+                    )
+                    bd = e.get("bound_s") if e else None
+                    if bd is None:
+                        comp = False
+                    else:
+                        hb.append(bd)
+            hv["skew_bound_s"] = _round9(max(hb)) if hb else None
+            hv["skew_complete"] = comp and len(involved) > 1
+
+    run_b = gossip_acc.get("run")
+    lag_samples["run"] = run_b["lags"] if run_b else []
+
+    t0 = rows[0][0] if rows else 0
+    end = rows[-1][0] if rows else 0
+    data = {
+        "schema": 1,
+        "domain": domain,
+        "nodes": nodes,
+        "n_events": len(rows),
+        "heights": ordered,
+        "run": {
+            "t0_ns": t0,
+            "end_ns": end,
+            "duration_s": _round9((end - t0) / 1e9),
+            "gossip": _gossip_view("run"),
+            "annotations": run_ann,
+        },
+        "skew": {
+            "edges": skew_edges,
+            "max_bound_s": (
+                _round9(max(bounds_all)) if bounds_all else
+                (0.0 if domain == "virtual" else None)
+            ),
+            "complete": complete,
+        },
+    }
+    return Timeline(data, lag_samples)
+
+
+def merge_ring_export(export: dict, name: str | None = None) -> Timeline:
+    """Convenience: one ring export (possibly multi-node) -> Timeline."""
+    return merge(sources_from_obj(export, name=name))
+
+
+# re-export for callers that build synthetic sources in tests
+__all__ = [
+    "Source",
+    "Timeline",
+    "sources_from_obj",
+    "load_sources",
+    "fetch_ring",
+    "merge",
+    "merge_ring_export",
+]
+
+# keep a reference so the decoder-completeness contract is importable
+# from one place (tests walk libhealth.ring_event_codes())
+RING_EVENT_CODES = libhealth.ring_event_codes

@@ -39,6 +39,11 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
+    ap.add_argument(
+        "--postmortem", action="store_true",
+        help="print the cross-node timeline attribution table for the "
+        "run (cometbft_tpu/postmortem)",
+    )
     args = ap.parse_args(argv)
     if args.list:
         for name in sorted(SCENARIOS):
@@ -49,6 +54,11 @@ def main(argv=None) -> int:
         kw["n_nodes"] = args.nodes
     result = run_scenario(args.scenario, args.seed, **kw)
     print(json.dumps(result.summary(), default=str, indent=1))
+    if args.postmortem and result.ring is not None:
+        from ..postmortem import report_from_ring
+
+        _tl, report = report_from_ring(result.ring)
+        print(report.table())
     return 0 if result.ok else 1
 
 

@@ -31,6 +31,7 @@ import queue
 
 from ..libs import health as libhealth
 from ..libs import fail as libfail
+from ..libs import netstats as libnetstats
 from ..types import serialization as ser
 from .link import (
     DROP_CHANNEL,
@@ -68,6 +69,21 @@ _DROP_TO_FAULT_DETAIL = {
     DROP_CLASS: 2,
     DROP_PARTITION: 3,
     DROP_DEAD: 4,
+}
+
+# channel -> gossip phase recorded per delivered message (EV_GOSSIP;
+# codes from libs/netstats.PHASE_CODES).  Channel-grain — the delivery
+# plane never decodes payloads — which is exactly the granularity the
+# postmortem latency attribution needs: a per-hop virtual lag sample
+# for every message the links carried.
+_CH_PHASE = {
+    0x20: "state",  # consensus NewRoundStep/HasVote/maj23
+    0x21: "block_part",  # consensus proposal + block parts
+    0x22: "vote",  # consensus prevotes/precommits
+    0x23: "state",  # consensus vote-set bits
+    0x30: "tx",  # mempool
+    0x38: "evidence",
+    0x40: "block",  # blocksync
 }
 
 
@@ -388,12 +404,43 @@ class SimNet:
     def start(self) -> None:
         """Boot every node and connect the topology."""
         self._install_sig_cache()
+        # Flight-ring integration: stamp ring rows from the SHARED
+        # virtual clock (exact cross-node merge — the postmortem
+        # layer's lossless case) and intern one origin per node so
+        # every row decodes with the node that recorded it.  The
+        # scheduler thread switches origin per event (_enter_node).
+        self._prev_ring_clock = libhealth.set_clock(
+            self.clock.time_ns, domain="virtual"
+        )
+        self._origin_ids = [
+            libhealth.register_origin(f"node{i}") for i in range(self.n)
+        ]
         for node in self.nodes:
-            node.boot()
+            prev = self._enter_node(node.idx)
+            try:
+                node.boot()
+            finally:
+                self._exit_node(prev)
         for node in self.nodes:
             node.start()
         for i, j in self._topology_edges():
             self.connect(i, j)
+
+    # -- origin bookkeeping (who records the current ring row) ---------
+
+    def _enter_node(self, idx: int) -> int:
+        prev = self._current_node
+        self._current_node = idx
+        libhealth.set_thread_origin(
+            self._origin_ids[idx] if idx >= 0 else 0
+        )
+        return prev
+
+    def _exit_node(self, prev: int) -> None:
+        self._current_node = prev
+        libhealth.set_thread_origin(
+            self._origin_ids[prev] if prev >= 0 else 0
+        )
 
     _SIG_CACHE_CAP = 200_000
 
@@ -452,6 +499,10 @@ class SimNet:
         for node in self.nodes:
             if node.alive:
                 node.shutdown(crash=False)
+        if getattr(self, "_prev_ring_clock", None) is not None:
+            libhealth.set_clock(*self._prev_ring_clock)
+            self._prev_ring_clock = None
+        libhealth.set_thread_origin(0)
         if getattr(self, "_orig_verify_signature", None) is not None:
             from ..crypto import coalesce as crypto_coalesce
 
@@ -535,9 +586,17 @@ class SimNet:
 
     def _fault(self, kind: int, src: int = 0, dst: int = 0,
                detail: int = 0) -> None:
-        libhealth.record(
-            libhealth.EV_FAULT, height=src, round_=dst, a=kind, b=detail
-        )
+        # fault rows are NETWORK-plane annotations, not any one node's
+        # view — record with origin cleared (src/dst ride in h/r)
+        prev = libhealth.current_thread_origin()
+        libhealth.set_thread_origin(0)
+        try:
+            libhealth.record(
+                libhealth.EV_FAULT, height=src, round_=dst, a=kind,
+                b=detail,
+            )
+        finally:
+            libhealth.set_thread_origin(prev)
         if self._log:
             import sys
 
@@ -613,8 +672,12 @@ class SimNet:
         if node.alive:
             return
         node.restarts += 1
-        node.boot(block_sync=block_sync)
-        node.start()
+        prev = self._enter_node(idx)
+        try:
+            node.boot(block_sync=block_sync)  # WAL replay records here
+            node.start()
+        finally:
+            self._exit_node(prev)
         for j in range(self.n):
             if j != idx and self.nodes[j].alive and (
                 (idx, j) in self._base_edges()
@@ -622,7 +685,11 @@ class SimNet:
                 self.connect(idx, j)
         self.stats["restarts"] += 1
         self._fault(libhealth.FAULT_RESTART, src=idx)
-        self.nodes[idx].cs.process_pending()
+        prev = self._enter_node(idx)
+        try:
+            self.nodes[idx].cs.process_pending()
+        finally:
+            self._exit_node(prev)
 
     def _base_edges(self) -> set[tuple[int, int]]:
         out = set()
@@ -685,10 +752,15 @@ class SimNet:
             self._drop(reason, src, dst, ch)
             return True
         self.stats["sent"] += 1
-        self.sched.call_at(deliver_at, self._deliver, src, dst, ch, msg)
+        sent_ns = self.clock.now_ns
+        self.sched.call_at(
+            deliver_at, self._deliver, src, dst, ch, msg, sent_ns
+        )
         if dup_at is not None:
             self.stats["duplicated"] += 1
-            self.sched.call_at(dup_at, self._deliver, src, dst, ch, msg)
+            self.sched.call_at(
+                dup_at, self._deliver, src, dst, ch, msg, sent_ns
+            )
         return True
 
     def _drop(self, reason: str, src: int, dst: int, ch: int) -> None:
@@ -708,7 +780,9 @@ class SimNet:
             return DROP_PARTITION
         return DROP_DEAD
 
-    def _deliver(self, src: int, dst: int, ch: int, msg: bytes) -> None:
+    def _deliver(
+        self, src: int, dst: int, ch: int, msg: bytes, sent_ns: int = 0
+    ) -> None:
         node = self.nodes[dst]
         if self._stopped or not node.alive:
             self._drop(self._in_flight_drop_reason(src, dst), src, dst, ch)
@@ -719,13 +793,26 @@ class SimNet:
             return
         self.stats["delivered"] += 1
         self.stats[f"delivered_ch_{ch:#04x}"] += 1
-        prev, self._current_node = self._current_node, dst
+        prev = self._enter_node(dst)
         try:
+            # per-hop gossip lag into the receiving node's flight ring:
+            # the virtual-time analog of the netstamp EV_GOSSIP rows
+            # (phase by channel; sender's origin parked in the round
+            # column — the merge reads it back as the hop's src edge)
+            phase = _CH_PHASE.get(ch)
+            if phase is not None and sent_ns:
+                libhealth.record(
+                    libhealth.EV_GOSSIP,
+                    0,
+                    self._origin_ids[src],
+                    libnetstats.PHASE_CODES.get(phase, 0),
+                    self.clock.now_ns - sent_ns,
+                )
             node.hub.dispatch(ch, peer, msg)
             if node.alive:
                 node.cs.process_pending()
         finally:
-            self._current_node = prev
+            self._exit_node(prev)
 
     def inject(self, src: int, dst: int, ch: int, msg_bytes: bytes) -> bool:
         """Scenario-level send AS node ``src`` (byzantine behaviors):
@@ -742,11 +829,11 @@ class SimNet:
         except queue.Full:
             cs.process_pending()
             cs._queue.put_nowait(("timeout", ti))
-        prev, self._current_node = self._current_node, idx
+        prev = self._enter_node(idx)
         try:
             cs.process_pending()
         finally:
-            self._current_node = prev
+            self._exit_node(prev)
 
     # -- sim-driven reactor routines ---------------------------------------
 
@@ -845,7 +932,7 @@ class SimNet:
         reactor = node.hub.reactors.get("blocksync")
         if reactor is None or not reactor.is_running():
             return
-        prev, self._current_node = self._current_node, idx
+        prev = self._enter_node(idx)
         try:
             outcome = reactor._pool_step(self.clock.monotonic())
             node.cs.process_pending()
@@ -855,7 +942,7 @@ class SimNet:
             self._on_node_fatal(idx, e)
             return
         finally:
-            self._current_node = prev
+            self._exit_node(prev)
         if outcome == reactor.STEP_SWITCHED:
             return
         self._schedule_blocksync_tick(

@@ -86,6 +86,12 @@ def commit_metrics() -> dict:
     }
 
 
+# Scenario rings are sized to hold a WHOLE run's event stream — with
+# per-delivery EV_GOSSIP rows the default 4096 slots would wrap and
+# evict the early heights' commits, starving the postmortem merge.
+SCENARIO_RING = 1 << 16
+
+
 @dataclasses.dataclass
 class ScenarioResult:
     name: str
@@ -99,6 +105,10 @@ class ScenarioResult:
     signature: tuple
     failures: list
     notes: dict
+    # full flight-ring export (libs/health.export_ring shape) captured
+    # before the run's ring is torn down — the input the cross-node
+    # postmortem timeline (cometbft_tpu/postmortem) merges
+    ring: dict | None = None
 
     def summary(self) -> dict:
         return {
@@ -128,6 +138,8 @@ class _Run:
             tempfile.mkdtemp(prefix=f"simnet-{name}-") if homes else None
         )
         self._prev_enabled = libhealth.enabled()
+        self._prev_ring = libhealth.recorder().capacity
+        libhealth.set_ring_capacity(SCENARIO_RING)
         libhealth.reset()
         libhealth.enable()
         self.net: SimNet | None = None
@@ -160,6 +172,7 @@ class _Run:
                 ),
                 failures=self.failures,
                 notes=self.notes,
+                ring=libhealth.export_ring(),
             )
         finally:
             if net is not None:
@@ -170,6 +183,7 @@ class _Run:
             libfail.set_handler(None)
             if not self._prev_enabled:
                 libhealth.disable()
+            libhealth.set_ring_capacity(self._prev_ring)
             if self.home_root is not None:
                 shutil.rmtree(self.home_root, ignore_errors=True)
         return res
