@@ -2059,6 +2059,165 @@ def bench_postmortem_attribution(n_heights: int | None = None):
     }
 
 
+def bench_hash_plane(device: bool | None = None, n_threads: int | None = None):
+    """Config 18: the device hash plane (crypto/hashplane + ops/sha256)
+    on its two hot shapes.
+
+    (a) block-propose -> PartSet build: split a multi-MB block into
+        64 KiB parts with merkle proofs (types/part_set.from_data),
+        plane-routed vs plain host — the leaf hashing IS the byte-
+        hashing bill of proposing a large block;
+    (b) a mempool hash storm: concurrent CheckTx threads over a live
+        CListMempool + kvstore app, whose per-tx SHA-256 keys coalesce
+        into shared windows, vs the identical storm with no plane
+        routed (plain hashlib) — the headline carries the ratio as
+        ``hash_storm_vs_serial``.
+
+    ``device=None`` probes the backend; the dead-tunnel branch pins
+    ``device=False``, where the routed helpers BY DESIGN queue nothing
+    (SHA-256 has no host batch win) — that row measures the fallback
+    staying at serial parity, not a speedup.
+    """
+    import threading as _threading
+
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import MempoolConfig
+    from cometbft_tpu.crypto import hashplane as hpl
+    from cometbft_tpu.mempool import CListMempool
+    from cometbft_tpu.ops import sha256 as osha
+    from cometbft_tpu.types.part_set import PartSet
+
+    if device is None:
+        from cometbft_tpu.libs.accel import accelerator_backend
+
+        device = accelerator_backend()
+    if n_threads is None:
+        n_threads = _sz(32, 4)
+    n_parts = _sz(64, 4)
+    tx_bytes = 2048  # above the plane's single-message routing floor
+    per_thread = _sz(64, 8)
+    rng = np.random.default_rng(18)
+    block_data = rng.integers(
+        0, 256, size=n_parts * 65536 - 7, dtype=np.uint8
+    ).tobytes()
+
+    if device:
+        # Warm every (block-bucket, lane-bucket) pair the two workloads
+        # can launch, via direct kernel calls — cold XLA compiles inside
+        # a routed window would trip the plane's wedge breaker and the
+        # timed run would measure the cooldown, not the kernel.
+        tx_bb = osha.block_bucket(osha.n_blocks(tx_bytes))
+        lanes = 8
+        while lanes <= osha.lane_bucket(n_threads):
+            osha.sha256_many_async([b"w" * tx_bytes] * lanes, tx_bb)()
+            lanes *= 2
+        leaf_bb = osha.block_bucket(osha.n_blocks(65536 + 1))
+        osha.sha256_many_async(
+            [b"l" * 65537] * min(8, n_parts), leaf_bb
+        )()
+        if n_parts > 8:
+            osha.sha256_many_async([b"l" * 65537] * n_parts, leaf_bb)()
+        osha.sha256_many_async([b"i" * 65] * max(2, n_parts // 2), 2)()
+
+    # -- (a) PartSet build, host then routed ------------------------------
+    build_host_s = _steady(lambda: PartSet.from_data(block_data))
+    co = hpl.HashCoalescer(device=device, min_device_lanes=8)
+    co.start()
+    hpl.push_active(co)
+    try:
+        header_host = PartSet.from_data(block_data).header
+        build_routed_s = _steady(lambda: PartSet.from_data(block_data))
+        header_routed = PartSet.from_data(block_data).header
+        assert header_routed == header_host, "routed PartSet root diverged"
+
+        # -- (b) mempool hash storm ---------------------------------------
+        def storm(routed: bool):
+            app = KVStoreApplication()
+            client = LocalClient(app)
+            client.start()
+            try:
+                mp = CListMempool(
+                    MempoolConfig(size=n_threads * per_thread + 16),
+                    client,
+                )
+                barrier = _threading.Barrier(n_threads + 1)
+                fails: list = []
+                # per-thread payloads, generated before the threads
+                # start (the shared Generator is not thread-safe)
+                bases = [
+                    rng.integers(0, 256, size=tx_bytes,
+                                 dtype=np.uint8).tobytes()
+                    for _ in range(n_threads)
+                ]
+
+                def worker(tid):
+                    base = bases[tid]
+                    barrier.wait()
+                    for i in range(per_thread):
+                        tx = b"%d:%d:" % (tid, i) + base
+                        try:
+                            mp.check_tx(tx[:tx_bytes])
+                        except Exception as e:
+                            fails.append(repr(e))
+
+                threads = [
+                    _threading.Thread(
+                        target=worker, args=(t,), daemon=True
+                    )
+                    for t in range(n_threads)
+                ]
+                for t in threads:
+                    t.start()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join()
+                dt = time.perf_counter() - t0
+                assert not fails, fails[:3]
+                assert mp.size() == n_threads * per_thread
+                return n_threads * per_thread / dt
+            finally:
+                client.stop()
+
+        hpl.pop_active(co)
+        serial_tps = storm(routed=False)
+        hpl.push_active(co)
+        storm(routed=True)  # warm the plane's window path
+        # classify the STORM's own windows: the PartSet phase above
+        # already launched device windows on this coalescer, and an
+        # all-time counter would label a host-fallback storm "device"
+        w0, dw0 = co.windows, co.device_windows
+        storm_tps = storm(routed=True)
+        storm_windows = co.windows - w0
+        storm_backend = (
+            "device" if co.device_windows > dw0 else
+            ("host-window" if storm_windows else "unrouted")
+        )
+        windows = co.windows
+    finally:
+        hpl.pop_active(co)
+        co.stop()
+    return {
+        "parts": n_parts,
+        "block_mb": round(len(block_data) / 2**20, 2),
+        "partset_build_host_ms": round(build_host_s * 1e3, 2),
+        "partset_build_routed_ms": round(build_routed_s * 1e3, 2),
+        "partset_build_vs_host": round(build_host_s / build_routed_s, 2),
+        "storm_threads": n_threads,
+        "storm_txs": n_threads * per_thread,
+        "tx_bytes": tx_bytes,
+        "serial_checktx_per_sec": round(serial_tps, 1),
+        "coalesced_checktx_per_sec": round(storm_tps, 1),
+        "hash_storm_vs_serial": round(storm_tps / serial_tps, 2),
+        "storm_backend": storm_backend,
+        "storm_windows": storm_windows,
+        "windows": windows,
+        "note": "same digests, same call sites; routed runs send TxKey "
+        "and PartSet/merkle hashing through crypto/hashplane windows",
+    }
+
+
 def main() -> None:
     _pin_cpu_if_requested()
     if not _probe_device():
@@ -2273,6 +2432,23 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "17_postmortem_attribution",
                      "backend": "host", "error": repr(e)[:200]})
+        hash_row = None
+        try:
+            # device pinned off: no jit may touch the dead tunnel. The
+            # routed helpers queue NOTHING without a device (hashlib is
+            # already the optimal host path), so this row measures the
+            # fallback holding serial parity, not a speedup.
+            hash_row = bench_hash_plane(device=False)
+            _eprint(
+                {
+                    "config": "18_hash_plane",
+                    "backend": "host",
+                    **hash_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "18_hash_plane", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -2336,6 +2512,15 @@ def main() -> None:
                             ]
                         }
                         if pm_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "hash_storm_vs_serial": hash_row[
+                                "hash_storm_vs_serial"
+                            ]
+                        }
+                        if hash_row
                         else {}
                     ),
                 }
@@ -2490,6 +2675,17 @@ def main() -> None:
         _eprint({"config": "17_postmortem_attribution",
                  "error": repr(e)[:200]})
 
+    hash_row = None
+    try:
+        # device probe decides routing; min_device_lanes is pinned low
+        # inside (8) so storm windows — capped at n_threads lanes by
+        # each CheckTx thread blocking on its key — actually exercise
+        # the device path, mirroring 12's pin rationale
+        hash_row = bench_hash_plane()
+        _eprint({"config": "18_hash_plane", **hash_row})
+    except Exception as e:
+        _eprint({"config": "18_hash_plane", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -2575,6 +2771,17 @@ def main() -> None:
                         ]
                     }
                     if pm_row
+                    else {}
+                ),
+                # concurrent-CheckTx key hashing through the hash
+                # plane vs serial hashlib (config 18_hash_plane)
+                **(
+                    {
+                        "hash_storm_vs_serial": hash_row[
+                            "hash_storm_vs_serial"
+                        ]
+                    }
+                    if hash_row
                     else {}
                 ),
             }

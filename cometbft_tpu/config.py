@@ -122,6 +122,25 @@ ENV_KNOBS: dict[str, str] = {
         "(crypto/batch.host_batch_threshold) — sub-cutover windows "
         "still coalesce into one host MSM (crypto/coalesce.py)"
     ),
+    "COMETBFT_TPU_HASH": (
+        "cross-caller SHA-256 hash plane: auto (default, node starts "
+        "it on accelerator backends) | 1 force | 0 off "
+        "(crypto/hashplane.py)"
+    ),
+    "COMETBFT_TPU_HASH_WINDOW_US": (
+        "hash-plane deadline window in microseconds before a sub-size "
+        "window flushes (default 500; crypto/hashplane.py)"
+    ),
+    "COMETBFT_TPU_HASH_MAX_LANES": (
+        "lanes that trigger an immediate hash-plane size flush / the "
+        "per-window cap (default 2048; crypto/hashplane.py)"
+    ),
+    "COMETBFT_TPU_HASH_MIN_DEVICE_LANES": (
+        "pin the lane count above which a hash window's block buckets "
+        "go to the device; unset defers to the per-bucket adaptive "
+        "crossover seeded at ~2048 total SHA blocks per window "
+        "(crypto/hashplane.py)"
+    ),
     "COMETBFT_TPU_HEALTH": (
         "consensus flight recorder + SLO watchdogs (libs/health): auto "
         "(default — on while a node runs, refcounted like devstats) | "

@@ -13,7 +13,7 @@ from .batch import (  # noqa: F401
     create_batch_verifier,
     supports_batch_verifier,
 )
-from . import merkle, tmhash  # noqa: F401
+from . import hashplane, merkle, tmhash  # noqa: F401
 
 # sr25519/secp256k1 register here (not in keys.py) to avoid import cycles
 # while staying reachable from every production entry point.

@@ -4,6 +4,17 @@ Reference surface: crypto/merkle/tree.go (HashFromByteSlices), proof.go
 (Proof, ComputeProofs), proof_op.go (ProofOperator chaining). Domain
 separation: leaf = SHA256(0x00 || item), inner = SHA256(0x01 || l || r);
 empty tree hashes to SHA256("").
+
+The tree is built as an iterative LEVEL-ORDER walk, not the reference's
+largest-power-of-two-split recursion: pairing adjacent nodes and
+promoting an odd tail unchanged produces the IDENTICAL tree (the
+certificate-transparency construction — the promoted node is exactly
+the right spine the split recursion builds), it cannot hit Python's
+recursion limit on 100k+-leaf trees (large blocks, simnet storms), and
+each level is one flat batch of independent hashes — which is what
+lets the device hash plane (crypto/hashplane.py) run leaf and inner
+rounds level-by-level through the batched SHA-256 kernel. Level-shape
+identity with the recursion is pinned by tests/test_hashplane.py.
 """
 
 from __future__ import annotations
@@ -17,7 +28,12 @@ INNER_PREFIX = b"\x01"
 
 
 def _leaf_hash(item: bytes) -> bytes:
-    return tmhash.sum(LEAF_PREFIX + item)
+    # routed: a 64 KiB PartSet leaf coalesces into a device window when
+    # the hash plane is up; small leaves (and device-less containers)
+    # take the plain host hash with zero round trips
+    from . import hashplane
+
+    return hashplane.hash_bytes(LEAF_PREFIX + item)
 
 
 def _inner_hash(left: bytes, right: bytes) -> bytes:
@@ -32,17 +48,39 @@ def _split_point(n: int) -> int:
     return k
 
 
+def _compute_levels(items: list[bytes]) -> list[list[bytes]]:
+    """All tree levels bottom-up: level 0 = leaf hashes, last = [root].
+
+    Each level pairs adjacent nodes; an odd tail node is promoted to
+    the next level unchanged. THE one level walk — every level is one
+    flat batch through ``hashplane.hash_many``, which routes it to the
+    device plane when a routed window can win and to host ``hashlib``
+    otherwise, so the tree logic (and the domain-separation prefixes)
+    cannot fork between the two paths.
+    """
+    from . import hashplane
+
+    level = hashplane.hash_many([LEAF_PREFIX + bytes(x) for x in items])
+    levels = [level]
+    while len(level) > 1:
+        nxt = hashplane.hash_many(
+            [
+                INNER_PREFIX + level[i] + level[i + 1]
+                for i in range(0, len(level) - 1, 2)
+            ]
+        )
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+        levels.append(level)
+    return levels
+
+
 def hash_from_byte_slices(items: list[bytes]) -> bytes:
     """Root hash of the RFC-6962 tree over ``items``."""
-    n = len(items)
-    if n == 0:
+    if not items:
         return tmhash.sum(b"")
-    if n == 1:
-        return _leaf_hash(items[0])
-    k = _split_point(n)
-    return _inner_hash(
-        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
-    )
+    return _compute_levels(items)[-1][0]
 
 
 @dataclass(slots=True)
@@ -88,58 +126,37 @@ def _root_from_aunts(
 
 
 def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
-    """(root, per-item proofs) — crypto/merkle/proof.go ProofsFromByteSlices."""
-    trails, root = _trails_from_byte_slices(items)
-    root_hash = root.hash
-    proofs = [
-        Proof(
-            total=len(items),
-            index=i,
-            leaf_hash=trail.hash,
-            aunts=trail.flatten_aunts(),
-        )
-        for i, trail in enumerate(trails)
-    ]
-    return root_hash, proofs
+    """(root, per-item proofs) — crypto/merkle/proof.go ProofsFromByteSlices.
 
-
-class _ProofNode:
-    __slots__ = ("hash", "parent", "left", "right")
-
-    def __init__(self, hash_: bytes):
-        self.hash = hash_
-        self.parent = None
-        self.left = None  # sibling on the left
-        self.right = None  # sibling on the right
-
-    def flatten_aunts(self) -> list[bytes]:
+    Built from the level arrays instead of a recursive trail forest:
+    leaf ``i``'s aunt at each level is its pair sibling (``idx ^ 1``)
+    when one exists — a promoted odd-tail node contributes no aunt at
+    the level it skipped — and ``idx //= 2`` maps to the parent either
+    way. Aunt order is leaf-to-root, exactly what ``_root_from_aunts``
+    consumes from the end.
+    """
+    if not items:
+        return tmhash.sum(b""), []
+    levels = _compute_levels(items)
+    total = len(items)
+    proofs = []
+    for i in range(total):
         aunts: list[bytes] = []
-        node = self
-        while node is not None:
-            if node.left is not None:
-                aunts.append(node.left.hash)
-            elif node.right is not None:
-                aunts.append(node.right.hash)
-            node = node.parent
-        return aunts
-
-
-def _trails_from_byte_slices(items: list[bytes]):
-    n = len(items)
-    if n == 0:
-        return [], _ProofNode(tmhash.sum(b""))
-    if n == 1:
-        node = _ProofNode(_leaf_hash(items[0]))
-        return [node], node
-    k = _split_point(n)
-    lefts, left_root = _trails_from_byte_slices(items[:k])
-    rights, right_root = _trails_from_byte_slices(items[k:])
-    root = _ProofNode(_inner_hash(left_root.hash, right_root.hash))
-    left_root.parent = root
-    left_root.right = right_root
-    right_root.parent = root
-    right_root.left = left_root
-    return lefts + rights, root
+        idx = i
+        for level in levels[:-1]:
+            sib = idx ^ 1
+            if sib < len(level):
+                aunts.append(level[sib])
+            idx //= 2
+        proofs.append(
+            Proof(
+                total=total,
+                index=i,
+                leaf_hash=levels[0][i],
+                aunts=aunts,
+            )
+        )
+    return levels[-1][0], proofs
 
 
 # --- Proof operators (crypto/merkle/proof_op.go) -----------------------------

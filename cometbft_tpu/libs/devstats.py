@@ -292,7 +292,14 @@ class _TrackedJit:
 
 def track(kernel: str, fn, axis: int = 0) -> _TrackedJit:
     """Wrap a jitted callable for compile accounting. ``axis`` is the
-    positional arg whose LAST dimension is the lane bucket."""
+    positional arg whose LAST dimension is the lane bucket.
+
+    The recompile detector keys on ``(kernel, lane-bucket)`` — a
+    kernel whose compile shape varies on a SECOND axis must encode
+    that axis into the kernel name (one tracked jit per value, like
+    ops/sha256's ``sha256.xla.b<block-bucket>``), or a fresh sibling
+    shape at an already-seen lane bucket reads as a phantom
+    steady-state recompile and feeds the recompile-storm watchdog."""
     return _TrackedJit(fn, kernel, axis)
 
 

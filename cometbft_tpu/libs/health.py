@@ -111,6 +111,7 @@ EV_FSYNC = 7  # a=WAL fsync ns
 EV_WATCHDOG = 8  # a=watchdog bit (see _WATCHDOGS)
 EV_GOSSIP = 9  # a=propagation phase code (netstats.PHASE_NAMES), b=lag ns
 EV_FAULT = 10  # simnet fault plane: h=src node, r=dst node, a=kind, b=detail
+EV_HASH = 11  # hash-plane window flush: a=lanes, b=1 device / 0 host
 
 _N_CODES = 16  # size of the per-code last-seen vector
 
@@ -146,6 +147,7 @@ _CODE_NAMES = {
     EV_WATCHDOG: "health.watchdog",
     EV_GOSSIP: "p2p.gossip",
     EV_FAULT: "simnet.fault",
+    EV_HASH: "hash.flush",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -159,6 +161,7 @@ _CODE_FIELDS = {
     EV_WATCHDOG: ("watchdog", None),
     EV_GOSSIP: ("phase", "lag_ns"),
     EV_FAULT: ("kind", "detail"),
+    EV_HASH: ("lanes", "device"),
 }
 
 # codes whose payload is a wall-clock-measured duration: meaningless in

@@ -18,13 +18,17 @@ from dataclasses import dataclass, field
 
 from ..abci import types as abci
 from ..config import MempoolConfig
-from ..crypto import tmhash
+from ..crypto import hashplane
 from ..libs.clist import CList
 from .cache import LRUTxCache, NopTxCache
 
 
 def TxKey(tx: bytes) -> bytes:
-    return tmhash.sum(tx)
+    # routed through the device hash plane when one is up: concurrent
+    # CheckTx threads' key hashes coalesce into shared SHA-256 windows
+    # (large txs only — small keys stay on the host hash; digests are
+    # identical either way)
+    return hashplane.hash_bytes(tx)
 
 
 class MempoolError(Exception):
@@ -45,6 +49,10 @@ class MempoolTx:
     height: int  # height when validated
     gas_wanted: int = 0
     senders: set = field(default_factory=set)  # peer ids that sent it
+    # the tx key, computed ONCE at CheckTx ingress and threaded through
+    # every later cache/map touch — a 1 MB tx must never pay a second
+    # SHA-256 on the remove/recheck paths
+    key: bytes = b""
 
 
 class CListMempool:
@@ -76,6 +84,13 @@ class CListMempool:
         self._txs_available: threading.Event | None = None
         self._notified_txs_available = False
         self._pending_senders: dict[bytes, str] = {}
+        # tx bytes -> key for in-flight CheckTx requests: the async
+        # response callback only receives the tx back, and re-deriving
+        # the key there would re-hash up to max_tx_bytes per response
+        # (the call-count test in tests/test_hashplane.py pins ONE
+        # TxKey per CheckTx). Entries live exactly as long as a
+        # _pending_senders entry would.
+        self._pending_tx_keys: dict[bytes, bytes] = {}
         proxy_app.set_response_callback(self._global_cb)
 
     # -- config hooks ------------------------------------------------------
@@ -138,9 +153,20 @@ class CListMempool:
             libmetrics.node_metrics().mempool_tx_size.observe(len(tx))
             if sender:
                 self._pending_senders[key] = sender
-            reqres = self.proxy_app.check_tx_async(
-                abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW)
-            )
+            self._pending_tx_keys[tx] = key
+            try:
+                reqres = self.proxy_app.check_tx_async(
+                    abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW)
+                )
+            except BaseException:
+                # a failed dispatch means no response callback will
+                # ever pop these — each leaked tx-key entry pins up to
+                # max_tx_bytes of tx bytes, so clean up at the failure
+                # site (the cache entry stays, matching the reference's
+                # seen-tx semantics)
+                self._pending_tx_keys.pop(tx, None)
+                self._pending_senders.pop(key, None)
+                raise
             if cb is not None:
                 reqres.set_callback(cb)
 
@@ -155,7 +181,14 @@ class CListMempool:
 
     def _res_cb_first_time(self, req, res) -> None:
         tx = req.tx
-        key = TxKey(tx)
+        # the key was computed at CheckTx ingress; a socket client
+        # round-trips the tx bytes so the map lookup is by value (a
+        # dict hash, not another SHA-256). The TxKey fallback only
+        # fires for responses whose ingress predates this process
+        # (never in practice — the map is cleared with the pool).
+        key = self._pending_tx_keys.pop(tx, None)
+        if key is None:
+            key = TxKey(tx)
         with self._update_mtx:
             post_ok = True
             if self.post_check is not None:
@@ -173,6 +206,7 @@ class CListMempool:
                     tx=tx,
                     height=self.height,
                     gas_wanted=res.gas_wanted,
+                    key=key,
                 )
                 if sender:
                     memtx.senders.add(sender)
@@ -206,9 +240,10 @@ class CListMempool:
                 self._recheck_cursor = None
                 return
             if res.code != abci.OK:
+                key = el.value.key  # == TxKey(req.tx): el.value.tx matched
                 self._remove_tx_el(el)
                 if not self.config.keep_invalid_txs_in_cache:
-                    self.cache.remove(TxKey(req.tx))
+                    self.cache.remove(key)
             if el is self._recheck_end:
                 self._recheck_cursor = None
                 if self.size() > 0:
@@ -257,10 +292,13 @@ class CListMempool:
             self._size_bytes = 0
             self.cache.reset()
             self._recheck_cursor = None
+            self._pending_tx_keys.clear()
 
     def _remove_tx_el(self, el) -> None:
         self.txs.remove(el)
-        self.tx_map.pop(TxKey(el.value.tx), None)
+        # admitted txs always carry their ingress key; the TxKey
+        # fallback guards hand-constructed entries in tests
+        self.tx_map.pop(el.value.key or TxKey(el.value.tx), None)
         self._size_bytes -= len(el.value.tx)
 
     def remove_tx_by_key(self, key: bytes) -> None:
@@ -285,8 +323,12 @@ class CListMempool:
             self.pre_check = pre_check
         if post_check is not None:
             self.post_check = post_check
-        for tx, res in zip(txs, tx_results):
-            key = TxKey(tx)
+        # committed txs arrive keyless from the block — derive all
+        # their keys as ONE batch (hash_many routes to the device
+        # plane only when that wins, and per-tx routed tickets inside
+        # the commit critical section would pay a round trip each)
+        keys = hashplane.hash_many(txs)
+        for tx, key, res in zip(txs, keys, tx_results):
             if res.code == abci.OK:
                 self.cache.push(key)  # committed: never re-admit
             elif not self.config.keep_invalid_txs_in_cache:

@@ -455,6 +455,11 @@ class Node(BaseService):
         # on_start because in "auto" mode it probes the jax backend —
         # constructing a Node must stay free of backend init.
         self.verify_coalescer = None
+        # Cross-caller hash plane (crypto/hashplane.py): coalesced
+        # SHA-256 for mempool tx keys, PartSet leaves and merkle
+        # levels. COMETBFT_TPU_HASH gates it; same deferred-probe boot
+        # as the verify coalescer.
+        self.hash_plane = None
         # Health monitor (libs/health): started in _finish_start — the
         # always-on flight recorder + SLO watchdogs + black-box dumps.
         self.health_monitor = None
@@ -679,6 +684,27 @@ class Node(BaseService):
                 )
                 self.verify_coalescer.start()
                 crypto_coalesce.push_active(self.verify_coalescer)
+            # The hash plane rides the same boot slot and the same
+            # leak-safety rules as the verify coalescer: started before
+            # the switch so the first CheckTx keys / PartSet leaves
+            # coalesce, unwound on ANY later boot failure. "auto"
+            # starts one only on accelerator backends — host-only
+            # deployments keep plain hashlib with zero round trips.
+            from ..crypto import hashplane as crypto_hashplane
+
+            try:
+                if crypto_hashplane.node_wants_hashplane():
+                    self.hash_plane = crypto_hashplane.HashCoalescer(
+                        logger=self.logger.with_module("hashplane")
+                    )
+                    self.hash_plane.start()
+                    crypto_hashplane.push_active(self.hash_plane)
+            except BaseException:
+                if self.verify_coalescer is not None:
+                    crypto_coalesce.pop_active(self.verify_coalescer)
+                    self.verify_coalescer.stop()
+                    self.verify_coalescer = None
+                raise
             try:
                 self._finish_start()
             except BaseException:
@@ -686,6 +712,10 @@ class Node(BaseService):
                 # raise NotStartedError and on_stop would never unroute the
                 # coalescer — unwind it here or the orphan stays atop the
                 # process-wide routing stack with its executor running
+                if self.hash_plane is not None:
+                    crypto_hashplane.pop_active(self.hash_plane)
+                    self.hash_plane.stop()
+                    self.hash_plane = None
                 if self.verify_coalescer is not None:
                     crypto_coalesce.pop_active(self.verify_coalescer)
                     self.verify_coalescer.stop()
@@ -922,6 +952,18 @@ class Node(BaseService):
             try:
                 if self.verify_coalescer.is_running():
                     self.verify_coalescer.stop()
+            except Exception:
+                pass
+        # Hash plane with the same unroute-then-drain discipline: new
+        # hashers fall back to hashlib instantly, stop() resolves every
+        # pending digest ticket.
+        if getattr(self, "hash_plane", None) is not None:
+            from ..crypto import hashplane as crypto_hashplane
+
+            crypto_hashplane.pop_active(self.hash_plane)
+            try:
+                if self.hash_plane.is_running():
+                    self.hash_plane.stop()
             except Exception:
                 pass
         try:

@@ -332,9 +332,13 @@ class Data:
     txs: list[bytes] = dc_field(default_factory=list)
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
-            [tmhash.sum(tx) for tx in self.txs]
-        )
+        # the per-tx pre-hash is one flat batch over up to max_tx_bytes
+        # messages — the exact shape the device hash plane wins on; the
+        # merkle root over the 32-byte keys then routes level-by-level
+        # through the same plane (crypto/merkle._compute_levels)
+        from ..crypto import hashplane
+
+        return merkle.hash_from_byte_slices(hashplane.hash_many(self.txs))
 
 
 @dataclass(slots=True)

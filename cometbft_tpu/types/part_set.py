@@ -3,6 +3,13 @@
 Reference: types/part_set.go. Blocks gossip as parts so a proposal can
 stream from many peers concurrently; each part carries an inclusion proof
 against the PartSetHeader hash in the proposal.
+
+Hashing rides the device hash plane when one is routed: ``from_data``'s
+leaf/proof construction goes through the batched merkle backend
+(crypto/merkle._compute_levels -> crypto/hashplane), and ``add_part``'s
+proof verification hashes its 64 KiB leaf through the cross-caller
+coalescer — concurrent part gossip from many peers packs into shared
+device windows. Digests are bit-identical either way.
 """
 
 from __future__ import annotations
@@ -43,7 +50,12 @@ class PartSet:
         root, proofs = merkle.proofs_from_byte_slices(chunks)
         ps = cls(PartSetHeader(total=len(chunks), hash=root))
         for i, chunk in enumerate(chunks):
-            ps.add_part(Part(index=i, bytes_=chunk, proof=proofs[i]))
+            # proofs we JUST computed need no re-verification: skipping
+            # it saves total*(1 + log total) hashes per self-built block
+            # (the dominant cost of from_data after the leaf hashing
+            # itself); gossip ingress still takes the verifying
+            # add_part path
+            ps._add_trusted_part(Part(index=i, bytes_=chunk, proof=proofs[i]))
         return ps
 
     def __init__(self, header: PartSetHeader):
@@ -73,6 +85,20 @@ class PartSet:
             # (consensus addProposalBlockPart; a cross-round or byzantine
             # part must not escape that guard)
             raise PartSetError(f"invalid part proof: {e}")
+        return self._store(part)
+
+    def _add_trusted_part(self, part: Part) -> bool:
+        """Store a part whose proof this process just computed
+        (from_data) without the redundant proof walk; never for parts
+        from the wire."""
+        part.validate_basic()
+        if part.index >= self.header.total:
+            raise PartSetError("part index out of range")
+        if self.parts[part.index] is not None:
+            return False
+        return self._store(part)
+
+    def _store(self, part: Part) -> bool:
         self.parts[part.index] = part
         self.parts_bit_array.set_index(part.index, True)
         self.count += 1

@@ -1,0 +1,642 @@
+"""Device hash plane (ops/sha256.py + crypto/hashplane.py): kernel
+bit-identity vs hashlib across every padding boundary, merkle
+level-order identity vs the reference recursion (roots AND proofs,
+incl. a 64k-leaf tree the old recursion could not survive), coalescer
+flush/drain/failure-isolation semantics mirroring tests/test_coalesce,
+the warmed no-recompile contract extended to the hash kernels, the
+once-per-CheckTx tx-key pin, and the knob/doc registry gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+
+import pytest
+
+from cometbft_tpu.crypto import hashplane, merkle, tmhash
+from cometbft_tpu.libs import metrics as libmetrics
+from cometbft_tpu.libs.metrics import NodeMetrics
+from cometbft_tpu.ops import sha256 as osha
+
+pytestmark = pytest.mark.quick
+
+# Every SHA-256 padding boundary: the empty message, the 55/56 edge
+# (last length fitting one block / first needing two), the 63/64/65
+# block-boundary trio, and multi-block interiors.
+PADDING_EDGES = (0, 1, 3, 55, 56, 57, 63, 64, 65, 119, 127, 128, 129, 200)
+
+
+def _rand_msgs(lengths, seed=1):
+    rnd = random.Random(seed)
+    return [bytes(rnd.randrange(256) for _ in range(n)) for n in lengths]
+
+
+@pytest.fixture
+def metrics():
+    m = NodeMetrics()
+    libmetrics.push_node_metrics(m)
+    yield m
+    libmetrics.pop_node_metrics(m)
+
+
+def _plane(**kw):
+    kw.setdefault("device", False)
+    co = hashplane.HashCoalescer(**kw)
+    co.start()
+    return co
+
+
+class TestSha256KernelIdentity:
+    """The kernel is bit-identical to hashlib.sha256 — the acceptance
+    bar every digest through the plane must clear."""
+
+    def test_every_padding_edge(self):
+        msgs = _rand_msgs(PADDING_EDGES, seed=2)
+        assert osha.sha256_many_async(msgs)() == [
+            hashlib.sha256(m).digest() for m in msgs
+        ]
+
+    def test_random_length_fuzz(self):
+        rnd = random.Random(11)
+        lengths = [rnd.randrange(0, 700) for _ in range(64)]
+        msgs = _rand_msgs(lengths, seed=12)
+        assert osha.sha256_many_async(msgs)() == [
+            hashlib.sha256(m).digest() for m in msgs
+        ]
+
+    @pytest.mark.slow
+    def test_over_one_mebibyte_message(self):
+        big = random.Random(13).randbytes((1 << 20) + 13)
+        assert osha.sha256_many_async([big])()[0] == hashlib.sha256(
+            big
+        ).digest()
+
+    def test_block_count_and_buckets(self):
+        # 55 bytes is the last 1-block length, 56 the first 2-block one
+        assert osha.n_blocks(0) == 1
+        assert osha.n_blocks(55) == 1
+        assert osha.n_blocks(56) == 2
+        assert osha.n_blocks(64) == 2
+        assert osha.n_blocks(119) == 2
+        assert osha.n_blocks(120) == 3
+        assert osha.block_bucket(1) == 1
+        assert osha.block_bucket(3) == 4
+        assert osha.lane_bucket(1) == 8
+        assert osha.lane_bucket(9) == 16
+
+
+def _rec_root(items):
+    """The reference largest-power-of-two-split recursion — the oracle
+    the iterative level-order walk must match node-for-node."""
+    def lh(x):
+        return hashlib.sha256(b"\x00" + x).digest()
+
+    def ih(l, r):
+        return hashlib.sha256(b"\x01" + l + r).digest()
+
+    def go(items):
+        n = len(items)
+        if n == 0:
+            return hashlib.sha256(b"").digest()
+        if n == 1:
+            return lh(items[0])
+        k = 1
+        while k * 2 < n:
+            k *= 2
+        return ih(go(items[:k]), go(items[k:]))
+
+    return go(items)
+
+
+class TestMerkleIterativeIdentity:
+    def test_roots_match_reference_recursion(self):
+        rnd = random.Random(21)
+        for n in list(range(0, 34)) + [63, 64, 65, 100, 257, 1000]:
+            items = [
+                bytes(rnd.randrange(256) for _ in range(rnd.randrange(40)))
+                for _ in range(n)
+            ]
+            assert merkle.hash_from_byte_slices(items) == _rec_root(items), n
+
+    def test_proofs_match_and_verify(self):
+        rnd = random.Random(22)
+        for n in (1, 2, 3, 5, 7, 8, 9, 33):
+            items = [b"item-%d-%d" % (n, i) for i in range(n)]
+            root, proofs = merkle.proofs_from_byte_slices(items)
+            assert root == _rec_root(items)
+            assert len(proofs) == n
+            for i, p in enumerate(proofs):
+                assert p.total == n and p.index == i
+                p.verify(root, items[i])
+                with pytest.raises(ValueError):
+                    p.verify(root, items[i] + b"x")
+
+    def test_64k_leaf_tree_no_recursion_limit(self):
+        """The satellite contract: 100k+-leaf trees (large blocks,
+        simnet storms) must not hit Python's recursion limit. 64k
+        leaves under a tightened limit proves the walk is iterative;
+        root + spot proofs still match the (iteratively computed)
+        oracle relations."""
+        import sys
+
+        items = [b"leaf-%d" % i for i in range(1 << 16)]
+        limit = sys.getrecursionlimit()
+        sys.setrecursionlimit(100)
+        try:
+            root = merkle.hash_from_byte_slices(items)
+            root2, proofs = merkle.proofs_from_byte_slices(items)
+        finally:
+            sys.setrecursionlimit(limit)
+        assert root == root2
+        for i in (0, 1, 12345, (1 << 16) - 1):
+            proofs[i].verify(root, items[i])
+        # a 2^16-leaf tree is perfect: every proof carries 16 aunts
+        assert all(len(p.aunts) == 16 for p in proofs)
+
+    def test_empty_and_single(self):
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(
+            b""
+        ).digest()
+        root, proofs = merkle.proofs_from_byte_slices([])
+        assert root == hashlib.sha256(b"").digest() and proofs == []
+        root, proofs = merkle.proofs_from_byte_slices([b"only"])
+        assert root == hashlib.sha256(b"\x00only").digest()
+        assert proofs[0].aunts == []
+        proofs[0].verify(root, b"only")
+
+
+class TestFlushTriggers:
+    def test_size_flush_does_not_wait_for_deadline(self, metrics):
+        co = _plane(window_us=60_000_000, max_lanes=4)
+        try:
+            msgs = _rand_msgs((10, 20, 30, 40), seed=31)
+            digests = co.submit(msgs).result(timeout=10)
+            assert digests == [hashlib.sha256(m).digest() for m in msgs]
+            assert metrics.hash_flushes.labels("size").value() >= 1
+        finally:
+            co.stop()
+
+    def test_deadline_flush_serves_a_lone_lane(self, metrics):
+        co = _plane(window_us=20_000, max_lanes=1 << 20)
+        try:
+            digests = co.submit([b"lone"]).result(timeout=10)
+            assert digests == [hashlib.sha256(b"lone").digest()]
+            assert metrics.hash_flushes.labels("deadline").value() >= 1
+            assert metrics.hash_window_lanes._n >= 1
+        finally:
+            co.stop()
+
+    def test_device_window_matches_hashlib(self):
+        # XLA-CPU exercises the real device staging path; mixed lengths
+        # split into per-block-bucket launches inside ONE window.
+        co = _plane(
+            window_us=60_000_000, max_lanes=8, device=True,
+            min_device_lanes=1,
+        )
+        try:
+            msgs = _rand_msgs((0, 55, 56, 64, 65, 1000, 130, 7), seed=32)
+            digests = co.submit(msgs).result(timeout=120)
+            assert digests == [hashlib.sha256(m).digest() for m in msgs]
+            assert co.device_windows == 1
+        finally:
+            co.stop()
+
+
+class TestFailureIsolation:
+    def test_exception_in_one_submit_fails_only_that_ticket(self):
+        co = _plane(window_us=20_000, max_lanes=8)
+        try:
+            bad = co.submit([None])  # bytes(None) -> TypeError
+            good = co.submit([b"x", b"y"])
+            assert good.result(timeout=10) == [
+                hashlib.sha256(b"x").digest(),
+                hashlib.sha256(b"y").digest(),
+            ]
+            with pytest.raises(TypeError):
+                bad.result(timeout=10)
+        finally:
+            co.stop()
+
+
+class TestShutdownDrain:
+    def test_drain_delivers_every_pending_ticket(self, monkeypatch):
+        # a window/size pair that can never flush on its own (the
+        # work-proportional budget is pinned huge so the deadline
+        # cannot fire either — only the drain can resolve these)
+        monkeypatch.setattr(hashplane, "_HOST_S_PER_BLOCK", 1000.0)
+        co = _plane(window_us=60_000_000, max_lanes=1 << 20)
+        msgs = _rand_msgs((5, 10, 15, 20, 25, 30), seed=41)
+        tickets = [co.submit([m]) for m in msgs]
+        assert not any(t.done() for t in tickets)
+        co.stop()  # blocks until the drain resolved everything
+        assert all(t.done() for t in tickets)
+        for t, m in zip(tickets, msgs):
+            assert t.result(timeout=0.1) == [hashlib.sha256(m).digest()]
+
+    def test_submit_after_stop_raises_and_helpers_fall_back(self):
+        co = _plane(window_us=1_000, max_lanes=8, device=True)
+        hashplane.push_active(co)
+        try:
+            co.stop()
+            with pytest.raises(hashplane.HashplaneStoppedError):
+                co.submit([b"x"])
+            # the routed helpers must still answer, on the host path
+            big = b"z" * 4096
+            assert hashplane.hash_bytes(big) == hashlib.sha256(
+                big
+            ).digest()
+            msgs = [b"m" * 600] * 8
+            assert hashplane.hash_many(msgs) == [
+                hashlib.sha256(m).digest() for m in msgs
+            ]
+        finally:
+            hashplane.pop_active(co)
+
+    def test_concurrent_submitters_all_resolve_on_stop(self, monkeypatch):
+        monkeypatch.setattr(hashplane, "_HOST_S_PER_BLOCK", 1000.0)
+        co = _plane(window_us=60_000_000, max_lanes=1 << 20)
+        msgs = _rand_msgs(range(8, 16), seed=42)
+        results: dict[int, list] = {}
+
+        def submit_and_wait(i):
+            results[i] = co.submit([msgs[i]]).result(timeout=30)
+
+        threads = [
+            threading.Thread(target=submit_and_wait, args=(i,), daemon=True)
+            for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        gate = threading.Event()
+        for _ in range(200):
+            if co._pending_lanes == 8:
+                break
+            gate.wait(0.01)
+        co.stop()
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(results) == list(range(8))
+        for i in range(8):
+            assert results[i] == [hashlib.sha256(msgs[i]).digest()]
+
+
+class TestInflightRescue:
+    """A window popped from _pending but not yet materialized lives in
+    neither the queue nor any caller's hands — the rescue paths must
+    resolve its tickets (from the per-ticket wire copies) when the
+    executor faults or wedges."""
+
+    def test_rescue_resolves_undone_tickets_from_wire(self):
+        co = hashplane.HashCoalescer(device=False)  # never started
+        t1, t2 = hashplane._Ticket(2), hashplane._Ticket(1)
+        fl = hashplane._Inflight(
+            [(lambda: (_ for _ in ()).throw(RuntimeError("dead")), [0], 1,
+              0.0, 3)],
+            [None, None, None],
+            [(t1, [b"a", b"b"]), (t2, [b"c"])],
+            3,
+            "deadline",
+        )
+        t2.resolve([b"already"])  # a concurrently-resolved ticket is skipped
+        co._rescue_inflight(fl)
+        assert t1.result(timeout=0.1) == [
+            hashlib.sha256(b"a").digest(),
+            hashlib.sha256(b"b").digest(),
+        ]
+        assert t2.result(timeout=0.1) == [b"already"]
+
+    def test_finish_materialization_fault_falls_back_to_hashlib(self):
+        co = hashplane.HashCoalescer(device=True)  # never started
+
+        def boom():
+            raise RuntimeError("mosaic fault at readback")
+
+        t = hashplane._Ticket(2)
+        fl = hashplane._Inflight(
+            [(boom, [0, 1], 1, 0.0, 2)],
+            [None, None],
+            [(t, [b"x", b"y"])],
+            2,
+            "size",
+        )
+        co._finish(fl)
+        assert t.result(timeout=0.1) == [
+            hashlib.sha256(b"x").digest(),
+            hashlib.sha256(b"y").digest(),
+        ]
+
+
+class TestBreakerHealthChannel:
+    def test_trip_and_rearm_feed_the_breaker_ring(self):
+        """A wedged hash plane must page like a wedged verify
+        coalescer: _trip/_rearm feed the same EV_BREAKER channel the
+        wedged-coalescer watchdog converts into a trip + bundle."""
+        from cometbft_tpu.libs import health as libhealth
+
+        libhealth.enable(ring=256)
+        libhealth.reset()
+        co = _plane(window_us=1_000, max_lanes=8)
+        try:
+            co._trip()
+            co._rearm()
+        finally:
+            co.stop()
+            rows = [
+                e for e in libhealth.recorder().dump()
+                if e["event"] == "coalesce.breaker"
+            ]
+            libhealth.disable()
+            libhealth.reset()
+        assert [r["open"] for r in rows] == [1, 0]
+
+
+class TestRoutedHelpers:
+    """hash_bytes / hash_many (and the merkle walk built on them):
+    identical digests routed or not, and NO queueing when no device
+    could take the window."""
+
+    def test_helpers_skip_queue_without_device(self):
+        co = _plane(window_us=1_000, max_lanes=64, device=False)
+        hashplane.push_active(co)
+        try:
+            big = b"q" * 4096
+            assert hashplane.hash_bytes(big) == hashlib.sha256(
+                big
+            ).digest()
+            msgs = [b"w" * 900] * 16
+            assert hashplane.hash_many(msgs) == [
+                hashlib.sha256(m).digest() for m in msgs
+            ]
+            assert merkle.hash_from_byte_slices(msgs) == _rec_root(msgs)
+            # device_capable() is False: not one ticket was queued —
+            # hashlib already is the optimal host path, a coalesced
+            # host window would only add latency
+            assert co.tickets == 0 and co.windows == 0
+        finally:
+            hashplane.pop_active(co)
+            co.stop()
+
+    def test_small_messages_skip_queue_even_with_device(self):
+        co = _plane(window_us=1_000, max_lanes=64, device=True)
+        hashplane.push_active(co)
+        try:
+            assert hashplane.hash_bytes(b"tiny") == hashlib.sha256(
+                b"tiny"
+            ).digest()
+            assert hashplane.hash_many([b"a", b"b"]) == [
+                hashlib.sha256(b"a").digest(),
+                hashlib.sha256(b"b").digest(),
+            ]
+            assert co.tickets == 0
+        finally:
+            hashplane.pop_active(co)
+            co.stop()
+
+    def test_routed_identity_device_path(self):
+        # warm the buckets OUTSIDE the plane so the routed windows
+        # cannot trip the breaker on first-use compile time
+        msgs = [(b"m%02d" % i) * 300 for i in range(16)]
+        osha.sha256_many_async(msgs)()
+        co = _plane(
+            window_us=1_000, max_lanes=64, device=True,
+            min_device_lanes=1,
+        )
+        hashplane.push_active(co)
+        try:
+            assert hashplane.hash_many(msgs) == [
+                hashlib.sha256(m).digest() for m in msgs
+            ]
+            assert co.device_windows >= 1
+        finally:
+            hashplane.pop_active(co)
+            co.stop()
+
+    def test_merkle_routes_through_plane_bit_identically(self):
+        items = [(b"part-%02d" % i) * 200 for i in range(9)]
+        host_root, host_proofs = merkle.proofs_from_byte_slices(items)
+        # warm the leaf/inner buckets the routed run will launch
+        osha.sha256_many_async([b"\x00" + x for x in items])()
+        osha.sha256_many_async([b"\x01" + bytes(64)] * 4, 2)()
+        co = _plane(
+            window_us=1_000, max_lanes=64, device=True,
+            min_device_lanes=1,
+        )
+        hashplane.push_active(co)
+        try:
+            routed_root, routed_proofs = merkle.proofs_from_byte_slices(
+                items
+            )
+            assert co.tickets >= 1  # the leaf level actually routed
+        finally:
+            hashplane.pop_active(co)
+            co.stop()
+        assert routed_root == host_root
+        assert len(routed_proofs) == len(host_proofs)
+        for a, b in zip(routed_proofs, host_proofs):
+            assert (a.total, a.index, a.leaf_hash, a.aunts) == (
+                b.total, b.index, b.leaf_hash, b.aunts
+            )
+
+    def test_tmhash_tx_key_identity(self):
+        # TxKey == tmhash.sum == hashlib, routed or not
+        from cometbft_tpu.mempool.clist_mempool import TxKey
+
+        tx = b"k=v" * 700
+        assert TxKey(tx) == tmhash.sum(tx) == hashlib.sha256(tx).digest()
+
+
+class TestNoRecompileHashKernels:
+    """Tier-1 no-recompile guard, extended to the hash plane: once a
+    (block-bucket, lane-bucket) pair is warm, ragged windows inside it
+    must record ZERO new XLA compiles (libs/devstats tracks the kernel
+    as sha256.xla — same ledger the verify guard reconciles)."""
+
+    def test_warm_ragged_windows_compile_nothing(self):
+        from cometbft_tpu.libs import devstats
+
+        devstats.enable()
+        m = NodeMetrics()
+        libmetrics.push_node_metrics(m)
+        try:
+            # warm: the (4-block, 8-lane) and (1-block, 8-lane) buckets
+            osha.sha256_many_async([b"a" * 150] * 8)()
+            osha.sha256_many_async([b"b" * 20] * 8)()
+            compiles0 = devstats.compile_count()
+            co = _plane(
+                window_us=20_000, max_lanes=8, device=True,
+                min_device_lanes=1,
+            )
+            try:
+                # ragged lane counts and lengths inside the warm buckets
+                for lanes, ln in ((3, 140), (5, 30), (7, 200), (2, 55)):
+                    msgs = [b"x" * ln] * lanes
+                    assert co.submit(msgs).result(timeout=60) == [
+                        hashlib.sha256(x).digest() for x in msgs
+                    ]
+            finally:
+                co.stop()
+            assert devstats.compile_count() == compiles0, (
+                "hash kernels recompiled inside warm shape buckets"
+            )
+        finally:
+            libmetrics.pop_node_metrics(m)
+
+
+class TestMempoolTxKeyOnce:
+    """The satellite pin: ONE TxKey per CheckTx, threaded through the
+    admission callback and every later cache/map touch."""
+
+    def _pool(self):
+        from cometbft_tpu.abci.client import LocalClient
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.config import MempoolConfig
+        from cometbft_tpu.mempool import CListMempool
+
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        client.start()
+        return CListMempool(MempoolConfig(), client), client
+
+    def test_one_key_hash_per_checktx_and_remove(self, monkeypatch):
+        from cometbft_tpu.mempool import clist_mempool as mod
+
+        mp, client = self._pool()
+        try:
+            calls = []
+            real = mod.TxKey
+            monkeypatch.setattr(
+                mod, "TxKey", lambda tx: calls.append(tx) or real(tx)
+            )
+            mp.check_tx(b"alpha=1")  # LocalClient responds inline
+            assert calls == [b"alpha=1"], (
+                "TxKey must run exactly once per CheckTx — the "
+                "admission callback re-derived the key"
+            )
+            assert mp.size() == 1
+            calls.clear()
+            key = real(b"alpha=1")
+            mp.remove_tx_by_key(key)
+            assert mp.size() == 0
+            assert calls == [], (
+                "removal re-hashed the tx instead of using the "
+                "threaded MempoolTx.key"
+            )
+        finally:
+            client.stop()
+
+    def test_update_path_uses_threaded_key(self, monkeypatch):
+        from cometbft_tpu.abci import types as abci
+        from cometbft_tpu.mempool import clist_mempool as mod
+
+        mp, client = self._pool()
+        try:
+            mp.check_tx(b"beta=2")
+            calls = []
+            real = mod.TxKey
+            monkeypatch.setattr(
+                mod, "TxKey", lambda tx: calls.append(tx) or real(tx)
+            )
+            mp.lock()
+            try:
+                mp.update(
+                    1,
+                    [b"beta=2"],
+                    [abci.ExecTxResult(code=abci.OK)],
+                )
+            finally:
+                mp.unlock()
+            # the committed tx was found and removed, so the ONE batch
+            # (hashplane.hash_many) derived the identical key — and no
+            # per-tx TxKey ran inside the commit critical section, nor
+            # did the removal underneath re-hash the admitted entry
+            assert mp.size() == 0
+            assert calls == []
+        finally:
+            client.stop()
+
+
+class TestNodeIntegration:
+    def test_knob_gated_boot_routes_and_unwinds(
+        self, tmp_path, monkeypatch
+    ):
+        """COMETBFT_TPU_HASH=1 boots a HashCoalescer on a live node,
+        routes it process-wide, and consensus commits real blocks with
+        every merkle/data hash flowing through the routed helpers
+        (device-less here, so they stay on the hashlib path — the
+        digests agreeing IS the identity check, or no block would
+        verify); stop() unroutes and drains it."""
+        import dataclasses
+        import time
+
+        import helpers
+        from cometbft_tpu.config import default_config
+        from cometbft_tpu.node import Node, init_files
+
+        _MS = 1_000_000
+        cfg = default_config()
+        cfg.base.home = str(tmp_path)
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.consensus = dataclasses.replace(
+            cfg.consensus,
+            timeout_propose_ns=400 * _MS,
+            timeout_prevote_ns=200 * _MS,
+            timeout_precommit_ns=200 * _MS,
+            timeout_commit_ns=150 * _MS,
+            skip_timeout_commit=False,
+            create_empty_blocks=True,
+        )
+        init_files(cfg)
+        genesis, pvs = helpers.make_genesis(1)
+        monkeypatch.setenv("COMETBFT_TPU_HASH", "1")
+        node = Node(cfg, genesis, pvs[0])
+        node.start()
+        try:
+            assert node.hash_plane is not None
+            assert node.hash_plane.is_running()
+            assert hashplane.active() is node.hash_plane
+            deadline = time.monotonic() + 20
+            while (
+                node.block_store.height() < 3
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert node.block_store.height() >= 3
+        finally:
+            node.stop()
+        assert not node.hash_plane.is_running()
+        assert hashplane.active() is not node.hash_plane
+
+
+class TestNodeGating:
+    def test_default_auto_is_off_on_cpu(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_HASH", raising=False)
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        assert not hashplane.node_wants_hashplane()
+
+    def test_knob_forces_and_disables(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_HASH", "1")
+        assert hashplane.node_wants_hashplane()
+        monkeypatch.setenv("COMETBFT_TPU_HASH", "0")
+        assert not hashplane.node_wants_hashplane()
+
+
+class TestKnobsRegisteredAndDocumented:
+    def test_hash_knobs_in_registry_and_docs(self):
+        import os
+
+        from cometbft_tpu.config import ENV_KNOBS
+
+        doc = open(
+            os.path.join(os.path.dirname(__file__), "..", "docs", "perf.md")
+        ).read()
+        for knob in (
+            "COMETBFT_TPU_HASH",
+            "COMETBFT_TPU_HASH_WINDOW_US",
+            "COMETBFT_TPU_HASH_MAX_LANES",
+            "COMETBFT_TPU_HASH_MIN_DEVICE_LANES",
+        ):
+            assert knob in ENV_KNOBS, knob
+            assert knob in doc, f"{knob} missing from docs/perf.md"

@@ -517,6 +517,36 @@ class TestEngineWideGate:
         ]
         assert blocked == [], blocked
 
+    def test_hashplane_lock_registered_and_flush_never_blocks_under_it(
+        self, analysis
+    ):
+        """The hash plane's queue mutex carries the verify coalescer's
+        contract: 'crypto.hashplane._mtx' may be acquired UNDER caller
+        locks (TxKey routing near mempool.update, merkle hashing under
+        consensus.state), but it must never be the OUTER lock of any
+        acquisition-order edge — the executor pops a window under it
+        and releases it before pack, dispatch and the materializing
+        readback — and no CLNT009 blocking-under-lock finding may name
+        it (its own condition wait is the sanctioned exempt case)."""
+        d = analysis.graph_dict()
+        assert "crypto.hashplane._mtx" in {lk["name"] for lk in d["locks"]}
+        outgoing = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if e["from"] == "crypto.hashplane._mtx"
+        ]
+        assert outgoing == [], (
+            "the hash-plane flush path acquired a lock while holding "
+            f"its queue mutex: {outgoing}"
+        )
+        blocked = [
+            f.render()
+            for f in analysis.findings()
+            if f.code == "CLNT009"
+            and "'crypto.hashplane._mtx'" in f.message
+        ]
+        assert blocked == [], blocked
+
     def test_health_lock_registered_and_leaf(self, analysis):
         """libs/health's bundle-rate-limit mutex carries the same
         contract as the tracer's and devstats': present in the shipped
