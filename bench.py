@@ -547,7 +547,13 @@ def _host_floor_rows():
                 "host_sigs_per_sec": round(n / t_host, 1),
             }
         )
-    return {"rows": rows, "measured_crossover_lanes": None}
+    return {
+        "rows": rows,
+        "measured_crossover_lanes": None,
+        # no device reachable: there IS no crossover — the headline
+        # carries the explicit null so host-only rounds stay legible
+        "crossover_lanes": None,
+    }
 
 
 def bench_device_floor():
@@ -627,6 +633,15 @@ def _bench_device_floor_measured(libdevstats):
             return t_disp / reps, t_read / reps
 
         d_unc, r_unc = timed(lambda: ov.verify_bytes_async(buf, n))
+        # per-window transfer bytes, straight from the devstats ledger
+        # around ONE warmed uncached launch: what actually crossed the
+        # edge at this bucket (reconciles with the narrowed idx/mask
+        # dtypes — the no-recompile guard pins the exact arithmetic)
+        c_a = libdevstats.counters()
+        ov.verify_bytes_async(buf, n)()
+        c_b = libdevstats.counters()
+        h2d_bytes = c_b["h2d_bytes"] - c_a["h2d_bytes"]
+        d2h_bytes = c_b["d2h_bytes"] - c_a["d2h_bytes"]
         hit = ov._PUBKEY_CACHE.lookup(pubkeys)
         if hit is not None:
             idxs, arena, arena_ok = hit
@@ -644,6 +659,8 @@ def _bench_device_floor_measured(libdevstats):
         # here; on directly-attached hardware it is PCIe).
         t_compute = None
         t_transfer_sync = None  # measured, same-kernel (see below)
+        t_h2d = None  # pure host->device commit of the wire buffer
+        t_d2h = None  # transfer_sync minus the measured h2d share
         transfer_probe_compile_s = None
         probe_lanes = None  # lanes the timed kernel actually covered
         probe_kernel = None
@@ -661,7 +678,15 @@ def _bench_device_floor_measured(libdevstats):
             # otherwise) so compute_ms/utilization describe the real
             # path — falling back through the remaining candidates to
             # XLA so one broken pallas flavor can't erase the whole
-            # decomposition this probe exists to capture.
+            # decomposition this probe exists to capture. The live
+            # path's jit IDENTITY matters too: with the lane arena
+            # active, launches use the non-donating variants, and
+            # small buckets their dedicated small-grid jits — probe
+            # the exact (flavor, donation, grid) triple live windows
+            # launch, or the n<=256 rows (the crossover's home) would
+            # time a kernel the production path never runs.
+            probe_donate = not ov._lane_arena_enabled()
+            probe_grid = ov._small_grid(min(size, ov._CHUNK))
             cands = (
                 ov._pallas_candidates()
                 if ov._pallas_wanted() and size >= ov._PALLAS_MIN_LANES
@@ -670,7 +695,9 @@ def _bench_device_floor_measured(libdevstats):
             fn = None
             for probe_try in [*cands, ov._xla_which()]:
                 try:
-                    fn = ov._jitted_kernel(probe_try)
+                    fn = ov._jitted_kernel(
+                        probe_try, probe_donate, probe_grid
+                    )
                     # fresh device buffer per attempt: the kernels jit
                     # with input donation on TPU, so a faulting
                     # candidate consumes its warm buffer — reusing one
@@ -719,6 +746,17 @@ def _bench_device_floor_measured(libdevstats):
                 np.asarray(fn(host_in))
                 t_x.append(time.perf_counter() - t0)
             t_transfer_sync = max(0.0, min(t_x) - t_compute)
+            # decompose transfer_sync into its h2d and d2h shares: the
+            # h2d leg is measured directly (device_put + block of the
+            # same wire buffer); the d2h leg is the remainder — the
+            # packed-ok-bits readback plus sync overhead
+            t_hs = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.device_put(host_in).block_until_ready()
+                t_hs.append(time.perf_counter() - t0)
+            t_h2d = min(t_hs)
+            t_d2h = max(0.0, t_transfer_sync - t_h2d)
         except Exception:
             pass
 
@@ -796,6 +834,22 @@ def _bench_device_floor_measured(libdevstats):
                 "compute_ms": (
                     round(t_compute * 1e3, 2) if t_compute else None
                 ),
+                # per-window fixed-cost decomposition (pack / h2d /
+                # execute / d2h): pack_ms above is the host staging
+                # leg, compute_ms the execute leg (device-resident
+                # probe), h2d_ms the measured wire-buffer commit,
+                # d2h_ms the transfer_sync remainder (packed-ok-bits
+                # readback + sync). Bytes columns come from the
+                # devstats ledger around one warmed launch, so dtype
+                # narrowing lands here directly.
+                "h2d_ms": (
+                    round(t_h2d * 1e3, 2) if t_h2d is not None else None
+                ),
+                "d2h_ms": (
+                    round(t_d2h * 1e3, 2) if t_d2h is not None else None
+                ),
+                "h2d_bytes": h2d_bytes,
+                "d2h_bytes": d2h_bytes,
                 # same-kernel warmed e2e minus compute (NOT the old
                 # cross-kernel subtraction); compile the probe itself
                 # paid is its own column, never folded in
@@ -830,12 +884,37 @@ def _bench_device_floor_measured(libdevstats):
             crossover = row["n"]
         else:
             break
+    cbatch = __import__("cometbft_tpu.crypto.batch", fromlist=["x"])
+    # the fixed per-window cost at the SMALLEST measured size — the
+    # quantity the lane arenas / readback overlap / dtype shrink /
+    # small-grid split exist to drive down; legible across BENCH
+    # revisions as one number per leg
+    small = rows[0] if rows else {}
+    fixed = {
+        "pack_ms": small.get("pack_ms"),
+        "h2d_ms": small.get("h2d_ms"),
+        "execute_ms": small.get("compute_ms"),
+        "d2h_ms": small.get("d2h_ms"),
+        "n": small.get("n"),
+    }
+    known = [v for v in (
+        fixed["pack_ms"], fixed["h2d_ms"], fixed["execute_ms"],
+        fixed["d2h_ms"],
+    ) if v is not None]
+    fixed["total_ms"] = round(sum(known), 2) if known else None
     return {
+        # measured_crossover_lanes is the load-bearing legacy key (the
+        # chip table / crypto/batch._derive_host_threshold read it);
+        # crossover_lanes is the same number under the headline's
+        # name — the boundary below which the host wins, and the
+        # device-floor work is measured by it going DOWN
         "rows": rows,
         "measured_crossover_lanes": crossover,
-        "current_HOST_BATCH_THRESHOLD": __import__(
-            "cometbft_tpu.crypto.batch", fromlist=["x"]
-        ).HOST_BATCH_THRESHOLD,
+        "crossover_lanes": crossover,
+        "window_fixed_cost_ms": fixed,
+        # the LIVE adaptive floor fit, when the run calibrated one
+        "adaptive_fit": cbatch.CROSSOVER.fit_summary(),
+        "current_HOST_BATCH_THRESHOLD": cbatch.HOST_BATCH_THRESHOLD,
     }
 
 
@@ -1237,10 +1316,12 @@ def bench_coalesce_steady_state(
             ov.prestage_pubkeys(pub_raw)
         # warm: compile the window buckets outside the timed storm
         storm(lambda pk, m, s: cco.verify_signature(pk, m, s))
+        w0, dw0 = co.windows, co.device_windows
         lanes, dt = storm(lambda pk, m, s: cco.verify_signature(pk, m, s))
         coalesced_lps = lanes / dt
-        backend = "device" if co.device_windows else "host-window"
-        windows = co.windows
+        windows = co.windows - w0
+        device_windows = co.device_windows - dw0
+        backend = "device" if device_windows else "host-window"
     finally:
         cco.pop_active(co)
         co.stop()
@@ -1253,6 +1334,15 @@ def bench_coalesce_steady_state(
         "coalesced_vs_serial": round(coalesced_lps / serial_lps, 2),
         "coalesce_backend": backend,
         "windows": windows,
+        "device_windows": device_windows,
+        # the fraction of the TIMED storm's windows that actually took
+        # the device path: a device-present container whose crossover
+        # sits above the live window size quietly measures 100% host
+        # windows — this column makes that visible instead of letting
+        # the headline claim a device speedup it never exercised
+        "device_window_pct": round(
+            100.0 * device_windows / windows, 1
+        ) if windows else 0.0,
         "note": "same verdicts, same call sites; coalesced run routes "
         "pub_key.verify_signature through crypto/coalesce windows",
     }
@@ -2324,13 +2414,15 @@ def main() -> None:
             except Exception as e:
                 _eprint({"config": name, "backend": "host",
                          "error": repr(e)[:200]})
+        floor_row = None
         try:
+            floor_row = _host_floor_rows()
             _eprint(
                 {
                     "config": "9_device_floor",
                     "backend": "host",
                     "note": "no device: host RLC latency per size only",
-                    **_host_floor_rows(),
+                    **floor_row,
                 }
             )
         except Exception as e:
@@ -2464,11 +2556,25 @@ def main() -> None:
                     "unit": "sigs/sec (host fallback: tpu unreachable)",
                     "vs_baseline": round((4096 / dt) / batch_baseline, 2),
                     "provenance": _headline_provenance(prov),
+                    # measured host/device crossover (9_device_floor);
+                    # explicit null on host-only rounds
+                    **(
+                        {
+                            "crossover_lanes": floor_row.get(
+                                "crossover_lanes"
+                            )
+                        }
+                        if floor_row
+                        else {}
+                    ),
                     **(
                         {
                             "coalesce_vs_serial": coalesce_row[
                                 "coalesced_vs_serial"
-                            ]
+                            ],
+                            "device_window_pct": coalesce_row[
+                                "device_window_pct"
+                            ],
                         }
                         if coalesce_row
                         else {}
@@ -2597,6 +2703,7 @@ def main() -> None:
         }
     )
 
+    floor_row = None
     for name, fn in (
         ("6_wal_decode", bench_wal_decode),
         ("7_mempool", bench_mempool),
@@ -2606,7 +2713,11 @@ def main() -> None:
         ("11_trace_phases", bench_trace_phases),
     ):
         try:
-            _eprint({"config": name, **fn()})
+            row = fn()
+            if name == "9_device_floor":
+                # captured for the headline's crossover_lanes field
+                floor_row = row
+            _eprint({"config": name, **row})
         except Exception as e:  # micro extras must never sink the bench
             _eprint({"config": name, "error": repr(e)[:200]})
 
@@ -2714,13 +2825,26 @@ def main() -> None:
                 "unit": "sigs/sec",
                 "vs_baseline": round(tput / batch_baseline, 2),
                 "provenance": _headline_provenance(prov),
+                # the measured host/device crossover (config
+                # 9_device_floor) — the device-floor work is measured
+                # by this number dropping round-over-round
+                **(
+                    {"crossover_lanes": floor_row.get("crossover_lanes")}
+                    if floor_row
+                    else {}
+                ),
                 # steady-state vote-path headline: coalesced vs serial
-                # single-verify (config 12_coalesce_steady_state)
+                # single-verify (config 12_coalesce_steady_state), plus
+                # the fraction of storm windows that actually took the
+                # device path
                 **(
                     {
                         "coalesce_vs_serial": coalesce_row[
                             "coalesced_vs_serial"
-                        ]
+                        ],
+                        "device_window_pct": coalesce_row[
+                            "device_window_pct"
+                        ],
                     }
                     if coalesce_row
                     else {}

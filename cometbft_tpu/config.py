@@ -122,6 +122,23 @@ ENV_KNOBS: dict[str, str] = {
         "(crypto/batch.host_batch_threshold) — sub-cutover windows "
         "still coalesce into one host MSM (crypto/coalesce.py)"
     ),
+    "COMETBFT_TPU_COALESCE_INFLIGHT": (
+        "device verify windows dispatched but not yet materialized "
+        "across the executor + readback drain thread (default 2 — the "
+        "double buffer: window N's d2h overlaps window N+1's execute; "
+        "crypto/coalesce.py)"
+    ),
+    "COMETBFT_TPU_HASH_INFLIGHT": (
+        "hash-plane analog of COMETBFT_TPU_COALESCE_INFLIGHT: device "
+        "hash windows in flight across the executor + readback drain "
+        "thread (default 2; crypto/hashplane.py)"
+    ),
+    "COMETBFT_TPU_LANE_ARENA": (
+        "persistent donated device staging buffers for per-launch wire "
+        "rows (ops/verify.LaneArena): auto (default, accelerator "
+        "backends only) | 1 force (tests exercise staging on XLA-CPU) "
+        "| 0 off — fresh h2d allocations per launch"
+    ),
     "COMETBFT_TPU_HASH": (
         "cross-caller SHA-256 hash plane: auto (default, node starts "
         "it on accelerator backends) | 1 force | 0 off "

@@ -172,6 +172,48 @@ class AdaptiveCrossover:
             slope, floor = 0.0, my
         return floor, slope
 
+    def reset(self) -> None:
+        """Drop every accumulated sample (a refit from scratch).
+
+        The decayed moments forget slowly (~50-sample half-life); when
+        the device cost profile steps — lane arenas flip on, the
+        readback drain lands, a kernel swap — stale samples would keep
+        answering for the OLD floor for hundreds of windows. Callers
+        that change the profile (bench captures, an operator toggling
+        staging knobs) reset so the live fit re-converges on the new
+        floor immediately."""
+        with self._mtx:
+            self._host = [0.0, 0.0, 0.0, 0.0, 0.0]
+            self._dev = [0.0, 0.0, 0.0, 0.0, 0.0]
+            self._host_n = 0
+            self._dev_n = 0
+
+    def fit_summary(self) -> dict:
+        """The live floor fit, for bench/debug surfaces: per-side
+        (floor_s, slope_s_per_lane, samples) plus the solved crossover.
+        Floors are None while that side is uncalibrated."""
+        with self._mtx:
+            host_n, dev_n = self._host_n, self._dev_n
+            h = (
+                self._fit(self._host)
+                if host_n >= self.MIN_SAMPLES and self._host[0] > 0
+                else None
+            )
+            d = (
+                self._fit(self._dev)
+                if dev_n >= self.MIN_SAMPLES and self._dev[0] > 0
+                else None
+            )
+        return {
+            "host_floor_s": h[0] if h else None,
+            "host_rate_s_per_lane": h[1] if h else None,
+            "host_samples": host_n,
+            "device_floor_s": d[0] if d else None,
+            "device_slope_s_per_lane": d[1] if d else None,
+            "device_samples": dev_n,
+            "crossover_lanes": self.threshold(),
+        }
+
     def threshold(self) -> int | None:
         """The calibrated crossover, or None while uncalibrated."""
         with self._mtx:

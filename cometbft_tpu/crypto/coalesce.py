@@ -23,14 +23,19 @@ feeder for the verify kernel:
   the no-recompile guard stays green), flushed by a size threshold
   (COMETBFT_TPU_COALESCE_MAX_LANES) or a small deadline window
   (COMETBFT_TPU_COALESCE_WINDOW_US);
-* windows are double-buffered through the existing
-  ``verify_bytes_async`` / ``verify_rsk_async`` split: the host-side
-  pack + arena lookup of window N+1 overlaps the device execute of
-  window N, and window N materializes only after N+1 is in flight —
-  under sustained load the device never idles between launches;
+* windows pipeline through the ``verify_bytes_async`` /
+  ``verify_rsk_async`` split plus a dedicated readback drain thread:
+  the host-side pack + arena lookup of window N+1 overlaps the device
+  execute of window N, and window N's d2h readback materializes on the
+  drain thread while N+1 executes — under sustained load the device
+  never idles between launches and the per-window cost approaches
+  max(execute, readback) instead of their sum. The drain is strictly
+  FIFO (tickets resolve in submission order) and the executor blocks
+  at the COMETBFT_TPU_COALESCE_INFLIGHT depth bound (default 2, the
+  classic double buffer);
 * steady-state lanes are index-only: the consensus FSM prestages the
   validator set (crypto/batch.prestage_validators), so a window whose
-  signers are arena-resident ships 96 B of R|S|kneg plus a 4-byte slot
+  signers are arena-resident ships 96 B of R|S|kneg plus a 2-byte slot
   per lane through ``verify_rsk_async``;
 * host fallback is clean: device absent -> windows run the native host
   RLC batch (still one MSM for the whole window — coalescing wins on
@@ -43,11 +48,13 @@ host verifiers as every other batch path, so admission decisions are
 bit-identical to ``pub_key.verify_signature``; an exception raised
 while staging one submit's lanes fails only that submit's ticket.
 
-Locking: the ONE lock is ``crypto.coalesce._mtx`` guarding the pending
-queue. The flush path pops a window under it and releases it before
-pack, dispatch, the materializing readback, and ticket resolution — it
-never blocks on the device (or anything else) while holding it, and it
-never acquires an engine mutex (asserted by tests/test_lint_graph.py).
+Locking: ``crypto.coalesce._mtx`` guards the pending queue — the flush
+path pops a window under it and releases it before pack, dispatch, the
+materializing readback, and ticket resolution; ``crypto.coalesce.
+_rb_mtx`` guards only the executor->drain handoff (the drain pops
+under it and releases it before the readback). Neither blocks on the
+device while held and neither acquires an engine mutex (both asserted
+edge-free by tests/test_lint_graph.py).
 """
 
 from __future__ import annotations
@@ -94,6 +101,12 @@ _DEFAULT_MAX_LANES = 1024
 # below; a tunnel transient that outlives this bound therefore costs
 # one short cooldown of host routing, never a frozen node.
 _RESULT_TIMEOUT_S = 5.0
+# Device windows dispatched but not yet materialized, across the
+# executor and the readback drain thread. 2 = the classic double
+# buffer (window N materializing on the drain thread while the
+# executor packs + dispatches N+1); raising it deepens the pipeline at
+# the cost of more staged wire memory in flight.
+_DEFAULT_MAX_INFLIGHT = 2
 # How long a tripped coalescer stays unrouted before routing re-arms.
 # While tripped, every caller falls back to host instantly and the
 # groups already queued behind the (possibly wedged) executor are
@@ -257,6 +270,7 @@ class VerifyCoalescer(BaseService):
         max_lanes: int | None = None,
         min_device_lanes: int | None = None,
         device: bool | None = None,
+        max_inflight: int | None = None,
         logger=None,
     ):
         super().__init__("VerifyCoalescer", logger)
@@ -300,11 +314,37 @@ class VerifyCoalescer(BaseService):
         # coalescer unrouted (0.0 = armed); see _TRIP_COOLDOWN_S
         self._tripped_until = 0.0
         self._thread: threading.Thread | None = None
-        # dispatched-but-unmaterialized windows, mirrored here (single
-        # writer: the executor) so the rescue paths can reach their
-        # tickets — a popped window is in neither _pending nor any
-        # caller's hands. Up to TWO live at once: window N mid-finish
-        # and the just-dispatched window N+1 (the double buffer).
+        # -- readback drain: dispatched windows hand off to a dedicated
+        # drain thread that materializes them IN SUBMISSION ORDER, so
+        # the executor starts packing + dispatching window N+1 while
+        # window N's d2h readback is still in flight. The depth bound
+        # (max_inflight) counts queued + mid-finish windows; the
+        # executor blocks at the bound so device memory in flight stays
+        # bounded. _rb_mtx guards ONLY this handoff bookkeeping — the
+        # drain pops under it and releases it before the materializing
+        # readback and ticket resolution (same leaf contract as _mtx).
+        self.max_inflight = max(
+            1,
+            max_inflight
+            if max_inflight is not None
+            else _env_int(
+                "COMETBFT_TPU_COALESCE_INFLIGHT", _DEFAULT_MAX_INFLIGHT
+            ),
+        )
+        self._rb_mtx = libsync.Mutex("crypto.coalesce._rb_mtx")
+        self._rb_cv = libsync.Condition(
+            self._rb_mtx, name="crypto.coalesce._rb_mtx"
+        )
+        self._readback: deque[_Inflight] = deque()
+        self._rb_busy = 0  # windows the drain popped but hasn't finished
+        self._rb_closed = False
+        self._rb_alive = False
+        self._rb_thread: threading.Thread | None = None
+        # dispatched-but-unmaterialized windows, mirrored here (the
+        # executor appends, the drain thread drops) so the rescue
+        # paths can reach their tickets — a popped window is in
+        # neither _pending nor any caller's hands. At most
+        # max_inflight live at once (the drain depth bound).
         self._inflights: list[_Inflight] = []
         # the window currently inside _launch (popped from _pending,
         # not yet host-resolved or published to _inflights): same
@@ -324,6 +364,14 @@ class VerifyCoalescer(BaseService):
     def on_start(self) -> None:
         with self._mtx:
             self._draining = False
+        with self._rb_mtx:
+            self._rb_closed = False
+            self._rb_alive = True
+        rt = threading.Thread(
+            target=self._drain_run, name="verify-readback", daemon=True
+        )
+        rt.start()
+        self._rb_thread = rt
         t = threading.Thread(
             target=self._run, name="verify-coalescer", daemon=True
         )
@@ -341,9 +389,16 @@ class VerifyCoalescer(BaseService):
             self._draining = True
             self._accepting = False
             self._cv.notify_all()
+        with self._rb_mtx:
+            # wake an executor blocked at the in-flight depth bound
+            self._rb_cv.notify_all()
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=self._JOIN_TIMEOUT_S)
+        rt = self._rb_thread
+        if rt is not None and rt is not threading.current_thread():
+            self._close_readback()
+            rt.join(timeout=self._JOIN_TIMEOUT_S)
         # Safety net: if the executor died (or the join timed out with
         # it wedged), resolve leftovers on host so no caller hangs —
         # including a window the executor popped and dispatched but
@@ -551,29 +606,22 @@ class VerifyCoalescer(BaseService):
     # -- the executor ------------------------------------------------------
 
     def _run(self) -> None:
-        inflight: _Inflight | None = None
         try:
             while True:
                 try:
-                    groups, lanes, reason = self._collect(
-                        block=inflight is None
-                    )
-                    handle = None
+                    groups, lanes, reason = self._collect(block=True)
                     if groups:
                         self._staging = groups
                         handle = self._launch(groups, lanes, reason)
                         if handle is not None:
-                            # published BEFORE finishing window N: if
+                            # published BEFORE the drain handoff: if
                             # the finish faults or wedges, this
                             # window's tickets must be reachable by
                             # the rescues
                             self._inflights.append(handle)
+                            self._hand_to_drain(handle)
                         self._staging = None
-                    if inflight is not None:
-                        self._finish(inflight)
-                        self._drop_inflight(inflight)
-                    inflight = handle
-                    if inflight is None and reason == "quit":
+                    if reason == "quit":
                         return
                 except Exception:
                     # The loop must survive anything: pending tickets
@@ -582,7 +630,7 @@ class VerifyCoalescer(BaseService):
                     # iteration (or the on_stop safety net). A staged
                     # or in-flight window's tickets live NOWHERE else —
                     # rescue the staging slot and every tracked window
-                    # (both double-buffer slots) before dropping the
+                    # (every drain-queue slot) before dropping the
                     # handles, or their submitters stall the full
                     # result timeout.
                     try:
@@ -597,15 +645,20 @@ class VerifyCoalescer(BaseService):
                     for fl in tuple(self._inflights):
                         self._rescue_inflight(fl)
                         self._drop_inflight(fl)
-                    inflight = None
         finally:
             # The executor is gone for good — normal drain exit or a
-            # death nothing above could catch. Whatever the cause, no
-            # ticket may be left for callers to time out on: stop
+            # death nothing above could catch. Let the readback drain
+            # finish the windows already handed to it (submission-order
+            # resolution with real device verdicts), then make sure no
+            # ticket is left for callers to time out on: stop
             # accepting, then drain every slot a ticket can live in
-            # (pending queue, staging window, both in-flight slots).
+            # (pending queue, staging window, drain-queue windows).
             # Everything here is done()-gated/idempotent, so overlap
             # with on_stop's safety net is benign.
+            self._close_readback()
+            rt = self._rb_thread
+            if rt is not None and rt is not threading.current_thread():
+                rt.join(timeout=self._JOIN_TIMEOUT_S)
             with self._mtx:
                 self._accepting = False
                 leftovers, self._pending = self._pending, deque()
@@ -616,6 +669,84 @@ class VerifyCoalescer(BaseService):
             for group in leftovers:
                 self._resolve_group_host(group)
             for fl in tuple(self._inflights):
+                self._rescue_inflight(fl)
+                self._drop_inflight(fl)
+
+    # -- the readback drain ------------------------------------------------
+
+    def _hand_to_drain(self, fl: _Inflight) -> None:
+        """Queue a dispatched window for the readback drain, blocking at
+        the in-flight depth bound so execute of window N+1 overlaps the
+        d2h of window N without letting the pipeline run unboundedly
+        ahead. Falls back to finishing inline if the drain thread is
+        gone (it must never strand a dispatched window)."""
+        handed = False
+        with self._rb_mtx:
+            if self._rb_alive and not self._rb_closed:
+                self._readback.append(fl)
+                handed = True
+                self._rb_cv.notify_all()
+                while (
+                    self._rb_alive
+                    and not self._rb_closed
+                    and not self._draining
+                    and len(self._readback) + self._rb_busy
+                    >= self.max_inflight
+                ):
+                    self._rb_cv.wait(0.2)
+        if not handed:
+            self._finish(fl)
+            self._drop_inflight(fl)
+
+    def _close_readback(self) -> None:
+        with self._rb_mtx:
+            self._rb_closed = True
+            self._rb_cv.notify_all()
+
+    def _drain_run(self) -> None:
+        """Materialize dispatched windows in submission order.
+
+        FIFO over the handoff queue: window N's tickets resolve before
+        window N+1's even when N+1's device result lands first — routed
+        callers observe the same ordering the synchronous executor
+        gave them. A finish fault falls back to the host rescue for
+        that window only; the loop survives anything.
+        """
+        try:
+            while True:
+                with self._rb_mtx:
+                    while not self._readback and not self._rb_closed:
+                        self._rb_cv.wait(0.2)
+                    if not self._readback:
+                        return  # closed and empty
+                    fl = self._readback.popleft()
+                    self._rb_busy += 1
+                try:
+                    self._finish(fl)
+                except Exception:
+                    try:
+                        import traceback
+
+                        traceback.print_exc()
+                    except Exception:
+                        pass
+                    self._rescue_inflight(fl)
+                finally:
+                    self._drop_inflight(fl)
+                    with self._rb_mtx:
+                        self._rb_busy -= 1
+                        self._rb_cv.notify_all()
+        finally:
+            # drain death (normal close or a fault nothing above
+            # caught): no handed-off window may be left unresolved,
+            # and a depth-blocked executor must wake and notice
+            # _rb_alive is down (it then finishes windows inline)
+            with self._rb_mtx:
+                self._rb_alive = False
+                leftovers = list(self._readback)
+                self._readback.clear()
+                self._rb_cv.notify_all()
+            for fl in leftovers:
                 self._rescue_inflight(fl)
                 self._drop_inflight(fl)
 

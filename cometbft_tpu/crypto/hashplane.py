@@ -40,12 +40,15 @@ SHA-256 — the node's OTHER ubiquitous crypto primitive
   kernel is fuzz-pinned across every padding boundary), so routing can
   never change a hash — only where it is computed.
 
-Locking: the ONE lock is ``crypto.hashplane._mtx`` guarding the
-pending queue. The flush path pops a window under it and releases it
-before pack, dispatch, the materializing readback, and ticket
-resolution — it never blocks on the device while holding it and never
-acquires an engine mutex (asserted by tests/test_lint_graph.py, same
-contract as crypto.coalesce._mtx).
+Locking: ``crypto.hashplane._mtx`` guards the pending queue — the
+flush path pops a window under it and releases it before pack,
+dispatch, the materializing readback, and ticket resolution;
+``crypto.hashplane._rb_mtx`` guards only the executor->drain handoff
+(dispatched windows materialize on a dedicated readback drain thread,
+FIFO, so execute of window N+1 overlaps the d2h of window N). Neither
+blocks on the device while held and neither acquires an engine mutex
+(asserted by tests/test_lint_graph.py, same contract as the verify
+coalescer's locks).
 """
 
 from __future__ import annotations
@@ -61,7 +64,12 @@ from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from ..libs import trace as libtrace
 from ..libs.service import BaseService, ServiceError
-from .coalesce import _env_int, _env_opt_int, deadline_remaining
+from .coalesce import (
+    _DEFAULT_MAX_INFLIGHT,
+    _env_int,
+    _env_opt_int,
+    deadline_remaining,
+)
 
 # Deadline window before a sub-size window flushes anyway; same scale
 # and rationale as the verify coalescer's window.
@@ -237,6 +245,7 @@ class HashCoalescer(BaseService):
         max_lanes: int | None = None,
         min_device_lanes: int | None = None,
         device: bool | None = None,
+        max_inflight: int | None = None,
         logger=None,
     ):
         super().__init__("HashCoalescer", logger)
@@ -284,6 +293,27 @@ class HashCoalescer(BaseService):
         # popped window's tickets (see crypto/coalesce.py)
         self._inflights: list[_Inflight] = []
         self._staging: list[tuple] | None = None
+        # readback drain handoff, mirroring the verify coalescer's:
+        # dispatched windows materialize on a dedicated drain thread in
+        # submission order while the executor packs + dispatches the
+        # next window; the depth bound keeps the pipeline bounded.
+        self.max_inflight = max(
+            1,
+            max_inflight
+            if max_inflight is not None
+            else _env_int(
+                "COMETBFT_TPU_HASH_INFLIGHT", _DEFAULT_MAX_INFLIGHT
+            ),
+        )
+        self._rb_mtx = libsync.Mutex("crypto.hashplane._rb_mtx")
+        self._rb_cv = libsync.Condition(
+            self._rb_mtx, name="crypto.hashplane._rb_mtx"
+        )
+        self._readback: deque[_Inflight] = deque()
+        self._rb_busy = 0
+        self._rb_closed = False
+        self._rb_alive = False
+        self._rb_thread: threading.Thread | None = None
         self.windows = 0
         self.device_windows = 0
         self.tickets = 0
@@ -293,6 +323,14 @@ class HashCoalescer(BaseService):
     def on_start(self) -> None:
         with self._mtx:
             self._draining = False
+        with self._rb_mtx:
+            self._rb_closed = False
+            self._rb_alive = True
+        rt = threading.Thread(
+            target=self._drain_run, name="hash-readback", daemon=True
+        )
+        rt.start()
+        self._rb_thread = rt
         t = threading.Thread(target=self._run, name="hash-plane", daemon=True)
         t.start()
         self._thread = t
@@ -305,9 +343,16 @@ class HashCoalescer(BaseService):
             self._draining = True
             self._accepting = False
             self._cv.notify_all()
+        with self._rb_mtx:
+            # wake an executor blocked at the in-flight depth bound
+            self._rb_cv.notify_all()
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=self._JOIN_TIMEOUT_S)
+        rt = self._rb_thread
+        if rt is not None and rt is not threading.current_thread():
+            self._close_readback()
+            rt.join(timeout=self._JOIN_TIMEOUT_S)
         # Safety net mirroring the verify coalescer's: host-resolve
         # anything a dead or wedged executor left behind; done() gates
         # make overlap with a still-alive executor benign.
@@ -516,29 +561,22 @@ class HashCoalescer(BaseService):
     # -- the executor ------------------------------------------------------
 
     def _run(self) -> None:
-        inflight: _Inflight | None = None
         try:
             while True:
                 try:
-                    groups, lanes, reason = self._collect(
-                        block=inflight is None
-                    )
-                    handle = None
+                    groups, lanes, reason = self._collect(block=True)
                     if groups:
                         self._staging = groups
                         handle = self._launch(groups, lanes, reason)
                         if handle is not None:
                             self._inflights.append(handle)
+                            self._hand_to_drain(handle)
                         self._staging = None
-                    if inflight is not None:
-                        self._finish(inflight)
-                        self._drop_inflight(inflight)
-                    inflight = handle
-                    if inflight is None and reason == "quit":
+                    if reason == "quit":
                         return
                 except Exception:
                     # survive anything; rescue every slot a ticket can
-                    # live in (staging + both double-buffer slots)
+                    # live in (staging + every drain-queue slot)
                     try:
                         import traceback
 
@@ -551,8 +589,11 @@ class HashCoalescer(BaseService):
                     for fl in tuple(self._inflights):
                         self._rescue_inflight(fl)
                         self._drop_inflight(fl)
-                    inflight = None
         finally:
+            self._close_readback()
+            rt = self._rb_thread
+            if rt is not None and rt is not threading.current_thread():
+                rt.join(timeout=self._JOIN_TIMEOUT_S)
             with self._mtx:
                 self._accepting = False
                 leftovers, self._pending = self._pending, deque()
@@ -564,6 +605,69 @@ class HashCoalescer(BaseService):
             for group in leftovers:
                 self._resolve_group_host(group)
             for fl in tuple(self._inflights):
+                self._rescue_inflight(fl)
+                self._drop_inflight(fl)
+
+    # -- the readback drain (see crypto/coalesce.py — same design) ---------
+
+    def _hand_to_drain(self, fl: _Inflight) -> None:
+        handed = False
+        with self._rb_mtx:
+            if self._rb_alive and not self._rb_closed:
+                self._readback.append(fl)
+                handed = True
+                self._rb_cv.notify_all()
+                while (
+                    self._rb_alive
+                    and not self._rb_closed
+                    and not self._draining
+                    and len(self._readback) + self._rb_busy
+                    >= self.max_inflight
+                ):
+                    self._rb_cv.wait(0.2)
+        if not handed:
+            self._finish(fl)
+            self._drop_inflight(fl)
+
+    def _close_readback(self) -> None:
+        with self._rb_mtx:
+            self._rb_closed = True
+            self._rb_cv.notify_all()
+
+    def _drain_run(self) -> None:
+        """Materialize dispatched windows in submission order; a finish
+        fault falls back to the hashlib rescue for that window only."""
+        try:
+            while True:
+                with self._rb_mtx:
+                    while not self._readback and not self._rb_closed:
+                        self._rb_cv.wait(0.2)
+                    if not self._readback:
+                        return
+                    fl = self._readback.popleft()
+                    self._rb_busy += 1
+                try:
+                    self._finish(fl)
+                except Exception:
+                    try:
+                        import traceback
+
+                        traceback.print_exc()
+                    except Exception:
+                        pass
+                    self._rescue_inflight(fl)
+                finally:
+                    self._drop_inflight(fl)
+                    with self._rb_mtx:
+                        self._rb_busy -= 1
+                        self._rb_cv.notify_all()
+        finally:
+            with self._rb_mtx:
+                self._rb_alive = False
+                leftovers = list(self._readback)
+                self._readback.clear()
+                self._rb_cv.notify_all()
+            for fl in leftovers:
                 self._rescue_inflight(fl)
                 self._drop_inflight(fl)
 

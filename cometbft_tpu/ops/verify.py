@@ -28,7 +28,7 @@ from ..libs import devstats as libdevstats
 from ..libs.accel import ACCELERATOR_BACKENDS
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from functools import lru_cache
 
 import numpy as np
@@ -369,7 +369,7 @@ def _kernel_from_bytes8(buf):
 # LRU of expanded pubkeys because validators recur every round
 # (crypto/ed25519/ed25519.go:31,56); the TPU analog caches each key's
 # DECOMPRESSED point + 16-entry Niels table in a device arena, so a
-# steady-state commit verify ships only (R, S, -k) plus 4-byte slot
+# steady-state commit verify ships only (R, S, -k) plus uint16 slot
 # indices and skips the ~254-squaring sqrt chain and the 14-point-op
 # table build entirely (~11% of per-signature muls, SURVEY §7(c)).
 
@@ -466,6 +466,151 @@ def _donatable(argnums: tuple[int, ...]) -> tuple[int, ...]:
         return ()
 
 
+# ------------------------------------------------- persistent lane arenas
+# The wire rows of every launch used to arrive as fresh host numpy
+# arrays: each dispatch paid an implicit host->device transfer INTO A
+# FRESH DEVICE ALLOCATION, and the buffer died after unpacking. The
+# LaneArena keeps one persistent, donated device staging buffer per
+# (kind, shape) — a window writes its rows into the arena through a
+# jitted ``lax.dynamic_update_slice`` whose FIRST argument (the previous
+# arena) is donated, so steady-state launches reuse the same device
+# allocation instead of minting one per window and never call
+# ``jax.device_put`` (the one device_put below runs once per (kind,
+# bucket), at arena creation). Two slots ping-pong per key so staging
+# window N+1 never writes into a buffer window N's launch still reads.
+#
+# COMETBFT_TPU_LANE_ARENA: "auto" (default) stages only on accelerator
+# backends — on the CPU test backend donation is unsupported, so the
+# arena would only add a copy; "1" forces (tests exercise the full
+# staging path on XLA-CPU), "0" disables.
+
+_LANE_ARENA_MODE = None
+
+
+def _lane_arena_enabled() -> bool:
+    global _LANE_ARENA_MODE
+    if _LANE_ARENA_MODE is None:
+        import os
+
+        _LANE_ARENA_MODE = os.environ.get("COMETBFT_TPU_LANE_ARENA", "auto")
+    mode = _LANE_ARENA_MODE
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    try:
+        return jax.default_backend() in ACCELERATOR_BACKENDS
+    except Exception:
+        return False
+
+
+def _stage_write(arena, rows):
+    """Write one window's rows into the staging arena, in place when the
+    arena is donated (full-shape dynamic_update_slice: XLA lowers it to
+    a copy into the donated buffer — no fresh allocation)."""
+    from jax import lax
+
+    return lax.dynamic_update_slice(
+        arena, rows, tuple(0 for _ in rows.shape)
+    )
+
+
+@lru_cache(maxsize=None)
+def _staging_jit(kind: str):
+    _enable_compilation_cache()
+    return libdevstats.track(
+        "stage." + kind,
+        jax.jit(_stage_write, donate_argnums=_donatable((0,))),
+        axis=0,
+    )
+
+
+class LaneArena:
+    """Persistent device staging buffers for per-launch wire rows.
+
+    ``stage(kind, buf)`` returns a device-resident copy of ``buf``
+    whose allocation is recycled window-over-window (donation of the
+    previous arena slot). Kernels consuming a staged buffer must NOT
+    donate it — the arena owns the allocation across launches; the
+    dispatchers below select non-donating jit variants when staging is
+    on. Thread-safe: verify paths stage from the coalescer executor,
+    consensus, blocksync and RPC threads concurrently; the mutex guards
+    only the slot bookkeeping, never a device wait (the staging jit
+    dispatch is asynchronous).
+    """
+
+    # slots per key: window N+1 stages into the OTHER slot while window
+    # N's launch may still read its staged rows (the readback drain
+    # overlaps execute of N+1 with d2h of N)
+    PING_PONG = 2
+
+    def __init__(self) -> None:
+        self._lock = libsync.Mutex("ops.verify._lane_mtx")
+        self._bufs: dict[tuple, deque] = {}
+        self.stages = 0  # total staging operations
+        self.reuses = 0  # stages that recycled a donated arena slot
+        self.allocs = 0  # one-time arena-slot allocations
+
+    def stage(self, kind: str, buf):
+        key = (kind, buf.shape, buf.dtype.str)
+        with self._lock:
+            self.stages += 1
+            slots = self._bufs.setdefault(key, deque())
+            if len(slots) < self.PING_PONG:
+                self.allocs += 1
+                staged = jax.device_put(buf)  # once per (kind, bucket) slot
+            else:
+                self.reuses += 1
+                staged = _staging_jit(kind)(slots.popleft(), buf)
+            slots.append(staged)
+            return staged
+
+    def buffers(self) -> int:
+        # snapshot under the lock: a concurrent stage() inserting a new
+        # (kind, shape) key must not resize the dict under this walk
+        # (the devstats scrape path calls these from other threads)
+        with self._lock:
+            return sum(len(v) for v in self._bufs.values())
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            arrs = [arr for slots in self._bufs.values() for arr in slots]
+        return sum(int(getattr(arr, "nbytes", 0) or 0) for arr in arrs)
+
+    def clear(self) -> None:
+        """Drop every staged slot (tests; a backend teardown)."""
+        with self._lock:
+            self._bufs.clear()
+
+
+_LANE_ARENA = LaneArena()
+
+
+def _stage_wire(kind: str, buf):
+    """Stage ``buf`` into the lane arena when enabled; None = launch
+    from host memory (arena off, or staging faulted — staging is an
+    optimization and must never kill a launch)."""
+    if not _lane_arena_enabled():
+        return None
+    try:
+        return _LANE_ARENA.stage(kind, buf)
+    except Exception:
+        return None
+
+
+# Buckets at or below this get a DEDICATED jit per (flavor, bucket):
+# their own executable cache, their own devstats kernel identity
+# (``verify.xla.g64``), and a compile traced with exactly that grid —
+# so a 64-lane coalescer window never shares (or walks) the big-bucket
+# kernel's signature cache, and the per-window fixed cost of small
+# grids is attributable per bucket in the 9_device_floor breakdown.
+_SMALL_GRID_MAX = 256
+
+
+def _small_grid(bucket: int):
+    return bucket if bucket <= _SMALL_GRID_MAX else None
+
+
 @lru_cache(maxsize=None)
 def _cached_jits():
     _enable_compilation_cache()
@@ -488,7 +633,15 @@ def _cached_jits():
 
 
 @lru_cache(maxsize=None)
-def _jitted_cached_kernel(which: str):
+def _jitted_cached_kernel(which: str, donate: bool = True, grid=None):
+    """The cached-table jit for one (flavor, donation, grid) triple.
+
+    ``donate=False`` variants serve lane-arena-staged launches (the
+    staged rows must survive the launch — the arena owns them);
+    ``grid`` pins a dedicated small-bucket jit (see _SMALL_GRID_MAX):
+    its own executable cache and its own devstats kernel name, so
+    small-window compiles and launches are attributable per bucket.
+    """
     _enable_compilation_cache()
     flavors = {
         "pallas": _cached_kernel_pallas,
@@ -497,22 +650,34 @@ def _jitted_cached_kernel(which: str):
     }
     fn = flavors.get(which, _cached_kernel)
     label = which if which in flavors else "xla"
+    if grid is not None:
+        label = f"{label}.g{grid}"
     # donate the per-launch R|S|kneg wire rows (arg 3) — NEVER the arena
     return libdevstats.track(
         "verify_cached." + label,
-        jax.jit(fn, donate_argnums=_donatable((3,))),
+        jax.jit(fn, donate_argnums=_donatable((3,)) if donate else ()),
         axis=3,
     )
 
 
 def _run_cached_kernel(arena, arena_ok, idxs, buf):
     """Cached-table launch with the same Pallas/XLA selection and Mosaic
-    fallback discipline as :func:`_run_kernel`."""
+    fallback discipline as :func:`_run_kernel`. Wire rows and slot
+    indices go through the persistent lane arena when enabled; small
+    buckets launch their dedicated small-grid jits."""
+    staged_buf = _stage_wire("rsk", buf)
+    staged_idx = _stage_wire("idx", idxs) if staged_buf is not None else None
+    if staged_buf is not None and staged_idx is None:
+        staged_buf = None  # stage both or neither
+    donate = staged_buf is None
+    buf_in = buf if donate else staged_buf
+    idx_in = idxs if staged_idx is None else staged_idx
+    grid = _small_grid(buf.shape[1])
     if buf.shape[1] >= _PALLAS_MIN_LANES and _pallas_wanted():
         for which in _pallas_candidates():
             try:
-                out = _jitted_cached_kernel(which)(
-                    arena, arena_ok, idxs, buf
+                out = _jitted_cached_kernel(which, donate, grid)(
+                    arena, arena_ok, idx_in, buf_in
                 )
             except Exception as e:
                 _note_pallas_broken(which, e)
@@ -521,7 +686,9 @@ def _run_cached_kernel(arena, arena_ok, idxs, buf):
                 # the slot indices cross the PCIe/tunnel edge
                 libdevstats.record_h2d(buf.nbytes + idxs.nbytes)
                 return out, which
-    out = _jitted_cached_kernel(_xla_which())(arena, arena_ok, idxs, buf)
+    out = _jitted_cached_kernel(_xla_which(), donate, grid)(
+        arena, arena_ok, idx_in, buf_in
+    )
     libdevstats.record_h2d(buf.nbytes + idxs.nbytes)
     return out, None
 
@@ -546,6 +713,14 @@ class PubkeyTableCache:
 
     def __init__(self, capacity: int = CAPACITY):
         self.capacity = capacity
+        # Slot indices ship host->device on EVERY cached-path window;
+        # use the narrowest dtype that can address capacity+1 slots
+        # (the +1 scratch slot included): uint16 halves the per-lane
+        # index wire cost vs int32 for every arena up to 65535 slots.
+        # The no-recompile transfer reconciliation pins the reduction.
+        self.idx_dtype = (
+            np.uint16 if capacity + 1 <= 1 << 16 else np.int32
+        )
         self._lock = libsync.Mutex("ops.verify._lock")
         self._slots: OrderedDict[bytes, int] = OrderedDict()
         self._arena = None
@@ -609,7 +784,7 @@ class PubkeyTableCache:
                     for batch_keys, tables, oks in built:
                         size = int(tables.shape[-1])
                         slots = np.full(
-                            size, self.capacity, np.int32
+                            size, self.capacity, self.idx_dtype
                         )  # pads -> scratch slot
                         for j, pk in enumerate(batch_keys):
                             slot = self._slots.get(pk)
@@ -636,7 +811,7 @@ class PubkeyTableCache:
                         self._arena, self._arena_ok = scatter(
                             self._arena, self._arena_ok, slots, tables, oks
                         )
-                    idxs = np.empty(len(keys), np.int32)
+                    idxs = np.empty(len(keys), self.idx_dtype)
                     for i, pk in enumerate(keys):
                         idxs[i] = self._slots[pk]
                         self._slots.move_to_end(pk)
@@ -759,7 +934,11 @@ def _enable_compilation_cache() -> None:
 
 
 @lru_cache(maxsize=None)
-def _jitted_kernel(which: str = "xla"):
+def _jitted_kernel(which: str = "xla", donate: bool = True, grid=None):
+    """The uncached-path jit for one (flavor, donation, grid) triple —
+    same contract as :func:`_jitted_cached_kernel`: ``donate=False``
+    variants serve lane-arena-staged launches, ``grid`` pins a
+    dedicated small-bucket jit with its own devstats identity."""
     _enable_compilation_cache()
     flavors = {
         "pallas": _kernel_from_bytes_pallas,
@@ -768,9 +947,11 @@ def _jitted_kernel(which: str = "xla"):
     }
     fn = flavors.get(which, _kernel_from_bytes)
     label = which if which in flavors else "xla"
+    if grid is not None:
+        label = f"{label}.g{grid}"
     return libdevstats.track(
         "verify." + label,
-        jax.jit(fn, donate_argnums=_donatable((0,))),
+        jax.jit(fn, donate_argnums=_donatable((0,)) if donate else ()),
         axis=0,
     )
 
@@ -895,16 +1076,20 @@ def _run_kernel(buf):
     result materializes — callers resolve through :func:`_materialize`,
     which marks the flavor broken and re-dispatches.
     """
+    staged = _stage_wire("wire", buf)
+    donate = staged is None
+    buf_in = buf if donate else staged
+    grid = _small_grid(buf.shape[1])
     if buf.shape[1] >= _PALLAS_MIN_LANES and _pallas_wanted():
         for which in _pallas_candidates():
             try:
-                out = _jitted_kernel(which)(buf)
+                out = _jitted_kernel(which, donate, grid)(buf_in)
             except Exception as e:  # synchronous trace/compile failure
                 _note_pallas_broken(which, e)
             else:
                 libdevstats.record_h2d(buf.nbytes)
                 return out, which
-    out = _jitted_kernel(_xla_which())(buf)
+    out = _jitted_kernel(_xla_which(), donate, grid)(buf_in)
     libdevstats.record_h2d(buf.nbytes)
     return out, None
 
@@ -1130,7 +1315,7 @@ def verify_batch(pubkeys, msgs, sigs) -> tuple[bool, np.ndarray]:
     (types/validation.go:243-250's find-first-invalid fallback).
 
     Steady state routes through the expanded-pubkey cache: per lane the
-    device receives 96 bytes (R, S, -k) plus a 4-byte arena slot, and the
+    device receives 96 bytes (R, S, -k) plus a 2-byte arena slot, and the
     kernel skips pubkey decompression + table build entirely.
     """
     n = len(pubkeys)

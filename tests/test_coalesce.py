@@ -881,6 +881,219 @@ class TestAdaptiveCrossover:
         monkeypatch.setattr(cbatch, "_ENV_PINNED", True)
         assert cbatch.host_batch_threshold() == 123
 
+    def test_post_optimization_device_profile_converges_below_256(
+        self, monkeypatch
+    ):
+        # THE device-floor acceptance stand-in for host-only
+        # containers: feed the live fit synthetic (lanes, seconds)
+        # samples shaped like the post-optimization device profile —
+        # per-window fixed cost down to ~2 ms (persistent lane arenas,
+        # overlapped d2h, narrowed dtypes, small-grid jits) against the
+        # measured ~28 us/lane host RLC rate — and the calibrated
+        # crossover must land under 256 lanes, where the coalescer's
+        # real steady-state windows (100-150 validator commits) live.
+        monkeypatch.setenv("COMETBFT_TPU_ADAPTIVE_THRESHOLD", "1")
+        monkeypatch.setattr(cbatch, "_ENV_PINNED", False)
+        xo = cbatch.AdaptiveCrossover()
+        for _ in range(xo.MIN_SAMPLES + 1):
+            for n in (8, 16, 32, 64, 128, 256):
+                xo.observe_host(n, 5e-6 + n * 28e-6)
+            for n in (64, 128, 256, 512, 1024, 2048):
+                xo.observe_device(n, 2e-3 + n * 1e-6)
+        t = xo.threshold()
+        assert t is not None and t < 256, t
+        monkeypatch.setattr(cbatch, "CROSSOVER", xo)
+        assert cbatch.host_batch_threshold() < 256
+        fit = xo.fit_summary()
+        assert fit["crossover_lanes"] == t
+        assert fit["device_floor_s"] == pytest.approx(2e-3, rel=0.1)
+        assert fit["host_rate_s_per_lane"] == pytest.approx(
+            28e-6, rel=0.1
+        )
+
+    def test_reset_refits_from_scratch(self):
+        # a stepped device profile (staging arenas toggled, kernel
+        # swap) must be able to drop stale samples instead of decaying
+        # through hundreds of windows
+        xo = cbatch.AdaptiveCrossover()
+        for _ in range(xo.MIN_SAMPLES + 1):
+            xo.observe_host(200, 200 * 100e-6)
+            xo.observe_device(128, 0.05 + 128 * 2e-6)
+            xo.observe_device(1024, 0.05 + 1024 * 2e-6)
+        assert xo.threshold() is not None
+        xo.reset()
+        assert xo.threshold() is None
+        assert xo.fit_summary()["host_samples"] == 0
+
+
+class TestReadbackDrain:
+    """The readback drain thread: dispatched windows materialize on a
+    dedicated thread IN SUBMISSION ORDER while the executor packs and
+    dispatches the next window — execute of window N+1 overlaps the
+    d2h of window N — and the rescue paths still reach every ticket
+    when either thread faults."""
+
+    def test_tickets_resolve_in_submission_order(self, monkeypatch):
+        # Window 1's device result is SLOW, window 2's instant: FIFO
+        # drain must still resolve window 1's tickets first. The gate
+        # event releases window 1 only after window 2 has been
+        # DISPATCHED — which simultaneously pins the overlap property
+        # (the executor launched N+1 while N's readback was pending).
+        gate = threading.Event()
+        dispatched: list[int] = []
+        resolved: list[int] = []
+        seq_by_groups: dict[int, int] = {}
+
+        def fake_launch(self, groups, lanes, reason):
+            pubkeys, msgs, sigs, staged = self._stage(groups)
+            seq = len(dispatched) + 1
+            dispatched.append(seq)
+            seq_by_groups[id(staged)] = seq
+
+            def finish(seq=seq):
+                if seq == 1:
+                    gate.wait(10)
+                return np.ones(lanes, bool)
+
+            return coalesce._Inflight(
+                finish, np.ones(lanes, bool), staged, lanes, reason,
+                0.0, (pubkeys, msgs, sigs),
+            )
+
+        real_rb = coalesce.VerifyCoalescer._resolve_bits
+
+        def tracking_rb(self, staged, bits, reason, backend):
+            seq = seq_by_groups.get(id(staged))
+            if seq is not None:
+                resolved.append(seq)
+            real_rb(self, staged, bits, reason, backend)
+
+        monkeypatch.setattr(
+            coalesce.VerifyCoalescer, "_launch", fake_launch
+        )
+        monkeypatch.setattr(
+            coalesce.VerifyCoalescer, "_resolve_bits", tracking_rb
+        )
+        co = _coalescer(window_us=1_000, max_lanes=2, max_inflight=2)
+        try:
+            _, pks, msgs, sigs = _lanes(4, seed=31)
+            t1 = co.submit(pks[:2], msgs[:2], sigs[:2])
+            # wait for window 1 to be dispatched before submitting
+            # window 2, so the two flushes cannot merge
+            for _ in range(200):
+                if dispatched:
+                    break
+                time.sleep(0.01)
+            t2 = co.submit(pks[2:], msgs[2:], sigs[2:])
+            # the executor must dispatch window 2 while window 1 is
+            # still materializing on the drain thread
+            for _ in range(500):
+                if len(dispatched) == 2:
+                    break
+                time.sleep(0.01)
+            assert dispatched == [1, 2], (
+                "executor never overlapped window 2's dispatch with "
+                "window 1's readback"
+            )
+            assert not t1.done() and not t2.done()
+            gate.set()
+            assert t1.result(timeout=10) == [True, True]
+            assert t2.result(timeout=10) == [True, True]
+            assert resolved == [1, 2], resolved
+        finally:
+            gate.set()
+            co.stop()
+
+    def test_drain_finish_fault_rescues_that_window_only(
+        self, monkeypatch
+    ):
+        # _finish raising on the drain thread (not the executor) must
+        # host-rescue THAT window's tickets from the retained wire and
+        # leave the loop alive for the next window
+        calls: list[int] = []
+
+        def fake_launch(self, groups, lanes, reason):
+            pubkeys, msgs, sigs, staged = self._stage(groups)
+            return coalesce._Inflight(
+                lambda: np.ones(lanes, bool), np.ones(lanes, bool),
+                staged, lanes, reason, 0.0, (pubkeys, msgs, sigs),
+            )
+
+        real_finish = coalesce.VerifyCoalescer._finish
+
+        def flaky_finish(self, fl):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("drain-side fault")
+            return real_finish(self, fl)
+
+        monkeypatch.setattr(
+            coalesce.VerifyCoalescer, "_launch", fake_launch
+        )
+        monkeypatch.setattr(
+            coalesce.VerifyCoalescer, "_finish", flaky_finish
+        )
+        co = _coalescer(window_us=1_000, max_lanes=2)
+        try:
+            _, pks, msgs, sigs = _lanes(4, seed=32)
+            sigs[1] = bytes(64)
+            # window 1: drain _finish faults -> host rescue, real
+            # verdicts (including the corrupted lane's False)
+            bits = co.submit(pks[:2], msgs[:2], sigs[:2]).result(
+                timeout=10
+            )
+            assert bits == [True, False]
+            # window 2: the drain thread survived and finishes normally
+            bits = co.submit(pks[2:], msgs[2:], sigs[2:]).result(
+                timeout=10
+            )
+            assert bits == [True, True]
+        finally:
+            co.stop()
+
+    def test_depth_bound_blocks_the_executor(self, monkeypatch):
+        # with max_inflight=1 the executor may not dispatch window 2
+        # until window 1 fully materialized
+        gate = threading.Event()
+        dispatched: list[int] = []
+
+        def fake_launch(self, groups, lanes, reason):
+            pubkeys, msgs, sigs, staged = self._stage(groups)
+            dispatched.append(len(dispatched) + 1)
+
+            def finish():
+                gate.wait(10)
+                return np.ones(lanes, bool)
+
+            return coalesce._Inflight(
+                finish, np.ones(lanes, bool), staged, lanes, reason,
+                0.0, (pubkeys, msgs, sigs),
+            )
+
+        monkeypatch.setattr(
+            coalesce.VerifyCoalescer, "_launch", fake_launch
+        )
+        co = _coalescer(window_us=1_000, max_lanes=2, max_inflight=1)
+        try:
+            _, pks, msgs, sigs = _lanes(4, seed=33)
+            t1 = co.submit(pks[:2], msgs[:2], sigs[:2])
+            for _ in range(200):
+                if dispatched:
+                    break
+                time.sleep(0.01)
+            t2 = co.submit(pks[2:], msgs[2:], sigs[2:])
+            time.sleep(0.3)  # give a buggy executor time to overrun
+            assert dispatched == [1], (
+                "depth bound 1 must serialize dispatches"
+            )
+            gate.set()
+            assert t1.result(timeout=10) == [True, True]
+            assert t2.result(timeout=10) == [True, True]
+            assert dispatched == [1, 2]
+        finally:
+            gate.set()
+            co.stop()
+
 
 class TestMixedBatchVerifierEdges:
     def test_empty_verifier_verifies_vacuously(self):

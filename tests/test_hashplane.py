@@ -328,6 +328,85 @@ class TestInflightRescue:
         ]
 
 
+class TestReadbackDrain:
+    """The hash plane's readback drain mirrors the verify coalescer's:
+    dispatched windows materialize on a dedicated thread in submission
+    order while the executor packs + dispatches the next window."""
+
+    def test_tickets_resolve_in_submission_order(self, monkeypatch):
+        gate = threading.Event()
+        dispatched: list[int] = []
+        resolved: list[int] = []
+        seq_by_groups: dict[int, int] = {}
+
+        def fake_launch(self, groups, lanes, reason):
+            msgs, staged, wire = self._stage(groups)
+            seq = len(dispatched) + 1
+            dispatched.append(seq)
+            seq_by_groups[id(wire)] = seq
+            out = [hashlib.sha256(m).digest() for m in msgs]
+
+            def finish(seq=seq):
+                if seq == 1:
+                    gate.wait(10)
+                return out
+
+            return hashplane._Inflight(
+                [(finish, list(range(lanes)), 1, 0.0, lanes)],
+                [None] * lanes,
+                wire,
+                lanes,
+                reason,
+            )
+
+        real_finish = hashplane.HashCoalescer._finish
+
+        def tracking_finish(self, fl):
+            real_finish(self, fl)
+            seq = seq_by_groups.get(id(fl.groups))
+            if seq is not None:
+                resolved.append(seq)
+
+        monkeypatch.setattr(
+            hashplane.HashCoalescer, "_launch", fake_launch
+        )
+        monkeypatch.setattr(
+            hashplane.HashCoalescer, "_finish", tracking_finish
+        )
+        co = _plane(window_us=1_000, max_lanes=2, max_inflight=2)
+        try:
+            t1 = co.submit([b"w1-a", b"w1-b"])
+            for _ in range(200):
+                if dispatched:
+                    break
+                threading.Event().wait(0.01)
+            t2 = co.submit([b"w2-a", b"w2-b"])
+            # executor must dispatch window 2 while window 1's readback
+            # is gated on the drain thread
+            for _ in range(500):
+                if len(dispatched) == 2:
+                    break
+                threading.Event().wait(0.01)
+            assert dispatched == [1, 2], (
+                "executor never overlapped window 2's dispatch with "
+                "window 1's readback"
+            )
+            assert not t1.done() and not t2.done()
+            gate.set()
+            assert t1.result(timeout=10) == [
+                hashlib.sha256(b"w1-a").digest(),
+                hashlib.sha256(b"w1-b").digest(),
+            ]
+            assert t2.result(timeout=10) == [
+                hashlib.sha256(b"w2-a").digest(),
+                hashlib.sha256(b"w2-b").digest(),
+            ]
+            assert resolved == [1, 2], resolved
+        finally:
+            gate.set()
+            co.stop()
+
+
 class TestBreakerHealthChannel:
     def test_trip_and_rearm_feed_the_breaker_ring(self):
         """A wedged hash plane must page like a wedged verify

@@ -547,6 +547,48 @@ class TestEngineWideGate:
         ]
         assert blocked == [], blocked
 
+    def test_readback_drain_locks_registered_and_leaf(self, analysis):
+        """The readback-drain handoff mutexes of both planes
+        ('crypto.coalesce._rb_mtx', 'crypto.hashplane._rb_mtx') are in
+        the shipped artifact and participate in NO acquisition-order
+        edges: the drain thread pops a window under its mutex and
+        releases it BEFORE the materializing readback and ticket
+        resolution, and the executor's depth wait is its own condition
+        — an edge appearing here means the drain handoff started
+        holding its lock into device waits or engine code, and the
+        overlap (execute of window N+1 over d2h of window N) turned
+        into a contention point."""
+        d = analysis.graph_dict()
+        names = {lk["name"] for lk in d["locks"]}
+        for lock in (
+            "crypto.coalesce._rb_mtx",
+            "crypto.hashplane._rb_mtx",
+        ):
+            assert lock in names, lock
+            edges = [
+                (e["from"], e["to"])
+                for e in d["edges"]
+                if lock in (e["from"], e["to"])
+            ]
+            assert edges == [], (lock, edges)
+
+    def test_lane_arena_lock_registered_and_leaf(self, analysis):
+        """The lane staging arena's slot mutex ('ops.verify._lane_mtx')
+        is in the shipped artifact and edge-free: stage() holds it only
+        across slot bookkeeping and the ASYNC staging-jit dispatch —
+        never a device wait, never another lock. It may be acquired
+        under caller engine mutexes (verify paths run from consensus /
+        blocksync / RPC threads), so an OUTGOING edge would splice the
+        staging arena into the engine lock hierarchy."""
+        d = analysis.graph_dict()
+        assert "ops.verify._lane_mtx" in {lk["name"] for lk in d["locks"]}
+        edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if e["from"] == "ops.verify._lane_mtx"
+        ]
+        assert edges == [], edges
+
     def test_health_lock_registered_and_leaf(self, analysis):
         """libs/health's bundle-rate-limit mutex carries the same
         contract as the tracer's and devstats': present in the shipped

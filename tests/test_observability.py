@@ -1561,7 +1561,7 @@ class TestNoRecompileGuard:
 
             # -- Counter/trace reconciliation: every traced device
             # dispatch is one cached-arena launch at bucket 8 (96-byte
-            # wire rows + int32 slot per lane up, ONE bit-packed ok
+            # wire rows + uint16 slot per lane up, ONE bit-packed ok
             # word — bucket/8 uint8 bytes — back) and exactly one h2d
             # and one d2h transfer was counted.
             disp = [
@@ -1580,7 +1580,10 @@ class TestNoRecompileGuard:
                 launches, c0, c1
             )
             assert c1["d2h_ops"] - c0["d2h_ops"] == launches
-            per_launch_up = 96 * 8 + 8 * 4  # wire rows + slot indices
+            # wire rows + slot indices: 2 B/lane uint16 idxs (the
+            # narrowed dtype — this arithmetic IS the proof the per-
+            # window h2d shrank from the old 4 B/lane int32 lanes)
+            per_launch_up = 96 * 8 + 8 * 2
             assert (
                 c1["h2d_bytes"] - c0["h2d_bytes"]
                 == launches * per_launch_up
