@@ -2013,6 +2013,11 @@ def _fault_matrix_cells():
             None,
         ),
         ("partition_heal", LinkConfig(), "partition"),
+        # gray-failure family (PR 13): asymmetric sever, slow-but-alive
+        # disk, and a mid-run statesync join that loses a serving peer
+        ("gray_partition", LinkConfig(), "oneway"),
+        ("slow_disk", LinkConfig(), "slow_disk"),
+        ("statesync_join", LinkConfig(), "statesync_join"),
     ]
 
 
@@ -2058,7 +2063,21 @@ def _run_fault_cell(name, link, special, n_heights, seed=16):
     libhealth.set_ring_capacity(SCENARIO_RING)
     libhealth.reset()
     libhealth.enable()
-    net = SimNet(4, seed=seed, config=cfg, default_link=link)
+    if special == "statesync_join":
+        # 4 validators + one LATE full node: grow the chain, then join
+        # it mid-run via the real statesync path, killing one serving
+        # peer mid-restore (the injected fault the attributor must name)
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.simnet.net import make_genesis
+
+        genesis, pvs = make_genesis(4)
+        net = SimNet(
+            5, seed=seed, config=cfg, default_link=link,
+            genesis=genesis, pvs=pvs, late=(4,),
+            app_factory=lambda idx: KVStoreApplication(snapshot_interval=5),
+        )
+    else:
+        net = SimNet(4, seed=seed, config=cfg, default_link=link)
     try:
         net.start()
         if special == "partition":
@@ -2066,7 +2085,45 @@ def _run_fault_cell(name, link, special, n_heights, seed=16):
             net.partition([0, 1], [2, 3])
             net.run(max_virtual_ms=1_500)
             net.heal()
-        ok = net.run_until_height(n_heights, max_virtual_ms=600_000)
+        elif special == "oneway":
+            net.run_until_height(2, max_virtual_ms=60_000)
+            net.sever_oneway(0, 1)
+            net.run_until_height(
+                max(net.heights()) + 2, max_virtual_ms=240_000
+            )
+            net.heal()
+        elif special == "slow_disk":
+            net.run_until_height(2, max_virtual_ms=60_000)
+            net.set_slow_disk(1, 120 * ms, 30 * ms)
+            net.run_until_height(
+                max(net.heights()) + 2, max_virtual_ms=600_000
+            )
+            net.set_slow_disk(1, 0)
+        elif special == "statesync_join":
+            vals = [0, 1, 2, 3]
+            net.run_until_height(12, nodes=vals, max_virtual_ms=600_000)
+            net.join_statesync(4, trust_height=1, chunk_timeout_s=0.5)
+            jn = net.nodes[4]
+            net.run(
+                until=lambda: jn.statesync_state["phase"] != "discover",
+                max_virtual_ms=60_000,
+            )
+            net.kill(1)  # a serving peer dies mid-restore
+            net.run(
+                until=lambda: (
+                    jn.alive
+                    and jn.statesync_state["phase"] == "switched"
+                ),
+                max_virtual_ms=600_000,
+            )
+        if special == "statesync_join":
+            ok = net.run_until_height(
+                n_heights,
+                nodes=[i for i in range(5) if net.nodes[i].alive],
+                max_virtual_ms=600_000,
+            )
+        else:
+            ok = net.run_until_height(n_heights, max_virtual_ms=600_000)
         net.assert_no_fork()
         cell = {
             "ok": ok,
@@ -2093,6 +2150,11 @@ _FAULT_CELL_EXPECTED = {
     "drop05": ("injected_drop",),
     "drop10_lat20": ("injected_drop", "injected_latency"),
     "partition_heal": ("injected_partition",),
+    "gray_partition": ("gray_partition",),
+    "slow_disk": ("slow_disk",),
+    # the join itself is not a fault; the injected fault in that cell
+    # is the serving peer killed mid-restore
+    "statesync_join": ("injected_churn",),
 }
 
 

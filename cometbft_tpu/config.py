@@ -258,6 +258,39 @@ ENV_KNOBS: dict[str, str] = {
         "unreachable peers degrade to the local view "
         "(cometbft_tpu/postmortem.bundle_timeline)"
     ),
+    "COMETBFT_TPU_SUSPICION": (
+        "peer-health suspicion scorer (p2p/suspicion.py): evicts gray "
+        "(slow-but-alive) peers off the netstats signals — send-queue-"
+        "full streaks, stamp staleness, propagation-lag outliers; "
+        "default on for every running node, 0 disables"
+    ),
+    "COMETBFT_TPU_SUSPICION_EVICT": (
+        "suspicion score at which a peer is evicted through the switch "
+        "(default 3.0 — roughly three consecutive bad check ticks; "
+        "scores decay 0.5x per clean tick, p2p/suspicion.py)"
+    ),
+    "COMETBFT_TPU_SUSPICION_COOLDOWN_S": (
+        "minimum seconds between suspicion evictions of the SAME peer "
+        "(default 30 — a genuinely-broken link must reconnect-and-"
+        "prove-itself, not flap; p2p/suspicion.py)"
+    ),
+    "COMETBFT_TPU_HEALTH_DISK_EWMA": (
+        "window (in fsyncs) of the WAL fsync-latency EWMA behind the "
+        "disk_degraded state and the slow_disk watchdog (default 8; "
+        "alpha = 2/(window+1), consensus/wal.py)"
+    ),
+    "COMETBFT_TPU_HEALTH_DISK_MS": (
+        "fsync-EWMA milliseconds at which the node enters "
+        "disk_degraded — propose timeouts widen, the slow_disk "
+        "watchdog trips a black-box bundle; clears below half the "
+        "threshold (hysteresis; default 50, consensus/wal.py)"
+    ),
+    "COMETBFT_TPU_STATESYNC_BACKOFF_S": (
+        "base seconds of the per-peer exponential backoff the "
+        "statesync chunk fetcher applies to a peer whose requests "
+        "time out (doubles per consecutive failure, capped; default "
+        "1.0, statesync/syncer.py ChunkFetchPlan)"
+    ),
 }
 
 

@@ -350,6 +350,24 @@ class ConsensusState(BaseService):
             TimeoutInfo(duration_s, height, round_, int(step))
         )
 
+    def _propose_timeout(self, round_: int) -> float:
+        """Propose timeout, widened while OUR disk is degraded: a
+        slow-but-alive WAL eats into every propose window this node
+        waits out (the proposer's own fsyncs delay its proposal by the
+        same amount), so stretching the window by a few smoothed fsyncs
+        — capped at one extra base timeout — turns spun rounds into a
+        slower-but-committing chain (consensus/wal.py disk_degraded).
+
+        Never widened for a sim-driven FSM: the EWMA measures WALL
+        fsync time, and feeding wall measurements into virtual-time
+        timeout scheduling would break the simnet's bit-reproducibility
+        (the sim injects slow disks at the message plane instead)."""
+        base = self.config.propose_timeout(round_)
+        wal = self.wal
+        if not self.sim_driven and wal is not None and wal.disk_degraded():
+            base += min(base, 4.0 * wal.fsync_ewma_s())
+        return base
+
     # ------------------------------------------------------------------
     # the single-writer loop
     # ------------------------------------------------------------------
@@ -927,7 +945,7 @@ class ConsensusState(BaseService):
         self._set_step(rs, RoundStep.PROPOSE)
         self._new_step()
         self._schedule_timeout(
-            self.config.propose_timeout(round_), height, round_,
+            self._propose_timeout(round_), height, round_,
             RoundStep.PROPOSE,
         )
         if self.priv_validator is None or self.priv_validator_pub_key is None:

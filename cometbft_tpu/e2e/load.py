@@ -267,7 +267,8 @@ class SimLoadGenerator:
     the net's seed like everything else on the scheduler."""
 
     def __init__(self, net, rate: int = 100, tx_size: int = 64,
-                 run_id: str = "simload", targets: list[int] | None = None):
+                 run_id: str = "simload", targets: list[int] | None = None,
+                 burst: int = 1):
         self.net = net
         self.rate = max(1, rate)
         self.tx_size = tx_size
@@ -279,7 +280,13 @@ class SimLoadGenerator:
         self.sent = 0
         self._seq = 0
         self._stopped = False
-        self._interval_ns = int(1e9 / self.rate)
+        # storm mode: ``burst`` txs pushed per tick, so a sustained
+        # thousands-of-tx/s mempool storm costs rate/burst scheduler
+        # events per virtual second instead of one event per tx — the
+        # pressure is identical (the mempool sees the same tx stream
+        # per virtual instant), the event heap stays tractable
+        self.burst = max(1, burst)
+        self._interval_ns = int(self.burst * 1e9 / self.rate)
 
     def start(self) -> None:
         self._stopped = False
@@ -291,21 +298,22 @@ class SimLoadGenerator:
     def _tick(self) -> None:
         if self._stopped:
             return
-        # rotate past dead targets: a killed node must cost ITS txs,
-        # not wedge the whole generator on one round-robin slot
-        for _ in range(len(self.targets)):
-            idx = self.targets[self._seq % len(self.targets)]
-            self._seq += 1
-            node = self.net.nodes[idx]
-            if node.alive and node.core is not None:
-                node.core["mempool"].push_tx(
-                    make_tx(
-                        self.run_id, self._seq, self.tx_size,
-                        now_ns=self.net.clock.time_ns(),
+        for _ in range(self.burst):
+            # rotate past dead targets: a killed node must cost ITS
+            # txs, not wedge the whole generator on one slot
+            for _ in range(len(self.targets)):
+                idx = self.targets[self._seq % len(self.targets)]
+                self._seq += 1
+                node = self.net.nodes[idx]
+                if node.alive and node.core is not None:
+                    node.core["mempool"].push_tx(
+                        make_tx(
+                            self.run_id, self._seq, self.tx_size,
+                            now_ns=self.net.clock.time_ns(),
+                        )
                     )
-                )
-                self.sent += 1
-                break
+                    self.sent += 1
+                    break
         self.net.sched.call_after(self._interval_ns, self._tick)
 
 

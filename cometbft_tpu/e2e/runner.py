@@ -494,18 +494,25 @@ class Testnet:
 
 
 def run_simnet_load(
-    seed: int, n_nodes: int = 4, rate: int = 200, heights: int = 6
+    seed: int, n_nodes: int = 4, rate: int = 200, heights: int = 6,
+    burst: int = 1,
 ) -> dict:
     """Scenario-less simnet load run: N validators, a virtual-rate tx
     stream, a block-walk latency report — the loadtime shape without a
-    socket in sight."""
+    socket in sight.  ``burst`` > 1 is the sustained mempool-STORM
+    mode: burst txs per tick at the same aggregate rate, so storms in
+    the thousands of tx/s stay tractable on the event heap."""
     from ..simnet import SimNet
     from .load import SimLoadGenerator, sim_load_report
 
     net = SimNet(n_nodes, seed=seed)
     try:
         net.start()
-        gen = SimLoadGenerator(net, rate=rate, run_id=f"sim{seed}")
+        gen = SimLoadGenerator(
+            net, rate=rate, burst=burst, run_id=f"sim{seed}"
+        )
+        if burst > 1:
+            net.mark_storm(rate)
         gen.start()
         ok = net.run_until_height(heights, max_virtual_ms=240_000)
         gen.stop()
@@ -547,6 +554,11 @@ def main(argv=None) -> int:
         help="simnet load mode: tx/s of virtual time instead of a "
         "fault scenario",
     )
+    ap.add_argument(
+        "--burst", type=int, default=1, metavar="N",
+        help="txs pushed per load tick (storm mode: thousands of tx/s "
+        "at rate/burst scheduler events per virtual second)",
+    )
     args = ap.parse_args(argv)
     if not args.simnet:
         ap.error(
@@ -555,7 +567,8 @@ def main(argv=None) -> int:
         )
     if args.load:
         out = run_simnet_load(
-            args.seed, n_nodes=args.nodes or 4, rate=args.load
+            args.seed, n_nodes=args.nodes or 4, rate=args.load,
+            burst=args.burst,
         )
         print(json.dumps(out, default=str, indent=1))
         return 0 if out["ok"] else 1

@@ -48,3 +48,30 @@ def set_handler(fn) -> None:
     by simnet scenarios; see :func:`fail_point`."""
     global _handler
     _handler = fn
+
+
+# -- delay points (gray-failure injection) ------------------------------
+#
+# Crash points model fail-stop; DELAY points model slow-but-alive — the
+# gray failures (a disk whose fsync takes 200 ms, a store write stuck
+# behind a saturated volume) that kill production clusters without ever
+# tripping a liveness check.  A delay point is free when no handler is
+# installed: one global read.  The simnet scenario engine installs a
+# handler that charges VIRTUAL latency to the current sim node (on the
+# sim clock, deterministic); live fault-injection tests may install one
+# that really sleeps.
+
+_delay_handler = None
+
+
+def delay_point(name: str) -> None:
+    """Charge the injected latency for this named point, if armed."""
+    if _delay_handler is not None:
+        _delay_handler(name)
+
+
+def set_delay_handler(fn) -> None:
+    """Install (or clear, with None) the slow-path handler used by the
+    simnet slow-disk injection; see :func:`delay_point`."""
+    global _delay_handler
+    _delay_handler = fn

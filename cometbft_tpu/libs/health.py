@@ -125,6 +125,16 @@ FAULT_RESTART = 4  # node restarted (churn)
 FAULT_DROP = 5  # one message eaten by link faults (detail = channel)
 FAULT_LINK = 6  # link fault parameters changed
 FAULT_CRASH = 7  # armed COMETBFT_TPU_FAIL crash point fired in-process
+# gray-failure vocabulary (PR 13): slow-but-alive and asymmetric faults
+FAULT_ONEWAY = 8  # one DIRECTION severed (h=src, r=dst; detail 1=sever 0=restore)
+FAULT_SLOW_DISK = 9  # node's disk slowed (h=node; detail = latency ms, 0=cleared)
+FAULT_STORM = 10  # sustained mempool storm (detail = tx/s rate, 0=stopped)
+FAULT_PEER_EVICT = 11  # a node-side DEFENSE evicted a peer (suspicion /
+# statesync chunk-peer rotation); h=node where known, detail=reason code
+# FAULT_PEER_EVICT detail namespace (WHICH defense acted): 1-4 are the
+# p2p/suspicion reason enum (queue_full/stale/lag/mixed); 5 is a
+# statesync chunk-fetch rotation abandoning a timing-out chunk peer
+PEER_EVICT_STATESYNC_ROTATE = 5
 
 _FAULT_NAMES = {
     FAULT_PARTITION: "partition",
@@ -134,7 +144,23 @@ _FAULT_NAMES = {
     FAULT_DROP: "drop",
     FAULT_LINK: "link_change",
     FAULT_CRASH: "crash_point",
+    FAULT_ONEWAY: "oneway_sever",
+    FAULT_SLOW_DISK: "slow_disk",
+    FAULT_STORM: "mempool_storm",
+    FAULT_PEER_EVICT: "peer_evict",
 }
+
+
+def fault_kind_codes() -> dict[str, int]:
+    """Every ``FAULT_*`` kind this module defines, by constant name —
+    the registry the EV_FAULT decode-completeness tier-1 test walks, so
+    a new fault kind cannot ship without a ``fault_name`` decode entry
+    and a docs row."""
+    return {
+        name: value
+        for name, value in globals().items()
+        if name.startswith("FAULT_") and isinstance(value, int)
+    }
 
 _CODE_NAMES = {
     EV_STEP: "consensus.step",
@@ -191,6 +217,7 @@ _WATCHDOGS = (
     ("verify_breaker", 2),
     ("recompile_storm", 4),
     ("send_queue_saturated", 8),
+    ("slow_disk", 16),
 )
 # send_queue_saturated: this many CONSECUTIVE checks each observing
 # fresh MConnection.send drops on a consensus channel = sustained
@@ -622,6 +649,7 @@ _ST_STALLED = 6  # 1.0 while the stall detector considers us stalled
 # allocation-free guard whenever an earlier test perturbed the free-list
 _QF_SEEN = 0
 _QF_STREAK = 1
+_ST_DISK_DEGRADED = 7  # 1.0 while the wired WAL reports disk_degraded
 
 
 class HealthMonitor(BaseService):
@@ -647,10 +675,17 @@ class HealthMonitor(BaseService):
         interval_s: float | None = None,
         trace_tail: int = 512,
         idle_ok=None,
+        disk_degraded_fn=None,
         logger=None,
     ):
         super().__init__("HealthMonitor", logger)
         self.metrics = metrics
+        # disk_degraded_fn: zero-arg bool — the slow-disk watchdog's
+        # signal, wired by node/node.py to the consensus WAL's fsync
+        # EWMA state (consensus/wal.py disk_degraded()). A trip fires
+        # on each False->True transition (per-episode, not per-tick);
+        # None (bare harnesses, NopWAL nodes) disables the watchdog.
+        self._disk_degraded = disk_degraded_fn
         # idle_ok: zero-arg callable consulted when the stall window
         # expires — True means the silence is LEGITIMATE (the node is
         # still block-syncing, or create_empty_blocks=False with an
@@ -808,10 +843,27 @@ class HealthMonitor(BaseService):
         else:
             qf[_QF_STREAK] = 0
         qf[_QF_SEEN] = qfull
+        # -- slow disk: the wired WAL's fsync-latency EWMA crossed its
+        # degradation threshold (consensus/wal.py hysteresis). Trip on
+        # the False->True EDGE only — degradation is an episode, and
+        # the widened propose timeouts keep the chain live through it;
+        # a raising probe fails toward alerting (degraded=True).
+        if self._disk_degraded is not None:
+            try:
+                degraded = bool(self._disk_degraded())
+            except Exception:
+                degraded = True
+            if degraded and st[_ST_DISK_DEGRADED] == 0.0:
+                mask |= 16
+            st[_ST_DISK_DEGRADED] = 1.0 if degraded else 0.0
         return mask
 
     def stalled(self) -> bool:
         return self._st[_ST_STALLED] != 0.0
+
+    def disk_degraded(self) -> bool:
+        """Last-observed slow-disk state (updated each check tick)."""
+        return self._st[_ST_DISK_DEGRADED] != 0.0
 
     def storm_active(self) -> bool:
         t = self._st[_ST_STORM_TRIP_T]
@@ -874,6 +926,7 @@ class HealthMonitor(BaseService):
             "interval_s": round(self.interval_s, 3),
             "stalled": self.stalled(),
             "storm_active": self.storm_active(),
+            "disk_degraded": self.disk_degraded(),
             "trips": dict(self.trips),
             "bundles": self.bundles,
             "bundle_dir": self.bundle_dir,
@@ -1027,8 +1080,10 @@ def sample(metrics=None) -> dict:
     mon = active_monitor()
     stalled = False
     storm = False
+    disk_degraded = False
     if mon is not None:
         storm = mon.storm_active()
+        disk_degraded = mon.disk_degraded()
         age = s["step_age_s"]
         stalled = mon.stalled() or (
             age is not None and age > mon.stall_after_s
@@ -1050,8 +1105,9 @@ def sample(metrics=None) -> dict:
     gossip_lag = libnetstats.gossip_lag_s()
     m.health_gossip_lag.set(gossip_lag)
     # composite score: 1.0 healthy; a stall zeroes it (liveness lost);
-    # an open breaker or an active recompile storm each cost 0.3
-    # (degraded but live) — documented in docs/observability.md
+    # an open breaker or an active recompile storm each cost 0.3, a
+    # degraded disk 0.2 (degraded but live — the widened propose
+    # timeouts keep commits flowing) — documented in docs/observability.md
     if stalled:
         score = 0.0
     else:
@@ -1060,6 +1116,8 @@ def sample(metrics=None) -> dict:
             score -= 0.3
         if storm:
             score -= 0.3
+        if disk_degraded:
+            score -= 0.2
         score = max(0.0, score)
     m.health_score.set(score)
     return {
@@ -1067,6 +1125,7 @@ def sample(metrics=None) -> dict:
         "stalled": stalled,
         "breaker_open": breaker_open,
         "recompile_storm": storm,
+        "disk_degraded": disk_degraded,
         "verify_wait_p99_s": wait_p99,
         "gossip_lag_p99_s": round(gossip_lag, 6),
         **s,

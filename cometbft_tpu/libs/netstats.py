@@ -318,6 +318,20 @@ class ConnStats:
             self._cols[_C_BYTES_RECV]
         )
 
+    def last_recv_ns(self) -> int:
+        """time_ns of the most recent received message across channels
+        (0 = nothing yet) — the suspicion scorer's staleness signal."""
+        col = self._cols[_C_LAST_RECV]
+        latest = 0
+        for i in range(len(col)):
+            if col[i] > latest:
+                latest = col[i]
+        return latest
+
+    def last_lag_ns(self) -> int:
+        """One-hop lag of the most recent stamped inbound message."""
+        return self.stamp_rx_lag_ns[0]
+
     def queue_full_total(self, channels=None) -> int:
         col = self._cols[_C_QUEUE_FULL]
         if channels is None:

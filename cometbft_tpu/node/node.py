@@ -463,6 +463,10 @@ class Node(BaseService):
         # Health monitor (libs/health): started in _finish_start — the
         # always-on flight recorder + SLO watchdogs + black-box dumps.
         self.health_monitor = None
+        # Peer-health suspicion scorer (p2p/suspicion): started in
+        # _finish_start behind COMETBFT_TPU_SUSPICION — evicts gray
+        # (slow-but-alive) peers off the netstats signals.
+        self.suspicion_scorer = None
         # Light-client proof service (light/service.py): serves
         # light_verify/light_status over the RPC server, funnelling
         # thousands of clients' skipping-verification commit checks
@@ -806,6 +810,9 @@ class Node(BaseService):
                         and self.mempool.size() == 0
                     )
                 ),
+                # slow-disk watchdog signal: this node's own WAL fsync
+                # EWMA state (consensus/wal.py disk_degraded)
+                disk_degraded_fn=self.consensus.wal.disk_degraded,
                 logger=self.logger.with_module("health"),
             )
             try:
@@ -816,7 +823,25 @@ class Node(BaseService):
                 # NotStartedError on a half-booted node, so on_stop
                 # never runs)
                 self.health_monitor = None
-                self._unwind_late_boot()
+                self._unwind_late_services()
+                raise
+        # Peer-health suspicion scorer (p2p/suspicion): acts on the
+        # netstats gray-failure signals by evicting suspect peers
+        # through the switch. Same late-boot posture — nothing below
+        # depends on it, and a failure unwinds the monitor + exporter.
+        from ..p2p import suspicion as p2p_suspicion
+
+        if p2p_suspicion.enabled():
+            try:
+                self.suspicion_scorer = p2p_suspicion.SuspicionScorer(
+                    self.switch,
+                    metrics=self.metrics,
+                    logger=self.logger.with_module("suspicion"),
+                )
+                self.suspicion_scorer.start()
+            except BaseException:
+                self.suspicion_scorer = None
+                self._unwind_late_services()
                 raise
         # Light-client proof service LAST, same leak-safety posture:
         # everything it depends on (stores, RPC env, metrics, the
@@ -840,19 +865,32 @@ class Node(BaseService):
                 self.light_service.start()
             except BaseException:
                 self.light_service = None
-                if self.health_monitor is not None:
-                    try:
-                        if self.health_monitor.is_running():
-                            self.health_monitor.stop()
-                    except Exception:
-                        pass
-                    self.health_monitor = None
-                self._unwind_late_boot()
+                self._unwind_late_services()
                 raise
             self.rpc_env.extra["light_service"] = self.light_service
             self.logger.with_module("light").info(
                 "light proof service serving light_verify/light_status"
             )
+
+    def _unwind_late_services(self) -> None:
+        """Stop every late-boot service started so far (reverse boot
+        order) and release the exporter acquire — the ONE failure path
+        of the _finish_start late-service ladder, so adding a new late
+        service cannot silently miss an earlier one's teardown.  The
+        caller Nones the service whose start just failed before calling
+        (a half-started BaseService raises from stop())."""
+        for attr in (
+            "light_service", "suspicion_scorer", "health_monitor",
+        ):
+            svc = getattr(self, attr)
+            if svc is not None:
+                try:
+                    if svc.is_running():
+                        svc.stop()
+                except Exception:
+                    pass
+                setattr(self, attr, None)
+        self._unwind_late_boot()
 
     def _unwind_late_boot(self) -> None:
         """Release the Prometheus exporter's devstats acquire after a
@@ -925,6 +963,12 @@ class Node(BaseService):
                 except Exception:
                     pass
             libdevstats.release()
+        if self.suspicion_scorer is not None:
+            try:
+                if self.suspicion_scorer.is_running():
+                    self.suspicion_scorer.stop()
+            except Exception:
+                pass
         if self.health_monitor is not None:
             try:
                 if self.health_monitor.is_running():

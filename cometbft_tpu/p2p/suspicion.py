@@ -1,0 +1,324 @@
+"""Peer-health suspicion scorer: the gray-failure defense of the p2p plane.
+
+The reference CometBFT evicts peers that are provably broken (bad
+messages, dead sockets: ``stopPeerForError``), but a *gray* peer — one
+that is connected and handshaking yet saturated, one-directionally
+partitioned, or seconds behind on every message — passes every
+liveness check while quietly degrading consensus.  The netstats layer
+(PR 8) already *sees* these peers: sustained send-queue-full streaks,
+stale last-receive stamps, one-hop propagation-lag outliers.  This
+module *acts* on those signals.
+
+Design: a :class:`SuspicionScorer` (BaseService, one per node, booted
+by node/node.py behind ``COMETBFT_TPU_SUSPICION``) polls the switch's
+live peers every ``interval_s`` and folds three per-peer signals into
+a decaying suspicion score:
+
+* **queue_full** — fresh ``MConnection.send`` drops on a consensus
+  channel since the last check: the peer stopped draining its socket
+  (+1.0 per check it persists);
+* **stale** — no message received from the peer for ``stale_after_s``
+  while at least one *other* peer delivered recently (the one-way
+  partition shape: our sends "succeed", nothing comes back) (+1.0);
+* **lag** — the peer's latest stamped one-hop lag is both a large
+  multiple of the live peer-set's median and above an absolute floor
+  (a slow-but-alive peer, not mutual clock noise) (+0.5).
+
+Scores decay multiplicatively (``decay`` per check), so one bad tick is
+forgiven and only *sustained* misbehavior accumulates — the hysteresis
+that keeps a transient burst from evicting a healthy peer.  At
+``evict_score`` the peer is evicted through the ordinary switch
+machinery (``stop_and_remove_peer``); persistent peers then reconnect
+with fresh sockets and fresh gossip state, which is exactly the
+recovery a gray TCP connection needs.  A per-peer ``cooldown_s`` floor
+between evictions stops a genuinely-broken link from flapping.
+
+Every eviction raises ``p2p_suspicion_evictions_total{reason}`` and
+records an ``EV_FAULT``/``peer_evict`` flight-ring row, so watchdog
+bundles and the postmortem attributor (``peer_evicted`` detector) can
+name the defense when it acts.
+
+The check path takes no lock: peers come from the switch's snapshot
+accessor and every signal is a lock-free read of preallocated netstats
+columns.  All scorer state lives in plain per-peer dicts owned by the
+scorer thread.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..libs import health as libhealth
+from ..libs import metrics as libmetrics
+from ..libs import netstats as libnetstats
+from ..libs.service import BaseService
+
+_ENV_SUSPICION = "COMETBFT_TPU_SUSPICION"
+_ENV_EVICT = "COMETBFT_TPU_SUSPICION_EVICT"
+_ENV_COOLDOWN = "COMETBFT_TPU_SUSPICION_COOLDOWN_S"
+
+_OFF_VALUES = ("0", "off", "false", "no")
+
+DEFAULT_EVICT_SCORE = 3.0
+DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_INTERVAL_S = 1.0
+# per-check multiplicative decay: with +1.0/check from one sustained
+# signal the score converges to 1/(1-decay) = 5.0, crossing the
+# default evict threshold after ~5 consecutive bad checks — and a
+# single transient burst decays back to zero in a few clean ones
+DEFAULT_DECAY = 0.8
+DEFAULT_STALE_AFTER_S = 10.0
+# lag outlier: both relative (vs the peer set's median) and absolute
+# floors must clear — a quiet LAN's microsecond medians must not make
+# a 5 ms hop "suspicious"
+LAG_OUTLIER_MULT = 8.0
+LAG_OUTLIER_FLOOR_S = 0.25
+
+# eviction reason codes (EV_FAULT/peer_evict detail + metrics label);
+# the detail namespace is shared with the other peer-evicting defense —
+# libs/health.PEER_EVICT_STATESYNC_ROTATE (5) marks a statesync
+# chunk-fetch rotation, so codes here must stay below 5
+REASON_QUEUE_FULL = 1
+REASON_STALE = 2
+REASON_LAG = 3
+REASON_MIXED = 4
+_REASON_NAMES = {
+    REASON_QUEUE_FULL: "queue_full",
+    REASON_STALE: "stale",
+    REASON_LAG: "lag",
+    REASON_MIXED: "mixed",
+}
+
+
+def enabled() -> bool:
+    """Whether a booting node should start a scorer (the operator kill
+    switch; default on — the scorer is pure defense and idles free)."""
+    return os.environ.get(_ENV_SUSPICION, "").lower() not in _OFF_VALUES
+
+
+_env_float = libhealth._env_float
+
+
+class SuspicionScorer(BaseService):
+    """Background peer-health watchdog over one node's switch."""
+
+    def __init__(
+        self,
+        switch,
+        metrics=None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        evict_score: float | None = None,
+        cooldown_s: float | None = None,
+        decay: float = DEFAULT_DECAY,
+        stale_after_s: float = DEFAULT_STALE_AFTER_S,
+        lag_outlier_mult: float = LAG_OUTLIER_MULT,
+        lag_floor_s: float = LAG_OUTLIER_FLOOR_S,
+        logger=None,
+    ):
+        super().__init__("SuspicionScorer", logger)
+        self.switch = switch
+        self.metrics = metrics
+        self.interval_s = interval_s
+        self.evict_score = (
+            evict_score
+            if evict_score is not None
+            else _env_float(_ENV_EVICT, DEFAULT_EVICT_SCORE)
+        )
+        self.cooldown_s = (
+            cooldown_s
+            if cooldown_s is not None
+            else _env_float(_ENV_COOLDOWN, DEFAULT_COOLDOWN_S)
+        )
+        self.decay = decay
+        self.stale_after_s = stale_after_s
+        self.lag_outlier_mult = lag_outlier_mult
+        self.lag_floor_s = lag_floor_s
+        # per-peer scorer state (scorer-thread-owned)
+        self._score: dict[str, float] = {}
+        self._qfull_seen: dict[str, int] = {}
+        self._first_seen: dict[str, int] = {}
+        self._last_evict: dict[str, float] = {}
+        self.evictions = 0
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def on_start(self) -> None:
+        t = threading.Thread(
+            target=self._run, name="p2p-suspicion", daemon=True
+        )
+        t.start()
+        self._thread = t
+
+    def on_stop(self) -> None:
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2)
+
+    def _run(self) -> None:
+        quit_ev = self.quit_event()
+        while not quit_ev.is_set():
+            try:
+                self.check_once()
+            except Exception:
+                # a scorer fault must never take the node down
+                import traceback
+
+                traceback.print_exc()
+            quit_ev.wait(self.interval_s)
+
+    # -- evaluation ------------------------------------------------------
+
+    def _peer_rows(self):
+        """(peer, stats) for live peers carrying a netstats block."""
+        out = []
+        for peer in self.switch.peers():
+            mconn = getattr(peer, "mconn", None)
+            stats = getattr(mconn, "stats", None)
+            if stats is not None:
+                out.append((peer, stats))
+        return out
+
+    def check_once(self, now_ns: int | None = None) -> list[dict]:
+        """One scoring pass; returns the evictions performed (empty on
+        a healthy net).  Pure over the switch + netstats state, so
+        tests drive it directly without the thread."""
+        if now_ns is None:
+            now_ns = time.time_ns()
+        rows = self._peer_rows()
+        live_ids = set()
+        # the staleness signal needs the net to be otherwise ACTIVE: a
+        # fully-idle net (nobody sends) must not mark everyone stale
+        freshest_ns = 0
+        lags_s = []
+        for _, stats in rows:
+            last = stats.last_recv_ns()
+            if last > freshest_ns:
+                freshest_ns = last
+            lag = stats.last_lag_ns()
+            if lag > 0:
+                lags_s.append(lag / 1e9)
+        lags_s.sort()
+        median_lag_s = lags_s[len(lags_s) // 2] if lags_s else 0.0
+        evicted: list[dict] = []
+        suspects = 0
+        for peer, stats in rows:
+            pid = peer.id
+            live_ids.add(pid)
+            score = self._score.get(pid, 0.0) * self.decay
+            reasons = 0
+            dominant = 0
+            # -- consecutive send-queue-full streaks
+            qfull = stats.queue_full_total(libnetstats.CONSENSUS_CHANNELS)
+            if qfull > self._qfull_seen.get(pid, 0):
+                score += 1.0
+                reasons += 1
+                dominant = REASON_QUEUE_FULL
+            self._qfull_seen[pid] = qfull
+            # -- stamp staleness while the rest of the net is live; a
+            # peer that NEVER delivered a message (deaf from connect —
+            # the sever pre-dates its first inbound) ages from the
+            # moment the scorer first saw it instead of escaping the
+            # signal on a zero stamp
+            last = stats.last_recv_ns() or self._first_seen.setdefault(
+                pid, now_ns
+            )
+            if (
+                freshest_ns
+                and (now_ns - last) / 1e9 > self.stale_after_s
+                and (now_ns - freshest_ns) / 1e9 < self.stale_after_s
+            ):
+                score += 1.0
+                reasons += 1
+                dominant = dominant or REASON_STALE
+            # -- propagation-lag outlier vs the live peer set
+            lag_s = stats.last_lag_ns() / 1e9
+            if (
+                lag_s > self.lag_floor_s
+                and median_lag_s > 0
+                and lag_s > self.lag_outlier_mult * median_lag_s
+            ):
+                score += 0.5
+                reasons += 1
+                dominant = dominant or REASON_LAG
+            if score < 1e-3:
+                score = 0.0
+            self._score[pid] = score
+            if score > 0:
+                suspects += 1
+            if score >= self.evict_score:
+                last_evict = self._last_evict.get(pid, 0.0)
+                now_s = now_ns / 1e9
+                if now_s - last_evict < self.cooldown_s:
+                    continue
+                reason = dominant if reasons == 1 else REASON_MIXED
+                self._last_evict[pid] = now_s
+                self._score[pid] = 0.0
+                evicted.append(
+                    self._evict(peer, reason, score)
+                )
+        # forget departed peers so churn can't grow the maps unbounded
+        for d in (self._score, self._qfull_seen, self._first_seen):
+            for pid in list(d):
+                if pid not in live_ids:
+                    del d[pid]
+        # eviction stamps persist past departure (an evicted peer is
+        # gone by the next check, and its cooldown must survive the
+        # reconnect) — but an EXPIRED cooldown is meaningless, so churn
+        # can't grow this map either
+        now_s = now_ns / 1e9
+        for pid in list(self._last_evict):
+            if now_s - self._last_evict[pid] > self.cooldown_s:
+                del self._last_evict[pid]
+        m = self.metrics if self.metrics is not None else (
+            libmetrics.node_metrics()
+        )
+        m.p2p_suspect_peers.set(suspects)
+        return evicted
+
+    def _evict(self, peer, reason: int, score: float) -> dict:
+        name = _REASON_NAMES.get(reason, "mixed")
+        m = self.metrics if self.metrics is not None else (
+            libmetrics.node_metrics()
+        )
+        m.p2p_suspicion_evictions.labels(name).inc()
+        self.evictions += 1
+        # the defense acted: annotate the flight ring so bundles and
+        # the postmortem peer_evicted detector can name it
+        libhealth.record(
+            libhealth.EV_FAULT, a=libhealth.FAULT_PEER_EVICT, b=reason
+        )
+        if self.logger is not None:
+            self.logger.error(
+                "evicting suspect peer",
+                peer=peer.id[:10],
+                reason=name,
+                score=round(score, 2),
+            )
+        try:
+            self.switch.stop_and_remove_peer(
+                peer, f"suspicion: {name} (score {score:.2f})"
+            )
+        except Exception:
+            pass
+        return {"peer": peer.id, "reason": name, "score": score}
+
+    def scores(self) -> dict:
+        """Current per-peer suspicion (10-char prefixes; /debug path)."""
+        return {
+            pid[:10]: round(s, 3)
+            for pid, s in self._score.items()
+            if s > 0
+        }
+
+    def status(self) -> dict:
+        return {
+            "running": self.is_running(),
+            "evict_score": self.evict_score,
+            "cooldown_s": self.cooldown_s,
+            "interval_s": self.interval_s,
+            "evictions": self.evictions,
+            "suspects": self.scores(),
+        }

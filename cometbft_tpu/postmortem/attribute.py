@@ -10,6 +10,10 @@ stack can actually see, and the ranked result is the **verdict**:
     injected_partition  a partition overlapped the window
     injected_churn      a node was killed/restarted in the window
     injected_crash      an armed crash point fired in the window
+    gray_partition      a one-DIRECTIONAL sever overlapped the window
+    slow_disk           a slow-but-alive disk fault overlapped the window
+    peer_evicted        a node-side defense evicted a peer (suspicion /
+                        statesync chunk rotation) in the window
     laggard_proposer    the proposal arrived long after its round opened
     slow_gossip_hop     one hop's lag dwarfs the window's typical lag
     verify_stall        the verify-coalescer breaker was open
@@ -159,12 +163,47 @@ def _partition_intervals(annotations: list, end_ns: int) -> list:
     return out
 
 
+def _fault_intervals(
+    annotations: list, end_ns: int, fault_name: str
+) -> list:
+    """[(start_ns, end_ns, row)] for set/clear fault pairs of one
+    gray-failure family (``oneway_sever``/``slow_disk``: ``detail`` > 0
+    opens an episode, 0 — or a ``heal`` row — closes it; an unclosed
+    episode runs to the end of the data).  Episodes are keyed per
+    (src, dst) so concurrent faults of the same family on different
+    nodes/links track independently — a clear on node 1 must not close
+    node 2's still-active episode.  Only explicit ``detail=0`` rows
+    close an episode: ``net.heal()`` emits one per open one-way sever
+    before its ``heal`` row, and slow disks are NOT healed by it, so a
+    bare ``heal`` must not close a still-charging disk fault."""
+    out = []
+    open_rows: dict = {}
+    for a in annotations:
+        if a.get("event") != _FAULT:
+            continue
+        if a.get("fault_name") == fault_name:
+            # fault rows park src/dst (slow_disk: node) in the ring's
+            # h/r columns, decoded as height/round
+            key = (a.get("height"), a.get("round"))
+            if a.get("detail", 0) > 0:
+                open_rows.setdefault(key, a)
+            elif key in open_rows:
+                row = open_rows.pop(key)
+                out.append((row.get("ts", 0), a.get("ts", 0), row))
+    for row in open_rows.values():
+        out.append((row.get("ts", 0), end_ns, row))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
 def _window_findings(
     *,
     t0_ns: int,
     end_ns: int,
     annotations: list,
     partitions: list,
+    gray_intervals: list = (),
+    slow_disk_intervals: list = (),
     lag_samples: list,
     gossip: dict | None,
     proposal_gap_s: float | None,
@@ -209,6 +248,65 @@ def _window_findings(
             "injected_partition",
             0.6 + 0.35 * frac,
             {"overlap_s": round(overlap_ns / 1e9, 6)},
+        ))
+
+    # -- gray (one-directional) partition overlap
+    gray_ns = 0
+    gray_row = None
+    for s, e, row in gray_intervals:
+        ov = max(0, min(e, end_ns) - max(s, t0_ns))
+        if ov > 0 and gray_row is None:
+            gray_row = row
+        gray_ns += ov
+    if gray_ns > 0:
+        frac = min(1.0, gray_ns / (end_ns - t0_ns + 1))
+        findings.append(Finding(
+            "gray_partition",
+            0.6 + 0.35 * frac,
+            {
+                "overlap_s": round(gray_ns / 1e9, 6),
+                # the sever rows park src/dst in the h/r columns
+                "src": (gray_row or {}).get("height"),
+                "dst": (gray_row or {}).get("round"),
+            },
+        ))
+
+    # -- slow-but-alive disk overlap
+    sd_ns = 0
+    sd_row = None
+    for s, e, row in slow_disk_intervals:
+        ov = max(0, min(e, end_ns) - max(s, t0_ns))
+        if ov > 0 and sd_row is None:
+            sd_row = row
+        sd_ns += ov
+    if sd_ns > 0:
+        # floor above laggard_proposer's 0.8 cap: a slow disk overlap
+        # is a DIRECTLY injected/observed fault, and "the proposer was
+        # late" is its symptom, not a competing root cause
+        frac = min(1.0, sd_ns / (end_ns - t0_ns + 1))
+        findings.append(Finding(
+            "slow_disk",
+            0.82 + 0.13 * frac,
+            {
+                "overlap_s": round(sd_ns / 1e9, 6),
+                "node": (sd_row or {}).get("height"),
+                "latency_ms": (sd_row or {}).get("detail"),
+            },
+        ))
+
+    # -- a node-side defense acted (suspicion eviction / statesync
+    # chunk-peer rotation): named, but scored BELOW the injected
+    # faults — the defense is the response, rarely the root cause
+    evictions = [
+        a for a in anns
+        if a.get("event") == _FAULT
+        and a.get("fault_name") == "peer_evict"
+    ]
+    if evictions:
+        findings.append(Finding(
+            "peer_evicted",
+            min(0.5, 0.25 + 0.05 * len(evictions)),
+            {"evictions": len(evictions)},
         ))
 
     # -- churn / crash points
@@ -355,6 +453,12 @@ def attribute(
     run = data["run"]
     annotations = run["annotations"]
     partitions = _partition_intervals(annotations, run["end_ns"])
+    gray_intervals = _fault_intervals(
+        annotations, run["end_ns"], "oneway_sever"
+    )
+    slow_disk_intervals = _fault_intervals(
+        annotations, run["end_ns"], "slow_disk"
+    )
 
     gaps = [g for g in (_proposal_gap_s(hv) for hv in heights)
             if g is not None]
@@ -388,6 +492,8 @@ def attribute(
             end_ns=hv["end_ns"],
             annotations=annotations,
             partitions=partitions,
+            gray_intervals=gray_intervals,
+            slow_disk_intervals=slow_disk_intervals,
             lag_samples=timeline.lag_samples["heights"].get(
                 hv["height"], []
             ),
@@ -410,6 +516,8 @@ def attribute(
         end_ns=run["end_ns"],
         annotations=annotations,
         partitions=partitions,
+        gray_intervals=gray_intervals,
+        slow_disk_intervals=slow_disk_intervals,
         lag_samples=timeline.lag_samples["run"],
         gossip=run.get("gossip"),
         proposal_gap_s=max(gaps) if gaps else None,

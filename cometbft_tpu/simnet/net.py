@@ -278,7 +278,9 @@ class SimNode:
     def height(self) -> int:
         return self.core["block_store"].height() if self.core else 0
 
-    def boot(self, block_sync: bool = False, app=None) -> None:
+    def boot(
+        self, block_sync: bool = False, statesync: bool = False, app=None
+    ) -> None:
         net = self.net
         if app is None and net.app_factory is not None:
             app = net.app_factory(self.idx)
@@ -290,6 +292,7 @@ class SimNode:
             app=app,
             with_evidence=net.with_evidence,
             block_sync=block_sync,
+            statesync=statesync,
             now_fn=net.clock.monotonic,
             clock=net.clock,
         )
@@ -355,6 +358,9 @@ class SimNet:
         topology: str | int = "mesh",
         reconnect_delay_ns: int = 500_000_000,
         app_factory=None,  # f(idx) -> ABCI app (None = per-node kvstore)
+        late: tuple = (),  # node idxs NOT booted by start() — mid-run
+        # joiners for the statesync_join scenario (join_statesync) or
+        # manual node.boot()+start()+connect by the scenario author
     ):
         from ..config import test_config
 
@@ -376,6 +382,7 @@ class SimNet:
         self.reconnect_delay_ns = reconnect_delay_ns
         self.home_root = home_root
         self.app_factory = app_factory
+        self.late = frozenset(late)
         self.nodes = [
             SimNode(
                 self, i,
@@ -386,6 +393,17 @@ class SimNet:
         self._links: dict[tuple[int, int], Link] = {}
         self._adj: set[tuple[int, int]] = set()
         self._partition: dict[int, int] | None = None
+        # gray-failure state: directions severed while the CONNECTION
+        # stays up (asymmetric partition), and per-node virtual disk
+        # latency charged at the libs/fail delay points.  Disk debt is
+        # a per-node BUSY DEADLINE: while a node's virtual disk is
+        # mid-fsync its FSM events (deliveries, tocks) defer to the
+        # deadline — exactly a thread blocked in write_sync — so its
+        # proposals/votes become visible to gossip that much later.
+        self._oneway: set[tuple[int, int]] = set()
+        self._slow_disk: dict[int, tuple[int, int]] = {}
+        self._slow_disk_rng = None
+        self._disk_busy = [0] * n_nodes  # virtual-ns busy deadlines
         self.stats = collections.Counter()
         self._log = os.environ.get(_ENV_LOG, "") in ("1", "on", "true")
         self._events_run = 0
@@ -402,8 +420,12 @@ class SimNet:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        """Boot every node and connect the topology."""
+        """Boot every node (late joiners excepted) and connect the
+        topology among the booted set."""
         self._install_sig_cache()
+        # slow-disk delay points (consensus/wal, store writes) route to
+        # this net for the run's lifetime; stop() uninstalls
+        libfail.set_delay_handler(self._on_delay_point)
         # Flight-ring integration: stamp ring rows from the SHARED
         # virtual clock (exact cross-node merge — the postmortem
         # layer's lossless case) and intern one origin per node so
@@ -416,15 +438,19 @@ class SimNet:
             libhealth.register_origin(f"node{i}") for i in range(self.n)
         ]
         for node in self.nodes:
+            if node.idx in self.late:
+                continue
             prev = self._enter_node(node.idx)
             try:
                 node.boot()
             finally:
                 self._exit_node(prev)
         for node in self.nodes:
-            node.start()
+            if node.idx not in self.late:
+                node.start()
         for i, j in self._topology_edges():
-            self.connect(i, j)
+            if i not in self.late and j not in self.late:
+                self.connect(i, j)
 
     # -- origin bookkeeping (who records the current ring row) ---------
 
@@ -496,6 +522,7 @@ class SimNet:
         if self._stopped:
             return
         self._stopped = True
+        libfail.set_delay_handler(None)
         for node in self.nodes:
             if node.alive:
                 node.shutdown(crash=False)
@@ -638,13 +665,325 @@ class SimNet:
                     node.hub.drop(self.node_id(b), reason)
 
     def heal(self) -> None:
-        """End the partition and re-form the base topology (fresh peers,
-        fresh gossip state — the reconnect a healed TCP net performs)."""
+        """End the partition — full AND asymmetric — and re-form the
+        base topology (fresh peers, fresh gossip state — the reconnect
+        a healed TCP net performs)."""
         self._partition = None
+        for a, b in sorted(self._oneway):
+            self._fault(libhealth.FAULT_ONEWAY, a, b, detail=0)
+        self._oneway.clear()
         self._fault(libhealth.FAULT_HEAL)
         for a, b in self._topology_edges():
             if self.nodes[a].alive and self.nodes[b].alive:
                 self.connect(a, b)
+
+    # -- gray failures: asymmetric severs + slow disks ---------------------
+
+    def sever_oneway(self, src: int, dst: int) -> None:
+        """Asymmetric (gray) partition: kill the ``src -> dst``
+        DIRECTION while the reverse direction — and the connection both
+        ends believe in — stays alive.  The half-dead peer still
+        handshakes, still receives, still thinks it is gossiping; only
+        its counterpart silently hears nothing.  Messages sent (or
+        already in flight) on the dead direction are destroyed and
+        classify as ``drop_partition``.  :meth:`heal` (or
+        :meth:`restore_oneway`) restores the direction."""
+        self._oneway.add((src, dst))
+        self.stats["oneway_severs"] += 1
+        self._fault(libhealth.FAULT_ONEWAY, src, dst, detail=1)
+
+    def restore_oneway(self, src: int, dst: int) -> None:
+        self._oneway.discard((src, dst))
+        self._fault(libhealth.FAULT_ONEWAY, src, dst, detail=0)
+
+    def set_slow_disk(
+        self, idx: int, latency_ns: int, jitter_ns: int = 0
+    ) -> None:
+        """Slow-but-alive disk on node ``idx``: every WAL fsync and
+        store write that node performs (the ``libs/fail`` delay points)
+        charges ``latency_ns`` (± uniform ``jitter_ns``) of VIRTUAL
+        time as disk debt — the node's outbound messages and its own
+        next timeout fire that much later, exactly as if its FSM sat
+        waiting on the volume.  ``latency_ns=0`` clears the fault.
+        Deterministic: jitter draws come from a seeded child rng."""
+        if self._slow_disk_rng is None:
+            self._slow_disk_rng = self.sched.sub_rng("slow-disk")
+        if latency_ns <= 0:
+            self._slow_disk.pop(idx, None)
+            self._fault(libhealth.FAULT_SLOW_DISK, src=idx, detail=0)
+        else:
+            self._slow_disk[idx] = (latency_ns, jitter_ns)
+            self._fault(
+                libhealth.FAULT_SLOW_DISK, src=idx,
+                detail=max(1, latency_ns // 1_000_000),
+            )
+
+    def _on_delay_point(self, name: str) -> None:
+        """libs/fail delay-point handler: push the current node's disk
+        BUSY deadline out by the injected latency — its FSM events
+        (deliveries, tocks) defer past the deadline, exactly a thread
+        blocked in write_sync.  The laggard stays attributable through
+        the slow_disk fault set/clear rows."""
+        idx = self._current_node
+        cfg = self._slow_disk.get(idx)
+        if cfg is None:
+            return
+        latency_ns, jitter_ns = cfg
+        lat = latency_ns
+        if jitter_ns > 0:
+            lat += int(self._slow_disk_rng.random() * jitter_ns)
+        self._disk_busy[idx] = (
+            max(self._disk_busy[idx], self.clock.now_ns) + lat
+        )
+        self.stats["disk_delay_ns"] += lat
+        # no EV_FSYNC row here: the WAL's own instrumentation already
+        # records one per fsync (wall-measured, dropped by virtual-
+        # domain timeline merges), and a second virtual-duration row
+        # would double-count the fsync in ring SLIs — attribution runs
+        # on the slow_disk fault set/clear rows, not fsync rows
+
+    def _disk_lag_ns(self, idx: int) -> int:
+        """How far past ``now`` node ``idx``'s disk is still busy."""
+        return max(0, self._disk_busy[idx] - self.clock.now_ns)
+
+    def mark_storm(self, rate_tx_s: int) -> None:
+        """Annotate the fault plane with a sustained mempool storm
+        starting/stopping (rate 0 = stopped) — the scenario engine
+        calls this around its load generator so postmortems and
+        black-box bundles can name the pressure that was live."""
+        self._fault(libhealth.FAULT_STORM, detail=max(0, rate_tx_s))
+
+    # -- statesync joins (mid-run node bootstrap over the real path) -------
+
+    _STATESYNC_TICK_NS = 20_000_000  # fetch/apply cadence (virtual)
+
+    def join_statesync(
+        self,
+        idx: int,
+        trust_height: int = 1,
+        chunk_timeout_s: float = 1.0,
+        serving: list | None = None,
+    ):
+        """Boot the (late) node ``idx`` mid-run and statesync it to the
+        chain tip over the REAL path: snapshot discovery on channel
+        0x60 → app offer → chunk fetch on 0x61 (with the per-peer
+        failure/rotation plan, on the virtual clock) → light-client
+        verification of the restored app hash against ``trust_height``
+        via store-backed providers on the live peers → bootstrap →
+        switch to blocksync → consensus.  Returns the Syncer (the
+        scenario asserts on its rotation counters)."""
+        from ..light import TrustOptions
+        from ..light.provider import StoreBackedProvider
+        from ..statesync import StateProvider, Syncer
+
+        node = self.nodes[idx]
+        if serving is None:
+            serving = [
+                i for i in range(self.n)
+                if i != idx and self.nodes[i].alive
+            ]
+        if not serving:
+            raise ValueError("statesync join needs at least one live peer")
+        src = self.nodes[serving[0]]
+        meta = src.block_store.load_block_meta(trust_height)
+        if meta is None:
+            raise ValueError(
+                f"no block at trust height {trust_height} on the chain yet"
+            )
+        prev = self._enter_node(idx)
+        try:
+            node.boot(statesync=True)
+            node.start()
+        finally:
+            self._exit_node(prev)
+        chain_id = self.genesis.chain_id
+        providers = [
+            StoreBackedProvider(
+                self.nodes[i].block_store,
+                self.nodes[i].core["state_store"],
+                chain_id,
+            )
+            for i in serving[:2]
+        ]
+        sp = StateProvider(
+            chain_id,
+            self.genesis,
+            providers,
+            TrustOptions(
+                # virtual-epoch headers vs the light client's wall
+                # clock: a decade-scale trusting period keeps every
+                # simulated header inside it (verdicts stay a pure
+                # function of the stores — deterministic)
+                period_ns=10 * 365 * 24 * 3600 * 1_000_000_000,
+                height=trust_height,
+                hash=meta.block_id.hash,
+            ),
+            initial_height=self.genesis.initial_height,
+        )
+        reactor = node.core["reactors"]["statesync"]
+        syncer = Syncer(
+            node.core["conns"].snapshot,
+            node.core["conns"].query,
+            sp,
+            reactor.request_chunk,
+            chunk_timeout=chunk_timeout_s,
+            now_fn=self.clock.monotonic,
+        )
+        reactor.syncer = syncer
+        node.core["syncer"] = syncer
+        node.statesync_state = {
+            "phase": "discover", "snapshot": None,
+            "offer_retries": 0, "finish_tries": 0,
+        }
+        for j in serving:
+            self.connect(idx, j)
+        self.sched.call_after(
+            self._STATESYNC_TICK_NS, self._statesync_tick, idx
+        )
+        return syncer
+
+    def _rebroadcast_snapshot_requests(self, idx: int) -> None:
+        """Ask every connected peer for its current snapshots again
+        (the on-add request only sees what existed at connect time)."""
+        from ..statesync.messages import (
+            SNAPSHOT_CHANNEL,
+            SnapshotsRequestMessage,
+        )
+        from ..types import serialization as _ser
+
+        hub = self.nodes[idx].hub
+        if hub is None:
+            return
+        raw = _ser.dumps(SnapshotsRequestMessage())
+        for peer in hub.peers():
+            peer.try_send(SNAPSHOT_CHANNEL, raw)
+
+    def _statesync_tick(self, idx: int) -> None:
+        """One step of a joiner's restore state machine (discover →
+        restore → finish → switched), re-armed until the handoff to
+        blocksync; the real syncer does the work, this tick only pumps
+        its non-blocking steps in virtual time."""
+        from ..statesync.syncer import (
+            AbortError,
+            AppHashMismatchError,
+            RejectFormatError,
+            RetrySnapshotError,
+            SyncError,
+        )
+
+        node = self.nodes[idx]
+        if self._stopped or not node.alive:
+            return
+        st = node.statesync_state
+        syncer = node.core["syncer"]
+        prev = self._enter_node(idx)
+        try:
+            phase = st["phase"]
+            if phase == "discover":
+                snap = syncer.pool.best()
+                if snap is None:
+                    # periodic re-discovery: a snapshot that went stale
+                    # (pruned by the app while we fetched) was rejected,
+                    # and the live peers have NEWER ones to advertise
+                    st["discover_ticks"] = st.get("discover_ticks", 0) + 1
+                    if st["discover_ticks"] % 25 == 0:
+                        self._rebroadcast_snapshot_requests(idx)
+                else:
+                    try:
+                        # attempts=1: the provider retry loop sleeps
+                        # REAL time, which would freeze the scheduler —
+                        # this tick retries on the virtual clock instead
+                        syncer.begin(snap, provider_attempts=1)
+                        st["snapshot"] = snap
+                        st["phase"] = "restore"
+                        st["restore_start_ns"] = self.clock.now_ns
+                        st["begin_tries"] = 0
+                        # each snapshot gets its own RETRY_SNAPSHOT
+                        # allowance (a fresh offer, a fresh app verdict)
+                        st["offer_retries"] = 0
+                    except RejectFormatError:
+                        syncer.pool.reject_format(snap.format)
+                    except (AbortError, AppHashMismatchError) as e:
+                        self._on_node_fatal(idx, e)
+                        return
+                    except SyncError:
+                        # young tip: the trusted app hash needs header
+                        # H+1, which appears as the chain grows — keep
+                        # ticking rather than rejecting a good
+                        # snapshot (bounded, then re-discover)
+                        st["begin_tries"] = st.get("begin_tries", 0) + 1
+                        if st["begin_tries"] > 100:
+                            st["begin_tries"] = 0
+                            syncer.pool.reject(snap)
+            elif phase == "restore":
+                snap = st["snapshot"]
+                budget_ns = int(
+                    syncer.chunk_timeout * max(1, snap.chunks) * 4 * 1e9
+                )
+                if self.clock.now_ns - st["restore_start_ns"] > budget_ns:
+                    # every serving peer exhausted its chances (a stale
+                    # snapshot the apps pruned, or all chunk paths
+                    # gray): reject and re-discover a fresh one
+                    syncer.abort_restore()
+                    syncer.pool.reject(snap)
+                    st["phase"] = "discover"
+                    self._rebroadcast_snapshot_requests(idx)
+                    self.sched.call_after(
+                        self._STATESYNC_TICK_NS, self._statesync_tick, idx
+                    )
+                    return
+                try:
+                    syncer.step_fetch()
+                    if syncer.step_apply():
+                        syncer.abort_restore()
+                        st["phase"] = "finish"
+                except RetrySnapshotError:
+                    syncer.abort_restore()
+                    st["offer_retries"] += 1
+                    if st["offer_retries"] >= 3:
+                        syncer.pool.reject(st["snapshot"])
+                    st["phase"] = "discover"
+                except (AbortError, AppHashMismatchError) as e:
+                    self._on_node_fatal(idx, e)
+                    return
+                except SyncError:
+                    syncer.abort_restore()
+                    syncer.pool.reject(st["snapshot"])
+                    st["phase"] = "discover"
+            elif phase == "finish":
+                try:
+                    state, commit = syncer.finish(
+                        st["snapshot"], provider_attempts=1
+                    )
+                except (AbortError, AppHashMismatchError) as e:
+                    self._on_node_fatal(idx, e)
+                    return
+                except SyncError:
+                    # young tip: the providers need blocks H+1/H+2 —
+                    # keep ticking while the chain grows past them
+                    st["finish_tries"] += 1
+                    if st["finish_tries"] > 500:
+                        self._on_node_fatal(
+                            idx,
+                            RuntimeError("statesync finish never verified"),
+                        )
+                        return
+                else:
+                    node.core["state_store"].bootstrap(state)
+                    node.core["block_store"].save_seen_commit(commit)
+                    bsr = node.core["reactors"]["blocksync"]
+                    bsr.switch_to_block_sync(state)
+                    st["phase"] = "switched"
+                    self._schedule_blocksync_tick(
+                        idx, _BLOCKSYNC_APPLIED_NS
+                    )
+                    return
+        finally:
+            self._exit_node(prev)
+        self.sched.call_after(
+            self._STATESYNC_TICK_NS, self._statesync_tick, idx
+        )
+
 
     def kill(self, idx: int, crash: bool = True) -> None:
         """Churn: take node ``idx`` down mid-whatever.  In-flight
@@ -660,6 +999,7 @@ class SimNet:
                 if other.hub is not None:
                     other.hub.drop(self.node_id(b), "peer killed")
         node.shutdown(crash=crash)
+        self._disk_busy[idx] = 0  # a dead node's disk owes nothing
         self.stats["kills"] += 1
         self._fault(libhealth.FAULT_KILL, src=idx)
 
@@ -736,6 +1076,11 @@ class SimNet:
         # so a partitioned pair already failed the _adj check above;
         # in-flight messages racing a fresh partition are classified at
         # delivery time (_deliver)
+        if (src, dst) in self._oneway:
+            # asymmetric sever: the direction is dead but the sender
+            # has no way to know — the wire ate it (gray partition)
+            self._drop(DROP_PARTITION, src, dst, ch)
+            return True
         link = self._link(src, dst)
         if link.cfg.drop_classes:
             try:
@@ -745,14 +1090,20 @@ class SimNet:
             if cls in link.cfg.drop_classes:
                 self._drop(DROP_CLASS, src, dst, ch)
                 return True  # the wire ate it; the sender can't tell
+        # slow-disk debt: a sender whose virtual disk is still busy
+        # puts this message on the wire only after the disk returns
+        lag = self._disk_lag_ns(src)
         deliver_at, dup_at, reason = link.plan(
-            self.clock.now_ns, ch, len(msg)
+            self.clock.now_ns + lag, ch, len(msg)
         )
         if reason is not None:
             self._drop(reason, src, dst, ch)
             return True
         self.stats["sent"] += 1
-        sent_ns = self.clock.now_ns
+        # stamp the VIRTUAL wire-entry moment (incl. disk debt): the
+        # per-hop gossip-lag rows measure the LINK, not the sender's
+        # disk — the slow_disk postmortem detector owns that signal
+        sent_ns = self.clock.now_ns + lag
         self.sched.call_at(
             deliver_at, self._deliver, src, dst, ch, msg, sent_ns
         )
@@ -773,7 +1124,10 @@ class SimNet:
 
     def _in_flight_drop_reason(self, src: int, dst: int) -> str:
         """An undeliverable in-flight message died either to a partition
-        that formed under it or to endpoint churn/eviction."""
+        (full or one-directional) that formed under it or to endpoint
+        churn/eviction."""
+        if (src, dst) in self._oneway:
+            return DROP_PARTITION
         if self._partition is not None and (
             self._partition.get(src) != self._partition.get(dst)
         ):
@@ -784,8 +1138,21 @@ class SimNet:
         self, src: int, dst: int, ch: int, msg: bytes, sent_ns: int = 0
     ) -> None:
         node = self.nodes[dst]
+        if (src, dst) in self._oneway:
+            # a one-way sever that formed under an in-flight message
+            # destroys it (the TCP stream it rode is half-dead)
+            self._drop(DROP_PARTITION, src, dst, ch)
+            return
         if self._stopped or not node.alive:
             self._drop(self._in_flight_drop_reason(src, dst), src, dst, ch)
+            return
+        busy = self._disk_busy[dst]
+        if busy > self.clock.now_ns:
+            # the receiver's FSM thread is blocked on its virtual disk:
+            # processing (not the wire) waits for the deadline
+            self.sched.call_at(
+                busy, self._deliver, src, dst, ch, msg, sent_ns
+            )
             return
         peer = node.hub.get_peer(self.node_id(src))
         if peer is None or not peer.is_running():
@@ -822,6 +1189,12 @@ class SimNet:
     def _tock(self, idx: int, ti) -> None:
         node = self.nodes[idx]
         if self._stopped or not node.alive:
+            return
+        busy = self._disk_busy[idx]
+        if busy > self.clock.now_ns:
+            # FSM blocked on its virtual disk: the timeout fires when
+            # the thread comes back (exactly a wedged receive loop)
+            self.sched.call_at(busy, self._tock, idx, ti)
             return
         cs = node.cs
         try:

@@ -97,6 +97,7 @@ def build_core(
     app=None,
     with_evidence: bool = True,
     block_sync: bool = False,
+    statesync: bool = False,
     now_fn=None,
     clock=None,
 ):
@@ -106,6 +107,14 @@ def build_core(
     ``block_sync=True`` builds the node in catching-up mode: the
     consensus reactor starts with ``wait_sync`` and a BlocksyncReactor
     drives the pool until it switches to consensus.
+
+    ``statesync=True`` builds a mid-run JOINER: consensus parks behind
+    ``wait_sync`` and blocksync stays idle until the snapshot restore
+    hands it a state (``switch_to_block_sync``) — the net's
+    ``join_statesync`` drives the real statesync reactor/syncer over
+    virtual links.  Every node carries a server-role StatesyncReactor
+    regardless (answering snapshot/chunk requests from the app, like
+    node.go does).
     """
     from .. import proxy
     from ..abci.kvstore import KVStoreApplication
@@ -117,6 +126,7 @@ def build_core(
     from ..evidence.reactor import EvidenceReactor
     from ..libs import db as dbm
     from ..state import BlockExecutor, Store, make_genesis_state
+    from ..statesync import StatesyncReactor
     from ..store import BlockStore
     from ..types.event_bus import EventBus
 
@@ -176,7 +186,9 @@ def build_core(
     cs.set_priv_validator(pv)
     cs.sim_driven = True
 
-    consensus_reactor = ConsensusReactor(cs, wait_sync=block_sync)
+    consensus_reactor = ConsensusReactor(
+        cs, wait_sync=block_sync or statesync
+    )
     reactors: dict[str, object] = {"consensus": consensus_reactor}
     if evidence_pool is not None:
         reactors["evidence"] = EvidenceReactor(evidence_pool)
@@ -194,6 +206,12 @@ def build_core(
     )
     bsr.sim_driven = True
     reactors["blocksync"] = bsr
+    # Statesync server role on every node (snapshots come from the
+    # app's ListSnapshots/LoadSnapshotChunk); a joiner's Syncer is
+    # attached by SimNet.join_statesync.
+    reactors["statesync"] = StatesyncReactor(conns.snapshot)
+    if statesync:
+        bsr.synced.clear()  # parked-for-statesync is NOT synced
     return dict(
         app=app,
         conns=conns,

@@ -26,6 +26,23 @@ cometbft_tpu.simnet``):
   validator set mid-run, then evict a genesis validator;
 * ``blocksync_catchup`` — a churned node rejoins via blocksync while a
   serving peer dies mid-sync.
+
+Gray-failure family (PR 13 — slow-but-alive and asymmetric faults):
+
+* ``gray_partition`` — ONE direction of one link is severed while the
+  connection stays up; the chain must keep committing via the live
+  direction plus relay through the other peers, and heal() restores
+  both directions;
+* ``slow_disk`` — one validator's WAL fsyncs/store writes carry
+  50–500 virtual ms of injected latency (libs/fail delay points on the
+  sim clock): the chain slows but never stalls, and the laggard is
+  attributable;
+* ``statesync_join`` — a fresh node joins a grown chain mid-run over
+  the REAL statesync path (snapshot offer → chunk fetch → light verify
+  → switch to blocksync → consensus), surviving an injected
+  chunk-peer failure via the fetch plan's rotation;
+* ``mempool_storm`` — sustained tx pressure through commit churn: the
+  chain keeps committing, committed txs drain from every mempool.
 """
 
 from __future__ import annotations
@@ -571,6 +588,303 @@ def scenario_blocksync_catchup(seed: int, n_nodes: int = 4, **_):
     return run.finish()
 
 
+# ---------------------------------------------------- gray failures
+
+
+def scenario_gray_partition(seed: int, n_nodes: int = 4,
+                            heights_after: int = 3, **_):
+    """Asymmetric (one-directional) partition: node 0's messages to
+    node 1 vanish while 1 -> 0 stays alive and BOTH ends keep the
+    connection.  Consensus must keep committing — node 1 still learns
+    0's votes via relay through the other peers (the consensus
+    reactor's ordinary vote gossip) — and heal() restores the severed
+    direction."""
+    run = _Run("gray_partition", seed)
+    net = run.net = SimNet(n_nodes, seed=seed)
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    h_sever = max(net.heights())
+    net.sever_oneway(0, 1)
+    # liveness THROUGH the gray failure: every node, including the
+    # half-deaf node 1, keeps committing
+    run.check(
+        net.run_until_height(
+            h_sever + heights_after, max_virtual_ms=240_000
+        ),
+        f"stall under one-way sever: {net.heights()}",
+    )
+    eaten = net.stats.get("drop_partition", 0)
+    run.check(eaten > 0, "the dead direction never ate a message")
+    run.notes["oneway_drops"] = eaten
+    net.heal()
+    target = max(net.heights()) + 2
+    run.check(
+        net.run_until_height(target, max_virtual_ms=120_000),
+        f"no progress after heal: {net.heights()}",
+    )
+    # both directions live again: no NEW drop_partition classifications
+    run.check(
+        net.stats.get("drop_partition", 0) == eaten
+        or net.stats.get("drop_partition", 0) <= eaten + 2,
+        "dead-direction drops kept accruing after heal",
+    )
+    return run.finish()
+
+
+def scenario_slow_disk(seed: int, n_nodes: int = 4, latency_ms: int = 120,
+                       jitter_ms: int = 30, heights_after: int = 4, **_):
+    """One validator's disk turns slow-but-alive: every WAL fsync and
+    store write on node 1 charges ``latency_ms`` (± jitter) of virtual
+    time (libs/fail delay points on the sim clock).  The chain SLOWS —
+    the laggard's votes and proposals hit the wire late, its proposal
+    rounds may expire — but must never stall; the laggard falls
+    observably behind the committing quorum and catches back up once
+    the disk heals."""
+    run = _Run("slow_disk", seed, homes=True)
+    net = run.net = SimNet(n_nodes, seed=seed, home_root=run.home_root)
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    victim = 1
+    survivors = [i for i in range(n_nodes) if i != victim]
+    h_slow = max(net.heights())
+    t_slow = net.clock.now_ns
+    ms = 1_000_000
+    net.set_slow_disk(victim, latency_ms * ms, jitter_ms * ms)
+    # liveness claim: the CHAIN keeps committing (quorum without the
+    # laggard; its proposal rounds expire and rotate) — measured on the
+    # survivors, because the victim itself crawls at disk speed
+    run.check(
+        net.run_until_height(
+            h_slow + heights_after, nodes=survivors,
+            max_virtual_ms=600_000,
+        ),
+        f"chain STALLED under a slow disk: {net.heights()}",
+    )
+    slow_virtual_ms = (net.clock.now_ns - t_slow) / 1e6
+    # the laggard is OBSERVABLE: it fell behind the committing quorum
+    run.check(
+        net.nodes[victim].height() < max(net.heights()),
+        f"victim never lagged: {net.heights()}",
+    )
+    run.notes["victim_lag_heights"] = (
+        max(net.heights()) - net.nodes[victim].height()
+    )
+    net.set_slow_disk(victim, 0)
+    # recovery: the healed laggard catches back up to the tip first —
+    # only THEN does the healthy-phase clock start, so the laggard's
+    # catch-up rounds (its proposer slots expire until it reaches the
+    # tip) are not charged to the healthy baseline the fault phase is
+    # compared against
+    run.check(
+        net.run(
+            until=lambda: (
+                net.nodes[victim].height() >= max(net.heights())
+            ),
+            max_virtual_ms=240_000,
+        ),
+        f"laggard never caught up after the disk healed: {net.heights()}",
+    )
+    h_clear = max(net.heights())
+    t_clear = net.clock.now_ns
+    # ...and the whole net advances together
+    run.check(
+        net.run_until_height(h_clear + heights_after,
+                             max_virtual_ms=240_000),
+        f"no recovery after the disk healed: {net.heights()}",
+    )
+    clear_virtual_ms = (net.clock.now_ns - t_clear) / 1e6
+    run.notes["slow_phase_ms_per_height"] = round(
+        slow_virtual_ms / heights_after, 1
+    )
+    run.notes["healthy_phase_ms_per_height"] = round(
+        clear_virtual_ms / heights_after, 1
+    )
+    # the fault must have COST something: real virtual latency charged
+    # at the delay points (the wall-clock phase comparison above stays
+    # a NOTE — whether one laggard's expired propose rounds slow the
+    # survivors' 4-height window beyond cadence noise is seed-luck,
+    # and the tier-1 smoke pins the slowdown at its fixed seed)
+    run.notes["disk_delay_ms"] = round(
+        net.stats.get("disk_delay_ns", 0) / 1e6, 1
+    )
+    run.check(
+        net.stats.get("disk_delay_ns", 0) > 0,
+        "slow disk charged no virtual latency at the delay points",
+    )
+    return run.finish()
+
+
+def scenario_statesync_join(seed: int, n_nodes: int = 5,
+                            pre_heights: int = 12,
+                            tail_heights: int = 3,
+                            snapshot_interval: int = 5, **_):
+    """A fresh full node joins a grown chain mid-run through the real
+    statesync path: snapshot discovery over channel 0x60, app offer,
+    chunk fetch over 0x61 (surviving an injected chunk-peer failure
+    via the fetch plan's peer rotation), light-client verification of
+    the restored app hash against a height-1 trust root served by the
+    live peers' stores, then blocksync to the tip and consensus
+    follow.  The pre-snapshot blocks are never fetched — the proof the
+    restore came from the snapshot, not replay."""
+    import dataclasses
+
+    from ..abci.kvstore import KVStoreApplication
+    from ..config import test_config
+    from ..statesync.messages import CHUNK_CHANNEL
+
+    run = _Run("statesync_join", seed)
+    joiner = n_nodes - 1
+    genesis, pvs = make_genesis(n_nodes - 1)
+    # Slower (latency-tolerant) consensus timeouts: the statesync
+    # machinery runs on 100s-of-ms virtual timescales (chunk timeouts,
+    # rotation backoff) — with millisecond heights the app would prune
+    # the advertised snapshot mid-restore and turn the scenario into a
+    # permanent stale-chase.  ~200 ms heights keep the snapshot window
+    # (snapshot_interval * 2 heights) comfortably wider than one full
+    # fetch-rotate-fetch cycle.
+    ms = 1_000_000
+    cfg = test_config()
+    cfg.consensus = dataclasses.replace(
+        cfg.consensus,
+        timeout_propose_ns=150 * ms,
+        timeout_propose_delta_ns=50 * ms,
+        timeout_prevote_ns=80 * ms,
+        timeout_prevote_delta_ns=40 * ms,
+        timeout_precommit_ns=80 * ms,
+        timeout_precommit_delta_ns=40 * ms,
+        timeout_commit_ns=20 * ms,
+        skip_timeout_commit=False,
+        # match the gossip cadence to the slower heights: 5 ms ticks
+        # against 200 ms heights would quadruple the event count for
+        # zero protocol effect
+        peer_gossip_sleep_duration_ns=20 * ms,
+        peer_query_maj23_sleep_duration_ns=40 * ms,
+    )
+    net = run.net = SimNet(
+        n_nodes, seed=seed, config=cfg, genesis=genesis, pvs=pvs,
+        late=(joiner,),
+        app_factory=lambda idx: KVStoreApplication(
+            snapshot_interval=snapshot_interval
+        ),
+    )
+    net.start()
+    run.check(
+        net.run_until_height(
+            pre_heights, nodes=list(range(n_nodes - 1)),
+            max_virtual_ms=600_000,
+        ),
+        f"chain never grew to {pre_heights}: {net.heights()}",
+    )
+    # gray chunk peer: node 0 answers snapshot offers but its chunk
+    # RESPONSES vanish — the fetch plan must time out, charge node 0 a
+    # failure, and rotate to the next serving peer
+    net.set_link(0, joiner, symmetric=False,
+                 drop_channels=frozenset({CHUNK_CHANNEL}))
+    syncer = net.join_statesync(joiner, trust_height=1,
+                                chunk_timeout_s=0.5)
+    bsr = None
+
+    def switched() -> bool:
+        node = net.nodes[joiner]
+        return (
+            node.alive
+            and node.statesync_state["phase"] == "switched"
+        )
+
+    run.check(
+        net.run(until=switched, max_virtual_ms=600_000),
+        f"statesync never switched to blocksync: "
+        f"{net.nodes[joiner].statesync_state if net.nodes[joiner].core else None}",
+    )
+    if net.nodes[joiner].core is not None:
+        bsr = net.nodes[joiner].core["reactors"]["blocksync"]
+        run.check(
+            net.run(
+                until=lambda: bsr.synced.is_set(), max_virtual_ms=600_000
+            ),
+            f"blocksync tail never finished: {net.heights()}",
+        )
+        snap_h = net.nodes[joiner].statesync_state["snapshot"].height
+        run.notes["snapshot_height"] = snap_h
+        run.notes["blocks_synced"] = bsr._n_synced
+        run.notes["chunk_peer_rotations"] = syncer.fetch_rotations()
+        # the defense was exercised: at least one chunk-peer failure
+        # survived via rotation
+        run.check(
+            syncer.fetch_rotations() >= 1,
+            "no chunk-peer rotation happened (gray peer unexercised)",
+        )
+        # statesync, not replay: the early blocks were never fetched
+        run.check(
+            net.nodes[joiner].block_store.load_block(2) is None,
+            "joiner fetched pre-snapshot blocks (blocksync-from-genesis?)",
+        )
+        run.check(
+            net.nodes[joiner].block_store.height() >= snap_h,
+            f"joiner below snapshot height: {net.heights()}",
+        )
+    # the joined node must now FOLLOW consensus with the validators
+    target = max(net.heights()) + tail_heights
+    run.check(
+        net.run_until_height(target, max_virtual_ms=600_000),
+        f"joiner does not follow consensus: {net.heights()}",
+    )
+    return run.finish()
+
+
+def scenario_mempool_storm(seed: int, n_nodes: int = 4, rate: int = 2000,
+                           burst: int = 10, storm_heights: int = 6, **_):
+    """Sustained CheckTx-pressure analog through commit churn: a
+    high-rate seeded load generator floods every node's mempool for
+    the whole run.  The chain must keep committing, blocks must carry
+    txs, and committed txs must drain from every mempool (the commit
+    churn path) — pressure degrades throughput, never liveness."""
+    from ..e2e.load import SimLoadGenerator, sim_load_report
+
+    run = _Run("mempool_storm", seed)
+    net = run.net = SimNet(n_nodes, seed=seed)
+    net.start()
+    run.check(
+        net.run_until_height(2, max_virtual_ms=60_000),
+        f"no baseline progress {net.heights()}",
+    )
+    gen = SimLoadGenerator(
+        net, rate=rate, burst=burst, run_id=f"storm-{seed}"
+    )
+    net.mark_storm(rate)
+    gen.start()
+    h0 = max(net.heights())
+    run.check(
+        net.run_until_height(h0 + storm_heights, max_virtual_ms=600_000),
+        f"chain stalled under the storm: {net.heights()}",
+    )
+    gen.stop()
+    net.mark_storm(0)
+    rep = sim_load_report(net, gen.run_id)
+    run.notes["txs_sent"] = gen.sent
+    run.notes["txs_committed"] = rep.txs
+    run.notes["tx_latency_p50_ms"] = (
+        round(rep.quantile(0.5) * 1e3, 1) if rep.latencies_s else None
+    )
+    run.check(rep.txs > 0, "no storm tx ever committed")
+    # commit churn: committed txs must leave the mempools
+    sizes = [
+        n.core["mempool"].size() for n in net.nodes if n.core is not None
+    ]
+    run.notes["mempool_sizes"] = sizes
+    run.check(
+        all(s < gen.sent for s in sizes),
+        f"mempools never drained: {sizes}",
+    )
+    return run.finish()
+
+
 SCENARIOS = {
     "healthy": scenario_healthy,
     "byzantine_double_sign": scenario_byzantine_double_sign,
@@ -578,6 +892,10 @@ SCENARIOS = {
     "crash_restart": scenario_crash_restart,
     "valset_churn": scenario_valset_churn,
     "blocksync_catchup": scenario_blocksync_catchup,
+    "gray_partition": scenario_gray_partition,
+    "slow_disk": scenario_slow_disk,
+    "statesync_join": scenario_statesync_join,
+    "mempool_storm": scenario_mempool_storm,
 }
 
 

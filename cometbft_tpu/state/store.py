@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 
 from ..libs import db as dbm
+from ..libs import fail as libfail
 from ..types import serialization as ser
 from ..types.validator_set import ValidatorSet
 from .state import State
@@ -34,6 +35,7 @@ class Store:
     def save(self, state: State) -> None:
         """Persist state + the validator/params records for the heights the
         snapshot implies (store.go:182 save)."""
+        libfail.delay_point("store-write")  # slow-disk injection seam
         batch = self.db.new_batch()
         next_height = state.last_block_height + 1
         if next_height == state.initial_height:

@@ -15,9 +15,25 @@ import tempfile
 from ..libs import sync as libsync
 
 
+DEFAULT_MAX_RETRIES = 8
+
+
+class ChunkRetryLimitError(Exception):
+    """One chunk index exceeded its retry cap: the snapshot is poisoned
+    (an app that answers RETRY forever, or a chunk no peer can serve
+    correctly) and the sync must fail CLEANLY instead of re-enqueueing
+    the same index until the heat death of the deadline."""
+
+
 class ChunkQueue:
-    def __init__(self, n_chunks: int, temp_dir: str | None = None):
+    def __init__(
+        self,
+        n_chunks: int,
+        temp_dir: str | None = None,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+    ):
         self.n_chunks = n_chunks
+        self.max_retries = max_retries
         self._dir = tempfile.mkdtemp(
             prefix="cometbft-tpu-statesync-", dir=temp_dir
         )
@@ -26,6 +42,7 @@ class ChunkQueue:
         self._next = 0
         self._closed = False
         self._returned: set[int] = set()
+        self._retries: dict[int, int] = {}  # index -> retry() count
 
     def _path(self, index: int) -> str:
         return os.path.join(self._dir, str(index))
@@ -120,8 +137,20 @@ class ChunkQueue:
 
     def retry(self, index: int) -> None:
         """Re-request from ``index`` on (refetch semantics of
-        ApplySnapshotChunkResult.RETRY / refetch_chunks)."""
+        ApplySnapshotChunkResult.RETRY / refetch_chunks).
+
+        Raises :class:`ChunkRetryLimitError` once ``index`` has been
+        retried ``max_retries`` times — a poisoned chunk (the app keeps
+        rejecting every copy) must fail the sync cleanly so the syncer
+        can reject the snapshot and rotate, not loop forever."""
         with self._mtx:
+            count = self._retries.get(index, 0) + 1
+            if count > self.max_retries:
+                raise ChunkRetryLimitError(
+                    f"chunk {index} retried {count - 1} times "
+                    f"(cap {self.max_retries}) — poisoned snapshot"
+                )
+            self._retries[index] = count
             self._next = min(self._next, index)
             for i in list(self._peers):
                 if i >= index:
@@ -130,6 +159,10 @@ class ChunkQueue:
                         os.remove(self._path(i))
                     except OSError:
                         pass
+
+    def retry_count(self, index: int) -> int:
+        with self._mtx:
+            return self._retries.get(index, 0)
 
     def pending(self) -> list[int]:
         """Indexes not yet stored nor consumed (fetch targets)."""

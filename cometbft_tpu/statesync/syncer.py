@@ -7,6 +7,24 @@ Reference: statesync/syncer.go:145-516. Flow per snapshot (best first):
   restored app hash against the light-client-verified header → hand back
   (state, commit) for the node to bootstrap stores and fall into
   blocksync/consensus.
+
+Gray-failure hardening (PR 13): chunk fetching carries **per-peer
+failure accounting** (:class:`ChunkFetchPlan`) — a request that times
+out counts a consecutive failure against the peer that owned it, each
+failure puts that peer into exponential backoff (base
+``COMETBFT_TPU_STATESYNC_BACKOFF_S``, doubling, capped), and the
+re-request **rotates** to the next serving peer.  The old behavior —
+re-asking the same dead peer at fixed cadence forever — made a single
+half-alive snapshot server fatal to the whole restore.  A successful
+chunk delivery clears the sender's failure streak.
+
+The fetch/apply control flow is also factored into non-blocking steps
+(:meth:`Syncer.begin` / :meth:`Syncer.step_fetch` /
+:meth:`Syncer.step_apply` / :meth:`Syncer.finish`), so the simnet
+scheduler can drive a REAL statesync restore in virtual time (the
+``statesync_join`` scenario) while the live node keeps the thread +
+blocking-wait loop (:meth:`sync_any`) built from the same pieces.
+``now_fn`` injects the clock both paths share.
 """
 
 from __future__ import annotations
@@ -16,8 +34,19 @@ from ..libs import sync as libsync
 import time
 
 from ..abci import types as abci
-from .chunks import ChunkQueue
+from ..libs import health as libhealth
+from .chunks import ChunkQueue, ChunkRetryLimitError
 from .snapshots import Snapshot, SnapshotPool
+
+_ENV_BACKOFF = "COMETBFT_TPU_STATESYNC_BACKOFF_S"
+DEFAULT_BACKOFF_S = 1.0
+BACKOFF_MAX_S = 30.0
+
+
+def _backoff_base_s() -> float:
+    return max(
+        0.05, libhealth._env_float(_ENV_BACKOFF, DEFAULT_BACKOFF_S)
+    )
 
 
 class SyncError(Exception):
@@ -48,6 +77,99 @@ class AbortError(SyncError):
     """App demanded the sync stop (syncer.go errAbort): terminal."""
 
 
+class ChunkFetchPlan:
+    """Per-restore chunk-request bookkeeping with peer rotation.
+
+    Owned by ONE requester (the live fetch thread or the sim tick);
+    ``note_delivery`` may be called from the reactor's receive path and
+    only appends to a list (GIL-atomic), which the owner drains.
+    """
+
+    def __init__(
+        self,
+        chunk_timeout: float,
+        backoff_base_s: float | None = None,
+        backoff_max_s: float = BACKOFF_MAX_S,
+    ):
+        self.chunk_timeout = chunk_timeout
+        self.backoff_base_s = (
+            backoff_base_s if backoff_base_s is not None
+            else _backoff_base_s()
+        )
+        self.backoff_max_s = backoff_max_s
+        # index -> [last_request_time, attempts, peer]
+        self._idx: dict[int, list] = {}
+        # peer -> consecutive timed-out requests / backed-off-until
+        self.failures: dict[str, int] = {}
+        self._banned_until: dict[str, float] = {}
+        self._delivered: list[str] = []  # drained by the owner
+        self.rotations = 0
+
+    def note_delivery(self, peer_id: str) -> None:
+        """A chunk from ``peer_id`` was accepted into the queue (called
+        from the reactor path — append only)."""
+        self._delivered.append(peer_id)
+
+    def _drain_deliveries(self) -> None:
+        while self._delivered:
+            peer = self._delivered.pop()
+            self.failures.pop(peer, None)
+            self._banned_until.pop(peer, None)
+
+    def _pick_peer(self, index: int, attempts: int, peers: list, now: float):
+        """Rotate: the attempt count walks the (sorted) peer list, and
+        peers in backoff are skipped while any alternative exists."""
+        usable = [
+            p for p in peers if now >= self._banned_until.get(p, 0.0)
+        ]
+        pool = usable if usable else peers
+        return pool[(index + attempts) % len(pool)]
+
+    def due(self, pending: list, peers: list, now: float) -> list:
+        """-> [(index, peer)] requests to fire now.  A pending index
+        whose last request aged past ``chunk_timeout`` counts one
+        consecutive failure against the peer that owned the request,
+        puts that peer into exponential backoff, and rotates."""
+        self._drain_deliveries()
+        if not peers:
+            return []
+        out = []
+        for index in pending:
+            ent = self._idx.get(index)
+            if ent is None:
+                peer = self._pick_peer(index, 0, peers, now)
+                self._idx[index] = [now, 0, peer]
+                out.append((index, peer))
+                continue
+            last, attempts, owner = ent
+            if now - last < self.chunk_timeout:
+                continue
+            # timed out: charge the owner, back it off, rotate
+            fails = self.failures.get(owner, 0) + 1
+            self.failures[owner] = fails
+            self._banned_until[owner] = now + min(
+                self.backoff_max_s,
+                self.backoff_base_s * (2 ** (fails - 1)),
+            )
+            attempts += 1
+            peer = self._pick_peer(index, attempts, peers, now)
+            if peer != owner:
+                self.rotations += 1
+            self._idx[index] = [now, attempts, peer]
+            out.append((index, peer))
+        return out
+
+    def forget(self, index: int) -> None:
+        """Chunk applied (or rewound): drop its request bookkeeping so
+        a later re-fetch starts fresh and immediate."""
+        self._idx.pop(index, None)
+
+    def forget_from(self, index: int) -> None:
+        for i in list(self._idx):
+            if i >= index:
+                del self._idx[i]
+
+
 class Syncer:
     def __init__(
         self,
@@ -57,6 +179,8 @@ class Syncer:
         request_chunk,  # f(peer_id, snapshot, index) -> None (reactor send)
         chunk_timeout: float = 10.0,
         discovery_time: float = 5.0,
+        now_fn=None,
+        backoff_base_s: float | None = None,
     ):
         self.proxy_snapshot = proxy_snapshot
         self.proxy_query = proxy_query
@@ -64,15 +188,20 @@ class Syncer:
         self.request_chunk = request_chunk
         self.chunk_timeout = chunk_timeout
         self.discovery_time = discovery_time
+        self._now = now_fn if now_fn is not None else time.monotonic
+        self._backoff_base_s = backoff_base_s
         self.pool = SnapshotPool()
         self._chunk_queue: ChunkQueue | None = None
         self._current: Snapshot | None = None
+        self._plan: ChunkFetchPlan | None = None
+        self._applied = 0
+        self._trusted_app_hash = b""
+        self.rotations_total = 0  # chunk-peer rotations across restores
         self._mtx = libsync.Mutex("statesync.syncer._mtx")
         # Once ANY chunk has been applied the app's state is no longer
         # genesis: callers must not fall back to blocksync-from-genesis
         # (the reference fail-stops post-restore errors for this reason).
         self.applied_any = False
-        self._requested: dict[int, float] = {}  # chunk index -> last request
 
     # -- inputs from the reactor -------------------------------------------
 
@@ -81,12 +210,16 @@ class Syncer:
 
     def add_chunk(self, height, fmt, index, chunk: bytes, peer_id: str) -> bool:
         with self._mtx:
-            cur, q = self._current, self._chunk_queue
+            cur, q, plan = self._current, self._chunk_queue, self._plan
         if cur is None or q is None:
             return False
         if height != cur.height or fmt != cur.format:
             return False
-        return q.put(index, chunk, peer_id)
+        added = q.put(index, chunk, peer_id)
+        if added and plan is not None:
+            # a delivered chunk clears the sender's failure streak
+            plan.note_delivery(peer_id)
+        return added
 
     def remove_peer(self, peer_id: str) -> None:
         self.pool.remove_peer(peer_id)
@@ -127,16 +260,23 @@ class Syncer:
             except (RejectSnapshotError, RetryError, SyncError):
                 self.pool.reject(snapshot)
 
-    def _sync_one(self, snapshot: Snapshot):
-        """syncer.go:236 Sync: offer → fetch+apply → verify."""
-        # The trusted app hash for this height must exist BEFORE restoring.
-        # Snapshot.hash is an OPAQUE app identifier (abci spec) — comparing
-        # it to the chain app hash is the APP's job via
-        # RequestOfferSnapshot.app_hash, not ours.
-        trusted_app_hash = self._provider_call(
-            lambda: self.state_provider.app_hash(snapshot.height)
-        )
+    # -- restore lifecycle (shared by the live loop and the sim steps) -----
 
+    def begin(
+        self, snapshot: Snapshot, provider_attempts: int = 20
+    ) -> None:
+        """Offer ``snapshot`` to the app and set up the chunk restore.
+        The trusted app hash for this height must exist BEFORE
+        restoring (fetched in :meth:`finish` against the same header).
+        Snapshot.hash is an OPAQUE app identifier (abci spec) —
+        comparing it to the chain app hash is the APP's job via
+        RequestOfferSnapshot.app_hash, not ours.  ``provider_attempts``
+        caps the real-time provider retries like :meth:`finish` — a
+        virtual-time driver passes 1 and retries on its own clock."""
+        trusted_app_hash = self._provider_call(
+            lambda: self.state_provider.app_hash(snapshot.height),
+            attempts=provider_attempts,
+        )
         res = self.proxy_snapshot.offer_snapshot(
             abci.RequestOfferSnapshot(
                 snapshot=abci.Snapshot(
@@ -156,26 +296,109 @@ class Syncer:
             raise RejectFormatError()
         if res.result in (r.REJECT, r.REJECT_SENDER, r.UNKNOWN):
             raise RejectSnapshotError(f"offer result {res.result}")
-
+        self._trusted_app_hash = trusted_app_hash
         with self._mtx:
             self._current = snapshot
             self._chunk_queue = ChunkQueue(snapshot.chunks)
-        try:
-            self._fetch_and_apply(snapshot)
-        finally:
-            with self._mtx:
-                q = self._chunk_queue
-                self._current = None
-                self._chunk_queue = None
-            if q is not None:
-                q.close()
+            self._plan = ChunkFetchPlan(
+                self.chunk_timeout, backoff_base_s=self._backoff_base_s
+            )
+        self._applied = 0
 
-        # verify restored app against the trusted header (syncer.go:485)
+    def abort_restore(self) -> None:
+        """Tear down the in-progress restore's queue/plan (idempotent)."""
+        with self._mtx:
+            q = self._chunk_queue
+            plan = self._plan
+            self._current = None
+            self._chunk_queue = None
+            self._plan = None
+        if plan is not None:
+            self.rotations_total += plan.rotations
+        if q is not None:
+            q.close()
+
+    def step_fetch(self) -> int:
+        """Fire the chunk requests that are due now (non-blocking); one
+        pass of the fetch loop.  Returns the number sent."""
+        with self._mtx:
+            cur, q, plan = self._current, self._chunk_queue, self._plan
+        if cur is None or q is None or plan is None:
+            return 0
+        peers = self.pool.peers_of(cur)
+        sent = 0
+        rot0 = plan.rotations
+        for index, peer in plan.due(q.pending(), peers, self._now()):
+            try:
+                self.request_chunk(peer, cur, index)
+                sent += 1
+            except Exception:
+                pass
+        for _ in range(plan.rotations - rot0):
+            # the defense acted: rotation abandoned a timing-out chunk
+            # peer — annotate the flight ring (peer_evicted detector)
+            libhealth.record(
+                libhealth.EV_FAULT,
+                a=libhealth.FAULT_PEER_EVICT,
+                b=libhealth.PEER_EVICT_STATESYNC_ROTATE,
+            )
+        return sent
+
+    def step_apply(self, block: float = 0.0) -> bool:
+        """Apply every chunk available in order (waiting up to
+        ``block`` seconds for the first); True once ALL chunks applied.
+        Raises the syncer.go control-flow errors on app verdicts."""
+        with self._mtx:
+            cur, q, plan = self._current, self._chunk_queue, self._plan
+        if cur is None or q is None:
+            raise SyncError("no restore in progress")
+        timeout = block
+        while self._applied < cur.chunks:
+            item = q.next(timeout=timeout)
+            if item is None:
+                return False
+            timeout = 0.0  # only the first wait blocks
+            index, chunk, peer = item
+            if plan is not None:
+                plan.forget(index)
+            res = self.proxy_snapshot.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(
+                    index=index, chunk=chunk, sender=peer
+                )
+            )
+            r = abci.ApplySnapshotChunkResult
+            if res.result == r.ACCEPT:
+                self._applied += 1
+                self.applied_any = True
+                continue
+            if res.result == r.ABORT:
+                raise AbortError("app aborted during chunk apply")
+            if res.result == r.RETRY:
+                try:
+                    q.retry(index)
+                except ChunkRetryLimitError as e:
+                    # poisoned chunk: fail THIS snapshot cleanly; the
+                    # caller rejects it and rotates to the next one
+                    raise RejectSnapshotError(str(e)) from e
+                # make the requester re-fire immediately: the per-index
+                # throttle would otherwise eat the deadline
+                if plan is not None:
+                    plan.forget_from(index)
+                self._applied = min(self._applied, index)
+                continue
+            if res.result == r.RETRY_SNAPSHOT:
+                raise RetrySnapshotError()
+            raise RejectSnapshotError(f"chunk apply result {res.result}")
+        return True
+
+    def finish(self, snapshot: Snapshot, provider_attempts: int = 20):
+        """Verify the restored app against the trusted header
+        (syncer.go:485) and fetch the bootstrap (state, commit)."""
         info = self.proxy_query.info(abci.RequestInfo())
-        if info.last_block_app_hash != trusted_app_hash:
+        if info.last_block_app_hash != self._trusted_app_hash:
             raise AppHashMismatchError(
                 f"restored app hash {info.last_block_app_hash.hex()} != "
-                f"trusted {trusted_app_hash.hex()}"
+                f"trusted {self._trusted_app_hash.hex()}"
             )
         if info.last_block_height != snapshot.height:
             raise AppHashMismatchError(
@@ -186,25 +409,46 @@ class Syncer:
         # needs light blocks H+1/H+2, which can lag the restore by a block
         # or two — retry instead of treating a young tip as fatal.
         state = self._provider_call(
-            lambda: self.state_provider.state(snapshot.height)
+            lambda: self.state_provider.state(snapshot.height),
+            attempts=provider_attempts,
         )
         commit = self._provider_call(
-            lambda: self.state_provider.commit(snapshot.height)
+            lambda: self.state_provider.commit(snapshot.height),
+            attempts=provider_attempts,
         )
         state.app_version = info.app_version
         return state, commit
+
+    def fetch_rotations(self) -> int:
+        """Chunk-peer rotations across every restore (live plan
+        included) — the observable the chunk-peer-failure scenario
+        asserts on."""
+        with self._mtx:
+            plan = self._plan
+        live = plan.rotations if plan is not None else 0
+        return self.rotations_total + live
+
+    def _sync_one(self, snapshot: Snapshot):
+        """syncer.go:236 Sync: offer → fetch+apply → verify."""
+        self.begin(snapshot)
+        try:
+            self._fetch_and_apply(snapshot)
+        finally:
+            self.abort_restore()
+        return self.finish(snapshot)
 
     def _provider_call(self, fn, attempts: int = 20, delay: float = 0.5):
         """Light-provider fetches retry through transient misses (young
         chain tip, RPC hiccup); persistent failure surfaces as a SyncError
         so sync_any's control flow — not the caller's thread — handles it."""
         last: Exception | None = None
-        for _ in range(attempts):
+        for i in range(attempts):
             try:
                 return fn()
             except Exception as e:  # light-client or provider/transport
                 last = e
-                time.sleep(delay)
+                if i + 1 < attempts:
+                    time.sleep(delay)
         raise SyncError(f"state provider unavailable: {last}")
 
     # -- chunk plumbing -----------------------------------------------------
@@ -213,69 +457,27 @@ class Syncer:
         q = self._chunk_queue
         stop = threading.Event()
         fetcher = threading.Thread(
-            target=self._fetch_loop, args=(snapshot, q, stop), daemon=True
+            target=self._fetch_loop, args=(q, stop), daemon=True
         )
         fetcher.start()
         try:
-            applied = 0
             deadline = time.monotonic() + self.chunk_timeout * max(
                 1, snapshot.chunks
             )
-            while applied < snapshot.chunks:
-                item = q.next(timeout=1.0)
-                if item is None:
-                    if time.monotonic() > deadline:
-                        raise RetryError("timed out fetching chunks")
-                    continue
-                index, chunk, peer = item
-                res = self.proxy_snapshot.apply_snapshot_chunk(
-                    abci.RequestApplySnapshotChunk(
-                        index=index, chunk=chunk, sender=peer
-                    )
-                )
-                r = abci.ApplySnapshotChunkResult
-                if res.result == r.ACCEPT:
-                    applied += 1
-                    self.applied_any = True
-                    continue
-                if res.result == r.ABORT:
-                    raise AbortError("app aborted during chunk apply")
-                if res.result == r.RETRY:
-                    q.retry(index)
-                    # make the fetcher re-request immediately: its
-                    # per-index throttle would otherwise eat the deadline
-                    for i in list(self._requested):
-                        if i >= index:
-                            del self._requested[i]
-                    applied = min(applied, index)
-                    continue
-                if res.result == r.RETRY_SNAPSHOT:
-                    raise RetrySnapshotError()
-                raise RejectSnapshotError(f"chunk apply result {res.result}")
+            while not self.step_apply(block=1.0):
+                if time.monotonic() > deadline:
+                    raise RetryError("timed out fetching chunks")
         finally:
             stop.set()
             fetcher.join(timeout=2)
 
-    def _fetch_loop(self, snapshot: Snapshot, q: ChunkQueue, stop) -> None:
-        """Round-robin pending chunk requests over serving peers
-        (syncer.go:415 fetchChunks, collapsed to one requester thread —
-        chunk application is serial anyway and peers stream responses)."""
-        self._requested.clear()
-        requested = self._requested
+    def _fetch_loop(self, q: ChunkQueue, stop) -> None:
+        """Requester thread (syncer.go:415 fetchChunks, collapsed to one
+        — chunk application is serial anyway and peers stream
+        responses); each pass fires the due requests under the plan's
+        rotation + backoff accounting."""
         while not stop.is_set() and not q.done():
-            peers = self.pool.peers_of(snapshot)
-            if not peers:
-                time.sleep(0.2)
-                continue
-            now = time.monotonic()
-            for n, index in enumerate(q.pending()):
-                last = requested.get(index, 0.0)
-                if now - last < self.chunk_timeout:
-                    continue
-                peer = peers[(index + int(now)) % len(peers)]
-                try:
-                    self.request_chunk(peer, snapshot, index)
-                    requested[index] = now
-                except Exception:
-                    pass
-            time.sleep(0.1)
+            if self.step_fetch() == 0:
+                time.sleep(0.1)
+            else:
+                time.sleep(0.02)

@@ -16,6 +16,7 @@ import json
 from ..libs import sync as libsync
 
 from ..libs import db as dbm
+from ..libs import fail as libfail
 from ..types import serialization as ser
 from ..types.block import Block, BlockID, BlockMeta, Commit
 from ..types.part_set import Part, PartSet
@@ -61,6 +62,10 @@ class BlockStore:
     def save_block(
         self, block: Block, part_set: PartSet, seen_commit: Commit
     ) -> None:
+        # slow-disk injection point (libs/fail delay_point): the simnet
+        # gray-failure scenarios charge virtual latency here, modeling a
+        # store volume that persists blocks slowly but successfully
+        libfail.delay_point("store-write")
         with self._mtx:  # cometlint: disable=CLNT009 -- block persistence is atomic under the store mutex; once per height
             self._save_block_locked(block, part_set, seen_commit, None)
 

@@ -229,14 +229,58 @@ class TestFuzzedConnection:
         assert time.monotonic() - t0 >= 0.05
         assert len(pipe.written) == 5  # delay mode never drops
 
+    def test_consensus_survives_conn_churn_simnet(self):
+        """The lossy-link LIVENESS claim, migrated onto the
+        deterministic simnet (PR 13 satellite): a lossy TCP frame kills
+        its connection (AEAD nonce desync), so the failure mode is
+        connection churn + reconnect + catch-up gossip.  The simnet
+        reproduces exactly that — seeded random connection severs with
+        persistent-peer reconnects over lossy links — bit-reproducibly,
+        where the old unseeded TCP version flaked ~2/15 runs on a slow
+        container.  A thin seeded TCP smoke below keeps the real-socket
+        path covered."""
+        from cometbft_tpu.simnet import LinkConfig, SimNet
+
+        def run(seed):
+            net = SimNet(
+                4, seed=seed,
+                default_link=LinkConfig(drop_p=0.02, jitter_ns=2_000_000),
+                reconnect_delay_ns=20_000_000,
+            )
+            try:
+                net.start()
+                rng = net.sched.sub_rng("conn-churn")
+
+                def churn():
+                    i = rng.randrange(4)
+                    j = (i + 1 + rng.randrange(3)) % 4
+                    net._disconnect_pair(i, j, "churn test")
+                    net.sched.call_after(15_000_000, churn)
+
+                net.sched.call_after(10_000_000, churn)
+                ok = net.run_until_height(3, max_virtual_ms=120_000)
+                net.assert_no_fork()
+                return ok, net.heights(), net.stats["dropped"]
+            finally:
+                net.stop()
+
+        ok, heights, dropped = run(99)
+        assert ok, f"churned lossy net stalled at {heights}"
+        # determinism: the same seed replays the identical run
+        assert run(99) == (ok, heights, dropped)
+
     def test_consensus_survives_lossy_links(self, tmp_path):
-        """4 validators over real TCP where every connection randomly
-        drops ~2% of frames. A single dropped frame desyncs the AEAD
-        nonce stream and KILLS that connection — so this drives the
-        reconnect-and-catch-up machinery hard (perturb.go's disconnect
-        analog); persistent full-mesh peers must re-establish and
-        consensus must keep committing."""
+        """Thin TCP smoke of the same failure mode: 4 validators over
+        real sockets where every connection drops ~2% of frames from a
+        SEEDED fuzzer (the unseeded variant flaked ~2/15 isolated runs
+        on this shared container — measured in PR 9 — because tail-lucky
+        reconnect storms blew the budget; the deterministic liveness
+        claim now lives in the simnet test above). A dropped frame
+        desyncs the AEAD nonce stream and KILLS that connection;
+        persistent full-mesh peers must re-establish and consensus must
+        keep committing."""
         import dataclasses
+        import itertools
 
         from cometbft_tpu import p2p
         from cometbft_tpu.config import default_config
@@ -247,13 +291,17 @@ class TestFuzzedConnection:
 
         _MS = 1_000_000
 
-        # wrap every upgraded secret connection in a lossy fuzzer
+        # wrap every upgraded secret connection in a lossy fuzzer with
+        # a DETERMINISTIC per-connection seed (connection order still
+        # races, but each conn's drop schedule is fixed — no unseeded
+        # tail-luck)
         orig_upgrade = p2p_transport.MultiplexTransport._upgrade
+        conn_seq = itertools.count(1)
 
         def lossy_upgrade(self, *a, **k):
             up = orig_upgrade(self, *a, **k)
             up.secret_conn = FuzzedConnection(
-                up.secret_conn, prob_drop_rw=0.02, seed=None
+                up.secret_conn, prob_drop_rw=0.02, seed=next(conn_seq)
             )
             return up
 
@@ -290,17 +338,15 @@ class TestFuzzedConnection:
                 node.config.p2p.persistent_peers = ",".join(peers)
                 node.switch.set_persistent_peers(peers)
                 node.switch.dial_peers_async(peers)
-            # 150s: the drops are UNSEEDED, so the reconnect storms are
-            # tail-lucky — at 90s this failed ~2/15 isolated runs on the
-            # SEED tree too (stalled at height 2 near t=94s), on a
-            # shared single-core container. The budget is slack for the
-            # liveness claim, not part of it.
-            deadline = time.monotonic() + 150
+            # smoke bar: TWO committed heights through seeded loss —
+            # the heavyweight liveness claim (height 3+ under sustained
+            # churn) lives in the deterministic simnet test above
+            deadline = time.monotonic() + 90
             while time.monotonic() < deadline:
-                if min(n.block_store.height() for n in nodes) >= 3:
+                if min(n.block_store.height() for n in nodes) >= 2:
                     break
                 time.sleep(0.2)
-            assert min(n.block_store.height() for n in nodes) >= 3, (
+            assert min(n.block_store.height() for n in nodes) >= 2, (
                 f"lossy net stalled at heights "
                 f"{[n.block_store.height() for n in nodes]}"
             )

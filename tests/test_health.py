@@ -23,11 +23,16 @@ import helpers
 
 @pytest.fixture
 def health():
-    """Enabled recorder with a clean ring; module state restored."""
+    """Enabled recorder with a clean ring; module state restored —
+    including the ring CAPACITY, which reset() deliberately preserves
+    (a later module's ring would otherwise silently shrink to 1024 and
+    evict rows its assertions depend on)."""
+    prev_capacity = libhealth.recorder().capacity
     libhealth.enable(ring=1024)
     libhealth.reset()
     yield libhealth
     libhealth.disable()
+    libhealth.set_ring_capacity(prev_capacity)
     libhealth.reset()
 
 
@@ -585,6 +590,7 @@ class TestHealthyBurst:
             "verify_breaker": 0,
             "recompile_storm": 0,
             "send_queue_saturated": 0,
+            "slow_disk": 0,
         }
         assert mon.bundles == 0
         # monotone non-degraded health: every sample along the way AND
@@ -649,3 +655,114 @@ class TestHealthSample:
         assert "score" in out["health"]
         assert out["watchdogs"] is None  # no monitor running
         assert out["events"][-1]["event"] == "consensus.step"
+
+
+class TestSlowDiskDefense:
+    """Gray-failure defense (PR 13): WAL fsync-latency EWMA →
+    disk_degraded hysteresis → widened propose timeouts + the
+    slow_disk watchdog."""
+
+    def _wal(self, tmp_path, monkeypatch, threshold_ms=50.0, window=8):
+        from cometbft_tpu.consensus.wal import WAL
+
+        monkeypatch.setenv("COMETBFT_TPU_HEALTH_DISK_MS",
+                           str(threshold_ms))
+        monkeypatch.setenv("COMETBFT_TPU_HEALTH_DISK_EWMA", str(window))
+        return WAL(str(tmp_path / "wal"))
+
+    def test_ewma_and_hysteresis(self, tmp_path, monkeypatch):
+        wal = self._wal(tmp_path, monkeypatch, threshold_ms=50.0,
+                        window=1)  # alpha=1: EWMA tracks the last sample
+        assert not wal.disk_degraded()
+        assert wal.fsync_ewma_s() == 0.0
+        wal._note_fsync(10_000_000)  # 10 ms: healthy
+        assert not wal.disk_degraded()
+        wal._note_fsync(80_000_000)  # 80 ms > 50 ms: degrade
+        assert wal.disk_degraded()
+        assert wal.fsync_ewma_s() == pytest.approx(0.08)
+        # hysteresis: 30 ms is under the threshold but above half of
+        # it — the state must NOT flap back yet
+        wal._note_fsync(30_000_000)
+        assert wal.disk_degraded()
+        wal._note_fsync(10_000_000)  # under half: clears
+        assert not wal.disk_degraded()
+        wal.close()
+
+    def test_measured_fsyncs_feed_the_ewma(self, tmp_path, monkeypatch,
+                                           health):
+        from cometbft_tpu.consensus.wal import EndHeightMessage
+
+        wal = self._wal(tmp_path, monkeypatch)
+        wal.write_sync(EndHeightMessage(1))
+        assert wal.fsync_ewma_s() > 0.0  # a real measured fsync landed
+        wal.close()
+
+    def test_propose_timeout_widens_only_live_and_degraded(self):
+        import types as _types
+
+        from cometbft_tpu.config import test_config
+        from cometbft_tpu.consensus.state import ConsensusState
+
+        cfg = test_config().consensus
+
+        class _Wal:
+            def __init__(self, degraded, ewma_s):
+                self._d, self._e = degraded, ewma_s
+
+            def disk_degraded(self):
+                return self._d
+
+            def fsync_ewma_s(self):
+                return self._e
+
+        def timeout(degraded, ewma_s, sim=False):
+            ns = _types.SimpleNamespace(
+                config=cfg, wal=_Wal(degraded, ewma_s), sim_driven=sim
+            )
+            return ConsensusState._propose_timeout(ns, 0)
+
+        base = cfg.propose_timeout(0)
+        assert timeout(False, 0.5) == base
+        # degraded: widened by 4x the smoothed fsync
+        assert timeout(True, 0.002) == pytest.approx(base + 0.008)
+        # capped at one extra base
+        assert timeout(True, 10.0) == pytest.approx(2 * base)
+        # NEVER widened for a sim-driven FSM (wall EWMA must not leak
+        # into virtual-time scheduling)
+        assert timeout(True, 0.002, sim=True) == base
+
+    def test_slow_disk_watchdog_trips_on_the_edge(self, health):
+        state = {"degraded": False}
+        mon = TestWatchdogUnits()._monitor(
+            disk_degraded_fn=lambda: state["degraded"]
+        )
+        assert mon._check() & 16 == 0
+        state["degraded"] = True
+        assert mon._check() & 16  # fresh episode: trip
+        assert mon.disk_degraded()
+        assert mon._check() & 16 == 0  # same episode: no re-trip
+        state["degraded"] = False
+        assert mon._check() & 16 == 0
+        assert not mon.disk_degraded()
+        state["degraded"] = True
+        assert mon._check() & 16  # NEW episode: trips again
+
+    def test_slow_disk_trip_counts_and_bundles(self, health, tmp_path):
+        state = {"degraded": True}
+        mon = TestWatchdogUnits()._monitor(
+            disk_degraded_fn=lambda: state["degraded"],
+            bundle_dir=str(tmp_path),
+        )
+        mask = mon._check()
+        assert mask & 16
+        mon._handle_trips(mask)
+        assert mon.trips["slow_disk"] == 1
+        names = [p for p in tmp_path.iterdir() if "slow_disk" in p.name]
+        assert names, "no slow_disk bundle written"
+
+    def test_raising_probe_fails_toward_alerting(self, health):
+        def boom():
+            raise RuntimeError("probe exploded")
+
+        mon = TestWatchdogUnits()._monitor(disk_degraded_fn=boom)
+        assert mon._check() & 16

@@ -72,6 +72,27 @@ class TestRingEventRegistry:
             assert r["round"] == 1
             assert r["ts"] > 0
 
+    def test_every_fault_kind_has_decode_name_and_docs(self):
+        """EV_FAULT decode completeness: every FAULT_* kind must decode
+        to a ``fault_name`` and appear in the docs fault vocabulary —
+        a new fault family cannot ship dark."""
+        kinds = libhealth.fault_kind_codes()
+        assert kinds, "no FAULT_* kinds found"
+        doc = open(_DOCS).read()
+        for const, kind in kinds.items():
+            name = libhealth._FAULT_NAMES.get(kind)
+            assert name is not None, (
+                f"{const} has no _FAULT_NAMES decode entry"
+            )
+            assert name in doc, (
+                f"{const} ({name}) missing from the docs fault catalog"
+            )
+            # and the decode path round-trips
+            rec = libhealth.FlightRecorder(8)
+            rec.record(libhealth.EV_FAULT, 1, 2, kind, 3)
+            row = rec.dump()[0]
+            assert row["fault_name"] == name
+
     def test_decoder_survives_missing_field_entry(self):
         """Hardening: a code present in _CODE_NAMES but absent from
         _CODE_FIELDS decodes as a bare row instead of KeyError-ing the
@@ -464,6 +485,71 @@ class TestAttributionUnits:
         assert all(
             f.cause != "injected_drop" for f in rep.run.findings
         )
+
+    def test_oneway_sever_names_gray_partition(self):
+        anns = [
+            _ev("simnet.fault", 1_100_000_000, 0, 1,
+                fault_name="oneway_sever", kind=8, detail=1),
+            _ev("simnet.fault", 1_118_000_000, 0, 1,
+                fault_name="oneway_sever", kind=8, detail=0),
+        ]
+        rep = attribute(self._tl(anns, lat_ns=900_000_000))
+        v = rep.run.verdict
+        assert v is not None and v.cause == "gray_partition"
+        assert (v.evidence["src"], v.evidence["dst"]) == (0, 1)
+
+    def test_slow_disk_outranks_laggard_proposer(self):
+        """The slow_disk interval is a directly-injected fault — it
+        must top-rank even when the symptom (a laggard proposer) also
+        scores at its 0.8 cap."""
+        anns = [
+            _ev("simnet.fault", 1_050_000_000, 1, 0,
+                fault_name="slow_disk", kind=9, detail=120),
+        ]
+        rep = attribute(self._tl(anns, lat_ns=900_000_000))
+        v = rep.run.verdict
+        assert v is not None and v.cause == "slow_disk"
+        assert v.score > 0.8
+        assert v.evidence["node"] == 1
+        assert v.evidence["latency_ms"] == 120
+
+    def test_slow_disk_cleared_interval_bounds_overlap(self):
+        """A cleared slow disk (detail=0) closes the episode: a HEIGHT
+        window entirely after the clear scores no slow_disk."""
+        anns = [
+            _ev("simnet.fault", 900_000_000, 1, 0,
+                fault_name="slow_disk", kind=9, detail=120),
+            _ev("simnet.fault", 950_000_000, 1, 0,
+                fault_name="slow_disk", kind=9, detail=0),
+        ]
+        evs = (
+            _height_events("node0", 1, 1_000_000_000)
+            + _height_events("node0", 2, 1_100_000_000)
+            + _height_events(
+                "node0", 3, 1_200_000_000, lat_ns=900_000_000
+            )
+            + anns
+        )
+        rep = attribute(merge([Source("node0", evs, domain="virtual")]))
+        assert rep.slow_heights, "the 900 ms height must read as slow"
+        for w in rep.slow_heights:
+            assert all(f.cause != "slow_disk" for f in w.findings), (
+                f"{w.window} scored a cleared slow-disk episode"
+            )
+
+    def test_peer_evicted_named_but_below_injected_faults(self):
+        anns = [
+            _ev("simnet.fault", 1_100_000_000, 0, 0,
+                fault_name="peer_evict", kind=11, detail=1),
+            _ev("simnet.fault", 1_105_000_000, 1, 0,
+                fault_name="kill", kind=3),
+        ]
+        rep = attribute(self._tl(anns, lat_ns=900_000_000))
+        v = rep.run.verdict
+        assert v is not None and v.cause == "injected_churn"
+        named = {f.cause: f for f in rep.run.findings}
+        assert "peer_evicted" in named
+        assert named["peer_evicted"].score < named["injected_churn"].score
 
     def test_breaker_open_names_verify_stall(self):
         trips = [

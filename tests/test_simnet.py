@@ -385,3 +385,131 @@ def test_simnet_module_cli():
 
     assert main(["--list"]) == 0
     assert main(["--scenario", "healthy", "--seed", "4"]) == 0
+
+
+# ------------------------------------- gray failures (PR 13 family)
+
+
+def test_oneway_sever_delivers_one_way_and_heals():
+    """Per-direction link semantics: an asymmetric sever kills exactly
+    one direction (classified drop_partition), the reverse direction
+    keeps delivering, and heal() restores both."""
+    net = SimNet(2, seed=5)
+    try:
+        net.start()
+        net.run(max_virtual_ms=50)
+        base_delivered = net.stats["delivered"]
+        net.sever_oneway(0, 1)
+        # dead direction: eaten at send time, wire-silently
+        assert net.inject(0, 1, 0x22, b"x" * 40) is True
+        assert net.stats["drop_partition"] == 1
+        # live direction: still delivers
+        before = net.stats["delivered"]
+        assert net.inject(1, 0, 0x22, b"y" * 40) is True
+        net.run(max_virtual_ms=50)
+        assert net.stats["delivered"] > before
+        net.heal()
+        d0 = net.stats["drop_partition"]
+        assert net.inject(0, 1, 0x22, b"z" * 40) is True
+        net.run(max_virtual_ms=50)
+        assert net.stats["drop_partition"] == d0
+        assert net.stats["delivered"] > base_delivered
+    finally:
+        net.stop()
+
+
+def test_oneway_sever_destroys_in_flight_as_drop_partition():
+    """A message already in flight when its direction is severed dies
+    at delivery time, classified drop_partition (not drop_dead)."""
+    net = SimNet(2, seed=5)
+    try:
+        net.start()
+        net.run(max_virtual_ms=50)
+        assert net.inject(0, 1, 0x22, b"w" * 40) is True  # in flight
+        net.sever_oneway(0, 1)
+        net.run(max_virtual_ms=50)
+        assert net.stats["drop_partition"] >= 1
+        assert net.stats.get("drop_dead", 0) == 0
+    finally:
+        net.stop()
+
+
+def test_oneway_fault_rows_reach_flight_recorder():
+    libhealth.reset()
+    libhealth.enable()
+    net = SimNet(2, seed=5)
+    try:
+        net.start()
+        net.sever_oneway(0, 1)
+        net.set_slow_disk(1, 50_000_000)
+        net.set_slow_disk(1, 0)
+        net.mark_storm(500)
+        net.heal()
+        rows = [
+            r for r in libhealth.recorder().dump()
+            if r["event"] == "simnet.fault"
+        ]
+        names = [r["fault_name"] for r in rows]
+        assert "oneway_sever" in names
+        assert "slow_disk" in names
+        assert "mempool_storm" in names
+        sever = next(r for r in rows if r["fault_name"] == "oneway_sever")
+        assert (sever["height"], sever["round"]) == (0, 1)  # src -> dst
+        # heal() closes the oneway episode with a detail=0 row
+        restores = [
+            r for r in rows
+            if r["fault_name"] == "oneway_sever" and r["detail"] == 0
+        ]
+        assert restores
+    finally:
+        net.stop()
+        libhealth.disable()
+
+
+# tier-1 smoke sizes + per-scenario acceptance assertions; each case
+# runs TWICE so the smoke and the determinism pin share the work
+_GRAY_SMOKE = {
+    "gray_partition": (
+        dict(heights_after=2),
+        lambda r: r.notes["oneway_drops"] > 0,
+    ),
+    "slow_disk": (
+        # the injected latency must visibly slow the chain while live
+        # (heights_after=4 covers a full proposer rotation, so the
+        # laggard's expired propose windows are guaranteed to land)
+        dict(heights_after=4),
+        lambda r: (
+            r.notes["slow_phase_ms_per_height"]
+            > r.notes["healthy_phase_ms_per_height"]
+        ),
+    ),
+    "mempool_storm": (
+        dict(storm_heights=3),
+        lambda r: r.notes["txs_committed"] > 0,
+    ),
+    # THE gray-failure statesync acceptance: a fresh node reaches the
+    # chain tip through the real snapshot→chunk→light-verify→blocksync
+    # path, surviving an injected chunk-peer failure via rotation
+    "statesync_join": (
+        dict(tail_heights=2),
+        lambda r: (
+            r.notes["chunk_peer_rotations"] >= 1
+            and r.notes["blocks_synced"] > 0
+        ),
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_GRAY_SMOKE))
+def test_gray_scenario_smoke_and_determinism(name):
+    """Each gray-failure scenario commits under its fault AND is
+    bit-deterministic: same (seed, scenario) ⇒ identical heights +
+    flight-ring signature across the NEW fault codes (oneway_sever,
+    slow_disk, mempool_storm, and the join's churn/evict rows)."""
+    kwargs, accept = _GRAY_SMOKE[name]
+    r1 = run_scenario(name, 23, **kwargs)
+    r2 = run_scenario(name, 23, **kwargs)
+    assert r1.ok, r1.failures
+    assert accept(r1), r1.notes
+    assert r1.signature == r2.signature
+    assert r1.heights == r2.heights

@@ -329,3 +329,177 @@ class TestChunkSpooling:
         assert q.pending() == [1, 2, 3]
         assert os.listdir(q._dir) == []
         q.close()
+
+
+class TestChunkRetryCap:
+    """Satellite (PR 13): ChunkQueue.retry is BOUNDED — a poisoned
+    chunk fails the sync cleanly instead of re-enqueueing forever."""
+
+    def test_retry_cap_raises_after_limit(self):
+        from cometbft_tpu.statesync.chunks import ChunkRetryLimitError
+
+        q = ChunkQueue(2, max_retries=3)
+        for _ in range(3):
+            q.put(0, b"bad", "p")
+            assert q.next(timeout=0.1)[0] == 0
+            q.retry(0)
+        assert q.retry_count(0) == 3
+        with pytest.raises(ChunkRetryLimitError):
+            q.retry(0)
+        q.close()
+
+    def test_poisoned_chunk_rejects_snapshot_cleanly(self):
+        """An app that answers RETRY forever: sync_any must reject the
+        snapshot (ChunkRetryLimitError → RejectSnapshotError) and
+        surface SyncError once no snapshot remains — not spin."""
+
+        class _RetryForeverApp:
+            calls = 0
+
+            def offer_snapshot(self, req):
+                return abci.ResponseOfferSnapshot(
+                    result=abci.OfferSnapshotResult.ACCEPT
+                )
+
+            def apply_snapshot_chunk(self, req):
+                self.calls += 1
+                return abci.ResponseApplySnapshotChunk(
+                    result=abci.ApplySnapshotChunkResult.RETRY
+                )
+
+            def info(self, req):
+                raise AssertionError("must never verify")
+
+        app = _RetryForeverApp()
+
+        def request_chunk(peer_id, snapshot, index):
+            syncer.add_chunk(
+                snapshot.height, snapshot.format, index, b"junk", peer_id
+            )
+
+        syncer = Syncer(
+            proxy_snapshot=app,
+            proxy_query=app,
+            state_provider=_FakeStateProvider({3: b"h"}),
+            request_chunk=request_chunk,
+            chunk_timeout=0.5,
+            discovery_time=0.5,
+        )
+        syncer.add_snapshot(
+            Snapshot(height=3, format=1, chunks=1, hash=b"x"), "p1"
+        )
+        with pytest.raises(SyncError):
+            syncer.sync_any(deadline=2.0)
+        from cometbft_tpu.statesync.chunks import DEFAULT_MAX_RETRIES
+
+        # the cap ended the loop: one apply per allowed retry plus the
+        # initial one — NOT a retry per fetch tick until the deadline
+        assert app.calls <= DEFAULT_MAX_RETRIES + 2
+        # and the poisoned snapshot was rejected from the pool
+        assert syncer.pool.best() is None
+
+
+class TestChunkFetchPlan:
+    """Per-peer failure accounting: a timing-out peer is backed off
+    exponentially and the re-request ROTATES to the next serving peer
+    (the gray-failure defense; previously the same dead peer was
+    re-asked forever at fixed cadence)."""
+
+    def _plan(self, timeout=1.0, base=1.0):
+        from cometbft_tpu.statesync.syncer import ChunkFetchPlan
+
+        return ChunkFetchPlan(timeout, backoff_base_s=base)
+
+    def test_first_requests_spread_by_index(self):
+        plan = self._plan()
+        due = plan.due([0, 1, 2], ["a", "b", "c"], now=0.0)
+        assert due == [(0, "a"), (1, "b"), (2, "c")]
+        # within the timeout nothing re-fires
+        assert plan.due([0, 1, 2], ["a", "b", "c"], now=0.5) == []
+
+    def test_timeout_charges_owner_and_rotates(self):
+        plan = self._plan(timeout=1.0, base=2.0)
+        assert plan.due([0], ["a", "b"], now=0.0) == [(0, "a")]
+        due = plan.due([0], ["a", "b"], now=1.5)
+        assert due == [(0, "b")]  # rotated off the timing-out peer
+        assert plan.failures["a"] == 1
+        assert plan.rotations == 1
+        # "a" is in backoff: the next timeout keeps rotating within
+        # the usable pool
+        due = plan.due([0], ["a", "b"], now=3.0)
+        assert plan.failures["b"] == 1
+        assert due[0][0] == 0
+
+    def test_backoff_grows_exponentially(self):
+        plan = self._plan(timeout=1.0, base=1.0)
+        plan.due([0], ["a"], now=0.0)
+        plan.due([0], ["a"], now=1.5)   # fail 1 -> ban until 2.5
+        assert plan._banned_until["a"] == pytest.approx(2.5)
+        plan.due([0], ["a"], now=3.0)   # fail 2 -> ban until 5.0
+        assert plan._banned_until["a"] == pytest.approx(5.0)
+        assert plan.failures["a"] == 2
+
+    def test_delivery_clears_failure_streak(self):
+        plan = self._plan(timeout=1.0)
+        plan.due([0, 1], ["a", "b"], now=0.0)
+        plan.due([0, 1], ["a", "b"], now=1.5)  # both owners charged
+        plan.note_delivery("a")
+        plan.due([], ["a", "b"], now=1.6)  # drain deliveries
+        assert "a" not in plan.failures
+
+    def test_syncer_rotation_survives_dead_peer(self):
+        """End-to-end through the Syncer stepper on an injected clock:
+        peer-a swallows every chunk request, peer-b serves — the
+        restore must finish and count a rotation."""
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+        src = KVStoreApplication(snapshot_interval=1)
+        _finalize(src, 1, [b"k=v"])
+        src.commit()
+        best = src.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+        dst = KVStoreApplication()
+        clock = [0.0]
+
+        def request_chunk(peer_id, snapshot, index):
+            if peer_id == "peer-a":
+                return  # gray peer: request vanishes
+            res = src.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=snapshot.height, format=snapshot.format,
+                    chunk=index,
+                )
+            )
+            syncer.add_chunk(
+                snapshot.height, snapshot.format, index, res.chunk,
+                peer_id,
+            )
+
+        syncer = Syncer(
+            proxy_snapshot=dst,
+            proxy_query=dst,
+            state_provider=_FakeStateProvider(
+                {best.height: best.hash},
+                state=types.SimpleNamespace(app_version=0, tag="S"),
+                commit="C",
+            ),
+            request_chunk=request_chunk,
+            chunk_timeout=1.0,
+            now_fn=lambda: clock[0],
+        )
+        snap = Snapshot(
+            height=best.height, format=best.format, chunks=best.chunks,
+            hash=best.hash,
+        )
+        syncer.add_snapshot(snap, "peer-a")
+        syncer.add_snapshot(snap, "peer-b")
+        syncer.begin(snap)
+        assert syncer.step_fetch() == 1  # -> peer-a (dead)
+        assert not syncer.step_apply()
+        clock[0] = 1.5  # past the chunk timeout: rotate
+        assert syncer.step_fetch() == 1  # -> peer-b (serves inline)
+        assert syncer.step_apply() is True
+        syncer.abort_restore()
+        assert syncer.fetch_rotations() == 1
+        state, commit = syncer.finish(snap, provider_attempts=1)
+        assert state.tag == "S" and commit == "C"
+        assert dst.app_hash == best.hash

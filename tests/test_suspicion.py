@@ -1,0 +1,241 @@
+"""Peer-health suspicion scorer (p2p/suspicion.py): signal scoring,
+decay hysteresis, eviction through the switch machinery, cooldown, and
+the flight-ring/metrics annotations of the gray-failure defense."""
+
+import time
+import types
+
+import pytest
+
+from cometbft_tpu.libs import health as libhealth
+from cometbft_tpu.libs.metrics import NodeMetrics
+from cometbft_tpu.libs.netstats import ConnStats
+from cometbft_tpu.p2p import suspicion
+
+CH = 0x22  # a consensus channel (the queue-full signal's scope)
+
+
+class _FakePeer:
+    def __init__(self, pid, stats):
+        self.id = pid
+        self.mconn = types.SimpleNamespace(stats=stats)
+
+
+class _FakeSwitch:
+    def __init__(self, peers):
+        self._peers = list(peers)
+        self.evicted = []
+
+    def peers(self):
+        return list(self._peers)
+
+    def stop_and_remove_peer(self, peer, reason):
+        self.evicted.append((peer.id, str(reason)))
+        self._peers = [p for p in self._peers if p is not peer]
+
+
+def _peer(pid):
+    stats = ConnStats(pid, [CH])
+    return _FakePeer(pid, stats), stats
+
+
+def _scorer(switch, **kw):
+    kw.setdefault("metrics", NodeMetrics())
+    kw.setdefault("evict_score", 3.0)
+    kw.setdefault("cooldown_s", 30.0)
+    return suspicion.SuspicionScorer(switch, **kw)
+
+
+class TestSignals:
+    def test_healthy_peers_score_zero(self):
+        p1, s1 = _peer("a" * 40)
+        p2, s2 = _peer("b" * 40)
+        now = time.time_ns()
+        s1.note_recv_bytes(0, 10)
+        s2.note_recv_bytes(0, 10)
+        sw = _FakeSwitch([p1, p2])
+        sc = _scorer(sw)
+        assert sc.check_once(now) == []
+        assert sc.scores() == {}
+
+    def test_queue_full_streak_accumulates_and_evicts(self):
+        p1, s1 = _peer("a" * 40)
+        sw = _FakeSwitch([p1])
+        sc = _scorer(sw)  # production defaults: evict 3.0, decay 0.8
+        now = time.time_ns()
+        s1.note_queue_full(0)
+        assert sc.check_once(now) == []  # score 1.0: suspect, not gone
+        assert sc.scores()[p1.id[:10]] > 0
+        evictions = []
+        for tick in range(1, 10):
+            s1.note_queue_full(0)  # the streak persists every check
+            evictions = sc.check_once(now + tick * 1_000_000_000)
+            if evictions:
+                break
+        assert sw.evicted, "sustained queue-full never evicted"
+        assert 3 <= tick <= 7  # sustained, not hair-trigger
+        assert sw.evicted[0][0] == p1.id
+        assert evictions[0]["reason"] == "queue_full"
+
+    def test_decay_forgives_a_transient_burst(self):
+        p1, s1 = _peer("a" * 40)
+        sw = _FakeSwitch([p1])
+        sc = _scorer(sw, decay=0.5)
+        now = time.time_ns()
+        s1.note_queue_full(0)
+        sc.check_once(now)
+        score0 = sc._score[p1.id]
+        # clean ticks: the score halves each check until it zeroes
+        sc.check_once(now + 1_000_000_000)
+        assert sc._score[p1.id] == pytest.approx(score0 * 0.5)
+        for i in range(12):
+            sc.check_once(now + (2 + i) * 1_000_000_000)
+        assert sc._score[p1.id] == 0.0
+
+    def test_staleness_needs_an_otherwise_active_net(self):
+        p1, s1 = _peer("a" * 40)  # silent peer
+        p2, s2 = _peer("b" * 40)  # active peer
+        now = time.time_ns()
+        stale_ns = now - 60_000_000_000  # last heard 60 s ago
+        s1._cols[8][0] = stale_ns  # _C_LAST_RECV
+        s2._cols[8][0] = now
+        sw = _FakeSwitch([p1, p2])
+        sc = _scorer(sw)
+        sc.check_once(now)
+        assert sc._score[p1.id] > 0  # one-way-partition shape
+        assert sc._score.get(p2.id, 0.0) == 0.0
+        # a fully-idle net (everyone silent) must NOT mark anyone
+        s2._cols[8][0] = stale_ns
+        sc2 = _scorer(_FakeSwitch([p1, p2]))
+        sc2.check_once(now)
+        assert sc2._score.get(p1.id, 0.0) == 0.0
+
+    def test_lag_outlier_needs_relative_and_absolute_floors(self):
+        peers = []
+        now = time.time_ns()
+        for i in range(4):
+            p, s = _peer(chr(ord("a") + i) * 40)
+            s.note_recv_bytes(0, 1)
+            s.stamp_rx_lag_ns[0] = 2_000_000  # 2 ms typical
+            peers.append((p, s))
+        lagger_stats = peers[0][1]
+        lagger_stats.stamp_rx_lag_ns[0] = 600_000_000  # 0.6 s
+        sw = _FakeSwitch([p for p, _ in peers])
+        sc = _scorer(sw)
+        sc.check_once(now)
+        assert sc._score[peers[0][0].id] > 0
+        assert sc._score.get(peers[1][0].id, 0.0) == 0.0
+        # a big multiple UNDER the absolute floor stays quiet (quiet
+        # LAN: microsecond medians, a 5 ms hop is not a gray peer)
+        lagger_stats.stamp_rx_lag_ns[0] = 5_000_000
+        for _, s in peers[1:]:
+            s.stamp_rx_lag_ns[0] = 100_000
+        sc2 = _scorer(_FakeSwitch([p for p, _ in peers]))
+        sc2.check_once(now)
+        assert sc2._score.get(peers[0][0].id, 0.0) == 0.0
+
+
+class TestEviction:
+    def _saturate(self, sc, stats, now, ticks=4):
+        for i in range(ticks):
+            stats.note_queue_full(0)
+            out = sc.check_once(now + i * 1_000_000_000)
+            if out:
+                return out
+        return []
+
+    def test_cooldown_blocks_reflapping(self):
+        p1, s1 = _peer("a" * 40)
+        sw = _FakeSwitch([p1])
+        sc = _scorer(sw, evict_score=1.0, cooldown_s=1000.0)
+        now = time.time_ns()
+        out = self._saturate(sc, s1, now, ticks=2)
+        assert out and len(sw.evicted) == 1
+        # the peer reconnects (same id) and misbehaves again inside
+        # the cooldown: suspicion accrues but no second eviction
+        sw._peers = [p1]
+        s1.note_queue_full(0)
+        assert sc.check_once(now + 5_000_000_000) == []
+        assert len(sw.evicted) == 1
+
+    def test_eviction_emits_ring_annotation_and_metric(self):
+        libhealth.enable()
+        libhealth.reset()
+        try:
+            p1, s1 = _peer("a" * 40)
+            sw = _FakeSwitch([p1])
+            m = NodeMetrics()
+            sc = _scorer(sw, evict_score=1.0, metrics=m)
+            now = time.time_ns()
+            out = self._saturate(sc, s1, now, ticks=2)
+            assert out
+            rows = [
+                r for r in libhealth.recorder().dump()
+                if r["event"] == "simnet.fault"
+                and r.get("fault_name") == "peer_evict"
+            ]
+            assert rows, "eviction never annotated the flight ring"
+            assert m.p2p_suspicion_evictions.labels(
+                "queue_full"
+            ).value() == 1
+        finally:
+            libhealth.disable()
+
+    def test_departed_peers_are_forgotten(self):
+        p1, s1 = _peer("a" * 40)
+        sw = _FakeSwitch([p1])
+        sc = _scorer(sw)
+        now = time.time_ns()
+        s1.note_queue_full(0)
+        sc.check_once(now)
+        assert p1.id in sc._score
+        sw._peers = []
+        sc.check_once(now + 1_000_000_000)
+        assert p1.id not in sc._score
+        assert p1.id not in sc._qfull_seen
+
+
+class TestLifecycleAndKnobs:
+    def test_enabled_kill_switch(self, monkeypatch):
+        monkeypatch.delenv("COMETBFT_TPU_SUSPICION", raising=False)
+        assert suspicion.enabled()
+        monkeypatch.setenv("COMETBFT_TPU_SUSPICION", "0")
+        assert not suspicion.enabled()
+
+    def test_env_thresholds(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_SUSPICION_EVICT", "7.5")
+        monkeypatch.setenv("COMETBFT_TPU_SUSPICION_COOLDOWN_S", "11")
+        sc = suspicion.SuspicionScorer(
+            _FakeSwitch([]), metrics=NodeMetrics()
+        )
+        assert sc.evict_score == 7.5
+        assert sc.cooldown_s == 11.0
+
+    def test_service_start_stop(self):
+        sc = _scorer(_FakeSwitch([]), interval_s=0.05)
+        sc.start()
+        try:
+            assert sc.is_running()
+            time.sleep(0.12)  # a couple of ticks on the thread
+        finally:
+            sc.stop()
+        assert not sc.is_running()
+
+    def test_status_shape(self):
+        sc = _scorer(_FakeSwitch([]))
+        st = sc.status()
+        assert {"running", "evict_score", "cooldown_s", "evictions",
+                "suspects"} <= set(st)
+
+    def test_knobs_registered(self):
+        from cometbft_tpu.config import ENV_KNOBS
+
+        for knob in (
+            "COMETBFT_TPU_SUSPICION",
+            "COMETBFT_TPU_SUSPICION_EVICT",
+            "COMETBFT_TPU_SUSPICION_COOLDOWN_S",
+            "COMETBFT_TPU_HEALTH_DISK_EWMA",
+            "COMETBFT_TPU_HEALTH_DISK_MS",
+            "COMETBFT_TPU_STATESYNC_BACKOFF_S",
+        ):
+            assert knob in ENV_KNOBS, knob
