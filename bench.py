@@ -1348,6 +1348,97 @@ def bench_coalesce_steady_state(
     }
 
 
+def _perfect_gossip_net(chain_id: str, n_vals: int = 4):
+    """One in-process n-validator consensus net with perfect gossip —
+    the shared burst harness of configs 13 and 19.  Returns the
+    ``[(ConsensusState, parts)]`` list; parts carries conns/bus/
+    block_store for teardown."""
+    from cometbft_tpu import proxy
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import test_config
+    from cometbft_tpu.consensus import ConsensusState
+    from cometbft_tpu.consensus.messages import (
+        BlockPartMessage,
+        ProposalMessage,
+        VoteMessage,
+    )
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.libs import db as dbm
+    from cometbft_tpu.state import BlockExecutor, Store, make_genesis_state
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.types import GenesisDoc, GenesisValidator, MockPV
+    from cometbft_tpu.types.event_bus import EventBus
+
+    pvs = [
+        MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
+        for i in range(n_vals)
+    ]
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=pv.get_pub_key(), power=10)
+            for pv in pvs
+        ],
+    )
+    vs = doc.validator_set()
+    by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
+    pvs = [by_addr[v.address] for v in vs.validators]
+    nodes = []
+    for pv in pvs:
+        conns = proxy.AppConns(
+            proxy.local_client_creator(KVStoreApplication(dbm.MemDB()))
+        )
+        conns.start()
+        state_store = Store(dbm.MemDB())
+        block_store = BlockStore(dbm.MemDB())
+        bus = EventBus()
+        bus.start()
+        state = make_genesis_state(doc)
+        state_store.save(state)
+        executor = BlockExecutor(
+            state_store, conns.consensus,
+            block_store=block_store, event_bus=bus,
+        )
+        cs = ConsensusState(
+            test_config().consensus, state, executor, block_store,
+            event_bus=bus,
+        )
+        cs.set_priv_validator(pv)
+        nodes.append(
+            (cs, dict(conns=conns, bus=bus, block_store=block_store))
+        )
+    css = [cs for cs, _ in nodes]
+    for i, cs in enumerate(css):  # perfect gossip, as in the tests
+        orig = cs._send_internal
+
+        def send(msg, cs=cs, orig=orig, me=i):
+            orig(msg)
+            for j, other in enumerate(css):
+                if j == me:
+                    continue
+                if isinstance(msg, VoteMessage):
+                    other.add_vote_from_peer(msg.vote, f"n{me}")
+                elif isinstance(msg, ProposalMessage):
+                    other.set_proposal_from_peer(msg.proposal, f"n{me}")
+                elif isinstance(msg, BlockPartMessage):
+                    other.add_block_part_from_peer(
+                        msg.height, msg.round, msg.part, f"n{me}"
+                    )
+
+        cs._send_internal = send
+    return nodes
+
+
+def _stop_net(nodes) -> None:
+    for cs, parts in nodes:
+        for closer in (cs.stop, parts["bus"].stop, parts["conns"].stop):
+            try:
+                closer()
+            except Exception:
+                pass
+
+
 def bench_health_overhead(n_heights: int | None = None):
     """Config 13: flight-recorder overhead on a warmed 4-validator burst.
 
@@ -1363,94 +1454,18 @@ def bench_health_overhead(n_heights: int | None = None):
     """
     import threading as _threading  # noqa: F401  (parity with config 12)
 
-    from cometbft_tpu import proxy
-    from cometbft_tpu.abci.kvstore import KVStoreApplication
-    from cometbft_tpu.config import test_config
-    from cometbft_tpu.consensus import ConsensusState
-    from cometbft_tpu.consensus.messages import (
-        BlockPartMessage,
-        ProposalMessage,
-        VoteMessage,
-    )
-    from cometbft_tpu.crypto.keys import Ed25519PrivKey
-    from cometbft_tpu.libs import db as dbm
     from cometbft_tpu.libs import health as libhealth
-    from cometbft_tpu.state import BlockExecutor, Store, make_genesis_state
-    from cometbft_tpu.store import BlockStore
-    from cometbft_tpu.types import GenesisDoc, GenesisValidator, MockPV
-    from cometbft_tpu.types.event_bus import EventBus
 
     if n_heights is None:
         n_heights = _sz(25, 4)
     warm_heights = _sz(3, 1)
-
-    def make_net():
-        pvs = [
-            MockPV(Ed25519PrivKey.from_seed(bytes([i + 1]) * 32))
-            for i in range(4)
-        ]
-        doc = GenesisDoc(
-            chain_id="bench-health",
-            genesis_time_ns=1_700_000_000_000_000_000,
-            validators=[
-                GenesisValidator(pub_key=pv.get_pub_key(), power=10)
-                for pv in pvs
-            ],
-        )
-        vs = doc.validator_set()
-        by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
-        pvs = [by_addr[v.address] for v in vs.validators]
-        nodes = []
-        for pv in pvs:
-            conns = proxy.AppConns(
-                proxy.local_client_creator(KVStoreApplication(dbm.MemDB()))
-            )
-            conns.start()
-            state_store = Store(dbm.MemDB())
-            block_store = BlockStore(dbm.MemDB())
-            bus = EventBus()
-            bus.start()
-            state = make_genesis_state(doc)
-            state_store.save(state)
-            executor = BlockExecutor(
-                state_store, conns.consensus,
-                block_store=block_store, event_bus=bus,
-            )
-            cs = ConsensusState(
-                test_config().consensus, state, executor, block_store,
-                event_bus=bus,
-            )
-            cs.set_priv_validator(pv)
-            nodes.append(
-                (cs, dict(conns=conns, bus=bus, block_store=block_store))
-            )
-        css = [cs for cs, _ in nodes]
-        for i, cs in enumerate(css):  # perfect gossip, as in the tests
-            orig = cs._send_internal
-
-            def send(msg, cs=cs, orig=orig, me=i):
-                orig(msg)
-                for j, other in enumerate(css):
-                    if j == me:
-                        continue
-                    if isinstance(msg, VoteMessage):
-                        other.add_vote_from_peer(msg.vote, f"n{me}")
-                    elif isinstance(msg, ProposalMessage):
-                        other.set_proposal_from_peer(msg.proposal, f"n{me}")
-                    elif isinstance(msg, BlockPartMessage):
-                        other.add_block_part_from_peer(
-                            msg.height, msg.round, msg.part, f"n{me}"
-                        )
-
-            cs._send_internal = send
-        return nodes
 
     was_on = libhealth.enabled()
     per_off = []
     per_on = []
     records_on = 0
     commits_on = 0
-    nodes = make_net()
+    nodes = _perfect_gossip_net("bench-health")
     store = nodes[0][1]["block_store"]
     try:
         for cs, _ in nodes:
@@ -1493,14 +1508,7 @@ def bench_health_overhead(n_heights: int | None = None):
                     )
                     commits_on += commits
     finally:
-        for cs, parts in nodes:
-            for closer in (
-                cs.stop, parts["bus"].stop, parts["conns"].stop
-            ):
-                try:
-                    closer()
-                except Exception:
-                    pass
+        _stop_net(nodes)
         libhealth.enable() if was_on else libhealth.disable()
 
     # direct record-path cost: tight loop over the four hot call shapes
@@ -2370,6 +2378,378 @@ def bench_hash_plane(device: bool | None = None, n_threads: int | None = None):
     }
 
 
+def bench_device_ledger(
+    n_heights: int | None = None,
+    device: bool = False,
+    light_threads: int | None = None,
+    hash_threads: int | None = None,
+):
+    """Config 19: mixed-tenant storm through the device-time ledger.
+
+    One live 4-validator consensus burst (the config-13 harness) shares
+    a routed VerifyCoalescer and HashCoalescer with a light-service
+    verify storm and a CheckTx-shaped hash storm, every submit tagged
+    with its caller class (libs/devledger).  Headlines: the
+    consensus-caller queue-wait p99 under tenant pressure, per-caller
+    lane/time shares, the ledger-reconciliation check (caller-
+    attributed time sums to total window time within 1%), and the
+    per-height budget coverage (stages explain >=90% of measured
+    commit latency).  ``device=False`` pins every window to the host
+    path (the dead-tunnel branch) — attribution and reconciliation are
+    path-independent, which is exactly what this config proves.
+    """
+    import threading as _threading
+
+    from cometbft_tpu.crypto import coalesce as crypto_coalesce
+    from cometbft_tpu.crypto import hashplane as crypto_hashplane
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.libs import devledger as libdevledger
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.libs import metrics as libmetrics
+
+    if n_heights is None:
+        n_heights = _sz(12, 3)
+    if light_threads is None:
+        light_threads = _sz(8, 2)
+    if hash_threads is None:
+        hash_threads = _sz(4, 1)
+    warm_heights = _sz(2, 1)
+
+    ledger_was = libdevledger.enabled()
+    health_was = libhealth.enabled()
+    prev_ring = libhealth.recorder().capacity
+    libdevledger.enable()
+    libdevledger.reset()
+    libhealth.enable(ring=16384)
+    libhealth.reset()
+    m = libmetrics.NodeMetrics()
+    libmetrics.push_node_metrics(m)
+    # EVERYTHING fallible — plane construction, the net, the burst, the
+    # derive section — runs inside the restore scope below, so no
+    # failure path can leak the pushed metrics, the forced-on
+    # ledger/health, or the 4x ring into later configs
+    co = crypto_coalesce.VerifyCoalescer(
+        device=device,
+        # device rounds pin the cut low (the config-12 rationale: storm
+        # windows cap at thread count, far below the live crossover);
+        # host rounds coalesce into one host MSM per window either way
+        min_device_lanes=8 if device else (1 << 30),
+    )
+    hco = crypto_hashplane.HashCoalescer(
+        device=device, min_device_lanes=8 if device else (1 << 30)
+    )
+
+    # pre-signed storm material
+    lk = Ed25519PrivKey.from_seed(b"\x77" * 32)
+    lpub = lk.pub_key().data
+    lmsgs = [b"light-proof-%d" % i for i in range(4)]
+    lsigs = [lk.sign(msg) for msg in lmsgs]
+    lpubs = [lpub] * 4
+    tx = b"\xab" * 2048
+    stop = _threading.Event()
+    storm_counts = {"light": 0, "hash": 0}
+
+    def light_storm():
+        n = 0
+        while not stop.is_set():
+            with libdevledger.caller_class("light"):
+                bits = co.try_verify(lpubs, lmsgs, lsigs)
+            if bits is not None:
+                n += len(bits)
+        storm_counts["light"] += n
+
+    def hash_storm():
+        n = 0
+        while not stop.is_set():
+            with libdevledger.caller_class("mempool"):
+                digs = hco.try_hash_many([tx] * 8)
+            if digs is not None:
+                n += len(digs)
+        storm_counts["hash"] += n
+
+    threads = []
+    nodes = []
+    t_burst = 0.0
+    routed = False
+    try:
+        try:
+            co.start()
+            crypto_coalesce.push_active(co)
+            hco.start()
+            crypto_hashplane.push_active(hco)
+            routed = True
+            nodes = _perfect_gossip_net("bench-ledger")
+            store = nodes[0][1]["block_store"]
+            for cs, _ in nodes:
+                cs.start()
+            deadline = time.monotonic() + 240
+            while (
+                store.height() < warm_heights
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+            if store.height() < warm_heights:
+                raise RuntimeError("ledger burst never warmed")
+            for fn in (
+                [light_storm] * light_threads
+                + [hash_storm] * hash_threads
+            ):
+                t = _threading.Thread(target=fn, daemon=True)
+                t.start()
+                threads.append(t)
+            h0 = store.height()
+            t0 = time.perf_counter()
+            while (
+                store.height() < h0 + n_heights
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+            t_burst = time.perf_counter() - t0
+            commits = store.height() - h0
+            if commits <= 0:
+                raise RuntimeError("ledger burst stalled")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            _stop_net(nodes)
+            if routed:
+                crypto_hashplane.pop_active(hco)
+                crypto_coalesce.pop_active(co)
+            for svc in (hco, co):
+                try:
+                    if svc.is_running():
+                        svc.stop()
+                except Exception:
+                    pass
+        # -- derive the row from the ledger + ring (still inside the
+        # restore scope: a failure here must not leak the pushed
+        # metrics, the forced-on ledger/health, or the 4x ring into
+        # the configs that run after this one)
+        snap = libdevledger.snapshot()
+        recon = snap["reconciliation"]
+        recon_ok = all(
+            r["window_ns"] == 0 or abs(1.0 - r["ratio"]) <= 0.01
+            for r in recon.values()
+        )
+
+        def _p99_ms(callers) -> float:
+            fam = m.device_queue_wait
+            nb = len(fam.buckets) + 1
+            counts = [0] * nb
+            for name in callers:
+                child = fam.labels("verify", name)
+                for i in range(nb):
+                    counts[i] += child._counts[i]
+            return round(
+                libmetrics.quantile_from_buckets(
+                    fam.buckets, counts, 0.99
+                )
+                * 1e3,
+                3,
+            )
+
+        cons_p99 = _p99_ms(("consensus-vote", "proposal", "commit-verify"))
+        light_p99 = _p99_ms(("light",))
+        shares = {}
+        for plane, rows in snap["callers"].items():
+            total_lanes = sum(r["lanes"] for r in rows.values()) or 1
+            total_t = sum(
+                r["execute_s"] + r["host_s"] for r in rows.values()
+            ) or 1.0
+            shares[plane] = {
+                name: {
+                    "lane_pct": round(
+                        100.0 * r["lanes"] / total_lanes, 1
+                    ),
+                    "time_pct": round(
+                        100.0 * (r["execute_s"] + r["host_s"]) / total_t,
+                        1,
+                    ),
+                }
+                for name, r in rows.items()
+            }
+        bud = libhealth.budget()
+    finally:
+        libmetrics.pop_node_metrics(m)
+        libdevledger.enable() if ledger_was else libdevledger.disable()
+        libhealth.enable() if health_was else libhealth.disable()
+        # the 4x ring this config sized for its own burst must not tax
+        # (or pollute) every config that runs after it in the process
+        libhealth.set_ring_capacity(prev_ring)
+    return {
+        "heights": n_heights,
+        "burst_s": round(t_burst, 2),
+        "light_threads": light_threads,
+        "hash_threads": hash_threads,
+        "light_lanes": storm_counts["light"],
+        "hash_lanes": storm_counts["hash"],
+        "consensus_wait_p99_ms": cons_p99,
+        "light_wait_p99_ms": light_p99,
+        "caller_share_pct": shares,
+        "reconciliation": {
+            plane: {
+                "ratio": r["ratio"],
+                "window_ms": round(r["window_ns"] / 1e6, 2),
+            }
+            for plane, r in recon.items()
+        },
+        "reconciled_within_1pct": recon_ok,
+        "budget_coverage": bud["coverage"],
+        "budget_stage_fractions": bud["stage_fractions"],
+        "occupancy": snap["occupancy"],
+        "note": "4-val burst + light verify storm + CheckTx hash storm "
+        "over shared planes; shares/reconciliation from the lock-free "
+        "devledger columns, budget from the flight ring",
+    }
+
+
+# -------------------------------------------------- bench --compare
+
+
+def _compare_load_rows(path: str) -> dict:
+    """Rows-by-config from a BENCH_DETAILS*.json (list of config rows),
+    a BENCH_r*.json capture (JSON lines embedded in its ``tail``), or a
+    bare headline/config object."""
+    with open(path) as f:
+        obj = json.load(f)
+    rows: dict[str, dict] = {}
+
+    def _add(d) -> None:
+        if not isinstance(d, dict):
+            return
+        key = d.get("config") or ("headline" if "metric" in d else None)
+        if key is not None:
+            rows.setdefault(key, d)
+
+    if isinstance(obj, list):
+        for d in obj:
+            _add(d)
+    elif isinstance(obj, dict) and "tail" in obj:
+        # capture wrapper: best-effort recovery of the JSON objects the
+        # bench printed (one per line; the tail may cut the first line)
+        for line in str(obj["tail"]).splitlines():
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    _add(json.loads(line))
+                except ValueError:
+                    continue
+    else:
+        _add(obj)
+    return rows
+
+
+# metric-direction heuristics: which way is WORSE. Checked in order
+# (higher-better first), so e.g. device_window_pct — more windows on
+# the device path is the metric's goal — resolves higher-better before
+# any lower-better fragment could claim it; bare "_pct" is deliberately
+# NOT a lower-better fragment (overhead/noise/delta name their
+# lower-better percentage metrics explicitly).
+_HIGHER_IS_BETTER = (
+    "per_sec", "vs_baseline", "vs_serial", "vs_batch_baseline", "rate",
+    "hit", "coverage", "util", "value", "window_pct", "share",
+)
+_LOWER_IS_BETTER = (
+    "_ms", "_s", "latency", "seconds", "wait", "overhead", "noise",
+    "delta", "bytes", "compile",
+)
+
+
+def _metric_direction(key: str) -> int:
+    """+1 higher-better, -1 lower-better, 0 unknown (flag any move)."""
+    for frag in _HIGHER_IS_BETTER:
+        if frag in key:
+            return 1
+    for frag in _LOWER_IS_BETTER:
+        if frag in key:
+            return -1
+    return 0
+
+
+def bench_compare(path_a: str, path_b: str) -> dict:
+    """Noise-aware headline delta table across two bench runs.
+
+    Compares every numeric field of every config present in both runs;
+    a delta is flagged as a REGRESSION only when it moves in the
+    metric's worse direction by more than the measured noise floor —
+    taken from 13_health_overhead's ``ab_noise_floor_pct`` (the
+    off-window spread of one live burst, the config-13 methodology)
+    when either run recorded it, with a 10% default floor otherwise
+    and a 2% minimum (sub-noise jitter must never page).
+    """
+    a_rows = _compare_load_rows(path_a)
+    b_rows = _compare_load_rows(path_b)
+    floor = 10.0
+    for rows in (a_rows, b_rows):
+        h = rows.get("13_health_overhead")
+        if h and isinstance(h.get("ab_noise_floor_pct"), (int, float)):
+            floor = max(2.0, float(h["ab_noise_floor_pct"]))
+            break
+    deltas: list[dict] = []
+    regressions: list[dict] = []
+    for config in sorted(set(a_rows) & set(b_rows)):
+        ra, rb = a_rows[config], b_rows[config]
+        for key in sorted(set(ra) & set(rb)):
+            va, vb = ra[key], rb[key]
+            if (
+                not isinstance(va, (int, float))
+                or not isinstance(vb, (int, float))
+                or isinstance(va, bool)
+                or isinstance(vb, bool)
+                or va == 0
+            ):
+                continue
+            pct = 100.0 * (vb - va) / abs(va)
+            row = {
+                "config": config,
+                "metric": key,
+                "a": va,
+                "b": vb,
+                "delta_pct": round(pct, 2),
+            }
+            deltas.append(row)
+            if abs(pct) <= floor:
+                continue
+            direction = _metric_direction(key)
+            worse = (
+                (direction > 0 and pct < 0)
+                or (direction < 0 and pct > 0)
+                or direction == 0
+            )
+            if worse:
+                row["regression"] = True
+                regressions.append(row)
+    return {
+        "a": path_a,
+        "b": path_b,
+        "noise_floor_pct": round(floor, 2),
+        "compared": len(deltas),
+        "regressions": regressions,
+        "deltas": deltas,
+    }
+
+
+def compare_main(argv) -> int:
+    if len(argv) < 2:
+        print(
+            "usage: bench.py --compare A.json B.json  "
+            "(BENCH_DETAILS*.json / BENCH_r*.json / headline files)",
+            file=sys.stderr,
+        )
+        return 2
+    out = bench_compare(argv[0], argv[1])
+    for row in out["regressions"]:
+        print(
+            f"REGRESSION {row['config']}.{row['metric']}: "
+            f"{row['a']} -> {row['b']} ({row['delta_pct']:+.1f}% "
+            f"> noise {out['noise_floor_pct']}%)",
+            file=sys.stderr,
+        )
+    print(json.dumps(out))
+    return 1 if out["regressions"] else 0
+
+
 def main() -> None:
     _pin_cpu_if_requested()
     if not _probe_device():
@@ -2603,6 +2983,22 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "18_hash_plane", "backend": "host",
                      "error": repr(e)[:200]})
+        ledger_row = None
+        try:
+            # device pinned off: the mixed-tenant storm's windows all
+            # run host MSMs / hashlib — caller attribution and the
+            # reconciliation oracle are path-independent
+            ledger_row = bench_device_ledger(device=False)
+            _eprint(
+                {
+                    "config": "19_device_ledger",
+                    "backend": "host",
+                    **ledger_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "19_device_ledger", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -2689,6 +3085,18 @@ def main() -> None:
                             ]
                         }
                         if hash_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "ledger_consensus_wait_p99_ms": ledger_row[
+                                "consensus_wait_p99_ms"
+                            ],
+                            "ledger_reconciled": ledger_row[
+                                "reconciled_within_1pct"
+                            ],
+                        }
+                        if ledger_row
                         else {}
                     ),
                 }
@@ -2859,6 +3267,16 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "18_hash_plane", "error": repr(e)[:200]})
 
+    ledger_row = None
+    try:
+        # mixed-tenant storm over the shared planes with the device
+        # path live (min_device_lanes pinned low inside, the config-12
+        # rationale); attribution + reconciliation are the headline
+        ledger_row = bench_device_ledger(device=True)
+        _eprint({"config": "19_device_ledger", **ledger_row})
+    except Exception as e:
+        _eprint({"config": "19_device_ledger", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -2970,10 +3388,28 @@ def main() -> None:
                     if hash_row
                     else {}
                 ),
+                # consensus queue-wait p99 under a mixed-tenant storm
+                # + the ledger reconciliation oracle (config
+                # 19_device_ledger)
+                **(
+                    {
+                        "ledger_consensus_wait_p99_ms": ledger_row[
+                            "consensus_wait_p99_ms"
+                        ],
+                        "ledger_reconciled": ledger_row[
+                            "reconciled_within_1pct"
+                        ],
+                    }
+                    if ledger_row
+                    else {}
+                ),
             }
         )
     )
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        sys.exit(compare_main(sys.argv[i + 1 : i + 3]))
     main()

@@ -253,13 +253,16 @@ class BlocksyncReactor(Reactor):
                 raise VerificationError("second block missing last commit")
             if second.last_commit.block_id != first_id:
                 raise VerificationError("second block commits a fork?")
-            verify_commit_light(
-                self.state.chain_id,
-                self.state.validators,
-                first_id,
-                first.header.height,
-                second.last_commit,
-            )  # ◄◄ HOT BATCH (types/validation.go via TPU verifier)
+            from ..libs import devledger
+
+            with devledger.caller_class("blocksync"):
+                verify_commit_light(
+                    self.state.chain_id,
+                    self.state.validators,
+                    first_id,
+                    first.header.height,
+                    second.last_commit,
+                )  # ◄◄ HOT BATCH (types/validation.go via TPU verifier)
         except (VerificationError, ValueError):
             # Either block may be the forged one: redo BOTH and punish both
             # serving peers (reactor.go:447-470).

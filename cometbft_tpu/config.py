@@ -285,6 +285,20 @@ ENV_KNOBS: dict[str, str] = {
         "watchdog trips a black-box bundle; clears below half the "
         "threshold (hysteresis; default 50, consensus/wal.py)"
     ),
+    "COMETBFT_TPU_LEDGER": (
+        "device-time ledger (libs/devledger): per-(plane, caller) "
+        "attribution of the shared verify/hash coalescer planes — "
+        "auto (default, on while a node runs, refcounted like "
+        "devstats/health) | 1 force-on process-wide | 0 off (the "
+        "record path is a single flag check)"
+    ),
+    "COMETBFT_TPU_LEDGER_STARVE_MS": (
+        "consensus-starvation watchdog threshold: consensus-caller "
+        "verify queue-wait p99 in milliseconds above which — while "
+        "other callers dominate the window's lane share — the "
+        "consensus_starved watchdog trips and writes a black-box "
+        "bundle (default 50; <=0 disables; libs/health.py)"
+    ),
     "COMETBFT_TPU_STATESYNC_BACKOFF_S": (
         "base seconds of the per-peer exponential backoff the "
         "statesync chunk fetcher applies to a peer whose requests "

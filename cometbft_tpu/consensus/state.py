@@ -581,10 +581,13 @@ class ConsensusState(BaseService):
             # Keyed off the SET: a heterogeneous ed25519+sr25519 valset
             # pre-verifies through MixedBatchVerifier (one launch)
             # instead of losing batching to a foreign-key TypeError.
+            from ..libs import devledger
+
             verifier = crypto_batch.create_commit_batch_verifier(val_set)
             for pub_key, sign_bytes, sig in triples:
                 verifier.add(pub_key, sign_bytes, sig)
-            _, bits = verifier.verify()
+            with devledger.caller_class("consensus-vote"):
+                _, bits = verifier.verify()
         except (ValueError, TypeError):
             # no batch backend for some key type (e.g. secp256k1):
             # skip pre-verification — admission falls back to per-vote
@@ -1028,10 +1031,13 @@ class ConsensusState(BaseService):
         # votes draining around it (identical verdict; clean host
         # fallback inside crypto/coalesce.verify_signature).
         from ..crypto import coalesce as crypto_coalesce
+        from ..libs import devledger
 
-        if not crypto_coalesce.verify_signature(
-            proposer.pub_key, sign_bytes, proposal.signature
-        ):
+        with devledger.caller_class("proposal"):
+            sig_ok = crypto_coalesce.verify_signature(
+                proposer.pub_key, sign_bytes, proposal.signature
+            )
+        if not sig_ok:
             raise ConsensusError("invalid proposal signature")
         rs.proposal = proposal
         libmetrics.node_metrics().proposals.labels("accepted").inc()

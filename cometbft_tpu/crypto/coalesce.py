@@ -65,6 +65,7 @@ import threading
 import time
 from collections import deque
 
+from ..libs import devledger as libdevledger
 from ..libs import health as libhealth
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
@@ -186,10 +187,14 @@ class _Ticket:
     submit's lanes — never the whole window's.
     """
 
-    __slots__ = ("n", "t_submit", "_done", "_bits", "_exc")
+    __slots__ = ("n", "caller", "t_submit", "_done", "_bits", "_exc")
 
-    def __init__(self, n: int):
+    def __init__(self, n: int, caller: int = 0):
         self.n = n
+        # caller class (libs/devledger enum) captured at submit from
+        # the submitting thread's declaration — the device-time
+        # ledger's attribution key
+        self.caller = caller
         self.t_submit = time.perf_counter()
         self._done = threading.Event()
         self._bits: list[bool] | None = None
@@ -233,10 +238,14 @@ class _Inflight:
     """A dispatched-but-unmaterialized window (double-buffer slot)."""
 
     __slots__ = (
-        "finish", "host_ok", "groups", "lanes", "reason", "prep_s", "wire"
+        "finish", "host_ok", "groups", "lanes", "reason", "prep_s",
+        "wire", "t_launch",
     )
 
-    def __init__(self, finish, host_ok, groups, lanes, reason, prep_s, wire):
+    def __init__(
+        self, finish, host_ok, groups, lanes, reason, prep_s, wire,
+        t_launch=0.0,
+    ):
         self.finish = finish  # zero-arg materializer from ops/verify
         self.host_ok = host_ok
         self.groups = groups  # [(ticket, lo, n)]
@@ -249,6 +258,10 @@ class _Inflight:
         # gap to the device would systematically overstate its cost
         self.prep_s = prep_s
         self.wire = wire  # (pubkeys, msgs, sigs) for fault recovery
+        # window pop time: the queue-wait anchor the ledger charges
+        # tickets against (submit -> launch is queueing; launch ->
+        # resolve is execute)
+        self.t_launch = t_launch
 
 
 class VerifyCoalescer(BaseService):
@@ -446,8 +459,9 @@ class VerifyCoalescer(BaseService):
         """
         tickets: list[_Ticket] = []
         staged: list[tuple] = []
+        cid = libdevledger.current_caller()
         for pks, ms, ss in groups:
-            t = _Ticket(len(pks))
+            t = _Ticket(len(pks), cid)
             tickets.append(t)
             if t.n == 0:
                 t.resolve([])
@@ -838,6 +852,17 @@ class VerifyCoalescer(BaseService):
         in-flight handle (materialized by the NEXT loop turn — the
         double buffer); host windows resolve synchronously and return
         None."""
+        t_pop = time.perf_counter()
+        libdevledger.exec_begin(libdevledger.PLANE_VERIFY)
+        try:
+            return self._launch_inner(groups, lanes, reason, t_pop)
+        finally:
+            # the executor-busy marker brackets staging, pack, dispatch
+            # AND the inline host resolve — the occupancy view's
+            # overlap estimator reads it from the readback drain
+            libdevledger.exec_end(libdevledger.PLANE_VERIFY)
+
+    def _launch_inner(self, groups, lanes, reason, t_pop) -> _Inflight | None:
         pubkeys, msgs, sigs, staged = self._stage(groups)
         if not staged:
             # every group failed staging: nothing flushed, nothing to
@@ -890,9 +915,13 @@ class VerifyCoalescer(BaseService):
                     arena=arena,
                 )
                 self.device_windows += 1
+                libdevledger.note_window(
+                    libdevledger.PLANE_VERIFY, n, True
+                )
                 return _Inflight(
                     finish, host_ok, staged, n, reason,
                     time.perf_counter() - t0, (pubkeys, msgs, sigs),
+                    t_launch=t_pop,
                 )
             except Exception:
                 # device staging/dispatch fault: clean host fallback
@@ -900,12 +929,15 @@ class VerifyCoalescer(BaseService):
                 import traceback
 
                 traceback.print_exc()
-        self._resolve_host(pubkeys, msgs, sigs, staged, reason)
+        libdevledger.note_window(libdevledger.PLANE_VERIFY, n, False)
+        self._resolve_host(pubkeys, msgs, sigs, staged, reason, t_pop)
         return None
 
     def _finish(self, fl: _Inflight) -> None:
         """Materialize a dispatched window and resolve its tickets."""
         t0 = time.perf_counter()
+        t0_ns = time.monotonic_ns()
+        busy0 = libdevledger.exec_busy_ns(libdevledger.PLANE_VERIFY)
         try:
             device_ok = fl.finish()
         except Exception:
@@ -916,9 +948,14 @@ class VerifyCoalescer(BaseService):
 
             traceback.print_exc()
             pubkeys, msgs, sigs = fl.wire
-            self._resolve_host(pubkeys, msgs, sigs, fl.groups, fl.reason)
+            self._resolve_host(
+                pubkeys, msgs, sigs, fl.groups, fl.reason, fl.t_launch
+            )
             return
         now = time.perf_counter()
+        libdevledger.note_readback(
+            libdevledger.PLANE_VERIFY, t0_ns, busy0
+        )
         libmetrics.observe_verify_phase(
             "readback", "ed25519-coalesce", now - t0, fl.lanes
         )
@@ -926,9 +963,14 @@ class VerifyCoalescer(BaseService):
 
         crypto_batch.note_device_window(fl.lanes, fl.prep_s + (now - t0))
         valid = device_ok & fl.host_ok
-        self._resolve_bits(fl.groups, valid, fl.reason, "device")
+        self._resolve_bits(
+            fl.groups, valid, fl.reason, "device",
+            t_launch=fl.t_launch, exec_s=fl.prep_s + (now - t0),
+        )
 
-    def _resolve_host(self, pubkeys, msgs, sigs, staged, reason) -> None:
+    def _resolve_host(
+        self, pubkeys, msgs, sigs, staged, reason, t_launch=None
+    ) -> None:
         """Host-window verdicts: one native RLC batch for the whole
         window (coalescing still wins on host), sequential per-lane
         verify if the batch engine throws."""
@@ -954,14 +996,61 @@ class VerifyCoalescer(BaseService):
         from . import batch as crypto_batch
 
         crypto_batch.note_host_window(n, dt)
-        self._resolve_bits(staged, bitmap, reason, "host")
+        self._resolve_bits(
+            staged, bitmap, reason, "host", t_launch=t_launch, exec_s=dt
+        )
 
-    def _resolve_bits(self, staged, bits, reason, backend) -> None:
+    def _resolve_bits(
+        self, staged, bits, reason, backend, t_launch=None, exec_s=0.0
+    ) -> None:
         m = libmetrics.node_metrics()
         now = time.perf_counter()
+        total = 0
+        for _, _, n in staged:
+            total += n
+        exec_ns = int(exec_s * 1e9)
+        device = backend == "device"
+        plane = libdevledger.PLANE_VERIFY
+        # the WHOLE accounting block rides the ledger kill switch:
+        # COMETBFT_TPU_LEDGER=0 promises a single flag check, so the
+        # per-ticket histogram observes (two mutex hops each) and the
+        # EV_BUDGET ring rows go dark with the columns
+        ledger_on = libdevledger.enabled()
+        if ledger_on and exec_ns > 0:
+            libdevledger.note_window_time(plane, exec_ns)
+        # queue-wait anchor: the window pop — submit->pop is queueing,
+        # pop->resolve is execute (charged pro-rata by lane count so
+        # per-caller shares reconcile to the window total within
+        # integer floor error, < one ns per ticket)
+        anchor = t_launch if t_launch is not None else now
+        bw = bx = 0  # consensus-caller wait/exec sums (the budget row)
         for ticket, lo, n in staged:
             ticket.resolve([bool(b) for b in bits[lo : lo + n]])
             m.coalesce_wait_seconds.observe(now - ticket.t_submit)
+            if not ledger_on:
+                continue
+            wait_ns = int((anchor - ticket.t_submit) * 1e9)
+            if wait_ns < 0:
+                wait_ns = 0
+            share = exec_ns * n // total if total else 0
+            cid = ticket.caller
+            libdevledger.note_resolve(
+                plane, cid, n, wait_ns,
+                share if device else 0, 0 if device else share,
+            )
+            m.device_queue_wait.labels(
+                "verify", libdevledger.caller_name(cid)
+            ).observe(wait_ns / 1e9)
+            if cid in libdevledger.BUDGET_VERIFY_CALLERS:
+                bw += wait_ns
+                bx += share
+        if bw or bx:
+            # the per-height budget overlay: consensus-caller verify
+            # queue+execute time, window-assigned to a height by the
+            # budget decomposition (libs/health.budget)
+            libhealth.record(
+                libhealth.EV_BUDGET, 0, plane, bw, bx
+            )
         if libtrace.enabled():
             libtrace.event(
                 "coalesce.flush",

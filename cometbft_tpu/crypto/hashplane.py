@@ -59,6 +59,7 @@ import threading
 import time
 from collections import deque
 
+from ..libs import devledger as libdevledger
 from ..libs import health as libhealth
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
@@ -122,10 +123,15 @@ class HashplaneStoppedError(ServiceError):
 class _Ticket:
     """One submit()'s pending digests; resolved exactly once."""
 
-    __slots__ = ("n", "blocks", "t_submit", "_done", "_digests", "_exc")
+    __slots__ = (
+        "n", "blocks", "caller", "t_submit", "_done", "_digests", "_exc"
+    )
 
-    def __init__(self, n: int, blocks: int = 0):
+    def __init__(self, n: int, blocks: int = 0, caller: int = 0):
         self.n = n
+        # caller class (libs/devledger enum) captured at submit — the
+        # device-time ledger's attribution key
+        self.caller = caller
         # total padded SHA blocks across this submit's lanes — the
         # executor's work-proportional deadline budget reads it
         self.blocks = blocks
@@ -165,9 +171,13 @@ class _Ticket:
 class _Inflight:
     """A window with dispatched-but-unmaterialized device buckets."""
 
-    __slots__ = ("finishes", "out", "groups", "lanes", "reason", "device")
+    __slots__ = (
+        "finishes", "out", "groups", "lanes", "reason", "device",
+        "t_launch", "host_s",
+    )
 
-    def __init__(self, finishes, out, groups, lanes, reason):
+    def __init__(self, finishes, out, groups, lanes, reason,
+                 t_launch=0.0, host_s=0.0):
         # [(materializer, window_indices, block_bucket, prep_s, lanes)]
         self.finishes = finishes
         self.out = out  # window-ordered digest slots (host buckets filled)
@@ -175,6 +185,11 @@ class _Inflight:
         self.lanes = lanes
         self.reason = reason
         self.device = bool(finishes)
+        # window pop time (queue-wait anchor) and the host-bucket
+        # fallback seconds already spent at launch — _finish adds the
+        # device buckets' prep+readback for the window execute total
+        self.t_launch = t_launch
+        self.host_s = host_s
 
 
 class _BucketCrossover:
@@ -383,13 +398,14 @@ class HashCoalescer(BaseService):
 
         tickets: list[_Ticket] = []
         staged: list[tuple] = []
+        cid = libdevledger.current_caller()
         for msgs in groups:
             blocks = 0
             try:
                 blocks = sum(n_blocks(len(m)) for m in msgs)
             except TypeError:
                 pass  # unsized lanes fail in _stage, per-ticket
-            t = _Ticket(len(msgs), blocks)
+            t = _Ticket(len(msgs), blocks, cid)
             tickets.append(t)
             if t.n == 0:
                 t.resolve([])
@@ -757,6 +773,14 @@ class HashCoalescer(BaseService):
         double buffer materializes them NEXT loop turn); host buckets
         resolve inline with hashlib. Returns an in-flight handle when
         any device bucket launched, else resolves synchronously."""
+        t_pop = time.perf_counter()
+        libdevledger.exec_begin(libdevledger.PLANE_HASH)
+        try:
+            return self._launch_inner(groups, lanes, reason, t_pop)
+        finally:
+            libdevledger.exec_end(libdevledger.PLANE_HASH)
+
+    def _launch_inner(self, groups, lanes, reason, t_pop) -> _Inflight | None:
         from ..ops import sha256 as osha
 
         msgs, staged, wire = self._stage(groups)
@@ -776,6 +800,7 @@ class HashCoalescer(BaseService):
             buckets.setdefault(bb, []).append(i)
         out: list[bytes | None] = [None] * n
         finishes = []
+        host_s = 0.0
         for bb in sorted(buckets):
             idxs = buckets[bb]
             sub = [msgs[i] for i in idxs]
@@ -800,23 +825,39 @@ class HashCoalescer(BaseService):
             for i in idxs:
                 out[i] = hashlib.sha256(msgs[i]).digest()
             dt = time.perf_counter() - t0
+            host_s += dt
             libmetrics.observe_hash_phase("fallback", dt, len(idxs))
             CROSSOVER.note_host(bb, len(idxs), dt)
         if finishes:
             self.device_windows += 1
-            return _Inflight(finishes, out, wire, n, reason)
-        self._resolve_bits(staged, out, reason, "host")
+            libdevledger.note_window(libdevledger.PLANE_HASH, n, True)
+            return _Inflight(
+                finishes, out, wire, n, reason,
+                t_launch=t_pop, host_s=host_s,
+            )
+        libdevledger.note_window(libdevledger.PLANE_HASH, n, False)
+        self._resolve_bits(
+            staged, out, reason, "host", t_launch=t_pop, host_s=host_s
+        )
         return None
 
     def _finish(self, fl: _Inflight) -> None:
         """Materialize a window's device buckets and resolve tickets."""
+        t0_ns = time.monotonic_ns()
+        busy0 = libdevledger.exec_busy_ns(libdevledger.PLANE_HASH)
+        device_s = 0.0
         for finish, idxs, bb, prep, k in fl.finishes:
             t0 = time.perf_counter()
             try:
                 digests = finish()
             except Exception:
                 # device fault at materialization: hashlib fallback for
-                # the bucket — verdict-identical, never an error
+                # the bucket — verdict-identical, never an error. The
+                # recovery's hashlib time is NOT folded into device_s:
+                # the whole window resolves as backend="device", and
+                # charging host fault-recovery time as device execute
+                # would skew the ledger exactly during the fault
+                # episodes attribution exists to explain.
                 import traceback
 
                 traceback.print_exc()
@@ -824,24 +865,72 @@ class HashCoalescer(BaseService):
                     fl.out[i] = hashlib.sha256(fl_msg(fl, i)).digest()
                 continue
             dt = time.perf_counter() - t0
+            device_s += prep + dt
             libmetrics.observe_hash_phase("readback", dt, k)
             CROSSOVER.note_device(bb, k, prep + dt)
             for j, i in enumerate(idxs):
                 fl.out[i] = digests[j]
+        libdevledger.note_readback(libdevledger.PLANE_HASH, t0_ns, busy0)
         staged = []
         lo = 0
         for ticket, lanes in fl.groups:
             staged.append((ticket, lo, ticket.n))
             lo += ticket.n
-        self._resolve_bits(staged, fl.out, fl.reason, "device")
+        self._resolve_bits(
+            staged, fl.out, fl.reason, "device",
+            t_launch=fl.t_launch, exec_s=device_s, host_s=fl.host_s,
+        )
 
-    def _resolve_bits(self, staged, out, reason, backend) -> None:
+    def _resolve_bits(
+        self, staged, out, reason, backend, t_launch=None,
+        exec_s=0.0, host_s=0.0,
+    ) -> None:
+        """Resolve tickets, then account.  ``exec_s`` is the window's
+        DEVICE bucket time, ``host_s`` its inline hashlib bucket time —
+        a mixed window charges callers both shares separately, so
+        /debug/budget's execute_s/host_s split never reports host work
+        as device time."""
         for ticket, lo, n in staged:
             ticket.resolve(out[lo : lo + n])
+        total = 0
+        for _, _, n in staged:
+            total += n
+        # ledger kill switch gates the whole accounting block
+        # (histogram observes + EV_BUDGET rows), same as the verify
+        # plane — a dark ledger costs one flag check here
+        if libdevledger.enabled():
+            m = libmetrics.node_metrics()
+            plane = libdevledger.PLANE_HASH
+            exec_ns = int(exec_s * 1e9)
+            host_ns = int(host_s * 1e9)
+            if exec_ns + host_ns > 0:
+                libdevledger.note_window_time(plane, exec_ns + host_ns)
+            anchor = (
+                t_launch if t_launch is not None else time.perf_counter()
+            )
+            bw = bx = 0  # FSM-adjacent (merkle/mempool) wait/exec sums
+            for ticket, lo, n in staged:
+                wait_ns = int((anchor - ticket.t_submit) * 1e9)
+                if wait_ns < 0:
+                    wait_ns = 0
+                dev_share = exec_ns * n // total if total else 0
+                host_share = host_ns * n // total if total else 0
+                cid = ticket.caller
+                libdevledger.note_resolve(
+                    plane, cid, n, wait_ns, dev_share, host_share
+                )
+                m.device_queue_wait.labels(
+                    "hash", libdevledger.caller_name(cid)
+                ).observe(wait_ns / 1e9)
+                if cid in libdevledger.BUDGET_HASH_CALLERS:
+                    bw += wait_ns
+                    bx += dev_share + host_share
+            if bw or bx:
+                libhealth.record(libhealth.EV_BUDGET, 0, plane, bw, bx)
         if libhealth.enabled():
             libhealth.record(
                 libhealth.EV_HASH,
-                a=sum(n for _, _, n in staged),
+                a=total,
                 b=1 if backend == "device" else 0,
             )
         if libtrace.enabled():
@@ -849,7 +938,7 @@ class HashCoalescer(BaseService):
                 "hash.flush",
                 reason=reason,
                 backend=backend,
-                lanes=sum(n for _, _, n in staged),
+                lanes=total,
                 tickets=len(staged),
             )
 

@@ -30,10 +30,14 @@ INNER_PREFIX = b"\x01"
 def _leaf_hash(item: bytes) -> bytes:
     # routed: a 64 KiB PartSet leaf coalesces into a device window when
     # the hash plane is up; small leaves (and device-less containers)
-    # take the plain host hash with zero round trips
+    # take the plain host hash with zero round trips. Ledger default:
+    # untagged merkle hashing attributes to the merkle tenant (an
+    # outer mempool/blocksync declaration wins).
     from . import hashplane
+    from ..libs import devledger
 
-    return hashplane.hash_bytes(LEAF_PREFIX + item)
+    with devledger.caller_class("merkle"):
+        return hashplane.hash_bytes(LEAF_PREFIX + item)
 
 
 def _inner_hash(left: bytes, right: bytes) -> bytes:
@@ -59,21 +63,25 @@ def _compute_levels(items: list[bytes]) -> list[list[bytes]]:
     cannot fork between the two paths.
     """
     from . import hashplane
+    from ..libs import devledger
 
-    level = hashplane.hash_many([LEAF_PREFIX + bytes(x) for x in items])
-    levels = [level]
-    while len(level) > 1:
-        nxt = hashplane.hash_many(
-            [
-                INNER_PREFIX + level[i] + level[i + 1]
-                for i in range(0, len(level) - 1, 2)
-            ]
+    with devledger.caller_class("merkle"):
+        level = hashplane.hash_many(
+            [LEAF_PREFIX + bytes(x) for x in items]
         )
-        if len(level) % 2:
-            nxt.append(level[-1])
-        level = nxt
-        levels.append(level)
-    return levels
+        levels = [level]
+        while len(level) > 1:
+            nxt = hashplane.hash_many(
+                [
+                    INNER_PREFIX + level[i] + level[i + 1]
+                    for i in range(0, len(level) - 1, 2)
+                ]
+            )
+            if len(level) % 2:
+                nxt.append(level[-1])
+            level = nxt
+            levels.append(level)
+        return levels
 
 
 def hash_from_byte_slices(items: list[bytes]) -> bytes:

@@ -27,14 +27,22 @@ def verify_evidence(ev, state, val_set_at_height, common_val_set=None) -> None:
             f"evidence from height {ev.height()} is too old "
             f"({age_blocks} blocks / {age_ns / 1e9:.0f}s)"
         )
-    if isinstance(ev, DuplicateVoteEvidence):
-        verify_duplicate_vote(ev, state.chain_id, val_set_at_height)
-    elif isinstance(ev, LightClientAttackEvidence):
-        verify_light_client_attack(
-            ev, state.chain_id, common_val_set or val_set_at_height
-        )
-    else:
-        raise EvidenceError(f"unknown evidence type {type(ev).__name__}")
+    from ..libs import devledger
+
+    # outermost ledger tenant: every routed verify under evidence
+    # checking (vote signatures, the attack header's trusting commit
+    # check) attributes to the evidence caller class
+    with devledger.caller_class("evidence"):
+        if isinstance(ev, DuplicateVoteEvidence):
+            verify_duplicate_vote(ev, state.chain_id, val_set_at_height)
+        elif isinstance(ev, LightClientAttackEvidence):
+            verify_light_client_attack(
+                ev, state.chain_id, common_val_set or val_set_at_height
+            )
+        else:
+            raise EvidenceError(
+                f"unknown evidence type {type(ev).__name__}"
+            )
 
 
 def verify_duplicate_vote(

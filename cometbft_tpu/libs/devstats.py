@@ -614,8 +614,21 @@ def snapshot() -> dict:
             "d2h_ops": c["d2h_ops"],
             "d2h_bytes": c["d2h_bytes"],
         },
+        # who used the device: the per-(plane, caller) time/lane ledger
+        # and its occupancy view (libs/devledger; full budget plane at
+        # /debug/budget)
+        "device_ledger": _ledger_block(),
         **sample(),
     }
+
+
+def _ledger_block() -> dict:
+    try:
+        from . import devledger as libdevledger
+
+        return libdevledger.snapshot()
+    except Exception as e:  # a ledger fault must not sink a bundle
+        return {"error": repr(e)}
 
 
 # --------------------------------------------------------- scrape server

@@ -69,6 +69,7 @@ import threading
 import time
 from array import array
 
+from . import devledger as libdevledger
 from . import metrics as libmetrics
 from . import netstats as libnetstats
 from . import sync as libsync
@@ -112,6 +113,12 @@ EV_WATCHDOG = 8  # a=watchdog bit (see _WATCHDOGS)
 EV_GOSSIP = 9  # a=propagation phase code (netstats.PHASE_NAMES), b=lag ns
 EV_FAULT = 10  # simnet fault plane: h=src node, r=dst node, a=kind, b=detail
 EV_HASH = 11  # hash-plane window flush: a=lanes, b=1 device / 0 host
+# plane.budget: FSM-blocking device-plane time per window resolution —
+# r=plane (libs/devledger: 0 verify / 1 hash), a=consensus-caller
+# queue-wait ns, b=consensus-caller pro-rata execute ns. The per-height
+# latency budget (budget_from_events) window-assigns these rows to the
+# height they delayed, exactly like EV_FSYNC.
+EV_BUDGET = 12
 
 _N_CODES = 16  # size of the per-code last-seen vector
 
@@ -174,6 +181,7 @@ _CODE_NAMES = {
     EV_GOSSIP: "p2p.gossip",
     EV_FAULT: "simnet.fault",
     EV_HASH: "hash.flush",
+    EV_BUDGET: "plane.budget",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -188,12 +196,13 @@ _CODE_FIELDS = {
     EV_GOSSIP: ("phase", "lag_ns"),
     EV_FAULT: ("kind", "detail"),
     EV_HASH: ("lanes", "device"),
+    EV_BUDGET: ("wait_ns", "exec_ns"),
 }
 
 # codes whose payload is a wall-clock-measured duration: meaningless in
 # a virtual-time (simnet) ring, so the cross-node timeline merge drops
 # them from virtual-domain sources (cometbft_tpu/postmortem)
-WALL_DURATION_CODES = frozenset({EV_FSYNC})
+WALL_DURATION_CODES = frozenset({EV_FSYNC, EV_BUDGET})
 
 
 def ring_event_codes() -> dict[str, int]:
@@ -218,7 +227,16 @@ _WATCHDOGS = (
     ("recompile_storm", 4),
     ("send_queue_saturated", 8),
     ("slow_disk", 16),
+    ("consensus_starved", 32),
 )
+# consensus_starved: consensus-caller verify queue-wait p99 (windowed,
+# from the device_queue_wait_seconds buckets) above the threshold WHILE
+# other callers dominate the window's lane share — a light-service /
+# mempool storm taxing consensus through the shared device planes. The
+# lane-share test keeps an overloaded-but-fairly-shared plane from
+# paging as starvation.
+STARVE_LANE_SHARE = 0.5  # others' share that counts as "dominating"
+STARVE_MIN_LANES = 64  # ledger lanes per check window before judging
 # send_queue_saturated: this many CONSECUTIVE checks each observing
 # fresh MConnection.send drops on a consensus channel = sustained
 # backpressure (a one-off burst drop re-baselines without a trip)
@@ -336,7 +354,7 @@ class FlightRecorder:
 
     __slots__ = (
         "capacity", "_ts", "_code", "_h", "_r", "_a", "_b", "_o",
-        "_seq", "_written", "_last",
+        "_seq", "_written", "_last", "_commits",
     )
 
     def __init__(self, capacity: int = DEFAULT_RING_SIZE):
@@ -353,6 +371,9 @@ class FlightRecorder:
         self._written = array("q", [0])
         # monotonic last-seen per event code (watchdog math)
         self._last = array("d", [0.0] * _N_CODES)
+        # commit-row tally: the budget memo's invalidation key — the
+        # per-height decomposition only changes when a height closes
+        self._commits = array("q", [0])
 
     def record(
         self, code: int, height: int = 0, round_: int = 0,
@@ -372,6 +393,8 @@ class FlightRecorder:
             # the one last-seen the stall watchdog consumes; the other
             # codes skip the extra clock read on the hot path
             self._last[EV_STEP] = time.monotonic()
+        elif code == EV_COMMIT:
+            self._commits[0] = self._commits[0] + 1
         if seq >= self._written[0]:
             self._written[0] = seq + 1
 
@@ -425,6 +448,12 @@ class FlightRecorder:
                     rec["src"] = origin_name(self._r[i])
             elif code == EV_FAULT:
                 rec["fault_name"] = _FAULT_NAMES.get(self._a[i], "?")
+            elif code == EV_BUDGET:
+                # the plane rides the round column (libs/devledger
+                # plane codes); heightless rows keep round=plane
+                rec["plane"] = libdevledger.PLANES[
+                    self._r[i] % len(libdevledger.PLANES)
+                ]
             o = self._o[i]
             if o:
                 rec["node"] = origin_name(o)
@@ -479,18 +508,11 @@ def _quantile(values: list[float], q: float) -> float | None:
 def histogram_quantile(h, q: float) -> float:
     """Upper-bound quantile estimate from a libs/metrics Histogram's
     cumulative buckets (the promql-style read).  Unlocked GIL-consistent
-    snapshot: the scrape path must not contend with observers."""
-    counts = list(h._counts)
-    total = sum(counts)
-    if total == 0:
-        return 0.0
-    rank = q * total
-    cum = 0
-    for i, c in enumerate(counts[:-1]):
-        cum += c
-        if cum >= rank:
-            return float(h.buckets[i])
-    return float(h.buckets[-1])  # everything above the top edge
+    snapshot: the scrape path must not contend with observers.  The
+    math lives in the shared :func:`libmetrics.quantile_from_buckets`
+    estimator (one implementation for health, netstats and the
+    device-ledger budget plane)."""
+    return libmetrics.quantile_from_buckets(h.buckets, list(h._counts), q)
 
 
 # -------------------------------------------------- module-level recorder
@@ -570,6 +592,208 @@ def export_ring(node: str | None = None) -> dict:
         "ring": _REC.status(),
         "events": _REC.dump(),
     }
+
+
+# ------------------------------------------------- per-height budget
+
+# The stage vocabulary of the per-height latency budget — the ``stage``
+# label of height_budget_seconds and the keys of every budget row.
+BUDGET_STAGES = (
+    "proposal_wait",  # enter-height -> Prevote step (proposal receipt)
+    "gossip",  # vote-gathering wall time net of plane overlays
+    "verify_queue",  # consensus-caller coalescer queue wait
+    "verify_execute",  # consensus-caller pro-rata verify execute
+    "hash",  # FSM-adjacent hash-plane time (merkle/mempool)
+    "wal_fsync",  # WAL fsync durations in the height window
+    "apply",  # Commit step -> applied, net of fsync overlay
+    "residual",  # whatever the named stages don't explain
+)
+
+_STEP_PREVOTE = 4  # RoundStep.PREVOTE in the EV_STEP step column
+_STEP_COMMIT = 8  # RoundStep.COMMIT
+
+
+def budget_from_events(events) -> dict[int, dict]:
+    """Decompose each committed height's latency into BUDGET_STAGES.
+
+    Input is a decoded event stream (``FlightRecorder.dump()`` rows, a
+    ``flight.json`` export's ``events``, or a merged multi-node
+    stream).  Per height: the EARLIEST commit row anchors the window
+    ``[commit_ts - latency, commit_ts]``; that node's first Prevote and
+    Commit step rows split it into proposal / vote-gathering / apply
+    spans; ``plane.budget`` (EV_BUDGET) and ``wal.fsync`` rows are
+    window-assigned by timestamp as overlays, and each span reports its
+    remainder — so the stages tile the measured latency and
+    ``coverage`` (stage sum / latency) sits at ~1.0 on a healthy burst.
+    Pure function: deterministic for a given event list (the timeline
+    merge reuses it for its per-height budget rows)."""
+    commits: dict[int, tuple] = {}
+    steps: dict[tuple, dict] = {}
+    planes: list[tuple] = []
+    fsyncs: list[tuple] = []
+    for ev in events:
+        name = ev.get("event")
+        if name == "consensus.commit":
+            h = ev.get("height", 0)
+            if h:
+                cur = commits.get(h)
+                if cur is None or ev.get("ts", 0) < cur[0]:
+                    commits[h] = (
+                        ev.get("ts", 0), ev.get("dur_ns", 0),
+                        ev.get("node"),
+                    )
+        elif name == "consensus.step":
+            h = ev.get("height", 0)
+            if h:
+                d = steps.setdefault((h, ev.get("node")), {})
+                s = ev.get("step")
+                if s not in d:
+                    d[s] = ev.get("ts", 0)
+        elif name == "plane.budget":
+            planes.append((
+                ev.get("ts", 0), ev.get("plane"),
+                ev.get("wait_ns", 0), ev.get("exec_ns", 0),
+            ))
+        elif name == "wal.fsync":
+            fsyncs.append((ev.get("ts", 0), ev.get("dur_ns", 0)))
+    out: dict[int, dict] = {}
+    for h in sorted(commits):
+        cts, dur, node = commits[h]
+        if dur <= 0:
+            continue
+        t0 = cts - dur
+        sd = steps.get((h, node), {})
+        t_pv = sd.get(_STEP_PREVOTE)
+        t_cm = sd.get(_STEP_COMMIT)
+        have_steps = t_pv is not None
+        e1 = min(max(t_pv, t0), cts) if t_pv else cts
+        e2 = min(max(t_cm, e1), cts) if t_cm else cts
+
+        def _span(ts: int) -> int:
+            if ts <= e1:
+                return 0
+            return 1 if ts <= e2 else 2
+
+        # per span: [verify_wait, verify_exec, hash, fsync]
+        ov = [[0, 0, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]]
+        for ts, plane, w, x in planes:
+            if t0 <= ts <= cts:
+                k = _span(ts)
+                if plane == "verify":
+                    ov[k][0] += w
+                    ov[k][1] += x
+                else:
+                    ov[k][2] += w + x
+        for ts, d in fsyncs:
+            if t0 <= ts <= cts:
+                ov[_span(ts)][3] += d
+        # Clamp each span's overlay total to the span's wall length:
+        # FSM-blocking time inside a span cannot exceed the span, but
+        # a shared multi-node ring (in-process nets, simnet) assigns
+        # every node's plane rows to the one committing node's window,
+        # and concurrent-thread callers (CheckTx hashing) overlap the
+        # FSM wall — scaling the components pro-rata keeps the stage
+        # tiling honest (coverage ~1.0) instead of double-counting.
+        spans = (e1 - t0, e2 - e1, cts - e2)
+        for k in range(3):
+            tot = ov[k][0] + ov[k][1] + ov[k][2] + ov[k][3]
+            if tot > spans[k] > 0:
+                for j in range(4):
+                    ov[k][j] = ov[k][j] * spans[k] // tot
+            elif tot > 0 and spans[k] <= 0:
+                ov[k] = [0, 0, 0, 0]
+        vq = ov[0][0] + ov[1][0] + ov[2][0]
+        vx = ov[0][1] + ov[1][1] + ov[2][1]
+        hs = ov[0][2] + ov[1][2] + ov[2][2]
+        fs = ov[0][3] + ov[1][3] + ov[2][3]
+        # a height with NO step rows cannot attribute its wall time to
+        # a protocol stage — the unexplained remainder goes to
+        # `residual`, not `proposal_wait`, so residual is the honest
+        # "no data / decomposition gap" signal rather than a stage
+        # that silently absorbs everything
+        proposal_wait = (
+            max(0, (e1 - t0) - sum(ov[0])) if have_steps else 0
+        )
+        gossip = max(0, (e2 - e1) - sum(ov[1]))
+        apply_ = max(0, (cts - e2) - sum(ov[2]))
+        named = proposal_wait + gossip + apply_ + vq + vx + hs + fs
+        residual = max(0, dur - named)
+        stages_ns = {
+            "proposal_wait": proposal_wait,
+            "gossip": gossip,
+            "verify_queue": vq,
+            "verify_execute": vx,
+            "hash": hs,
+            "wal_fsync": fs,
+            "apply": apply_,
+            "residual": residual,
+        }
+        out[h] = {
+            "height": h,
+            "node": node,
+            "latency_s": round(dur / 1e9, 9),
+            "stages": {
+                s: round(v / 1e9, 9) for s, v in stages_ns.items()
+            },
+            "coverage": round((named + residual) / dur, 4),
+        }
+    return out
+
+
+# budget() memo for the live-ring case: [recorder identity, commit
+# tally, result]. sample() runs on every metrics scrape (and health
+# tests poll it in tight loops); the per-height decomposition only
+# changes when a height CLOSES, so keying the memo on the commit-row
+# tally makes every between-commits scrape O(1) instead of a full
+# 4096+-slot ring decode. (Overlay rows resolved after a commit carry
+# post-commit timestamps, outside every closed window — they cannot
+# change a cached view.)
+_BUDGET_CACHE: list = [None, -1, None]
+
+
+def budget(events=None) -> dict:
+    """The per-height latency-budget view: ``/debug/budget``'s budget
+    body, ``budget.json``'s, and the source of the
+    ``height_budget_seconds{stage}`` gauges.  ``events`` defaults to
+    the live flight ring (memoized on the ring's commit tally — no new
+    commit returns the cached view without re-decoding)."""
+    if events is None:
+        rec = _REC
+        cursor = rec._commits[0]
+        if _BUDGET_CACHE[0] is rec and _BUDGET_CACHE[1] == cursor:
+            return _BUDGET_CACHE[2]
+        evs = rec.dump()
+    else:
+        rec = None
+        evs = events
+    per = budget_from_events(evs)
+    heights = [per[h] for h in sorted(per)]
+    agg = {s: 0.0 for s in BUDGET_STAGES}
+    tot = 0.0
+    for hv in heights:
+        for s in BUDGET_STAGES:
+            agg[s] += hv["stages"][s]
+        tot += hv["latency_s"]
+    out = {
+        "commits": len(heights),
+        "heights": heights,
+        "stages_total_s": {s: round(v, 6) for s, v in agg.items()},
+        "stage_fractions": (
+            {s: round(v / tot, 4) for s, v in agg.items()}
+            if tot > 0
+            else None
+        ),
+        "coverage": (
+            round(sum(agg.values()) / tot, 4) if tot > 0 else None
+        ),
+    }
+    if events is None:
+        # value slot FIRST: a concurrent reader that matches the key
+        # slots below must find the new result, never None/stale
+        _BUDGET_CACHE[2] = out
+        _BUDGET_CACHE[1] = cursor
+        _BUDGET_CACHE[0] = rec
+    return out
 
 
 def acquire() -> None:
@@ -672,6 +896,9 @@ class HealthMonitor(BaseService):
         storm_recompiles: int = STORM_RECOMPILES,
         storm_window_s: float = STORM_WINDOW_S,
         saturation_streak: int = SATURATION_STREAK,
+        starve_s: float | None = None,
+        starve_share: float = STARVE_LANE_SHARE,
+        starve_min_lanes: int = STARVE_MIN_LANES,
         interval_s: float | None = None,
         trace_tail: int = 512,
         idle_ok=None,
@@ -730,6 +957,34 @@ class HealthMonitor(BaseService):
         # drops that predate this monitor must not count toward a streak
         self._qfull = array("q", [0, 0])
         self._qfull[_QF_SEEN] = libnetstats.consensus_queue_full_total()
+        # -- consensus-starvation state (preallocated, the _qfull
+        # posture): [prev consensus lanes, prev total lanes, starved
+        # flag]; the windowed queue-wait bucket watermarks allocate
+        # lazily on the first window that reaches starve_min_lanes —
+        # never on the steady no-traffic path the tracemalloc guard
+        # drives. ``starve_s <= 0`` disables the watchdog.
+        self.starve_s = (
+            starve_s
+            if starve_s is not None
+            else libdevledger.starve_threshold_s()
+        )
+        self.starve_share = starve_share
+        self.starve_min_lanes = max(1, starve_min_lanes)
+        self._sv = array("q", [0, 0, 0])
+        cons0, total0 = libdevledger.verify_lanes_split()
+        self._sv[0] = cons0  # lanes that predate this monitor don't count
+        self._sv[1] = total0
+        self._starve_counts: array | None = None
+        if self.starve_s > 0:
+            try:
+                # same watermark posture as the lanes above: queue-wait
+                # observations that predate this monitor must not leak
+                # into the first judged window's p99 (the delta would
+                # otherwise be computed against a zero baseline and
+                # replay an old storm as a fresh trip)
+                self._consensus_wait_p99()
+            except Exception:
+                pass  # no metrics yet: first _check seeds the baseline
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -856,7 +1111,61 @@ class HealthMonitor(BaseService):
             if degraded and st[_ST_DISK_DEGRADED] == 0.0:
                 mask |= 16
             st[_ST_DISK_DEGRADED] = 1.0 if degraded else 0.0
+        # -- consensus starvation: consensus-caller verify queue-wait
+        # p99 (windowed from the device_queue_wait_seconds buckets)
+        # above the threshold WHILE other callers dominate the lane
+        # share of the same window. Judged only once the ledger saw
+        # starve_min_lanes fresh lanes — an idle or lightly-loaded
+        # plane is never starved, and the no-traffic check path stays
+        # allocation-free. Edge-triggered per episode like slow_disk.
+        if self.starve_s > 0:
+            sv = self._sv
+            cons, total = libdevledger.verify_lanes_split()
+            d_total = total - sv[1]
+            if d_total >= self.starve_min_lanes:
+                d_cons = cons - sv[0]
+                sv[0] = cons
+                sv[1] = total
+                others = d_total - d_cons
+                dominate = others >= d_total * self.starve_share
+                p99 = self._consensus_wait_p99()
+                if dominate and p99 > self.starve_s:
+                    if sv[2] == 0:
+                        mask |= 32
+                    sv[2] = 1
+                else:
+                    sv[2] = 0
         return mask
+
+    def _consensus_wait_p99(self) -> float:
+        """Windowed p99 of the consensus-caller verify queue wait:
+        delta of the device_queue_wait_seconds{plane=verify,caller}
+        buckets (summed over the consensus caller classes) since the
+        last judged window, through the shared
+        libmetrics.quantile_from_buckets estimator."""
+        m = self.metrics if self.metrics is not None else (
+            libmetrics.node_metrics()
+        )
+        fam = m.device_queue_wait
+        nb = len(fam.buckets) + 1
+        prev = self._starve_counts
+        if prev is None:
+            prev = self._starve_counts = array("q", [0] * nb)
+        cur = [0] * nb
+        for cid in libdevledger.BUDGET_VERIFY_CALLERS:
+            child = fam.labels("verify", libdevledger.caller_name(cid))
+            cc = child._counts
+            for i in range(nb):
+                cur[i] += cc[i]
+        delta = [0] * nb
+        for i in range(nb):
+            delta[i] = cur[i] - prev[i]
+            prev[i] = cur[i]
+        return libmetrics.quantile_from_buckets(fam.buckets, delta, 0.99)
+
+    def starved(self) -> bool:
+        """Last-observed consensus-starvation state."""
+        return self._sv[2] != 0
 
     def stalled(self) -> bool:
         return self._st[_ST_STALLED] != 0.0
@@ -927,6 +1236,8 @@ class HealthMonitor(BaseService):
             "stalled": self.stalled(),
             "storm_active": self.storm_active(),
             "disk_degraded": self.disk_degraded(),
+            "consensus_starved": self.starved(),
+            "starve_threshold_s": round(self.starve_s, 4),
             "trips": dict(self.trips),
             "bundles": self.bundles,
             "bundle_dir": self.bundle_dir,
@@ -991,6 +1302,15 @@ def write_bundle(
         },
     )
     save("flight.json", export_ring())
+    # the device-time ledger + per-height latency budget: who used the
+    # device and where each height's wall time went at the failure edge
+    try:
+        save(
+            "budget.json",
+            {"ledger": libdevledger.snapshot(), "budget": budget()},
+        )
+    except Exception as e:
+        save("budget.json.err", repr(e))
     # merged cross-node timeline + root-cause attribution: peers' rings
     # are pulled over RPC when COMETBFT_TPU_POSTMORTEM_PEERS names them
     # (reachable or not, the local view is always written) — the knob
@@ -1104,6 +1424,15 @@ def sample(metrics=None) -> dict:
         m.health_stall_seconds.set(s["step_age_s"])
     gossip_lag = libnetstats.gossip_lag_s()
     m.health_gossip_lag.set(gossip_lag)
+    # device-time ledger bridge + the latest height's latency budget
+    # (gauges carry the most recent fully-decomposed height; the full
+    # per-height table lives on /debug/budget and in budget.json)
+    libdevledger.sample(m)
+    bud = budget()
+    if bud["heights"]:
+        last_stages = bud["heights"][-1]["stages"]
+        for stage in BUDGET_STAGES:
+            m.height_budget.labels(stage).set(last_stages[stage])
     # composite score: 1.0 healthy; a stall zeroes it (liveness lost);
     # an open breaker or an active recompile storm each cost 0.3, a
     # degraded disk 0.2 (degraded but live — the widened propose
@@ -1130,6 +1459,19 @@ def sample(metrics=None) -> dict:
         "gossip_lag_p99_s": round(gossip_lag, 6),
         **s,
     }
+
+
+def debug_budget_json() -> str:
+    """Body of the pprof server's ``/debug/budget`` route: the
+    device-time ledger (per-caller attribution + occupancy +
+    reconciliation) and the per-height latency budget."""
+    return json.dumps(
+        {
+            "ledger": libdevledger.snapshot(),
+            "budget": budget(),
+        },
+        default=str,
+    )
 
 
 def debug_health_json(tail: int = 100) -> str:

@@ -613,6 +613,27 @@ def observe_propagation(phase: str, height: int = 0) -> None:
         )
 
 
+def propagation_p99(metrics=None) -> dict:
+    """Per-phase p99 of the one-hop propagation histogram, through the
+    shared promql-style estimator (libmetrics.quantile_from_buckets —
+    the same math health.sample and the budget plane use).  Scrape-time
+    only; phases with no observations yet are omitted."""
+    m = metrics if metrics is not None else libmetrics.node_metrics()
+    fam = m.p2p_propagation
+    with fam._mtx:
+        children = list(fam._children.items())
+    out: dict[str, float] = {}
+    for key, child in children:
+        counts = list(child._counts)
+        if not any(counts):
+            continue
+        out[key[0]] = round(
+            libmetrics.quantile_from_buckets(child.buckets, counts, 0.99),
+            6,
+        )
+    return out
+
+
 def gossip_lag_s(q: float = 0.99) -> float:
     """Quantile of the recent one-hop gossip-lag window (seconds);
     0.0 when nothing stamped arrived yet.  Scrape-time only."""
@@ -708,6 +729,7 @@ def snapshot() -> dict:
         "connections": len(conns),
         "gossip_lag_p50_s": round(gossip_lag_s(0.50), 6),
         "gossip_lag_p99_s": round(gossip_lag_s(0.99), 6),
+        "propagation_p99_s": propagation_p99(),
         "consensus_send_queue_full": consensus_queue_full_total(),
         "clock_skew": skew_table(),
         "peers": [c.row() for c in conns],

@@ -140,6 +140,8 @@ class PprofServer(HTTPService):
                 "/debug/locks\n"
                 "/debug/devstats         device/XLA telemetry (JSON)\n"
                 "/debug/health           flight-recorder SLIs + watchdogs (JSON)\n"
+                "/debug/budget           device-time ledger + per-height\n"
+                "                        latency budgets (JSON)\n"
                 "/debug/net              per-peer/per-channel p2p telemetry (JSON)\n"
                 "/debug/flight           raw flight-ring export (JSON; the\n"
                 "                        cross-node merge input peers pull)\n"
@@ -197,6 +199,11 @@ class PprofServer(HTTPService):
             from . import netstats as libnetstats
 
             return libnetstats.debug_net_json()
+
+        def budget_dump(q):
+            from . import health as libhealth
+
+            return libhealth.debug_budget_json()
 
         def flight_dump(q):
             from . import health as libhealth
@@ -258,6 +265,7 @@ class PprofServer(HTTPService):
             "/debug/locks": locks,
             "/debug/devstats": devstats_dump,
             "/debug/health": health_dump,
+            "/debug/budget": budget_dump,
             "/debug/net": net_dump,
             "/debug/flight": flight_dump,
             "/debug/timeline": timeline_dump,

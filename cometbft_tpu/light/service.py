@@ -60,6 +60,7 @@ from collections import OrderedDict
 
 from ..crypto import coalesce as crypto_coalesce
 from ..crypto import tmhash
+from ..libs import devledger as libdevledger
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from ..libs.service import BaseService
@@ -313,12 +314,15 @@ class CachedCommitVerifier(light_verifier.CommitVerifier):
             # cached success must never mask a mismatch there
             tmhash.sum(ser.dumps(block_id)),
         )
-        self._cached(
-            key,
-            lambda: verify_commit_light(
-                chain_id, vals, block_id, height, commit
-            ),
-        )
+        # outermost ledger tenant: a proof-service client's coalescer
+        # lanes attribute to "light", not the commit-verify mechanism
+        with libdevledger.caller_class("light"):
+            self._cached(
+                key,
+                lambda: verify_commit_light(
+                    chain_id, vals, block_id, height, commit
+                ),
+            )
 
     def verify_commit_light_trusting(
         self, chain_id, vals, commit, trust_level
@@ -331,12 +335,13 @@ class CachedCommitVerifier(light_verifier.CommitVerifier):
             _commit_digest(commit),
             (trust_level.numerator, trust_level.denominator),
         )
-        self._cached(
-            key,
-            lambda: verify_commit_light_trusting(
-                chain_id, vals, commit, trust_level
-            ),
-        )
+        with libdevledger.caller_class("light"):
+            self._cached(
+                key,
+                lambda: verify_commit_light_trusting(
+                    chain_id, vals, commit, trust_level
+                ),
+            )
 
     def _cached(self, key: tuple, run) -> None:
         m = libmetrics.node_metrics()

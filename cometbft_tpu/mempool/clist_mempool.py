@@ -27,8 +27,11 @@ def TxKey(tx: bytes) -> bytes:
     # routed through the device hash plane when one is up: concurrent
     # CheckTx threads' key hashes coalesce into shared SHA-256 windows
     # (large txs only — small keys stay on the host hash; digests are
-    # identical either way)
-    return hashplane.hash_bytes(tx)
+    # identical either way); ledger-attributed to the mempool tenant
+    from ..libs import devledger
+
+    with devledger.caller_class("mempool"):
+        return hashplane.hash_bytes(tx)
 
 
 class MempoolError(Exception):
@@ -327,7 +330,10 @@ class CListMempool:
         # their keys as ONE batch (hash_many routes to the device
         # plane only when that wins, and per-tx routed tickets inside
         # the commit critical section would pay a round trip each)
-        keys = hashplane.hash_many(txs)
+        from ..libs import devledger
+
+        with devledger.caller_class("mempool"):
+            keys = hashplane.hash_many(txs)
         for tx, key, res in zip(txs, keys, tx_results):
             if res.code == abci.OK:
                 self.cache.push(key)  # committed: never re-admit

@@ -653,9 +653,14 @@ class Node(BaseService):
         # health; COMETBFT_TPU_NET=0 pins it off): it must be live
         # before the switch accepts the first connection, and the boot
         # unwind below releases it on any failure.
+        from ..libs import devledger as libdevledger
         from ..libs import netstats as libnetstats
 
         libnetstats.acquire()
+        # the device-time ledger rides the same lifecycle: per-caller
+        # attribution is on exactly while a node runs (kill switch
+        # COMETBFT_TPU_LEDGER=0), released on any boot failure below
+        libdevledger.acquire()
         try:
             if self.pprof_server is not None:
                 self.pprof_server.start()
@@ -726,8 +731,9 @@ class Node(BaseService):
                     self.verify_coalescer = None
                 raise
         except BaseException:
-            # ANY boot failure: release the netstats acquire (on_stop
-            # never runs on a half-booted node)
+            # ANY boot failure: release the netstats + ledger acquires
+            # (on_stop never runs on a half-booted node)
+            libdevledger.release()
             libnetstats.release()
             raise
 
@@ -982,10 +988,13 @@ class Node(BaseService):
             except Exception:
                 pass
         # after the switch (its peers deregister their stats blocks on
-        # connection stop): release this node's netstats acquire
+        # connection stop): release this node's netstats + device-time
+        # ledger acquires
+        from ..libs import devledger as libdevledger
         from ..libs import netstats as libnetstats
 
         libnetstats.release()
+        libdevledger.release()
         # Coalescer after consensus is down: unroute first (new callers
         # fall back to host instantly), then drain — stop() resolves
         # every pending ticket, so no verifier thread is left hanging.

@@ -460,6 +460,22 @@ def merge(sources: list[Source]) -> Timeline:
             hv["skew_bound_s"] = _round9(max(hb)) if hb else None
             hv["skew_complete"] = comp and len(involved) > 1
 
+    # per-height latency budgets over the merged stream: the SAME
+    # decomposition libs/health.budget serves locally (stage tiling
+    # from the committing node's step rows + plane.budget / wal.fsync
+    # overlays), so a timeline.json reader sees where each height's
+    # wall time went next to who proposed and who lagged.  Pure
+    # function of the decoded rows — deterministic per (seed, scenario)
+    # like the rest of the canonical serialization.
+    budgets = libhealth.budget_from_events([r[3] for r in rows])
+    for hv in ordered:
+        b = budgets.get(hv["height"])
+        hv["budget"] = (
+            {"stages": b["stages"], "coverage": b["coverage"]}
+            if b is not None
+            else None
+        )
+
     run_b = gossip_acc.get("run")
     lag_samples["run"] = run_b["lags"] if run_b else []
 

@@ -147,14 +147,22 @@ def verify_commit_light_trusting(
 def _verify(
     chain_id, vals, commit, needed, ignore, count, count_all, by_index
 ) -> None:
-    if _should_batch_verify(vals, commit):
-        _verify_batch(
-            chain_id, vals, commit, needed, ignore, count, count_all, by_index
-        )
-    else:
-        _verify_single(
-            chain_id, vals, commit, needed, ignore, count, count_all, by_index
-        )
+    from ..libs import devledger
+
+    # ledger attribution default: an untagged commit verification is
+    # the consensus apply path; outer tenants (the light service, the
+    # blocksync reactor, statesync restores) declared first and win
+    with devledger.caller_class("commit-verify"):
+        if _should_batch_verify(vals, commit):
+            _verify_batch(
+                chain_id, vals, commit, needed, ignore, count, count_all,
+                by_index,
+            )
+        else:
+            _verify_single(
+                chain_id, vals, commit, needed, ignore, count, count_all,
+                by_index,
+            )
 
 
 def _verify_batch(

@@ -68,12 +68,18 @@ class Vote:
         is exactly ``pub_key.verify_signature``.
         """
         from ..crypto import coalesce
+        from ..libs import devledger
 
         if bytes(pub_key.address()) != self.validator_address:
             raise VoteError("invalid validator address")
-        if not coalesce.verify_signature(
-            pub_key, self.sign_bytes(chain_id), self.signature
-        ):
+        # ledger attribution default: an untagged vote verify is the
+        # steady-state consensus path; outer tenants (the evidence
+        # verifier, the light service) already declared and win
+        with devledger.caller_class("consensus-vote"):
+            ok = coalesce.verify_signature(
+                pub_key, self.sign_bytes(chain_id), self.signature
+            )
+        if not ok:
             raise VoteError("invalid signature")
 
     def verify_vote_and_extension(self, chain_id: str, pub_key) -> None:
@@ -89,13 +95,16 @@ class Vote:
         """Extension signature only (types/vote.go:254-270); coalesced
         like :meth:`verify`."""
         from ..crypto import coalesce
+        from ..libs import devledger
 
         if self.msg_type != canonical.PRECOMMIT_TYPE or self.block_id.is_nil():
             return
-        if not coalesce.verify_signature(
-            pub_key, self.extension_sign_bytes(chain_id),
-            self.extension_signature,
-        ):
+        with devledger.caller_class("consensus-vote"):
+            ok = coalesce.verify_signature(
+                pub_key, self.extension_sign_bytes(chain_id),
+                self.extension_signature,
+            )
+        if not ok:
             raise VoteError("invalid extension signature")
 
     def commit_sig(self) -> CommitSig:

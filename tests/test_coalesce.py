@@ -962,11 +962,11 @@ class TestReadbackDrain:
 
         real_rb = coalesce.VerifyCoalescer._resolve_bits
 
-        def tracking_rb(self, staged, bits, reason, backend):
+        def tracking_rb(self, staged, bits, reason, backend, **kw):
             seq = seq_by_groups.get(id(staged))
             if seq is not None:
                 resolved.append(seq)
-            real_rb(self, staged, bits, reason, backend)
+            real_rb(self, staged, bits, reason, backend, **kw)
 
         monkeypatch.setattr(
             coalesce.VerifyCoalescer, "_launch", fake_launch

@@ -625,6 +625,86 @@ class TestTrace:
             libhealth.disable()
             libhealth.reset()
 
+    def test_device_ledger_record_path_allocation_free(self):
+        """The device-time ledger rides the same always-on tier: the
+        ENABLED record path (ticket resolves, window counters, the
+        executor-busy/readback overlap marks) must retain zero
+        allocations — storage is preallocated array('q') columns.
+
+        Precision guard: a plane executor / health monitor left running
+        by an EARLIER module writes the same devledger lines
+        concurrently, and tracemalloc attributes its in-flight
+        temporaries to this file — wait those threads out, and name the
+        straggler instead of failing on its traffic."""
+        import threading as _threading
+        import time as _time
+
+        from cometbft_tpu.libs import devledger
+
+        plane_prefixes = (
+            "verify-coalescer", "hash-plane", "verify-readback",
+            "hash-readback", "health-monitor",
+        )
+
+        def stragglers():
+            return sorted(
+                t.name
+                for t in _threading.enumerate()
+                if t.is_alive()
+                and t.name.startswith(plane_prefixes)
+            )
+
+        deadline = _time.monotonic() + 10
+        while stragglers() and _time.monotonic() < deadline:
+            _time.sleep(0.1)
+        left = stragglers()
+        if left:
+            pytest.skip(
+                "live plane/monitor threads from an earlier test would "
+                f"pollute the tracemalloc window: {left}"
+            )
+
+        was = devledger.enabled()
+        devledger.enable()
+        devledger.reset()
+        try:
+            cid = devledger.CALLER_CODES["consensus-vote"]
+
+            def hot():
+                for _ in range(400):
+                    devledger.note_window(devledger.PLANE_VERIFY, 8, True)
+                    devledger.note_resolve(
+                        devledger.PLANE_VERIFY, cid, 8, 1_000, 2_000,
+                        0,
+                    )
+                    devledger.note_window_time(
+                        devledger.PLANE_VERIFY, 2_000
+                    )
+                    devledger.exec_begin(devledger.PLANE_VERIFY)
+                    devledger.exec_end(devledger.PLANE_VERIFY)
+
+            hot()  # warm interpreter caches outside the measured window
+            stats = _retained_after(hot, [devledger.__file__])
+            # Tolerance for the CPython frame free-list artifact: a
+            # frame object allocated during the window and PARKED on
+            # the per-type free list at snapshot time reads as ~100-300
+            # retained bytes attributed to the function's `def` line
+            # (observed deterministically in full-suite runs; the
+            # _retained_after gc+rewindow defense doesn't clear free
+            # lists). It is CONSTANT per function — real per-record
+            # retention scales with the 400-iteration window (>=3.2 KB
+            # even at one byte per record, with per-line counts ~400),
+            # so the bounds below still catch any actual leak.
+            assert sum(s.size for s in stats) < 1024, stats
+            assert all(s.count < 100 for s in stats), stats
+            # and the columns really accumulated through both windows
+            c = devledger.cell(devledger.PLANE_VERIFY, cid)
+            assert c["lanes"] >= 400 * 8 * 2
+            assert devledger.occupancy()["verify"]["windows"] >= 800
+        finally:
+            devledger.reset()
+            devledger.enable() if was else devledger.disable()
+
     def test_events_spans_and_nesting(self, tracer):
         with libtrace.span("outer", k="v") as outer:
             libtrace.event("mid", n=1)
@@ -763,6 +843,8 @@ class TestTrace:
             "COMETBFT_TPU_NET",
             "COMETBFT_TPU_NET_STAMP",
             "COMETBFT_TPU_NET_TOPK",
+            "COMETBFT_TPU_LEDGER",
+            "COMETBFT_TPU_LEDGER_STARVE_MS",
         ):
             assert knob in ENV_KNOBS, knob
             assert knob in doc, f"{knob} missing from docs/observability.md"
