@@ -416,6 +416,7 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
             ("devstats.json", "/debug/devstats"),
             ("health.json", "/debug/health"),
             ("net.json", "/debug/net"),
+            ("tx.json", "/debug/tx"),
             ("flight.json", "/debug/flight"),
             ("timeline.json", "/debug/timeline"),
             ("trace.json", "/debug/trace"),

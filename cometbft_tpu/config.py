@@ -299,6 +299,33 @@ ENV_KNOBS: dict[str, str] = {
         "consensus_starved watchdog trips and writes a black-box "
         "bundle (default 50; <=0 disables; libs/health.py)"
     ),
+    "COMETBFT_TPU_TX": (
+        "transaction-lifecycle plane (libs/txtrace): sampled "
+        "end-to-end tx tracing from CheckTx admission through gossip, "
+        "proposal inclusion and commit — auto (default, on while a "
+        "node runs, refcounted like devstats/netstats) | 1 force-on "
+        "process-wide | 0 off (kill switch: the record path is one "
+        "flag check)"
+    ),
+    "COMETBFT_TPU_TX_SAMPLE": (
+        "tx-lifecycle sampling denominator: 1/N of tx keys are traced "
+        "(deterministic on the key's first 8 bytes, so every node "
+        "samples the SAME txs and cross-node joins need no "
+        "coordination; default 64, 1 = every tx, <= 0 disables "
+        "sampling; libs/txtrace.py)"
+    ),
+    "COMETBFT_TPU_TX_RING": (
+        "tx-lifecycle in-flight table + completion-ring capacity in "
+        "rows (default 4096; a colliding sampled key evicts the "
+        "oldest row — flight-recorder semantics; libs/txtrace.py)"
+    ),
+    "COMETBFT_TPU_TX_STARVE_COMMITS": (
+        "tx_starved watchdog window in commit intervals: an admitted "
+        "tx older than N measured inter-commit intervals WHILE "
+        "heights keep committing trips a page + black-box bundle "
+        "naming the oldest keys (default 16; <= 0 disables; "
+        "libs/health.py HealthMonitor)"
+    ),
     "COMETBFT_TPU_STATESYNC_BACKOFF_S": (
         "base seconds of the per-peer exponential backoff the "
         "statesync chunk fetcher applies to a peer whose requests "

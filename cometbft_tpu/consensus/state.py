@@ -1042,6 +1042,14 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         libmetrics.node_metrics().proposals.labels("accepted").inc()
         libhealth.record(libhealth.EV_PROPOSAL, rs.height, rs.round, 1)
+        # tx-lifecycle proposal stamp: ONE per accepted proposal, not
+        # per tx — the proposal message does not name its txs, so the
+        # per-tx join happens at commit (CListMempool.update), where
+        # the committed keys are already derived, against this
+        # height's stamp (libs/txtrace.note_proposal docstring)
+        from ..libs import txtrace as libtxtrace
+
+        libtxtrace.note_proposal(rs.height, rs.round)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header

@@ -119,8 +119,26 @@ EV_HASH = 11  # hash-plane window flush: a=lanes, b=1 device / 0 host
 # latency budget (budget_from_events) window-assigns these rows to the
 # height they delayed, exactly like EV_FSYNC.
 EV_BUDGET = 12
+# tx.stage: one sampled transaction crossing a lifecycle stage
+# (libs/txtrace): r=stage code (TX_STAGES), a=signed 64-bit key
+# fingerprint (first 8 key bytes; decoded as the 16-hex-char ``key``
+# prefix), b=stage payload — mempool depth at admit, one-hop lag ns at
+# gossip_recv, ns-since-admit at gossip_send/commit. Stamped from the
+# ring clock, so virtual-domain (simnet) rows stay merge-consistent.
+EV_TX = 13
 
 _N_CODES = 16  # size of the per-code last-seen vector
+
+# EV_TX stage vocabulary (the decode side of libs/txtrace's stage
+# codes — the decoder lives here with the rest of the ring vocabulary,
+# txtrace aliases this map so the two cannot diverge)
+TX_STAGES = {
+    1: "admit",
+    2: "gossip_send",
+    3: "gossip_recv",
+    4: "proposal",
+    5: "commit",
+}
 
 # EV_FAULT kinds (recorded by cometbft_tpu/simnet): the black-box ring
 # explains WHICH fault was live when a scenario failed — a partition
@@ -182,6 +200,7 @@ _CODE_NAMES = {
     EV_FAULT: "simnet.fault",
     EV_HASH: "hash.flush",
     EV_BUDGET: "plane.budget",
+    EV_TX: "tx.stage",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -197,6 +216,7 @@ _CODE_FIELDS = {
     EV_FAULT: ("kind", "detail"),
     EV_HASH: ("lanes", "device"),
     EV_BUDGET: ("wait_ns", "exec_ns"),
+    EV_TX: ("key_fp", "val"),
 }
 
 # codes whose payload is a wall-clock-measured duration: meaningless in
@@ -228,7 +248,13 @@ _WATCHDOGS = (
     ("send_queue_saturated", 8),
     ("slow_disk", 16),
     ("consensus_starved", 32),
+    ("tx_starved", 64),
 )
+# tx_starved: an ADMITTED tx is older than COMETBFT_TPU_TX_STARVE_COMMITS
+# commit intervals WHILE heights keep committing — inclusion is broken
+# though the chain is live (a dead chain is the stall watchdog's case,
+# and an idle mempool can never starve: the age signal is the oldest
+# admitted-uncommitted tx across libs/txtrace's registered mempools).
 # consensus_starved: consensus-caller verify queue-wait p99 (windowed,
 # from the device_queue_wait_seconds buckets) above the threshold WHILE
 # other callers dominate the window's lane share — a light-service /
@@ -281,6 +307,14 @@ def _ring_size_from_env() -> int:
 # with a netstamp-derived skew bound instead.
 _now_ns = time.time_ns
 _clock_domain = "wall"  # "wall" | "virtual" — exported with the ring
+
+
+def now_ns() -> int:
+    """The ring clock (wall on live nodes, the shared virtual clock
+    under simnet) — sibling planes (libs/txtrace, the mempool admit
+    stamps) read it so their durations stay domain-consistent with the
+    ring rows they sit next to."""
+    return _now_ns()
 
 
 def set_clock(fn, domain: str = "wall"):
@@ -454,6 +488,11 @@ class FlightRecorder:
                 rec["plane"] = libdevledger.PLANES[
                     self._r[i] % len(libdevledger.PLANES)
                 ]
+            elif code == EV_TX:
+                # the stage rides the round column; the key exports as
+                # its bounded 16-hex-char prefix, never the raw key
+                rec["stage_name"] = TX_STAGES.get(self._r[i], "?")
+                rec["key"] = format(self._a[i] % (1 << 64), "016x")
             o = self._o[i]
             if o:
                 rec["node"] = origin_name(o)
@@ -874,6 +913,13 @@ _ST_STALLED = 6  # 1.0 while the stall detector considers us stalled
 _QF_SEEN = 0
 _QF_STREAK = 1
 _ST_DISK_DEGRADED = 7  # 1.0 while the wired WAL reports disk_degraded
+# tx-starvation slots: ring commit tally already seen, monotonic of the
+# last observed tally advance, inter-commit interval EWMA (seconds),
+# and the edge-trigger episode flag
+_ST_TX_SEEN = 8
+_ST_TX_LAST_T = 9
+_ST_TX_INTERVAL = 10
+_ST_TX_STARVED = 11
 
 
 class HealthMonitor(BaseService):
@@ -899,6 +945,7 @@ class HealthMonitor(BaseService):
         starve_s: float | None = None,
         starve_share: float = STARVE_LANE_SHARE,
         starve_min_lanes: int = STARVE_MIN_LANES,
+        tx_starve_commits: float | None = None,
         interval_s: float | None = None,
         trace_tail: int = 512,
         idle_ok=None,
@@ -947,13 +994,28 @@ class HealthMonitor(BaseService):
         self.trips = {name: 0 for name, _ in _WATCHDOGS}
         self.bundles = 0
         self._thread: threading.Thread | None = None
+        # tx-starvation config + the txtrace handle (resolved once at
+        # construction — the per-tick check must not run the import
+        # machinery; health cannot top-import txtrace, which imports
+        # this module for the ring clock and EV_TX recording)
+        from . import txtrace as libtxtrace
+
+        self._txtrace = libtxtrace
+        self.tx_starve_commits = (
+            tx_starve_commits
+            if tx_starve_commits is not None
+            else libtxtrace.starve_commits()
+        )
         # preallocated scalar state — see the _ST_* index comments
-        self._st = array("d", [0.0] * 8)
+        self._st = array("d", [0.0] * 12)
         now = time.monotonic()
         self._st[_ST_PROGRESS_BASE] = now
         self._st[_ST_STORM_T0] = now
         self._st[_ST_STORM_BASE] = float(self._recompile_total())
         self._st[_ST_BREAKER_SEEN] = float(_BREAKER_NOTICES[0])
+        # commits that predate this monitor must not feed the
+        # inter-commit interval estimate (the lane-watermark posture)
+        self._st[_ST_TX_SEEN] = float(_REC._commits[0])
         # drops that predate this monitor must not count toward a streak
         self._qfull = array("q", [0, 0])
         self._qfull[_QF_SEEN] = libnetstats.consensus_queue_full_total()
@@ -1135,6 +1197,42 @@ class HealthMonitor(BaseService):
                     sv[2] = 1
                 else:
                     sv[2] = 0
+        # -- tx starvation: the oldest admitted-uncommitted tx is older
+        # than N measured commit intervals WHILE heights keep
+        # committing. The interval EWMA comes from the ring's commit
+        # tally (pre-monitor commits excluded at ctor); "keeps
+        # committing" = the tally advanced within the starve window
+        # itself, so a dead chain stays the stall watchdog's case.
+        # Edge-triggered per episode like slow_disk.
+        if self.tx_starve_commits > 0:
+            cur_c = _REC._commits[0]
+            seen_c = st[_ST_TX_SEEN]
+            if cur_c > seen_c:
+                t_last = st[_ST_TX_LAST_T]
+                if t_last > 0:
+                    iv = (now - t_last) / (cur_c - seen_c)
+                    ew = st[_ST_TX_INTERVAL]
+                    st[_ST_TX_INTERVAL] = (
+                        iv if ew == 0.0 else 0.75 * ew + 0.25 * iv
+                    )
+                st[_ST_TX_LAST_T] = now
+                st[_ST_TX_SEEN] = float(cur_c)
+            interval = st[_ST_TX_INTERVAL]
+            if interval > 0:
+                window = self.tx_starve_commits * interval
+                committing = (
+                    st[_ST_TX_LAST_T] > 0
+                    and now - st[_ST_TX_LAST_T] <= window
+                )
+                if (
+                    committing
+                    and self._txtrace.oldest_admitted_age_s() > window
+                ):
+                    if st[_ST_TX_STARVED] == 0.0:
+                        mask |= 64
+                    st[_ST_TX_STARVED] = 1.0
+                else:
+                    st[_ST_TX_STARVED] = 0.0
         return mask
 
     def _consensus_wait_p99(self) -> float:
@@ -1166,6 +1264,11 @@ class HealthMonitor(BaseService):
     def starved(self) -> bool:
         """Last-observed consensus-starvation state."""
         return self._sv[2] != 0
+
+    def tx_starved(self) -> bool:
+        """Last-observed tx-starvation state (inclusion broken while
+        the chain keeps committing)."""
+        return self._st[_ST_TX_STARVED] != 0.0
 
     def stalled(self) -> bool:
         return self._st[_ST_STALLED] != 0.0
@@ -1237,6 +1340,8 @@ class HealthMonitor(BaseService):
             "storm_active": self.storm_active(),
             "disk_degraded": self.disk_degraded(),
             "consensus_starved": self.starved(),
+            "tx_starved": self.tx_starved(),
+            "tx_starve_commits": round(self.tx_starve_commits, 2),
             "starve_threshold_s": round(self.starve_s, 4),
             "trips": dict(self.trips),
             "bundles": self.bundles,
@@ -1322,6 +1427,15 @@ def write_bundle(
             save("timeline.json", _pm.bundle_timeline())
         except Exception as e:
             save("timeline.json.err", repr(e))
+    # tx-lifecycle plane: in-flight + recently-committed sampled txs
+    # and the per-mempool oldest-admitted table — a tx_starved bundle
+    # names the starved keys (bounded short prefixes) right here
+    try:
+        from . import txtrace as libtxtrace
+
+        save("tx.json", libtxtrace.snapshot())
+    except Exception as e:
+        save("tx.json.err", repr(e))
     try:
         from . import devstats as libdevstats
 
@@ -1401,9 +1515,11 @@ def sample(metrics=None) -> dict:
     stalled = False
     storm = False
     disk_degraded = False
+    tx_starved = False
     if mon is not None:
         storm = mon.storm_active()
         disk_degraded = mon.disk_degraded()
+        tx_starved = mon.tx_starved()
         age = s["step_age_s"]
         stalled = mon.stalled() or (
             age is not None and age > mon.stall_after_s
@@ -1424,6 +1540,14 @@ def sample(metrics=None) -> dict:
         m.health_stall_seconds.set(s["step_age_s"])
     gossip_lag = libnetstats.gossip_lag_s()
     m.health_gossip_lag.set(gossip_lag)
+    # tx-lifecycle plane bridge: completed sampled txs observe into
+    # the tx histograms from per-registry watermarks, and the
+    # mempool_oldest_age_seconds gauge reads the live mempools
+    # (libs/txtrace.sample — lazy import: txtrace imports this module
+    # for the ring clock and EV_TX recording)
+    from . import txtrace as libtxtrace
+
+    libtxtrace.sample(m)
     # device-time ledger bridge + the latest height's latency budget
     # (gauges carry the most recent fully-decomposed height; the full
     # per-height table lives on /debug/budget and in budget.json)
@@ -1435,8 +1559,8 @@ def sample(metrics=None) -> dict:
             m.height_budget.labels(stage).set(last_stages[stage])
     # composite score: 1.0 healthy; a stall zeroes it (liveness lost);
     # an open breaker or an active recompile storm each cost 0.3, a
-    # degraded disk 0.2 (degraded but live — the widened propose
-    # timeouts keep commits flowing) — documented in docs/observability.md
+    # degraded disk or a starved tx 0.2 each (degraded but live — the
+    # chain still commits) — documented in docs/observability.md
     if stalled:
         score = 0.0
     else:
@@ -1447,6 +1571,8 @@ def sample(metrics=None) -> dict:
             score -= 0.3
         if disk_degraded:
             score -= 0.2
+        if tx_starved:
+            score -= 0.2
         score = max(0.0, score)
     m.health_score.set(score)
     return {
@@ -1455,6 +1581,7 @@ def sample(metrics=None) -> dict:
         "breaker_open": breaker_open,
         "recompile_storm": storm,
         "disk_degraded": disk_degraded,
+        "tx_starved": tx_starved,
         "verify_wait_p99_s": wait_p99,
         "gossip_lag_p99_s": round(gossip_lag, 6),
         **s,

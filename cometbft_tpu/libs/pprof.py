@@ -113,6 +113,43 @@ def stop_jax_trace() -> str:
     return f"trace written to {d}"
 
 
+# One-line operator docs per route, rendered into the index page.  The
+# index is GENERATED from the live route map (every registered route
+# appears, with its doc line when one exists), and the tier-1
+# completeness gate in tests/test_observability.py pins exactly that —
+# a new debug plane cannot silently ship an unlisted route.
+ROUTE_DOCS: dict[str, str] = {
+    "/debug/pprof/goroutine": "thread stacks",
+    "/debug/pprof/heap": "rss + tracemalloc snapshot",
+    "/debug/heap/start": "enable tracemalloc",
+    "/debug/heap/stop": "disable tracemalloc",
+    "/debug/jax/start_trace": "?dir=PATH  start a JAX profiler trace",
+    "/debug/jax/stop_trace": "stop the JAX profiler trace",
+    "/debug/locks": "deadlock-tier status",
+    "/debug/devstats": "device/XLA telemetry (JSON)",
+    "/debug/health": "flight-recorder SLIs + watchdogs (JSON)",
+    "/debug/budget": (
+        "device-time ledger + per-height latency budgets (JSON)"
+    ),
+    "/debug/net": "per-peer/per-channel p2p telemetry (JSON)",
+    "/debug/tx": (
+        "sampled tx-lifecycle plane; ?key=<hex-prefix> looks one "
+        "transaction up (JSON)"
+    ),
+    "/debug/flight": (
+        "raw flight-ring export (JSON; the cross-node merge input "
+        "peers pull)"
+    ),
+    "/debug/timeline": (
+        "merged height timelines + root-cause verdicts (JSON; "
+        "?peer=URL fans in)"
+    ),
+    "/debug/trace": "span-tracer ring dump",
+    "/debug/trace/start": "?file=PATH  enable the span tracer",
+    "/debug/trace/stop": "disable the tracer, close the sink",
+}
+
+
 class PprofServer(HTTPService):
     """Tiny threaded HTTP server bound to ``pprof_laddr`` (scaffolding
     shared with the Prometheus exporter via ``libs/service.HTTPService``)."""
@@ -127,30 +164,20 @@ class PprofServer(HTTPService):
             raise KeyError(path)
         return "text/plain; charset=utf-8", fn(query)
 
+    def index_text(self) -> str:
+        """The index body, generated from the registered routes so a
+        new route can never be omitted from the listing."""
+        lines = ["cometbft-tpu pprof"]
+        for path in sorted(self._route_map):
+            if path in ("/debug/pprof", "/debug/pprof/"):
+                continue  # the index's own aliases
+            doc = ROUTE_DOCS.get(path, "")
+            lines.append(f"{path:<24} {doc}".rstrip())
+        return "\n".join(lines) + "\n"
+
     def _routes(self):
         def index(q):
-            return (
-                "cometbft-tpu pprof\n"
-                "/debug/pprof/goroutine  thread stacks\n"
-                "/debug/pprof/heap       rss + tracemalloc snapshot\n"
-                "/debug/heap/start       enable tracemalloc\n"
-                "/debug/heap/stop        disable tracemalloc\n"
-                "/debug/jax/start_trace?dir=PATH\n"
-                "/debug/jax/stop_trace\n"
-                "/debug/locks\n"
-                "/debug/devstats         device/XLA telemetry (JSON)\n"
-                "/debug/health           flight-recorder SLIs + watchdogs (JSON)\n"
-                "/debug/budget           device-time ledger + per-height\n"
-                "                        latency budgets (JSON)\n"
-                "/debug/net              per-peer/per-channel p2p telemetry (JSON)\n"
-                "/debug/flight           raw flight-ring export (JSON; the\n"
-                "                        cross-node merge input peers pull)\n"
-                "/debug/timeline         merged height timelines + root-cause\n"
-                "                        verdicts (JSON; ?peer=URL fans in)\n"
-                "/debug/trace            span-tracer ring dump\n"
-                "/debug/trace/start?file=PATH\n"
-                "/debug/trace/stop\n"
-            )
+            return self.index_text()
 
         def goroutine(q):
             return thread_dump()
@@ -199,6 +226,15 @@ class PprofServer(HTTPService):
             from . import netstats as libnetstats
 
             return libnetstats.debug_net_json()
+
+        def tx_dump(q):
+            # "where is my transaction": ?key=<hex prefix> (up to the
+            # retained 16 chars; a full 64-char tx-key hex works and
+            # is truncated) — no key returns the plane snapshot
+            from . import txtrace as libtxtrace
+
+            keys = q.get("key")
+            return libtxtrace.debug_tx_json(keys[0] if keys else None)
 
         def budget_dump(q):
             from . import health as libhealth
@@ -267,6 +303,7 @@ class PprofServer(HTTPService):
             "/debug/health": health_dump,
             "/debug/budget": budget_dump,
             "/debug/net": net_dump,
+            "/debug/tx": tx_dump,
             "/debug/flight": flight_dump,
             "/debug/timeline": timeline_dump,
             "/debug/trace": trace_dump,

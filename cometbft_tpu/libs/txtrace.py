@@ -1,0 +1,753 @@
+"""Transaction lifecycle plane: sampled end-to-end tx tracing.
+
+Every prior observability plane instrumented a LAYER — the engine
+(libs/trace), the device (libs/devstats), the network (libs/netstats),
+liveness (libs/health), device tenancy (libs/devledger) — but nothing
+follows a TRANSACTION through them, and submit→commit is the one
+latency a user of the chain actually feels.  This module is that
+plane: a sampled, lock-free lifecycle ledger keyed on the mempool's
+``TxKey`` (the SHA-256 computed once per CheckTx since the hash-plane
+PR), recording fixed-width stage stamps per sampled tx:
+
+* **admit** — the CheckTx response admitted the tx into the mempool
+  (plus the mempool depth it saw at admission),
+* **gossip_send** — the first time this node's mempool reactor sent
+  the tx to a peer (channel 0x30),
+* **gossip_recv** — the first time the tx arrived FROM a peer, with
+  the one-hop lag from the PR 8 netstamp thread-local when the link
+  negotiated provenance stamps,
+* **proposal** — the accepted proposal for the height that later
+  committed the tx (per-height stamp, backfilled at commit — the
+  proposal message does not name its txs, and re-hashing a block's
+  txs on the FSM thread to find out would cost more than the plane
+  is allowed to),
+* **commit** — the tx landed in a committed block
+  (``CListMempool.update``), closing the submit→commit latency.
+
+**Deterministic hash-based sampling.**  A tx is sampled iff
+``key[0] % COMETBFT_TPU_TX_SAMPLE == 0`` — a pure function of the tx
+key's first byte (uniform for SHA-256 keys), so every node samples
+the SAME txs and cross-node joins (timeline tx rows, multi-node
+benches) work with no coordination, and the not-sampled path — what
+EVERY tx pays at each stage — is one flag check, one byte index and
+one modulo.  Default 1/64; rates above 256 degrade to 1/256 (the
+predicate reads one byte — documented, not silent: ``status()``
+reports the effective rate).
+
+**Flight-recorder storage posture** (the libs/health contract — this
+plane is on for every running node):
+
+* the disabled path is ONE module-flag check;
+* the enabled record path retains ZERO allocations — all state lives
+  in preallocated ``array('q')`` columns (pinned by the tracemalloc
+  guard in tests/test_observability.py alongside the flight-recorder
+  and devledger guards);
+* the record path takes NO lock: the in-flight table is direct-mapped
+  by key fingerprint (a colliding key evicts the older row — sampled
+  flight-recorder semantics, losing an old row is the design), the
+  completion ring reserves slots through one GIL-atomic
+  ``itertools.count``.  The one lock here (``libs.txtrace._mtx``)
+  serializes only the mempool-probe registry and is asserted
+  edge-free in tests/test_lint_graph.py like ``libs.trace._mtx``.
+
+Exposure (every surface the other planes use):
+
+* ``EV_TX`` flight-ring rows per sampled stage (decoded ``tx.stage``;
+  the timeline merge groups them into per-height sampled-tx rows);
+* ``tx_commit_latency_seconds`` / ``tx_stage_seconds{stage}`` /
+  ``tx_sampled_total{stage}`` and the ``mempool_oldest_age_seconds``
+  gauge, bridged at scrape by :func:`sample` (called from
+  libs/health.sample — the devledger watermark pattern, so the record
+  path touches no metrics object);
+* ``/debug/tx?key=<hex-prefix>`` on the pprof server ("where is my
+  transaction") and ``tx.json`` in watchdog black-box bundles;
+* the ``tx_starved`` watchdog (libs/health): an admitted tx older
+  than N commit intervals while heights keep committing pages with
+  the oldest keys named.
+
+Knobs (registered in config.ENV_KNOBS, enforced by cometlint CLNT007):
+``COMETBFT_TPU_TX`` (auto: on while a node runs, refcounted like
+devstats/netstats; 1 force; 0 kill switch), ``COMETBFT_TPU_TX_SAMPLE``
+(sampling denominator; 1 = every tx, <= 0 disables),
+``COMETBFT_TPU_TX_RING`` (in-flight table + completion ring capacity),
+``COMETBFT_TPU_TX_STARVE_COMMITS`` (the tx_starved watchdog's window
+in commit intervals).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from array import array
+
+from . import health as libhealth
+from . import sync as libsync
+
+_ENV_TX = "COMETBFT_TPU_TX"
+_ENV_SAMPLE = "COMETBFT_TPU_TX_SAMPLE"
+_ENV_RING = "COMETBFT_TPU_TX_RING"
+_ENV_STARVE = "COMETBFT_TPU_TX_STARVE_COMMITS"
+
+_ON_VALUES = ("1", "on", "true", "yes")
+_OFF_VALUES = ("0", "off", "false", "no")
+
+DEFAULT_SAMPLE = 64
+DEFAULT_RING = 4096
+DEFAULT_STARVE_COMMITS = 16.0
+
+# -- stage codes (the EV_TX ``round`` column; the decode names live
+# with the rest of the ring vocabulary in libs/health.TX_STAGES —
+# aliased here so the record and decode sides cannot diverge) -----------
+ST_ADMIT = 1
+ST_SEND = 2
+ST_RECV = 3
+ST_PROPOSAL = 4
+ST_COMMIT = 5
+STAGE_NAMES = libhealth.TX_STAGES
+# per-stage residencies of the completed-tx view (the ``stage`` label
+# of tx_stage_seconds): admit->first gossip send, the stamped one-hop
+# receive lag, admit->proposal (mempool residency), proposal->commit
+RESIDENCIES = (
+    "admit_to_send", "hop", "admit_to_proposal", "proposal_to_commit",
+)
+
+_U64 = 1 << 64
+_S63 = 1 << 63
+
+
+def _env_mode() -> str:
+    v = os.environ.get(_ENV_TX, "").lower()
+    if v in _ON_VALUES:
+        return "on"
+    if v in _OFF_VALUES:
+        return "off"
+    return "auto"
+
+
+def sample_rate() -> int:
+    """The sampling denominator (1/N of keys; <= 0 disables)."""
+    try:
+        return int(os.environ.get(_ENV_SAMPLE, ""))
+    except ValueError:
+        return DEFAULT_SAMPLE
+
+
+def starve_commits() -> float:
+    """tx_starved window in commit intervals (<= 0 disables) —
+    through the shared lenient parser every health knob uses."""
+    return libhealth._env_float(_ENV_STARVE, DEFAULT_STARVE_COMMITS)
+
+
+def _ring_size_from_env() -> int:
+    try:
+        n = int(os.environ.get(_ENV_RING, ""))
+    except ValueError:
+        n = DEFAULT_RING
+    return max(64, n)
+
+
+def key_fp(key: bytes) -> int:
+    """Unsigned 64-bit fingerprint: the key's first 8 bytes.  A pure
+    function of the tx key, so sampling and slot assignment agree on
+    every node; displayed as the 16-hex-char key prefix."""
+    return int.from_bytes(key[:8], "big")
+
+
+def _signed(fp: int) -> int:
+    """Two's-complement store form for the array('q') columns."""
+    return fp - _U64 if fp >= _S63 else fp
+
+
+def _unsigned(fp_s: int) -> int:
+    return fp_s % _U64
+
+
+def fp_hex(fp: int) -> str:
+    """The bounded short key prefix (16 hex chars = 8 key bytes) —
+    the ONLY key form this plane ever exports (never a raw 32-byte
+    key, and never as a metric label)."""
+    return format(_unsigned(fp), "016x")
+
+
+# -- enable gating (the devstats/netstats refcount pattern) --------------
+
+_mode = _env_mode()
+_enabled: bool = _mode == "on"
+_acquirers = 0
+_rate: int = sample_rate()
+
+# mempool-probe registry only (node boot/stop — never the record path)
+_mtx = libsync.Mutex("libs.txtrace._mtx")
+_MEMPOOLS: list = []
+
+
+def enabled() -> bool:
+    """The one check hot paths make before recording."""
+    return _enabled
+
+
+def enable(rate: int | None = None) -> None:
+    """Force the plane on (tests, bench); ``rate`` overrides the
+    sampling denominator for the process."""
+    global _enabled, _rate
+    if rate is not None:
+        _rate = int(rate)
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def acquire() -> None:
+    """Reference-counted enable for node lifecycles: the plane is on
+    exactly while a node runs unless ``COMETBFT_TPU_TX=0``."""
+    global _acquirers, _enabled, _rate
+    if _env_mode() == "off":
+        return
+    _acquirers += 1
+    _rate = sample_rate()
+    _enabled = True
+
+
+def release() -> None:
+    global _acquirers, _enabled
+    _acquirers = max(0, _acquirers - 1)
+    if _acquirers == 0 and _env_mode() != "on":
+        _enabled = False
+
+
+def register_mempool(mp) -> None:
+    """Register a mempool for the oldest-age probe (node boot).  The
+    object answers ``oldest_age_s()`` and ``oldest_entries(n)``."""
+    with _mtx:
+        _MEMPOOLS.append(mp)
+
+
+def deregister_mempool(mp) -> None:
+    with _mtx:
+        for i in range(len(_MEMPOOLS) - 1, -1, -1):
+            if _MEMPOOLS[i] is mp:
+                del _MEMPOOLS[i]
+                return
+
+
+def mempools() -> tuple:
+    """Lock-free snapshot (the netstats.connections posture)."""
+    return tuple(_MEMPOOLS)
+
+
+def oldest_admitted_age_s() -> float:
+    """Age of the oldest admitted-uncommitted tx across registered
+    mempools (0.0 = every mempool empty) — the tx_starved watchdog's
+    signal.  Plain loop over a tuple snapshot: the no-trip check path
+    stays allocation-free."""
+    worst = 0.0
+    for mp in mempools():
+        try:
+            age = mp.oldest_age_s()
+        except Exception:
+            continue
+        if age > worst:
+            worst = age
+    return worst
+
+
+# -- storage -------------------------------------------------------------
+#
+# In-flight table: direct-mapped by fingerprint (slot = fp % capacity).
+# A row is created by the admit/recv stages; send matches by fp;
+# commit closes the row into the completion ring and frees the slot.
+# fp 0 doubles as the empty sentinel (a real all-zero 8-byte key
+# prefix has probability 2^-64 — that tx simply goes untracked).
+
+
+class _Tables:
+    __slots__ = (
+        "capacity", "fp", "t_admit", "depth", "t_send", "t_recv",
+        "recv_lag",
+        "d_cap", "d_fp", "d_h", "d_r", "d_admit", "d_total", "d_send",
+        "d_recv_lag", "d_prop", "d_wait", "d_depth", "d_seq",
+        "d_written",
+        "ph", "pr", "pts",
+        "counts",
+    )
+
+    _PH_CAP = 64  # per-height proposal-stamp slots (height % 64)
+
+    def __init__(self, capacity: int):
+        self.capacity = max(64, int(capacity))
+        zeros = [0] * self.capacity
+        # in-flight columns
+        self.fp = array("q", zeros)
+        self.t_admit = array("q", zeros)
+        self.depth = array("q", zeros)
+        self.t_send = array("q", zeros)
+        self.t_recv = array("q", zeros)
+        self.recv_lag = array("q", zeros)
+        # completion ring
+        self.d_cap = self.capacity
+        dz = [0] * self.d_cap
+        self.d_fp = array("q", dz)
+        self.d_h = array("q", dz)
+        self.d_r = array("q", dz)
+        self.d_admit = array("q", dz)
+        self.d_total = array("q", dz)
+        self.d_send = array("q", dz)
+        self.d_recv_lag = array("q", dz)
+        self.d_prop = array("q", dz)
+        self.d_wait = array("q", dz)
+        self.d_depth = array("q", dz)
+        self.d_seq = itertools.count()
+        self.d_written = array("q", [0])
+        # per-height proposal stamps (backfilled into commits)
+        self.ph = array("q", [0] * self._PH_CAP)
+        self.pr = array("q", [0] * self._PH_CAP)
+        self.pts = array("q", [0] * self._PH_CAP)
+        # per-stage record tallies (index = stage code)
+        self.counts = array("q", [0] * 8)
+
+
+_T = _Tables(_ring_size_from_env())
+
+
+def reset(capacity: int | None = None) -> None:
+    """Drop all rows (tests, bench windows); ``capacity`` rebuilds."""
+    global _T
+    _T = _Tables(capacity if capacity is not None else _T.capacity)
+
+
+# -- record paths (lock-free, allocation-free) ---------------------------
+
+
+def _sampled(fp: int) -> bool:
+    """The sampling predicate on a fingerprint: the key's FIRST BYTE
+    (fp's top byte — big-endian) mod the rate.  The record paths
+    inline the equivalent ``key[0] % rate`` so the not-sampled path
+    never builds the 8-byte fingerprint int at all.  fp 0 is the
+    empty-slot sentinel AND the fingerprint of a keyless
+    (hand-constructed test) entry — never tracked."""
+    r = _rate
+    return fp != 0 and r > 0 and (fp >> 56) % r == 0
+
+
+def note_admit(key: bytes, depth: int) -> None:
+    """CheckTx response admitted the tx into the mempool; ``depth`` is
+    the mempool size the tx saw at admission (txs queued ahead)."""
+    if not _enabled:
+        return
+    r = _rate
+    if r <= 0 or not key or key[0] % r:
+        return  # the not-sampled path: flag, byte, modulo — nothing else
+    fp = key_fp(key)
+    if fp == 0:
+        return
+    t = _T
+    i = fp % t.capacity
+    fps = _signed(fp)
+    now = libhealth.now_ns()
+    if t.fp[i] != fps:
+        # claim (or evict a colliding/stale row — sampled
+        # flight-recorder semantics): clear the per-stage columns a
+        # previous occupant left behind
+        t.fp[i] = fps
+        t.t_admit[i] = 0
+        t.t_send[i] = 0
+        t.t_recv[i] = 0
+        t.recv_lag[i] = 0
+    if t.t_admit[i] == 0:
+        # SET-ONCE: in-process multi-node nets share one table, and a
+        # peer re-admitting a gossiped tx must not overwrite the
+        # origin node's admission stamp (the submit time the
+        # submit->commit latency anchors on); each node's admit still
+        # counts and rings below
+        t.t_admit[i] = now
+        t.depth[i] = depth
+    t.counts[ST_ADMIT] += 1
+    libhealth.record(libhealth.EV_TX, 0, ST_ADMIT, fps, depth)
+
+
+def note_gossip_send(key: bytes) -> None:
+    """First gossip send of the tx toward any peer (set-once)."""
+    if not _enabled:
+        return
+    r = _rate
+    if r <= 0 or not key or key[0] % r:
+        return
+    fp = key_fp(key)
+    if fp == 0:
+        return
+    t = _T
+    i = fp % t.capacity
+    if t.fp[i] != _signed(fp) or t.t_send[i] != 0:
+        return
+    now = libhealth.now_ns()
+    t.t_send[i] = now
+    t.counts[ST_SEND] += 1
+    admit = t.t_admit[i]
+    libhealth.record(
+        libhealth.EV_TX, 0, ST_SEND, _signed(fp),
+        now - admit if admit else 0,
+    )
+
+
+def note_gossip_recv(key: bytes, wall_hint_ns: int = 0) -> None:
+    """First receipt of the tx FROM a peer (set-once; creates the row
+    when the tx reaches this node by gossip before local admission).
+    ``wall_hint_ns`` is the sender-side stamp wall from the netstamp
+    thread-local when the mempool channel negotiated provenance — the
+    one-hop ``hop`` residency; 0 = unstamped link."""
+    if not _enabled:
+        return
+    r = _rate
+    if r <= 0 or not key or key[0] % r:
+        return
+    fp = key_fp(key)
+    if fp == 0:
+        return
+    t = _T
+    i = fp % t.capacity
+    fps = _signed(fp)
+    now = libhealth.now_ns()
+    if t.fp[i] != fps:
+        t.fp[i] = fps
+        t.t_admit[i] = 0
+        t.depth[i] = 0
+        t.t_send[i] = 0
+    elif t.t_recv[i] != 0:
+        return  # later duplicate gossip of a tracked tx
+    t.t_recv[i] = now
+    lag = now - wall_hint_ns if wall_hint_ns else 0
+    t.recv_lag[i] = lag if lag > 0 else 0
+    t.counts[ST_RECV] += 1
+    libhealth.record(
+        libhealth.EV_TX, 0, ST_RECV, fps, t.recv_lag[i]
+    )
+
+
+def note_proposal(height: int, round_: int) -> None:
+    """An accepted proposal for ``height`` (consensus/state hook; one
+    call per proposal, NOT per tx).  The stamp is backfilled into each
+    sampled tx the height later commits — the proposal message does
+    not name its txs, so the per-tx join happens at commit where the
+    keys are already derived."""
+    if not _enabled:
+        return
+    t = _T
+    i = height % t._PH_CAP
+    t.ph[i] = height
+    t.pr[i] = round_
+    t.pts[i] = libhealth.now_ns()
+
+
+def note_commit(key: bytes, height: int) -> None:
+    """The tx landed in the committed block at ``height``
+    (CListMempool.update) — closes the row into the completion ring.
+    Recorded for every sampled committed tx even when this node never
+    admitted it (blocksync replay, table eviction): the commit tally
+    must reconcile against EV_COMMIT tx counts."""
+    if not _enabled:
+        return
+    r = _rate
+    if r <= 0 or not key or key[0] % r:
+        return
+    fp = key_fp(key)
+    if fp == 0:
+        return
+    t = _T
+    i = fp % t.capacity
+    fps = _signed(fp)
+    now = libhealth.now_ns()
+    if t.fp[i] == fps:
+        admit, depth = t.t_admit[i], t.depth[i]
+        send, recv, lag = t.t_send[i], t.t_recv[i], t.recv_lag[i]
+        t.fp[i] = 0  # free the slot
+    else:
+        admit = depth = send = recv = lag = 0
+    # proposal backfill: the accepted proposal stamp for this height
+    pi = height % t._PH_CAP
+    prop_ts = t.pts[pi] if t.ph[pi] == height else 0
+    prop_r = t.pr[pi] if t.ph[pi] == height else -1
+    # completion-ring slot (GIL-atomic reservation, libs/health style)
+    seq = next(t.d_seq)
+    k = seq % t.d_cap
+    t.d_fp[k] = 0  # mark in-progress: readers skip torn rows
+    t.d_h[k] = height
+    t.d_r[k] = prop_r
+    t.d_admit[k] = admit
+    t.d_total[k] = now - admit if admit else 0
+    t.d_send[k] = send - admit if (admit and send) else -1
+    t.d_recv_lag[k] = lag if recv else -1
+    if admit and prop_ts:
+        p = prop_ts - admit
+        t.d_prop[k] = p if p > 0 else 0
+    else:
+        t.d_prop[k] = -1
+    if prop_ts:
+        w = now - prop_ts
+        t.d_wait[k] = w if w > 0 else 0
+    else:
+        t.d_wait[k] = -1
+    t.d_depth[k] = depth if admit else -1
+    t.d_fp[k] = fps  # publish last
+    if seq >= t.d_written[0]:
+        t.d_written[0] = seq + 1
+    t.counts[ST_COMMIT] += 1
+    if prop_ts:
+        t.counts[ST_PROPOSAL] += 1
+    libhealth.record(
+        libhealth.EV_TX, height, ST_COMMIT, fps,
+        t.d_total[k],
+    )
+
+
+def note_commit_many(keys, height: int) -> None:
+    """Batched commit stamping: ONE call per committed block
+    (CListMempool.update already derives every committed key as a
+    batch).  The not-sampled per-key cost is a byte index and a modulo
+    inside one loop — no per-tx function call, which measurably
+    matters: the call overhead alone was the largest share of the
+    plane's per-tx cost (bench 20_tx_lifecycle's record_ns columns)."""
+    if not _enabled:
+        return
+    r = _rate
+    if r <= 0:
+        return
+    for key in keys:
+        if not key or key[0] % r:
+            continue
+        note_commit(key, height)
+
+
+# -- read paths (scrape / debug / bench — may allocate) ------------------
+
+
+def _iter_done():
+    t = _T
+    w = t.d_written[0]
+    n = min(w, t.d_cap)
+    for seq in range(w - n, w):
+        yield seq, seq % t.d_cap
+
+
+def completed_rows(limit: int | None = None) -> list[dict]:
+    """Decoded completion-ring rows, oldest first (lock-free snapshot;
+    torn rows are skipped)."""
+    t = _T
+    out = []
+    for _seq, k in _iter_done():
+        fps = t.d_fp[k]
+        if fps == 0:
+            continue
+        row = {
+            "key": fp_hex(fps),
+            "height": t.d_h[k],
+            "round": t.d_r[k] if t.d_r[k] >= 0 else None,
+            "latency_s": (
+                round(t.d_total[k] / 1e9, 6) if t.d_total[k] else None
+            ),
+            "admit_to_send_s": (
+                round(t.d_send[k] / 1e9, 6) if t.d_send[k] >= 0 else None
+            ),
+            "hop_s": (
+                round(t.d_recv_lag[k] / 1e9, 6)
+                if t.d_recv_lag[k] >= 0
+                else None
+            ),
+            "admit_to_proposal_s": (
+                round(t.d_prop[k] / 1e9, 6) if t.d_prop[k] >= 0 else None
+            ),
+            "proposal_to_commit_s": (
+                round(t.d_wait[k] / 1e9, 6) if t.d_wait[k] >= 0 else None
+            ),
+            "depth_at_admit": (
+                t.d_depth[k] if t.d_depth[k] >= 0 else None
+            ),
+        }
+        out.append(row)
+    return out[-limit:] if limit else out
+
+
+def in_flight_rows(now_ns: int | None = None) -> list[dict]:
+    """Sampled txs admitted/received but not yet committed."""
+    t = _T
+    if now_ns is None:
+        now_ns = libhealth.now_ns()
+    out = []
+    for i in range(t.capacity):
+        fps = t.fp[i]
+        if fps == 0:
+            continue
+        admit = t.t_admit[i]
+        first = admit or t.t_recv[i]
+        out.append({
+            "key": fp_hex(fps),
+            "age_s": (
+                round((now_ns - first) / 1e9, 6) if first else None
+            ),
+            "admitted": bool(admit),
+            "depth_at_admit": t.depth[i] if admit else None,
+            "gossip_sent": bool(t.t_send[i]),
+            "gossip_received": bool(t.t_recv[i]),
+        })
+    out.sort(key=lambda r: -(r["age_s"] or 0.0))
+    return out
+
+
+def commit_latencies_s() -> list[float]:
+    """Submit→commit latencies of completed rows with a known admit
+    (seconds) — the bench p50/p99 source."""
+    t = _T
+    out = []
+    for _seq, k in _iter_done():
+        if t.d_fp[k] != 0 and t.d_total[k] > 0:
+            out.append(t.d_total[k] / 1e9)
+    return out
+
+
+def stage_counts() -> dict[str, int]:
+    return {
+        name: _T.counts[code] for code, name in STAGE_NAMES.items()
+    }
+
+
+def effective_rate() -> float:
+    """The rate the one-byte predicate ACTUALLY samples at: exact for
+    divisors of 256 (incl. the default 64), 256/ceil(256/r) otherwise,
+    and 256 for anything above — the number a consumer must scale
+    sampled counts by (0.0 = sampling off)."""
+    r = _rate
+    if r <= 0:
+        return 0.0
+    matching = sum(1 for b in range(256) if b % r == 0)
+    return 256.0 / matching
+
+
+def status() -> dict:
+    return {
+        "enabled": _enabled,
+        "sample_rate": _rate,
+        "sample_rate_effective": round(effective_rate(), 2),
+        "capacity": _T.capacity,
+        "completed": _T.d_written[0],
+        "counts": stage_counts(),
+    }
+
+
+def mempool_table(n: int = 8) -> list[dict]:
+    """Oldest admitted-uncommitted txs per registered mempool (the
+    starved keys a tx_starved bundle names; key prefixes only)."""
+    out = []
+    for mp in mempools():
+        try:
+            entries = mp.oldest_entries(n)
+        except Exception:
+            continue
+        out.append({
+            "size": mp.size(),
+            "oldest": [
+                {
+                    "key": fp_hex(_signed(key_fp(key))),
+                    "age_s": round(age, 6),
+                    "sampled": _sampled(key_fp(key)),
+                }
+                for key, age in entries
+            ],
+        })
+    return out
+
+
+def snapshot() -> dict:
+    """The ``tx.json`` bundle body and the ``/debug/tx`` index view."""
+    return {
+        **status(),
+        "oldest_admitted_age_s": round(oldest_admitted_age_s(), 6),
+        "mempools": mempool_table(),
+        "in_flight": in_flight_rows()[:64],
+        "recent_completed": completed_rows(limit=64),
+    }
+
+
+def lookup(prefix: str) -> dict:
+    """'Where is my transaction': rows whose 16-hex-char key prefix
+    starts with ``prefix`` (a full 64-char tx-key hex is accepted and
+    truncated — only the first 8 key bytes are retained)."""
+    prefix = prefix.strip().lower()[:16]
+    t = _T
+    in_flight = [
+        r for r in in_flight_rows() if r["key"].startswith(prefix)
+    ]
+    completed = [
+        r for r in completed_rows() if r["key"].startswith(prefix)
+    ]
+    fp = None
+    sampled = None
+    if prefix and all(c in "0123456789abcdef" for c in prefix):
+        if len(prefix) == 16:
+            fp = int(prefix, 16)
+            sampled = _sampled(fp)
+    return {
+        "prefix": prefix,
+        "sampled": sampled,
+        "sample_rate": _rate,
+        "in_flight": in_flight,
+        "completed": completed,
+    }
+
+
+def debug_tx_json(prefix: str | None = None) -> str:
+    """Body of the pprof server's ``/debug/tx`` route."""
+    import json
+
+    if prefix:
+        return json.dumps(lookup(prefix), default=str)
+    return json.dumps(snapshot(), default=str)
+
+
+def sample(metrics=None) -> None:
+    """Scrape-time bridge (called from libs/health.sample): completed
+    rows since the per-registry watermark observe into the tx
+    histograms, stage tallies bridge into ``tx_sampled_total``, and
+    ``mempool_oldest_age_seconds`` is set from the live mempools —
+    the devledger watermark pattern, so multi-node scrapes each see
+    the full series and the record path touches no metrics object."""
+    from . import metrics as libmetrics
+
+    m = metrics if metrics is not None else libmetrics.node_metrics()
+    t = _T
+    wm = getattr(m, "_txtrace_wm", None)
+    if wm is None:
+        wm = m._txtrace_wm = {"seq": 0, "counts": [0] * 8}
+    w = t.d_written[0]
+    start = max(wm["seq"], w - t.d_cap)
+    for seq in range(start, w):
+        k = seq % t.d_cap
+        if t.d_fp[k] == 0:
+            continue
+        if t.d_total[k] > 0:
+            m.tx_commit_latency.observe(t.d_total[k] / 1e9)
+        if t.d_send[k] >= 0:
+            m.tx_stage_seconds.labels("admit_to_send").observe(
+                t.d_send[k] / 1e9
+            )
+        if t.d_recv_lag[k] >= 0:
+            m.tx_stage_seconds.labels("hop").observe(
+                t.d_recv_lag[k] / 1e9
+            )
+        if t.d_prop[k] >= 0:
+            m.tx_stage_seconds.labels("admit_to_proposal").observe(
+                t.d_prop[k] / 1e9
+            )
+        if t.d_wait[k] >= 0:
+            m.tx_stage_seconds.labels("proposal_to_commit").observe(
+                t.d_wait[k] / 1e9
+            )
+    wm["seq"] = w
+    seen = wm["counts"]
+    for code, name in STAGE_NAMES.items():
+        cur = t.counts[code]
+        if cur > seen[code]:
+            m.tx_sampled.labels(name).inc(cur - seen[code])
+            seen[code] = cur
+    m.mempool_oldest_age.set(round(oldest_admitted_age_s(), 6))

@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import threading
 
+from ..libs import health as libhealth
 from ..libs import metrics as libmetrics
 from ..libs import sync as libsync
 from ..libs import trace as libtrace
+from ..libs import txtrace as libtxtrace
 from dataclasses import dataclass, field
 
 from ..abci import types as abci
@@ -56,6 +58,11 @@ class MempoolTx:
     # every later cache/map touch — a 1 MB tx must never pay a second
     # SHA-256 on the remove/recheck paths
     key: bytes = b""
+    # admission stamp (libs/health ring clock, so the age is
+    # virtual-domain-consistent under simnet): the clist is FIFO, so
+    # the front element's stamp is the pool's oldest — the
+    # mempool_oldest_age_seconds gauge and the tx_starved watchdog
+    time_ns: int = 0
 
 
 class CListMempool:
@@ -113,6 +120,33 @@ class CListMempool:
         with self._update_mtx:
             return self._size_bytes
 
+    def oldest_age_s(self) -> float:
+        """Age of the oldest admitted-uncommitted tx (0.0 = empty).
+        Lock-free racy read of the clist front — the tx_starved
+        watchdog polls this from its check tick, which must not
+        contend with the update lock."""
+        el = self.txs.front()
+        if el is None:
+            return 0.0
+        t = el.value.time_ns
+        if not t:
+            return 0.0
+        age = (libhealth.now_ns() - t) / 1e9
+        return age if age > 0 else 0.0
+
+    def oldest_entries(self, n: int = 8) -> list[tuple[bytes, float]]:
+        """The ``n`` oldest pending txs as ``(key, age_s)`` — the
+        starved keys a tx_starved black-box bundle names."""
+        now = libhealth.now_ns()
+        out: list[tuple[bytes, float]] = []
+        for el in self.txs:
+            memtx = el.value
+            age = (now - memtx.time_ns) / 1e9 if memtx.time_ns else 0.0
+            out.append((memtx.key, age if age > 0 else 0.0))
+            if len(out) >= n:
+                break
+        return out
+
     def is_full(self, tx_len: int) -> MempoolFullError | None:
         if (
             self.size() >= self.config.size
@@ -154,6 +188,20 @@ class CListMempool:
             # first-seen only (mempool/metrics.go TxSizeBytes): duplicates
             # and rejected-before-cache txs must not shift the histogram
             libmetrics.node_metrics().mempool_tx_size.observe(len(tx))
+            if sender and libtxtrace.enabled():
+                # first receipt FROM a peer — stamped AFTER the cache
+                # dedup, so re-gossip of an already-seen/committed tx
+                # cannot re-create a ghost lifecycle row that never
+                # closes; the netstamp wall hint is still parked (the
+                # recv routine dispatches reactors synchronously on
+                # this thread, and the stamp stores are cheap array
+                # writes, safe under the update lock)
+                from ..libs import netstats as libnetstats
+
+                stamp = libnetstats.current_stamp()
+                libtxtrace.note_gossip_recv(
+                    key, stamp[2] if stamp is not None else 0
+                )
             if sender:
                 self._pending_senders[key] = sender
             self._pending_tx_keys[tx] = key
@@ -205,11 +253,16 @@ class CListMempool:
                     self._pending_senders.pop(key, None)
                     return
                 sender = self._pending_senders.pop(key, "")
+                # tx-lifecycle admission stamp (+ the mempool depth
+                # the tx saw — txs queued ahead of it at admit);
+                # self-gated: the disabled path is one flag check
+                libtxtrace.note_admit(key, len(self.txs))
                 memtx = MempoolTx(
                     tx=tx,
                     height=self.height,
                     gas_wanted=res.gas_wanted,
                     key=key,
+                    time_ns=libhealth.now_ns(),
                 )
                 if sender:
                     memtx.senders.add(sender)
@@ -334,6 +387,11 @@ class CListMempool:
 
         with devledger.caller_class("mempool"):
             keys = hashplane.hash_many(txs)
+        # the commit stage closes each sampled tx's lifecycle row —
+        # ONE batched call for the whole block (keys just derived
+        # above, no extra hashing; self-gated, so the disabled cost
+        # is one flag check per block)
+        libtxtrace.note_commit_many(keys, height)
         for tx, key, res in zip(txs, keys, tx_results):
             if res.code == abci.OK:
                 self.cache.push(key)  # committed: never re-admit

@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 
 from ..libs import netstats as libnetstats
+from ..libs import txtrace as libtxtrace
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
 from .clist_mempool import CListMempool, MempoolError
 
@@ -61,6 +62,10 @@ class MempoolReactor(Reactor):
             if peer.id not in memtx.senders and not el.removed:
                 if not peer.send(MEMPOOL_CHANNEL, memtx.tx):
                     continue  # retry same element
+                # tx-lifecycle: first gossip send of a sampled tx
+                # toward ANY peer (set-once inside the plane; the
+                # admitted element carries its ingress key)
+                libtxtrace.note_gossip_send(memtx.key)
             nxt = el.next_wait(timeout=0.2)
             if nxt is not None:
                 el = nxt

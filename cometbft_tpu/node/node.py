@@ -655,12 +655,19 @@ class Node(BaseService):
         # unwind below releases it on any failure.
         from ..libs import devledger as libdevledger
         from ..libs import netstats as libnetstats
+        from ..libs import txtrace as libtxtrace
 
         libnetstats.acquire()
         # the device-time ledger rides the same lifecycle: per-caller
         # attribution is on exactly while a node runs (kill switch
         # COMETBFT_TPU_LEDGER=0), released on any boot failure below
         libdevledger.acquire()
+        # the tx-lifecycle plane too (kill switch COMETBFT_TPU_TX=0):
+        # sampled stage stamps start with the first admitted tx, and
+        # this node's mempool joins the oldest-age probe the
+        # tx_starved watchdog and mempool_oldest_age_seconds read
+        libtxtrace.acquire()
+        libtxtrace.register_mempool(self.mempool)
         try:
             if self.pprof_server is not None:
                 self.pprof_server.start()
@@ -731,8 +738,10 @@ class Node(BaseService):
                     self.verify_coalescer = None
                 raise
         except BaseException:
-            # ANY boot failure: release the netstats + ledger acquires
-            # (on_stop never runs on a half-booted node)
+            # ANY boot failure: release the netstats + ledger + tx-plane
+            # acquires (on_stop never runs on a half-booted node)
+            libtxtrace.deregister_mempool(self.mempool)
+            libtxtrace.release()
             libdevledger.release()
             libnetstats.release()
             raise
@@ -989,10 +998,13 @@ class Node(BaseService):
                 pass
         # after the switch (its peers deregister their stats blocks on
         # connection stop): release this node's netstats + device-time
-        # ledger acquires
+        # ledger + tx-plane acquires
         from ..libs import devledger as libdevledger
         from ..libs import netstats as libnetstats
+        from ..libs import txtrace as libtxtrace
 
+        libtxtrace.deregister_mempool(self.mempool)
+        libtxtrace.release()
         libnetstats.release()
         libdevledger.release()
         # Coalescer after consensus is down: unroute first (new callers

@@ -19,6 +19,9 @@ stack can actually see, and the ranked result is the **verdict**:
     verify_stall        the verify-coalescer breaker was open
     recompile_storm     steady-state XLA recompiles burned the window
     wal_fsync_outlier   one WAL fsync consumed a large latency share
+    mempool_backlog     sampled txs committed in the window waited far
+                        longer in the mempool than the run's typical
+                        submit->commit wait (libs/txtrace rows)
 
 Scores live in [0, 1]; only findings at or above the report threshold
 make the verdict, so a healthy run yields **no verdict at all** — the
@@ -209,6 +212,9 @@ def _window_findings(
     proposal_gap_s: float | None,
     median_gap_s: float | None,
     baseline_lag_s: float,
+    tx_waits: list = (),
+    tx_depths: list = (),
+    median_tx_wait_s: float | None = None,
 ) -> list:
     """Score every cause over one window; returns ALL findings ranked
     by score (the caller applies the report threshold)."""
@@ -403,6 +409,31 @@ def _window_findings(
             {"recompiles": len(recompiles)},
         ))
 
+    # -- mempool backlog: sampled txs that committed IN this window
+    # waited far longer from admission to commit than the run's
+    # typical sampled tx — inclusion lagged while the chain ran, the
+    # tx-plane signature of a storm-backlogged mempool (tx rows come
+    # from libs/txtrace's deterministic sampling, so the comparison is
+    # apples-to-apples across heights and nodes)
+    if tx_waits and median_tx_wait_s:
+        tw = sorted(tx_waits)
+        p50 = tw[min(len(tw) - 1, len(tw) // 2)]
+        ratio = p50 / median_tx_wait_s
+        if ratio > 3.0:
+            dp = sorted(tx_depths)
+            findings.append(Finding(
+                "mempool_backlog",
+                min(0.85, 0.2 + 0.1 * ratio),
+                {
+                    "txs": len(tw),
+                    "wait_p50_ms": round(p50 * 1e3, 3),
+                    "typical_ms": round(median_tx_wait_s * 1e3, 3),
+                    "depth_p50": (
+                        dp[min(len(dp) - 1, len(dp) // 2)] if dp else None
+                    ),
+                },
+            ))
+
     # -- WAL fsync outlier (wall-domain rings only; virtual merges drop
     # fsync rows because real disk time has no virtual meaning)
     fsyncs = [a for a in anns if a.get("event") == _FSYNC]
@@ -464,6 +495,14 @@ def attribute(
             if g is not None]
     median_gap = sorted(gaps)[len(gaps) // 2] if gaps else None
 
+    # sampled tx-lifecycle samples (absent on timelines built before
+    # the tx plane, and on synthetic test Timelines)
+    tx_s = getattr(timeline, "tx_samples", None) or {}
+    tx_run = sorted(tx_s.get("run", []))
+    median_tx_wait = tx_run[len(tx_run) // 2] if tx_run else None
+    tx_heights = tx_s.get("heights", {})
+    tx_depths = tx_s.get("depths", {})
+
     lats = [x for x in (_height_latency(hv) for hv in heights)
             if x is not None]
     lat_sorted = sorted(lats)
@@ -501,6 +540,9 @@ def attribute(
             proposal_gap_s=_proposal_gap_s(hv),
             median_gap_s=median_gap,
             baseline_lag_s=baseline_lag_s,
+            tx_waits=tx_heights.get(hv["height"], ()),
+            tx_depths=tx_depths.get(hv["height"], ()),
+            median_tx_wait_s=median_tx_wait,
         )
         slow.append(WindowVerdict(
             window=f"height:{hv['height']}",

@@ -51,6 +51,7 @@ _EV_PROPOSAL = "consensus.proposal"
 _EV_VOTE = "consensus.vote"
 _EV_COMMIT = "consensus.commit"
 _EV_GOSSIP = "p2p.gossip"
+_EV_TX = "tx.stage"
 
 _HEIGHT_EVENTS = frozenset(
     {_EV_STEP, _EV_PROPOSAL, _EV_VOTE, _EV_COMMIT}
@@ -187,11 +188,16 @@ class Timeline:
     """The merged view: ``data`` is a plain JSON-able dict;
     ``lag_samples`` keeps the raw per-window gossip-lag samples for the
     attribution pass (aggregates only go to JSON — a 50k-hop run must
-    not serialize 50k floats)."""
+    not serialize 50k floats); ``tx_samples`` keeps the sampled-tx
+    submit->commit waits and admit-depth samples the mempool_backlog
+    detector scores."""
 
-    def __init__(self, data: dict, lag_samples: dict):
+    def __init__(self, data: dict, lag_samples: dict, tx_samples=None):
         self.data = data
         self.lag_samples = lag_samples
+        self.tx_samples = tx_samples or {
+            "run": [], "heights": {}, "depths": {},
+        }
 
     @property
     def domain(self) -> str:
@@ -288,12 +294,18 @@ def merge(sources: list[Source]) -> Timeline:
     heights: dict[int, dict] = {}
     votes_acc: dict[int, dict] = {}
     loose: list[tuple[int, int, dict]] = []  # (ts, si, ev) to place later
+    tx_rows: list[tuple[int, int, dict]] = []  # sampled tx.stage rows
 
     for ts, si, _k, ev in rows:
         name = ev.get("event")
         h = ev.get("height", 0)
         node = ev.get("node") or sources[si].name
-        if name in _HEIGHT_EVENTS and h > 0:
+        if name == _EV_TX:
+            # sampled tx-lifecycle rows get their own per-height view
+            # below (never the annotation stream — a storm's sampled
+            # txs would drown the fault/breaker rows there)
+            tx_rows.append((ts, si, ev))
+        elif name in _HEIGHT_EVENTS and h > 0:
             hv = heights.get(h)
             if hv is None:
                 hv = heights[h] = {
@@ -419,6 +431,56 @@ def merge(sources: list[Source]) -> Timeline:
             ann["assigned_height"] = target
             run_ann.append(ann)
 
+    # -- sampled tx-lifecycle rows: per-height tx tables + the wait /
+    # depth samples the mempool_backlog detector scores.  Deterministic
+    # sampling (libs/txtrace) means every node traced the SAME keys,
+    # so cross-node commit rows of one tx join here for free.
+    tx_samples: dict = {"run": [], "heights": {}, "depths": {}}
+    stage_acc: dict[str, dict] = {}  # key -> first-seen non-commit stamps
+    tx_acc: dict[int, dict] = {}  # height -> key -> joined row
+    for ts, si, ev in tx_rows:
+        stage = ev.get("stage_name", "?")
+        if stage == "commit":
+            continue  # second pass below (needs stage_acc complete)
+        key = ev.get("key", "?")
+        stamps = stage_acc.setdefault(key, {})
+        if stage not in stamps:
+            stamps[stage] = {
+                "node": ev.get("node") or sources[si].name,
+                "ts_ns": ts,
+            }
+        if stage == "admit":
+            h = _height_for(ts)
+            if h is not None:
+                tx_samples["depths"].setdefault(h, []).append(
+                    ev.get("val", 0)
+                )
+    for ts, si, ev in tx_rows:
+        if ev.get("stage_name") != "commit":
+            continue
+        h = ev.get("height", 0) or _height_for(ts)
+        if h is None:
+            continue
+        key = ev.get("key", "?")
+        wait_ns = ev.get("val", 0)
+        if wait_ns > 0:
+            tx_samples["run"].append(wait_ns / 1e9)
+            tx_samples["heights"].setdefault(h, []).append(wait_ns / 1e9)
+        bucket = tx_acc.setdefault(h, {})
+        row = bucket.get(key)
+        if row is None:
+            row = bucket[key] = {
+                "key": key,
+                "stages": stage_acc.get(key, {}),
+                "commits": {},
+            }
+        row["commits"][ev.get("node") or sources[si].name] = {
+            "ts_ns": ts,
+            "since_admit_s": (
+                _round9(wait_ns / 1e9) if wait_ns > 0 else None
+            ),
+        }
+
     def _gossip_view(key):
         b = gossip_acc.get(key)
         if b is None:
@@ -433,6 +495,10 @@ def merge(sources: list[Source]) -> Timeline:
     for hv in ordered:
         h = hv["height"]
         hv["gossip"] = _gossip_view(h)
+        bucket = tx_acc.get(h)
+        hv["txs"] = (
+            [bucket[k] for k in sorted(bucket)] if bucket else []
+        )
         hv["annotations"] = [
             a for a in run_ann if a["assigned_height"] == h
         ]
@@ -503,7 +569,7 @@ def merge(sources: list[Source]) -> Timeline:
             "complete": complete,
         },
     }
-    return Timeline(data, lag_samples)
+    return Timeline(data, lag_samples, tx_samples)
 
 
 def merge_ring_export(export: dict, name: str | None = None) -> Timeline:

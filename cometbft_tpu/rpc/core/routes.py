@@ -518,6 +518,21 @@ def broadcast_tx_commit(env, tx=None) -> dict:
             pass
 
 
+def tx_trace(env, key=None) -> dict:
+    """'Where is my transaction' over RPC: the sampled tx-lifecycle
+    plane's (libs/txtrace) view of one tx key — in-flight stage stamps
+    or the completed submit->commit decomposition.  ``key`` is the tx
+    key (SHA-256 of the tx) in hex; a prefix of the retained 16 chars
+    works, a full 64-char key hex is truncated.  An unsampled key
+    returns empty row lists with ``sampled: false`` so a client can
+    tell "not sampled" from "not seen"."""
+    from ...libs import txtrace as libtxtrace
+
+    if key is None or not str(key).strip():
+        raise RPCError("missing key param", code=-32602)
+    return libtxtrace.lookup(str(key))
+
+
 def check_tx(env, tx=None) -> dict:
     """Run CheckTx against the app WITHOUT adding to the mempool
     (rpc/core/mempool.go CheckTx)."""
@@ -834,6 +849,7 @@ ROUTES = {
     "header_by_hash": header_by_hash,
     "light_verify": light_verify,
     "light_status": light_status,
+    "tx_trace": tx_trace,
 }
 
 # Operator-only routes, merged in when config.rpc.unsafe is set

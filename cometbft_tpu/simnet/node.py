@@ -58,12 +58,29 @@ class SimTicker(BaseService):
 class SimListMempool:
     """Minimal reap-list mempool for tx injection (validator churn, the
     e2e ``--simnet`` load mode).  Implements exactly the
-    BlockExecutor-facing slice of the mempool contract."""
+    BlockExecutor-facing slice of the mempool contract.
+
+    When the tx-lifecycle plane (libs/txtrace) is enabled — bench
+    ``20_tx_lifecycle`` drives the mempool_storm scenario with it on —
+    push/update stamp admit/commit stages exactly like the real
+    CListMempool, keyed on the same SHA-256 tx key, with the depth the
+    tx saw at admission.  Keys are hashed ONLY while the plane is on
+    (hashlib directly: simnet routes no hash plane), and every stamp
+    reads the shared virtual clock through libs/health.now_ns, so the
+    sampled latencies are exact and runs stay deterministic."""
 
     def __init__(self):
         self._txs: list[bytes] = []
 
     def push_tx(self, tx: bytes) -> None:
+        from ..libs import txtrace as libtxtrace
+
+        if libtxtrace.enabled():
+            import hashlib
+
+            libtxtrace.note_admit(
+                hashlib.sha256(tx).digest(), len(self._txs)
+            )
         self._txs.append(tx)
 
     def size(self) -> int:
@@ -85,6 +102,15 @@ class SimListMempool:
         pass
 
     def update(self, height, txs, tx_results, *a, **k) -> None:
+        from ..libs import txtrace as libtxtrace
+
+        if libtxtrace.enabled():
+            import hashlib
+
+            for tx in txs:
+                libtxtrace.note_commit(
+                    hashlib.sha256(tx).digest(), height
+                )
         committed = set(txs)
         self._txs = [t for t in self._txs if t not in committed]
 

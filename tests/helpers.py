@@ -281,6 +281,61 @@ def stop_node(cs, parts):
             pass
 
 
+def ring_commit_rows() -> int:
+    """consensus.commit rows currently decodable from the flight ring."""
+    from cometbft_tpu.libs import health as libhealth
+
+    return sum(
+        1
+        for e in libhealth.recorder().dump()
+        if e["event"] == "consensus.commit"
+    )
+
+
+def wait_for_commits(
+    stores,
+    height: int,
+    ring_commits: int = 0,
+    timeout: float = 120.0,
+    tick: float = 0.05,
+    on_tick=None,
+):
+    """Wait until EVERY block store reaches ``height`` AND (when
+    ``ring_commits`` > 0) the flight ring holds that many decoded
+    consensus.commit rows, then assert both.
+
+    THE shared burst-wait: ``block_store.height()`` advances at
+    save_block, BEFORE ``_finalize_commit`` records EV_COMMIT
+    (post-apply), so a store-height wait alone races the laggard's
+    last commit row into whatever ring assertion follows (observed
+    ~2/5 under load on a shared single-core container — hardened
+    independently in test_health/test_devledger/test_postmortem
+    before this helper unified them).  ``on_tick`` runs once per poll
+    (e.g. sampling health scores during the wait)."""
+    import time as _t
+
+    stores = list(stores)
+    deadline = _t.monotonic() + timeout
+
+    def _done() -> bool:
+        if stores and min(s.height() for s in stores) < height:
+            return False
+        if ring_commits and ring_commit_rows() < ring_commits:
+            return False
+        return True
+
+    while not _done() and _t.monotonic() < deadline:
+        if on_tick is not None:
+            on_tick()
+        _t.sleep(tick)
+    assert not stores or min(s.height() for s in stores) >= height, [
+        s.height() for s in stores
+    ]
+    if ring_commits:
+        got = ring_commit_rows()
+        assert got >= ring_commits, (got, ring_commits)
+
+
 def wait_for_height(parts_or_store, height: int, timeout: float = 30.0):
     """Block until the node's block store reaches ``height``."""
     import time as _t

@@ -86,3 +86,195 @@ def test_bench_capture_path_end_to_end(tmp_path):
     table = json.loads((tmp_path / "BENCH_CHIP_TABLE.json").read_text())
     assert table["table"], "chip table must be written on a live backend"
     assert "device_kind" in table  # None on CPU, the chip kind on TPU
+
+
+# ----------------------------------------------- bench --compare units
+#
+# Direct unit coverage for the regression comparator (it shipped with
+# only review-hardening coverage): direction heuristics, noise-floor
+# gating, file-shape loading, and the CLI exit codes.
+
+
+def _bench_mod():
+    import importlib.util
+
+    spec = importlib.util.find_spec("bench")
+    if spec is None:
+        import sys as _sys
+
+        _sys.path.insert(0, _REPO)
+    import bench
+
+    return bench
+
+
+class TestMetricDirection:
+    def test_higher_is_better_fragments(self):
+        bench = _bench_mod()
+        for key in (
+            "sigs_per_sec",
+            "coalesced_vs_serial",
+            "storm_vs_serial",
+            "vs_batch_baseline",
+            "cache_hit_rate",
+            "budget_coverage",
+            "est_vpu_util",
+            "device_window_pct",  # resolves higher-better FIRST
+            "lane_share",
+        ):
+            assert bench._metric_direction(key) == 1, key
+
+    def test_lower_is_better_fragments(self):
+        bench = _bench_mod()
+        for key in (
+            "latency_ms",
+            "commit_ms_p50",
+            "burst_s",
+            "consensus_wait_p99_ms",
+            "overhead_pct",
+            "ab_noise_floor_pct",
+            "compile_ms",
+            "h2d_bytes",
+            "delta_pct",
+        ):
+            assert bench._metric_direction(key) == -1, key
+
+    def test_unknown_direction_flags_any_move(self):
+        bench = _bench_mod()
+        assert bench._metric_direction("mystery_quantity") == 0
+
+
+def _write(path, obj):
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+class TestBenchCompare:
+    def _rows(self, **overrides):
+        base = {
+            "config": "1_batch64",
+            "sigs_per_sec": 1000.0,
+            "latency_ms": 10.0,
+            "mystery_quantity": 5.0,
+        }
+        base.update(overrides)
+        return [base]
+
+    def test_regression_in_lower_better_metric_flags(self, tmp_path):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows())
+        b = _write(tmp_path / "b.json", self._rows(latency_ms=15.0))
+        out = bench.bench_compare(a, b)
+        regs = {r["metric"] for r in out["regressions"]}
+        assert "latency_ms" in regs
+        # default floor without a 13_health_overhead row: 10%
+        assert out["noise_floor_pct"] == 10.0
+
+    def test_improvement_is_not_a_regression(self, tmp_path):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows())
+        b = _write(
+            tmp_path / "b.json",
+            self._rows(latency_ms=5.0, sigs_per_sec=2000.0),
+        )
+        out = bench.bench_compare(a, b)
+        assert out["regressions"] == []
+
+    def test_throughput_drop_flags(self, tmp_path):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows())
+        b = _write(tmp_path / "b.json", self._rows(sigs_per_sec=500.0))
+        out = bench.bench_compare(a, b)
+        assert [r["metric"] for r in out["regressions"]] == [
+            "sigs_per_sec"
+        ]
+
+    def test_sub_noise_moves_never_flag(self, tmp_path):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows())
+        b = _write(
+            tmp_path / "b.json",
+            self._rows(latency_ms=10.9, sigs_per_sec=950.0),
+        )
+        out = bench.bench_compare(a, b)  # 9%/5% < the 10% default floor
+        assert out["regressions"] == []
+
+    def test_unknown_direction_flags_both_ways(self, tmp_path):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows())
+        up = _write(
+            tmp_path / "up.json", self._rows(mystery_quantity=10.0)
+        )
+        down = _write(
+            tmp_path / "dn.json", self._rows(mystery_quantity=1.0)
+        )
+        assert any(
+            r["metric"] == "mystery_quantity"
+            for r in bench.bench_compare(a, up)["regressions"]
+        )
+        assert any(
+            r["metric"] == "mystery_quantity"
+            for r in bench.bench_compare(a, down)["regressions"]
+        )
+
+    def test_noise_floor_from_health_row_with_2pct_min(self, tmp_path):
+        bench = _bench_mod()
+        rows_a = self._rows() + [
+            {"config": "13_health_overhead", "ab_noise_floor_pct": 25.0}
+        ]
+        a = _write(tmp_path / "a.json", rows_a)
+        b = _write(tmp_path / "b.json", self._rows(latency_ms=12.0))
+        out = bench.bench_compare(a, b)
+        assert out["noise_floor_pct"] == 25.0
+        assert out["regressions"] == []  # +20% < the measured floor
+        # the 2% minimum: a near-zero measured floor must not page on
+        # sub-noise jitter
+        rows_a[1]["ab_noise_floor_pct"] = 0.1
+        a2 = _write(tmp_path / "a2.json", rows_a)
+        b2 = _write(tmp_path / "b2.json", self._rows(latency_ms=10.15))
+        out2 = bench.bench_compare(a2, b2)
+        assert out2["noise_floor_pct"] == 2.0
+        assert out2["regressions"] == []  # +1.5% < the 2% min
+
+    def test_capture_tail_and_headline_shapes_load(self, tmp_path):
+        bench = _bench_mod()
+        lines = "\n".join([
+            json.dumps({"config": "1_batch64", "sigs_per_sec": 1000.0}),
+            json.dumps({"metric": "x", "value": 1.0}),
+        ])
+        cap = _write(
+            tmp_path / "cap.json", {"tail": lines, "rc": 0}
+        )
+        rows = bench._compare_load_rows(cap)
+        assert set(rows) == {"1_batch64", "headline"}
+        head = _write(
+            tmp_path / "head.json", {"metric": "x", "value": 2.0}
+        )
+        rows2 = bench._compare_load_rows(head)
+        assert set(rows2) == {"headline"}
+
+    def test_zero_and_non_numeric_fields_skipped(self, tmp_path):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows(
+            zeroed_ms=0.0, note="text", flag=True,
+        ))
+        b = _write(tmp_path / "b.json", self._rows(
+            zeroed_ms=99.0, note="other", flag=False,
+            latency_ms=10.0, sigs_per_sec=1000.0, mystery_quantity=5.0,
+        ))
+        out = bench.bench_compare(a, b)
+        compared = {d["metric"] for d in out["deltas"]}
+        assert "zeroed_ms" not in compared  # a==0: pct undefined
+        assert "note" not in compared and "flag" not in compared
+
+    def test_compare_main_exit_codes(self, tmp_path, capsys):
+        bench = _bench_mod()
+        a = _write(tmp_path / "a.json", self._rows())
+        ok = _write(tmp_path / "ok.json", self._rows())
+        bad = _write(tmp_path / "bad.json", self._rows(latency_ms=20.0))
+        assert bench.compare_main([a, ok]) == 0
+        capsys.readouterr()
+        assert bench.compare_main([a, bad]) == 1
+        err = capsys.readouterr().err
+        assert "REGRESSION" in err and "latency_ms" in err
+        assert bench.compare_main([a]) == 2

@@ -348,13 +348,12 @@ class TestBurstReconciliation:
             for cs, _ in nodes:
                 cs.start()
             stores = [parts["block_store"] for _, parts in nodes]
-            deadline = time.monotonic() + 120
-            while (
-                min(s.height() for s in stores) < 4
-                and time.monotonic() < deadline
-            ):
-                time.sleep(0.02)
-            assert min(s.height() for s in stores) >= 4
+            # the shared hardened wait: heights AND the 4x4 ring
+            # commit rows (the EV_BUDGET assertion below reads the
+            # ring, and save_block leads EV_COMMIT)
+            helpers.wait_for_commits(
+                stores, 4, ring_commits=4 * 4, tick=0.02
+            )
         finally:
             for cs, parts in nodes:
                 helpers.stop_node(cs, parts)
@@ -425,13 +424,11 @@ class TestBurstReconciliation:
             for cs, _ in nodes:
                 cs.start()
             stores = [parts["block_store"] for _, parts in nodes]
-            deadline = time.monotonic() + 120
-            while (
-                min(s.height() for s in stores) < 4
-                and time.monotonic() < deadline
-            ):
-                time.sleep(0.02)
-            assert min(s.height() for s in stores) >= 4
+            # shared hardened wait: the budget read below decodes the
+            # ring, so the laggard's commit rows must be in it
+            helpers.wait_for_commits(
+                stores, 4, ring_commits=4 * 4, tick=0.02
+            )
         finally:
             for cs, parts in nodes:
                 helpers.stop_node(cs, parts)

@@ -559,27 +559,15 @@ class TestHealthyBurst:
                 cs.start()
             mon.start()
             stores = [parts["block_store"] for _, parts in nodes]
-            deadline = time.monotonic() + 120
-            # EVERY node must reach height 3 AND the ring must hold all
-            # 3x4 commit rows: block_store.height() advances at
-            # save_block, BEFORE _finalize_commit records EV_COMMIT
-            # (post-apply), so a store-height wait alone races the
-            # laggard's last commit row into the dump below (observed
-            # ~2/5 on a loaded single-core container)
-            def ring_commits():
-                return sum(
-                    1
-                    for e in libhealth.recorder().dump()
-                    if e["event"] == "consensus.commit"
-                )
-
-            while (
-                min(s.height() for s in stores) < 3
-                or ring_commits() < 3 * 4
-            ) and time.monotonic() < deadline:
-                scores.append(libhealth.sample(m)["score"])
-                time.sleep(0.05)
-            assert min(s.height() for s in stores) >= 3
+            # EVERY node must reach height 3 AND the ring must hold
+            # all 3x4 commit rows — the shared hardened wait
+            # (helpers.wait_for_commits docstring has the race)
+            helpers.wait_for_commits(
+                stores, 3, ring_commits=3 * 4,
+                on_tick=lambda: scores.append(
+                    libhealth.sample(m)["score"]
+                ),
+            )
         finally:
             try:
                 mon.stop()
@@ -602,6 +590,7 @@ class TestHealthyBurst:
             "send_queue_saturated": 0,
             "slow_disk": 0,
             "consensus_starved": 0,
+            "tx_starved": 0,
         }
         assert mon.bundles == 0
         # monotone non-degraded health: every sample along the way AND

@@ -486,6 +486,22 @@ class TestEngineWideGate:
         ]
         assert trace_edges == [], trace_edges
 
+    def test_txtrace_lock_registered_and_leaf(self, analysis):
+        """The tx-lifecycle plane's mempool-probe registry mutex is in
+        the shipped artifact and participates in NO acquisition-order
+        edges: the record path (admit/send/recv/proposal/commit
+        stamps) is lock-free by construction — a txtrace.* edge
+        appearing here means someone made a per-tx stamp take a lock
+        under (or over) engine mutexes."""
+        d = analysis.graph_dict()
+        assert "libs.txtrace._mtx" in {lk["name"] for lk in d["locks"]}
+        tx_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "libs.txtrace._mtx" in (e["from"], e["to"])
+        ]
+        assert tx_edges == [], tx_edges
+
     def test_coalescer_lock_registered_and_flush_never_blocks_under_it(
         self, analysis
     ):

@@ -705,6 +705,59 @@ class TestTrace:
             devledger.reset()
             devledger.enable() if was else devledger.disable()
 
+    def test_txtrace_record_path_allocation_free(self):
+        """The tx-lifecycle plane rides the same always-on tier: the
+        ENABLED sampled record path — admit/send/recv stamps, the
+        commit closure into the completion ring, the batched
+        commit-many loop, AND the not-sampled fast path every tx pays —
+        must retain zero allocations (preallocated array('q') columns,
+        GIL-atomic slot reservation; the devledger guard's frame
+        free-list tolerance applies)."""
+        import hashlib as _hashlib
+
+        from cometbft_tpu.libs import health as libhealth
+        from cometbft_tpu.libs import txtrace
+
+        was = txtrace.enabled()
+        txtrace.reset()
+        txtrace.enable(rate=2)
+        libhealth.enable(ring=4096)
+        # sampled (first byte 0) and not-sampled (first byte 1) keys
+        skey = b"\x00" + _hashlib.sha256(b"tx-guard-s").digest()[1:]
+        nkey = b"\x01" + _hashlib.sha256(b"tx-guard-n").digest()[1:]
+        batch = [nkey, skey, nkey, nkey]
+        try:
+
+            def hot():
+                for _ in range(400):
+                    txtrace.note_admit(skey, 7)
+                    txtrace.note_gossip_send(skey)
+                    txtrace.note_gossip_recv(skey, 0)
+                    txtrace.note_proposal(3, 0)
+                    txtrace.note_commit(skey, 3)
+                    txtrace.note_admit(nkey, 1)  # the fast path
+                    txtrace.note_commit_many(batch, 3)
+                    assert txtrace.oldest_admitted_age_s() == 0.0
+
+            hot()  # warm interpreter caches outside the window
+            stats = _retained_after(hot, [txtrace.__file__])
+            # the devledger guard's CPython frame free-list tolerance,
+            # scaled for the seven record functions this loop drives
+            # (one parked frame per function, ~300-850 B each, count
+            # 1-3): real per-record retention scales with the
+            # 400-iteration window (>= 3.2 KB at one byte per record,
+            # per-line counts ~400) — the count bound still catches it
+            assert sum(s.size for s in stats) < 6144, stats
+            assert all(s.count < 100 for s in stats), stats
+            # the plane really recorded through both windows
+            assert txtrace.stage_counts()["commit"] >= 2 * 400 * 2
+        finally:
+            libhealth.set_ring_capacity(libhealth.DEFAULT_RING_SIZE)
+            libhealth.disable()
+            libhealth.reset()
+            txtrace.reset()
+            txtrace.enable() if was else txtrace.disable()
+
     def test_events_spans_and_nesting(self, tracer):
         with libtrace.span("outer", k="v") as outer:
             libtrace.event("mid", n=1)
@@ -845,6 +898,10 @@ class TestTrace:
             "COMETBFT_TPU_NET_TOPK",
             "COMETBFT_TPU_LEDGER",
             "COMETBFT_TPU_LEDGER_STARVE_MS",
+            "COMETBFT_TPU_TX",
+            "COMETBFT_TPU_TX_SAMPLE",
+            "COMETBFT_TPU_TX_RING",
+            "COMETBFT_TPU_TX_STARVE_COMMITS",
         ):
             assert knob in ENV_KNOBS, knob
             assert knob in doc, f"{knob} missing from docs/observability.md"
@@ -950,6 +1007,38 @@ class TestPprofDebugServer:
         status, dump = _get(server + "/debug/pprof/goroutine")
         assert status == 200
         assert "--- thread" in dump and "MainThread" in dump
+
+    def test_index_lists_every_registered_route(self, server):
+        """The completeness gate: the index page must list EVERY
+        registered debug route (it is generated from the route map —
+        pinned here so the next observability plane cannot silently
+        ship an unlisted route), each documented route carries its doc
+        line, and every ROUTE_DOCS entry names a real route."""
+        from cometbft_tpu.libs.pprof import ROUTE_DOCS, PprofServer
+
+        srv = PprofServer("tcp://127.0.0.1:0")
+        _, body = _get(server + "/debug/pprof/")
+        for path in srv._route_map:
+            if path in ("/debug/pprof", "/debug/pprof/"):
+                continue  # the index's own aliases
+            assert path in body, f"route {path} missing from the index"
+            doc = ROUTE_DOCS.get(path)
+            assert doc, f"route {path} has no ROUTE_DOCS entry"
+            # the doc line renders next to the path (first fragment —
+            # long lines aren't wrapped by the generator)
+            assert doc.split("\n")[0][:24] in body
+        for path in ROUTE_DOCS:
+            assert path in srv._route_map, (
+                f"ROUTE_DOCS names a nonexistent route {path}"
+            )
+        # the current planes' routes, by name — a regression here
+        # means a route was dropped, not just undocumented
+        for expected in (
+            "/debug/devstats", "/debug/health", "/debug/budget",
+            "/debug/net", "/debug/tx", "/debug/flight",
+            "/debug/timeline", "/debug/trace",
+        ):
+            assert expected in body
 
     def test_heap_gating(self, server):
         import tracemalloc

@@ -397,6 +397,88 @@ class TestMergeUnits:
             for a in tl.heights[0]["annotations"]
         )
 
+    def test_tx_stage_rows_become_per_height_tx_tables(self):
+        """Sampled tx.stage rows join into each height's ``txs`` table
+        (commit rows per node + first-seen non-commit stamps per key)
+        and never pollute the annotation stream."""
+        key = "00aabbccddeeff11"
+        evs = (
+            _height_events("node0", 1, 1_000_000_000, txs=1)
+            + [
+                _ev("tx.stage", 1_002_000_000, 0, 1, node="node0",
+                    stage_name="admit", key=key, val=7),
+                _ev("tx.stage", 1_003_000_000, 0, 2, node="node0",
+                    stage_name="gossip_send", key=key, val=1_000_000),
+                _ev("tx.stage", 1_019_000_000, 1, 5, node="node0",
+                    stage_name="commit", key=key, val=17_000_000),
+            ]
+        )
+        evs.sort(key=lambda e: e["ts"])
+        tl = merge([Source("node0", evs, domain="virtual")])
+        h1 = tl.heights[0]
+        assert len(h1["txs"]) == 1
+        row = h1["txs"][0]
+        assert row["key"] == key
+        assert row["commits"]["node0"]["since_admit_s"] == (
+            pytest.approx(0.017)
+        )
+        assert set(row["stages"]) == {"admit", "gossip_send"}
+        assert all(
+            a["event"] != "tx.stage" for a in h1["annotations"]
+        )
+        # the attribution samples rode along
+        assert tl.tx_samples["heights"][1] == [pytest.approx(0.017)]
+        assert tl.tx_samples["depths"][1] == [7]
+
+    def test_mempool_backlog_detector_names_the_backlogged_height(self):
+        """A slow height whose sampled txs waited >> the run's typical
+        submit->commit wait attributes to mempool_backlog; the healthy
+        heights stay silent."""
+        evs = []
+        t = 1_000_000_000
+        for h in range(1, 5):
+            evs += _height_events("node0", h, t, txs=2)
+            for i in range(2):
+                evs.append(_ev(
+                    "tx.stage", t + 19_000_000, h, 5, node="node0",
+                    stage_name="commit", key=f"{h:02x}{i:02x}" + "0" * 12,
+                    val=10_000_000,  # 10 ms typical wait
+                ))
+            t += 100_000_000
+        # height 5: 2 rounds (slow) + txs that waited 600 ms
+        evs += [
+            _ev("consensus.step", t, 5, 0, "node0", step=2),
+            _ev("consensus.step", t + 30_000_000, 5, 1, "node0", step=2),
+            _ev("consensus.proposal", t + 32_000_000, 5, 1, "node0",
+                accepted=1),
+            _ev("tx.stage", t + 10_000_000, 0, 1, node="node0",
+                stage_name="admit", key="ff00" + "0" * 12, val=55),
+            _ev("consensus.commit", t + 60_000_000, 5, 1, "node0",
+                dur_ns=60_000_000, txs=2),
+        ]
+        for i in range(2):
+            evs.append(_ev(
+                "tx.stage", t + 59_000_000, 5, 5, node="node0",
+                stage_name="commit", key=f"ff{i:02x}" + "0" * 12,
+                val=600_000_000,
+            ))
+        evs.sort(key=lambda e: e["ts"])
+        tl = merge([Source("node0", evs, domain="virtual")])
+        rep = attribute(tl)
+        slow = {w.height: w for w in rep.slow_heights}
+        assert 5 in slow
+        v = slow[5].verdict
+        assert v is not None and v.cause == "mempool_backlog", (
+            slow[5].findings
+        )
+        assert v.evidence["txs"] == 2
+        assert v.evidence["wait_p50_ms"] == pytest.approx(600.0)
+        assert v.evidence["typical_ms"] == pytest.approx(10.0)
+        assert v.evidence["depth_p50"] == 55
+        # healthy heights: nothing above threshold
+        for h in range(1, 5):
+            assert h not in slow or slow[h].verdict is None
+
     def test_gossip_rows_aggregate_per_window(self):
         evs = _height_events("node0", 1, 1_000_000_000) + [
             _ev("p2p.gossip", 1_005_000_000, 0, 0, node="node0",
@@ -867,14 +949,12 @@ class TestLiveTcpTimeline:
             for node in nodes[1:]:
                 node.config.p2p.persistent_peers = seed_addr
                 node.start()
-            deadline = time.monotonic() + 120
-            while time.monotonic() < deadline:
-                if all(n.block_store.height() >= 2 for n in nodes):
-                    break
-                time.sleep(0.05)
-            assert all(n.block_store.height() >= 2 for n in nodes), [
-                n.block_store.height() for n in nodes
-            ]
+            # shared hardened wait: the export below decodes the ring,
+            # and save_block leads EV_COMMIT — wait for the 2x4 commit
+            # rows too, not just the store heights
+            helpers.wait_for_commits(
+                [n.block_store for n in nodes], 2, ring_commits=2 * 4
+            )
             export = libhealth.export_ring()
         finally:
             for n in reversed(nodes):
