@@ -70,6 +70,18 @@ ENV_KNOBS: dict[str, str] = {
         "validates against (default: the lockorder.json shipped in "
         "devtools/lint/graph; libs/sync.py)"
     ),
+    "COMETBFT_TPU_LOCKSET": (
+        "lockset sanitizer: off (default) | record samples (field, "
+        "held-lock names) at accessor seams | enforce raises LocksetError "
+        "when a seam runs without the field's statically inferred guard "
+        "fully held (libs/sync.py; guards from `python -m "
+        "cometbft_tpu.devtools.lint --fields`)"
+    ),
+    "COMETBFT_TPU_LOCKSET_FIELDS": (
+        "path override for the guarded-field artifact that enforce mode "
+        "validates against (default: the fieldguards.json shipped in "
+        "devtools/lint/graph; libs/sync.py)"
+    ),
     "COMETBFT_TPU_FAIL": (
         "named crash point for fault-injection tests — the process "
         "dies hard when execution reaches it (libs/fail.py)"

@@ -38,7 +38,11 @@ class HeightVoteSet:
         # loop batch-preverifies drained vote signatures into this memo so
         # per-vote admission skips the per-signature check (SURVEY §7(d)).
         self.sig_memo: dict = {}
-        self._add_round(0)
+        # uncontended here, but every post-construction write to
+        # _round_vote_sets holds this lock — taking it for the round-0
+        # seed keeps the inferred guard (cometlint CLNT011) exact
+        with self._mtx:
+            self._add_round(0)
 
     def _add_round(self, round_: int) -> None:
         if round_ in self._round_vote_sets:
@@ -55,6 +59,7 @@ class HeightVoteSet:
             sig_memo=self.sig_memo,
         )
         self._round_vote_sets[round_] = (prevotes, precommits)
+        libsync.lockset_note("HeightVoteSet._round_vote_sets")
 
     def set_round(self, round_: int) -> None:
         """Ensure vote sets exist through round_+1 (height_vote_set.go:104)."""

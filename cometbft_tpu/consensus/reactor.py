@@ -257,9 +257,17 @@ class ConsensusReactor(Reactor):
         height: once peers see it they catch-up-gossip votes exactly once,
         and a still-syncing reactor would silently drop them."""
         self.wait_sync = False
-        self.cs.update_to_state(state)
-        self.cs.reconstruct_last_commit_if_needed(state)
-        self.cs.do_wal_catchup = not skip_wal
+        # This runs on the blocksync pool routine while the node's other
+        # threads are live — mutating FSM state needs the state mutex,
+        # exactly like the reference (reactor.go:109 takes conS.mtx
+        # before updateToState). update_to_state publishes the new-step
+        # event; deferral delivers it only after the mutex is released,
+        # same as the FSM receive loop.
+        with self.cs._deferred_events():
+            with self.cs._mtx:
+                self.cs.update_to_state(state)
+                self.cs.reconstruct_last_commit_if_needed(state)
+                self.cs.do_wal_catchup = not skip_wal
         self.cs.start()
 
     # -- event re-broadcast (reactor.go:415-530) ---------------------------

@@ -316,15 +316,18 @@ class VerifyCoalescer(BaseService):
         # the same lock every submit needs.
         self._pending: deque[tuple] = deque()
         self._pending_lanes = 0
+        # lockfree: drain gate — locked writes, advisory fast-path reads; a stale read routes one submit to the host fallback
         self._draining = False
         # Lock-free running flag read by submit()/active(): consulting
         # BaseService.is_running there would acquire libs.service._mtx
         # under crypto.coalesce._mtx (or under caller engine mutexes)
         # and grow the lock graph for a boolean. Benign races resolve
         # to the host fallback.
+        # lockfree: locked writes, advisory fast-path reads (see above)
         self._accepting = False
         # monotonic deadline until which the breaker keeps this
         # coalescer unrouted (0.0 = armed); see _TRIP_COOLDOWN_S
+        # lockfree: breaker deadline — locked writes, racy reads re-check under the lock before re-arming; a stale read only delays routing one window
         self._tripped_until = 0.0
         self._thread: threading.Thread | None = None
         # -- readback drain: dispatched windows hand off to a dedicated
@@ -358,6 +361,7 @@ class VerifyCoalescer(BaseService):
         # paths can reach their tickets — a popped window is in
         # neither _pending nor any caller's hands. At most
         # max_inflight live at once (the drain depth bound).
+        # lockfree: flight ring — executor appends, drain thread removes, rescues snapshot via tuple(); GIL-atomic list ops, single writer per end
         self._inflights: list[_Inflight] = []
         # the window currently inside _launch (popped from _pending,
         # not yet host-resolved or published to _inflights): same
@@ -384,6 +388,7 @@ class VerifyCoalescer(BaseService):
             target=self._drain_run, name="verify-readback", daemon=True
         )
         rt.start()
+        # lockfree: start/stop lifecycle handle, written only by the thread driving the service transition
         self._rb_thread = rt
         t = threading.Thread(
             target=self._run, name="verify-coalescer", daemon=True
@@ -392,6 +397,7 @@ class VerifyCoalescer(BaseService):
         # submits must keep raising (host fallback) rather than queue
         # lanes nobody will ever flush
         t.start()
+        # lockfree: start/stop lifecycle handle, written only by the thread driving the service transition
         self._thread = t
         with self._mtx:
             self._accepting = True

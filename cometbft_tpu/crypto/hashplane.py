@@ -299,13 +299,17 @@ class HashCoalescer(BaseService):
         self._pending: deque[tuple] = deque()  # (ticket, msgs)
         self._pending_lanes = 0
         self._pending_blocks = 0  # padded-block sum: the wait budget
+        # lockfree: drain gate — locked writes, advisory fast-path reads; a stale read routes one submit to the host fallback
         self._draining = False
         # lock-free running flag, same rationale as the verify coalescer
+        # lockfree: locked writes, advisory fast-path reads (see crypto/coalesce.py)
         self._accepting = False
+        # lockfree: breaker deadline — locked writes, racy reads re-check under the lock before re-arming
         self._tripped_until = 0.0
         self._thread: threading.Thread | None = None
         # executor-owned mirrors so the rescue paths can always reach a
         # popped window's tickets (see crypto/coalesce.py)
+        # lockfree: flight ring — executor appends, drain thread removes, rescues snapshot via tuple(); GIL-atomic list ops, single writer per end
         self._inflights: list[_Inflight] = []
         self._staging: list[tuple] | None = None
         # readback drain handoff, mirroring the verify coalescer's:
@@ -345,9 +349,11 @@ class HashCoalescer(BaseService):
             target=self._drain_run, name="hash-readback", daemon=True
         )
         rt.start()
+        # lockfree: start/stop lifecycle handle, written only by the thread driving the service transition
         self._rb_thread = rt
         t = threading.Thread(target=self._run, name="hash-plane", daemon=True)
         t.start()
+        # lockfree: start/stop lifecycle handle, written only by the thread driving the service transition
         self._thread = t
         with self._mtx:
             self._accepting = True

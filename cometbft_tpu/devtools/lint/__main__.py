@@ -22,13 +22,49 @@ from . import (
 )
 from .baseline import BaselineError
 from .engine import lint_contexts, parse_root
-from .graph import GRAPH_RULES, analyze_contexts
+from .graph import FIELD_RULES, GRAPH_RULES, analyze_contexts, analyze_fields
 
 
 def _default_root() -> str:
     import cometbft_tpu
 
     return os.path.dirname(os.path.abspath(cometbft_tpu.__file__))
+
+
+def _changed_files(ref: str) -> set[str] | None:
+    """Absolute paths of files differing from ``ref`` (plus untracked
+    files — they differ from every ref). None when git cannot answer."""
+    import subprocess
+
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=top,
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=top,
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return {
+        os.path.abspath(os.path.join(top, line))
+        for out in (diff, untracked)
+        for line in out.splitlines()
+        if line
+    }
 
 
 def _default_baseline(root: str, for_write: bool = False) -> str | None:
@@ -82,16 +118,38 @@ def main(argv: list[str] | None = None) -> int:
         "(cycle edges red)",
     )
     ap.add_argument(
+        "--fields",
+        metavar="PATH",
+        help="write the guarded-field artifact (deterministic JSON) to "
+        "PATH — the artifact COMETBFT_TPU_LOCKSET=enforce validates "
+        "against",
+    )
+    ap.add_argument(
+        "--fields-dot",
+        metavar="PATH",
+        help="write a GraphViz rendering of field->guard edges "
+        "(guardless multi-writer fields red, lockfree planes dashed)",
+    )
+    ap.add_argument(
         "--no-graph",
         action="store_true",
-        help="skip the whole-program pass (CLNT008-010)",
+        help="skip the whole-program passes (CLNT008-012)",
+    )
+    ap.add_argument(
+        "--changed",
+        nargs="?",
+        const="HEAD",
+        metavar="REF",
+        help="lint only files that differ from git REF (default HEAD), "
+        "per-file checkers only — the whole-program passes need every "
+        "file and are skipped",
     )
     args = ap.parse_args(argv)
 
     if args.list_checkers:
         for c in ALL_CHECKERS:
             print(f"{'/'.join(c.codes):18s} {c.name}: {c.description}")
-        for code, desc in sorted(GRAPH_RULES.items()):
+        for code, desc in sorted({**GRAPH_RULES, **FIELD_RULES}.items()):
             print(f"{code:18s} {desc}")
         return 0
 
@@ -104,17 +162,35 @@ def main(argv: list[str] | None = None) -> int:
     if args.no_baseline:
         baseline_path = None
 
+    changed: set[str] | None = None
+    if args.changed is not None:
+        changed = _changed_files(args.changed)
+        if changed is None:
+            print(
+                f"error: --changed: cannot diff against {args.changed!r}",
+                file=sys.stderr,
+            )
+            return 2
+
     findings, errors = [], []
     for i, root in enumerate(roots):
         if not os.path.isdir(root):
             print(f"error: not a directory: {root}", file=sys.stderr)
             return 2
         contexts, e = parse_root(root)
-        findings.extend(lint_contexts(contexts, ALL_CHECKERS))
         errors.extend(e)
-        if not args.no_graph:
+        if changed is not None:
+            contexts = [
+                c
+                for c in contexts
+                if os.path.abspath(os.path.join(root, c.relpath)) in changed
+            ]
+        findings.extend(lint_contexts(contexts, ALL_CHECKERS))
+        if not args.no_graph and changed is None:
             analysis = analyze_contexts(contexts)
             findings.extend(analysis.findings())
+            fields = analyze_fields(analysis)
+            findings.extend(fields.findings())
             if i == 0 and args.graph:
                 with open(args.graph, "w", encoding="utf-8") as fh:
                     json.dump(analysis.graph_dict(), fh, indent=2)
@@ -124,6 +200,15 @@ def main(argv: list[str] | None = None) -> int:
                 with open(args.dot, "w", encoding="utf-8") as fh:
                     fh.write(analysis.to_dot())
                 print(f"wrote lock-order diagram to {args.dot}")
+            if i == 0 and args.fields:
+                with open(args.fields, "w", encoding="utf-8") as fh:
+                    json.dump(fields.fieldguards_dict(), fh, indent=2)
+                    fh.write("\n")
+                print(f"wrote guarded-field artifact to {args.fields}")
+            if i == 0 and args.fields_dot:
+                with open(args.fields_dot, "w", encoding="utf-8") as fh:
+                    fh.write(fields.to_dot())
+                print(f"wrote guarded-field diagram to {args.fields_dot}")
     findings.sort(key=lambda f: (f.path, f.line, f.code))
 
     for err in errors:
@@ -147,6 +232,10 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         findings, matched, stale = apply_baseline(findings, bl)
         bad_justifications = unjustified(matched)
+        if changed is not None:
+            # a partial lint cannot distinguish "fixed" from "not
+            # linted this run" — stale detection needs the full walk
+            stale = []
 
     for f in findings:
         print(f.render())

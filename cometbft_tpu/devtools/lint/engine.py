@@ -17,7 +17,14 @@ staging that never ships to the device)::
 
     offs = np.zeros(n + 1, np.uint64)  # host-staging: byte offsets
 
-Both markers cover the physical lines of the flagged statement plus a
+Lockfree marker (CLNT011/012 only — brands a shared field as a
+deliberately lock-free plane whose accesses are GIL-atomic or
+single-writer by design; the reason after the colon is the
+documentation the guarded-field pass records in fieldguards.json)::
+
+    self._ring = [None] * n  # lockfree: GIL-atomic slot swaps, ...
+
+All markers cover the physical lines of the flagged statement plus a
 comment-only line directly above it.
 """
 
@@ -32,6 +39,7 @@ SUPPRESS_RE = re.compile(
     r"#\s*cometlint:\s*disable=([A-Z0-9,\s]+?)\s*--\s*(\S.*)$"
 )
 HOST_STAGING_RE = re.compile(r"#\s*host-staging:\s*(\S.*)$")
+LOCKFREE_RE = re.compile(r"#\s*lockfree:\s*(\S.*)$")
 
 
 @dataclass(frozen=True)
@@ -88,6 +96,7 @@ class FileContext:
         self.declared_knobs = declared_knobs
         self._suppressed: dict[int, set[str]] = {}
         self._host_staged: set[int] = set()
+        self._lockfree: dict[int, str] = {}
         for i, text in enumerate(self.lines, start=1):
             m = SUPPRESS_RE.search(text)
             if m:
@@ -95,6 +104,9 @@ class FileContext:
                 self._suppressed.setdefault(i, set()).update(codes)
             if HOST_STAGING_RE.search(text):
                 self._host_staged.add(i)
+            lf = LOCKFREE_RE.search(text)
+            if lf:
+                self._lockfree[i] = lf.group(1).strip()
 
     # -- marker queries ----------------------------------------------------
 
@@ -118,6 +130,17 @@ class FileContext:
 
     def host_staged(self, node: ast.AST) -> bool:
         return any(ln in self._host_staged for ln in self._node_lines(node))
+
+    def lockfree_reason(self, node: ast.AST) -> str | None:
+        """The documented reason when ``node`` carries a ``# lockfree:``
+        marker (the guarded-field pass exempts the whole field and
+        ships the reason in fieldguards.json). None when unmarked —
+        a bare ``# lockfree:`` with no reason never registers."""
+        for ln in self._node_lines(node):
+            reason = self._lockfree.get(ln)
+            if reason:
+                return reason
+        return None
 
     def finding(self, node: ast.AST, code: str, message: str) -> Finding:
         return Finding(self.relpath, getattr(node, "lineno", 1), code, message)
@@ -224,9 +247,11 @@ def lint_root(
     contexts, errors = parse_root(root, declared_knobs)
     findings = lint_contexts(contexts, checkers)
     if whole_program:
-        from .graph import analyze_contexts
+        from .graph import analyze_contexts, analyze_fields
 
-        findings.extend(analyze_contexts(contexts).findings())
+        analysis = analyze_contexts(contexts)
+        findings.extend(analysis.findings())
+        findings.extend(analyze_fields(analysis).findings())
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return findings, errors
 

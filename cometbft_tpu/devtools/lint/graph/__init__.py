@@ -40,3 +40,8 @@ from .analysis import (  # noqa: F401
     WholeProgramAnalysis,
     analyze_contexts,
 )
+from .fields import (  # noqa: F401
+    FIELD_RULES,
+    FieldGuardAnalysis,
+    analyze_fields,
+)

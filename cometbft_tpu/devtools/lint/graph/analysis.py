@@ -63,6 +63,19 @@ class _PubRec:
     stack: tuple[tuple[str, int], ...]
 
 
+@dataclass(frozen=True)
+class _AccessRec:
+    """One read/write of a shared-class data attribute, with the
+    lexical lock stack at the site (the guarded-field pass adds the
+    interprocedural caller context on top)."""
+
+    cls: str
+    attr: str
+    kind: str  # "read" | "write"
+    line: int
+    stack: tuple[tuple[str, int], ...]
+
+
 @dataclass
 class _Facts:
     acquired: set[str] = field(default_factory=set)
@@ -70,6 +83,7 @@ class _Facts:
     calls: list[_CallRec] = field(default_factory=list)
     prims: list[_PrimRec] = field(default_factory=list)
     pubs: list[_PubRec] = field(default_factory=list)
+    accesses: list[_AccessRec] = field(default_factory=list)
     # alias-groups acquired via bare .acquire() and NOT released later in
     # the same function — the signature of a hold-returning wrapper like
     # CListMempool.lock(); a balanced acquire/finally-release pair trims
@@ -85,6 +99,7 @@ class _FactsVisitor:
         self.local = index.local_types(fi)
         self.stack: list[tuple[str, int]] = []
         self.facts = _Facts()
+        self._recorded: set[int] = set()  # Attribute node ids already logged
 
     def run(self) -> _Facts:
         for stmt in self.fi.node.body:
@@ -143,8 +158,64 @@ class _FactsVisitor:
             for child in ast.iter_child_nodes(node):
                 self._visit(child)
             return
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            # self.tx_map[k] = v / del self.parts[i]: the root attribute
+            # is the thing being written, whatever its own Load ctx says
+            base = node.value
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute):
+                self._record_access(base, write=True)
+        if isinstance(node, ast.Attribute):
+            self._record_access(
+                node, write=isinstance(node.ctx, (ast.Store, ast.Del))
+            )
         for child in ast.iter_child_nodes(node):
             self._visit(child)
+
+    # -- field accesses (guarded-field pass input) -------------------------
+
+    def _record_access(
+        self, node: ast.Attribute, write: bool, mutator: bool = False
+    ) -> None:
+        """Log a read/write of a shared-class data attribute with the
+        lexical lock stack. Dunder/ALL_CAPS names, lock attributes and
+        method references (never assigned as ``self.X``) are skipped;
+        a mutator call only counts when the field is container-typed."""
+        if id(node) in self._recorded:
+            return
+        attr = node.attr
+        if attr.startswith("__") or attr.isupper():
+            return
+        recv = self.index.expr_types(node.value, self.fi, self.local)
+        owners: set[str] = set()
+        for t in recv:
+            if t.startswith("@"):
+                continue
+            for c in self.index.mro(t):
+                if c not in hints.SHARED_CLASSES:
+                    continue
+                if attr not in self.index.class_attrs.get(c, ()):
+                    continue
+                if self.index.attr_locks.get((c, attr)) is not None:
+                    continue
+                if mutator and (c, attr) not in self.index.container_attrs:
+                    continue
+                owners.add(c)
+        for c in sorted(owners):
+            self.facts.accesses.append(
+                _AccessRec(
+                    cls=c,
+                    attr=attr,
+                    kind="write" if write else "read",
+                    line=node.lineno,
+                    stack=self._stack_tuple(),
+                )
+            )
+        if owners or not mutator:
+            self._recorded.add(id(node))
 
     # -- calls ------------------------------------------------------------
 
@@ -192,6 +263,12 @@ class _FactsVisitor:
                             del self.facts.net_hold[i]
                             break
                 return
+            if fn.attr in hints.MUTATOR_METHODS and isinstance(
+                fn.value, ast.Attribute
+            ):
+                # self.tx_map.pop(...) mutates the FIELD when its value
+                # is a container; record-or-skip happens inside
+                self._record_access(fn.value, write=True, mutator=True)
             if self._classify_attr_call(call, fn):
                 return  # a stdlib blocking leaf — nothing to resolve into
         callees = self.index.resolve_call(call, self.fi, self.local)

@@ -1,0 +1,378 @@
+"""Guarded-field lockset inference (CLNT011/012) — RacerD-style.
+
+For every mutable attribute of the engine's shared classes
+(``hints.SHARED_CLASSES``) the pass collects every read/write site the
+facts extraction recorded, together with the set of locks *statically
+held* there: the lexical ``with`` stack at the site plus the
+interprocedural caller context — the locks held at EVERY call site of
+the enclosing function, meet-over-call-sites to a fixpoint (this is how
+``CListMempool._remove_tx_el``, lock-free in isolation, inherits the
+mempool update lock from its callers).
+
+The guard of a field is the intersection of the locksets over its
+post-``__init__`` write sites.  Two rules fall out:
+
+==========  ==============================================================
+CLNT011     the guard is non-empty, the field is touched from >= 2
+            thread roots, and some access site holds none of the guard
+            locks — the classic "forgot the lock on the read path"
+CLNT012     the field has writers on >= 2 thread roots and an empty
+            guard — no lock consistently protects it at all
+==========  ==============================================================
+
+Thread roots are ``threading.Thread(target=...)`` constructions resolved
+through the same call-graph machinery; a function's labels are the roots
+whose transitive callee closure contains it (``main`` otherwise).
+Deliberately lock-free planes carry a ``# lockfree: <reason>`` marker on
+a write site (usually the ``__init__`` assignment), which exempts the
+whole field and ships the reason in the ``fieldguards.json`` artifact —
+the contract ``COMETBFT_TPU_LOCKSET=record|enforce`` in ``libs/sync``
+cross-checks at runtime.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from ..engine import Finding
+from . import hints
+from .analysis import WholeProgramAnalysis, _probe
+
+FIELD_RULES = {
+    "CLNT011": "guarded-field: field written under its inferred guard at "
+    "some sites but accessed lock-free at others (multi-threaded)",
+    "CLNT012": "guarded-field: field with writers on >=2 threads and no "
+    "consistently-held guard lock",
+}
+
+
+@dataclass(frozen=True)
+class _Site:
+    cls: str
+    attr: str
+    kind: str                  # "read" | "write"
+    qual: str
+    path: str
+    line: int
+    lockset: frozenset[str]    # lexical stack + caller context
+    init: bool                 # write during the owner's __init__
+    threads: frozenset[str]    # thread-root labels of the enclosing func
+
+
+@dataclass
+class _FieldInfo:
+    guard: frozenset[str]
+    lockfree: str              # marker reason, "" when unmarked
+    sites: list[_Site]
+    writes: int
+    reads: int
+    threads: frozenset[str]
+
+
+class FieldGuardAnalysis:
+    """Consumes a finished :class:`WholeProgramAnalysis` (its index,
+    facts and call records) and derives per-field guards + findings."""
+
+    def __init__(self, wpa: WholeProgramAnalysis):
+        self.wpa = wpa
+        self.index = wpa.index
+        self._sites = self._call_sites()
+        self._ctx = self._ctx_fixpoint()
+        self._roots = self._thread_roots()
+        self._labels = self._reach_labels()
+        self.fields: dict[tuple[str, str], _FieldInfo] = {}
+        self._collect()
+
+    # -------------------------------------------------- caller context
+
+    def _call_sites(self) -> dict[str, list[tuple[str, frozenset[str]]]]:
+        """callee qual -> [(caller qual, lock names held at the site)]."""
+        sites: dict[str, list[tuple[str, frozenset[str]]]] = {}
+        for qual, f in self.wpa.facts.items():
+            for rec in f.calls:
+                held: set[str] = set()
+                for keys, _line in rec.stack:
+                    held.update(keys)
+                fs = frozenset(held)
+                for callee in rec.callees:
+                    sites.setdefault(callee, []).append((qual, fs))
+        return sites
+
+    def _ctx_fixpoint(self) -> dict[str, frozenset[str]]:
+        """Locks held at EVERY call site of each function, transitively:
+        ``CTX(f) = meet over sites (held(site) | CTX(caller))``, greatest
+        fixpoint from top.  Entry points (no static callers — thread
+        targets, RPC handlers, the public API) get the empty context."""
+        top = None  # universe sentinel
+        ctx: dict[str, frozenset[str] | None] = {}
+        for q in self.wpa.facts:
+            ctx[q] = top if self._sites.get(q) else frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for q, ss in self._sites.items():
+                meet: frozenset[str] | None = None
+                for caller, held in ss:
+                    c = ctx.get(caller, frozenset())
+                    if c is None:
+                        continue  # caller still top: contributes universe
+                    contrib = held | c
+                    meet = contrib if meet is None else (meet & contrib)
+                if meet is None:
+                    continue  # pure cycle, stays top for now
+                if ctx[q] is None or ctx[q] != meet:
+                    ctx[q] = meet
+                    changed = True
+        # functions only reachable through an unresolved cycle: no
+        # usable context — claim nothing rather than everything
+        return {q: (c if c is not None else frozenset()) for q, c in ctx.items()}
+
+    # ---------------------------------------------------- thread roots
+
+    def _resolve_target(self, target, fi, local):
+        """``Thread(target=<expr>)`` -> candidate FuncInfos."""
+        if isinstance(target, ast.Attribute):
+            types = self.index.expr_types(target.value, fi, local)
+            return self.index.methods_named(
+                {t for t in types if not t.startswith("@")}, target.attr
+            )
+        if isinstance(target, ast.Name):
+            if target.id in fi.nested:
+                return [fi.nested[target.id]]
+            mf = self.index.module_funcs.get((fi.module, target.id))
+            if mf is not None:
+                return [mf]
+            imp = self.index.from_funcs.get(fi.module, {}).get(target.id)
+            if imp is not None:
+                mf = self.index.module_funcs.get(imp)
+                if mf is not None:
+                    return [mf]
+        return []
+
+    def _thread_roots(self) -> set[str]:
+        roots: set[str] = set()
+        for fi in self.index.funcs.values():
+            std = self.index.stdlib_alias.get(fi.module, {})
+            local = None
+            for call in ast.walk(fi.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fn = call.func
+                if not (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and std.get(fn.value.id) == "threading"
+                    and fn.attr == "Thread"
+                ):
+                    continue
+                for kw in call.keywords:
+                    if kw.arg != "target":
+                        continue
+                    if local is None:
+                        local = self.index.local_types(fi)
+                    for callee in self._resolve_target(kw.value, fi, local):
+                        roots.add(callee.qual)
+        return roots
+
+    def _reach_labels(self) -> dict[str, set[str]]:
+        """qual -> thread roots whose callee closure contains it."""
+        callees_of: dict[str, set[str]] = {}
+        for q, f in self.wpa.facts.items():
+            cs: set[str] = set()
+            for rec in f.calls:
+                cs.update(rec.callees)
+            callees_of[q] = cs
+        labels: dict[str, set[str]] = {}
+        for root in sorted(self._roots):
+            seen: set[str] = set()
+            todo = [root]
+            while todo:
+                q = todo.pop()
+                if q in seen:
+                    continue
+                seen.add(q)
+                todo.extend(callees_of.get(q, ()))
+            for q in seen:
+                labels.setdefault(q, set()).add(root)
+        return labels
+
+    # --------------------------------------------------------- collect
+
+    def _collect(self) -> None:
+        table: dict[tuple[str, str], list[_Site]] = {}
+        for qual, f in self.wpa.facts.items():
+            if not f.accesses:
+                continue
+            fi = self.index.funcs[qual]
+            ctx_locks = self._ctx.get(qual, frozenset())
+            labels = frozenset(
+                self._labels.get(qual, ())
+            ) or frozenset({"main"})
+            in_init = fi.name == "__init__" and fi.cls is not None
+            init_mro = self.index.mro(fi.cls) if in_init else ()
+            for rec in f.accesses:
+                lex: set[str] = set()
+                for keys, _line in rec.stack:
+                    lex.update(keys)
+                table.setdefault((rec.cls, rec.attr), []).append(
+                    _Site(
+                        cls=rec.cls,
+                        attr=rec.attr,
+                        kind=rec.kind,
+                        qual=qual,
+                        path=fi.ctx.relpath,
+                        line=rec.line,
+                        lockset=frozenset(lex | ctx_locks),
+                        init=(
+                            rec.kind == "write" and rec.cls in init_mro
+                        ),
+                        threads=labels,
+                    )
+                )
+        for key in sorted(table):
+            sites = sorted(
+                table[key], key=lambda s: (s.path, s.line, s.kind, s.qual)
+            )
+            writes = [s for s in sites if s.kind == "write" and not s.init]
+            if not writes:
+                continue  # effectively immutable after construction
+            guard: frozenset[str] | None = None
+            for s in writes:
+                guard = s.lockset if guard is None else (guard & s.lockset)
+            lockfree = ""
+            for s in sites:
+                if s.kind != "write":
+                    continue
+                ctx = self.index.contexts.get(s.path)
+                reason = (
+                    ctx.lockfree_reason(_probe(s.line)) if ctx else None
+                )
+                if reason:
+                    lockfree = reason
+                    break
+            live = [s for s in sites if not s.init]
+            threads: set[str] = set()
+            for s in live:
+                threads |= s.threads
+            self.fields[key] = _FieldInfo(
+                guard=guard or frozenset(),
+                lockfree=lockfree,
+                sites=sites,
+                writes=len(writes),
+                reads=sum(1 for s in live if s.kind == "read"),
+                threads=frozenset(threads),
+            )
+
+    # -------------------------------------------------------- findings
+
+    def findings(self) -> list[Finding]:
+        out: list[Finding] = []
+        seen: set[tuple] = set()
+
+        def emit(path, line, code, key, msg):
+            dk = (path, line, code, key)
+            if dk in seen:
+                return
+            seen.add(dk)
+            ctx = self.index.contexts.get(path)
+            if ctx is not None and ctx.suppressed(_probe(line), code):
+                return
+            out.append(Finding(path, line, code, msg))
+
+        for (cls, attr), info in sorted(self.fields.items()):
+            if info.lockfree:
+                continue
+            field = f"{cls}.{attr}"
+            if not info.guard:
+                write_threads: set[str] = set()
+                for s in info.sites:
+                    if s.kind == "write" and not s.init:
+                        write_threads |= s.threads
+                if len(write_threads) < 2:
+                    continue
+                first = next(
+                    s for s in info.sites if s.kind == "write" and not s.init
+                )
+                roots = ", ".join(sorted(write_threads))
+                emit(
+                    first.path, first.line, "CLNT012", field,
+                    f"field {field} is written from multiple threads "
+                    f"({roots}) with no consistently-held lock — add a "
+                    f"guard, or mark the write sites '# lockfree: "
+                    f"<reason>' if the plane is GIL-atomic by design",
+                )
+                continue
+            if len(info.threads) < 2:
+                continue
+            guard_names = "/".join(sorted(info.guard))
+            for s in info.sites:
+                if s.init or (s.lockset & info.guard):
+                    continue
+                emit(
+                    s.path, s.line, "CLNT011", field,
+                    f"field {field} is guarded by '{guard_names}' at its "
+                    f"write sites but this {s.kind} holds none of the "
+                    f"guard locks — take the lock, or mark the field "
+                    f"'# lockfree: <reason>'",
+                )
+        out.sort(key=lambda f: (f.path, f.line, f.code, f.message))
+        return out
+
+    # -------------------------------------------------------- artifact
+
+    def fieldguards_dict(self) -> dict:
+        """Deterministic machine-readable field->guard map. The ``locks``
+        registry is shared verbatim with ``lockorder.json`` so the two
+        artifacts can never disagree on the lock-name vocabulary."""
+        fields = []
+        for (cls, attr), info in sorted(self.fields.items()):
+            first_write = next(
+                s for s in info.sites if s.kind == "write" and not s.init
+            )
+            fields.append(
+                {
+                    "class": cls,
+                    "field": attr,
+                    "guard": sorted(info.guard),
+                    "lockfree": info.lockfree,
+                    "writes": info.writes,
+                    "reads": info.reads,
+                    "threads": sorted(info.threads),
+                    "witness": f"{first_write.path}:{first_write.line}",
+                }
+            )
+        return {
+            "version": 1,
+            "generator": "python -m cometbft_tpu.devtools.lint --fields",
+            "locks": self.wpa.graph_dict()["locks"],
+            "fields": fields,
+        }
+
+    def to_dot(self) -> str:
+        """GraphViz rendering: field -> guard lock; lock-free fields
+        dashed, guardless multi-writer fields red."""
+        d = self.fieldguards_dict()
+        lines = [
+            "digraph fieldguards {",
+            "  rankdir=LR; node [shape=box, fontsize=10];",
+        ]
+        locks_used = {g for f in d["fields"] for g in f["guard"]}
+        for lk in sorted(locks_used):
+            lines.append(f'  "{lk}" [shape=ellipse];')
+        for f in d["fields"]:
+            name = f'{f["class"]}.{f["field"]}'
+            if f["lockfree"]:
+                lines.append(f'  "{name}" [style=dashed];')
+            elif not f["guard"] and len(f["threads"]) >= 2:
+                lines.append(f'  "{name}" [color=red];')
+            else:
+                lines.append(f'  "{name}";')
+            for g in f["guard"]:
+                lines.append(f'  "{name}" -> "{g}";')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def analyze_fields(wpa: WholeProgramAnalysis) -> FieldGuardAnalysis:
+    return FieldGuardAnalysis(wpa)

@@ -183,6 +183,50 @@ def distinctive(name: str) -> bool:
     return "_" in name or len(name) >= 9
 
 
+# -- guarded-field specification (CLNT011/012) -----------------------------
+
+# The engine's shared classes: instances cross thread boundaries
+# (consensus FSM vs receive routine vs reactors vs coalescer drainers),
+# so every mutable attribute needs a consistent guard, a documented
+# ``# lockfree:`` rationale, or a justified baseline entry. The
+# guarded-field pass only reasons about attributes of these classes —
+# thread-private helpers and value types stay out of scope.
+SHARED_CLASSES: frozenset[str] = frozenset(
+    {
+        "ConsensusState",
+        "CListMempool",
+        "BlockStore",
+        "Store",
+        "WAL",
+        "Switch",
+        "Peer",
+        "VerifyCoalescer",
+        "HashCoalescer",
+        "VoteSet",
+        "HeightVoteSet",
+        "PartSet",
+    }
+)
+
+# container-mutating method names: a call ``self.tx_map.pop(...)`` on a
+# field whose inferred type is a container literal/ctor counts as a
+# WRITE to the field for guard inference. Read-like lookups (get, keys,
+# values, items, __contains__) deliberately stay off this list.
+MUTATOR_METHODS: frozenset[str] = frozenset(
+    {
+        "append", "appendleft", "add", "clear", "discard", "extend",
+        "extendleft", "insert", "pop", "popleft", "popitem", "remove",
+        "setdefault", "sort", "reverse", "update",
+    }
+)
+
+# builtin/collections constructor names that brand a field "@container"
+# for the mutator-write rule above
+CONTAINER_CTORS: frozenset[str] = frozenset(
+    {"dict", "list", "set", "deque", "defaultdict", "OrderedDict"}
+)
+
+
 # method/function NAME -> classes it returns. The light type inference
 # reads constructor calls; these cover the few factory idioms the engine
 # uses where the constructor is behind a call (the metrics registry

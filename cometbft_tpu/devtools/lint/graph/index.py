@@ -61,6 +61,29 @@ class ClassInfo:
     methods: dict[str, FuncInfo] = field(default_factory=dict)
 
 
+def _is_container_value(value) -> bool:
+    """True when an assigned value is (or contains at top level) a
+    container literal / ctor — ``{}``, ``[None] * n``, ``deque()``,
+    a comprehension — so mutator-method calls on the attribute count
+    as writes in the guarded-field pass."""
+    for sub in ast.walk(value):
+        if isinstance(
+            sub, (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+                  ast.SetComp),
+        ):
+            return True
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            if name in hints.CONTAINER_CTORS:
+                return True
+    return False
+
+
 def module_name(relpath: str) -> str:
     mod = relpath[:-3] if relpath.endswith(".py") else relpath
     mod = mod.replace("/", ".")
@@ -98,10 +121,16 @@ class ProgramIndex:
         self.modalias: dict[str, dict[str, str]] = {}       # mod -> alias -> pkg mod
         self.from_funcs: dict[str, dict[str, tuple[str, str]]] = {}
         self.attr_types: dict[tuple[str, str], set[str]] = {}
+        # guarded-field pass tables: every self.X assigned anywhere in a
+        # class's own methods, and the (cls, attr) pairs whose value is
+        # a container literal/ctor (the mutator-call write rule)
+        self.class_attrs: dict[str, set[str]] = {}
+        self.container_attrs: set[tuple[str, str]] = set()
         for ctx in contexts:
             self._scan_file(ctx)
         self._link_hierarchy()
         self._infer_attr_types()
+        self._collect_class_attrs()
         self._resolve_cond_assocs()
 
     # ------------------------------------------------------------- scan
@@ -375,6 +404,40 @@ class ProgramIndex:
                         self.attr_types.setdefault(
                             (base, t.attr), set()
                         ).update(toks)
+
+    def _collect_class_attrs(self) -> None:
+        """Own-class attribute table for the guarded-field pass: every
+        ``self.X`` assignment target in a class's methods, plus which of
+        them are container-typed (dict/list/set/deque literals or
+        ctors — the receivers whose mutator-method calls count as
+        writes)."""
+        for fi in self.funcs.values():
+            if fi.cls is None:
+                continue
+            attrs = self.class_attrs.setdefault(fi.cls, set())
+            for stmt in ast.walk(fi.node):
+                value = None
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        stmt.targets
+                        if isinstance(stmt, ast.Assign)
+                        else [stmt.target]
+                    )
+                    value = stmt.value
+                elif isinstance(stmt, ast.AugAssign):
+                    targets = [stmt.target]
+                else:
+                    continue
+                for t in targets:
+                    if not (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        continue
+                    attrs.add(t.attr)
+                    if value is not None and _is_container_value(value):
+                        self.container_attrs.add((fi.cls, t.attr))
 
     def local_types(self, fi: FuncInfo) -> dict[str, set[str]]:
         """Flow-insensitive local-variable type tokens for one function:

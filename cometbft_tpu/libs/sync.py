@@ -33,6 +33,20 @@ edge is either a reentrant RLock or an instance-ambiguous hierarchy
 hop that neither side can order.  Like deadlock detection, the mode is
 read at lock *construction* — flip it (env var or
 :func:`set_lock_order_mode`) before building the objects under test.
+
+Lockset sanitizer (``COMETBFT_TPU_LOCKSET=record|enforce``): the
+runtime counterpart of the guarded-field pass (CLNT011/012).  Shared
+classes carry :func:`lockset_note` calls at a handful of accessor
+seams; each call samples ``(Class.field, held-lock names)`` from the
+same per-thread held stack the lock-order tier maintains.  ``record``
+accumulates the samples (:func:`observed_locksets`) so tests can
+assert every runtime sample is consistent with the static
+``fieldguards.json`` facts (guard held at the seam, or the field is a
+documented ``# lockfree:`` plane); ``enforce`` raises
+:class:`LocksetError` at the seam the moment the field's inferred
+guard is not fully held.  Like the other tiers, the mode is read at
+lock construction — flip it (env var or :func:`set_lockset_mode`)
+before building the objects under test.
 """
 
 from __future__ import annotations
@@ -71,6 +85,11 @@ class DeadlockError(RuntimeError):
 class LockOrderError(RuntimeError):
     """An acquisition-order edge not present in the static lock-order
     graph was taken under ``COMETBFT_TPU_LOCK_ORDER=enforce``."""
+
+
+class LocksetError(RuntimeError):
+    """A guarded field was accessed without its statically inferred
+    guard fully held, under ``COMETBFT_TPU_LOCKSET=enforce``."""
 
 
 # -------------------------------------------------------- lock ordering
@@ -141,6 +160,111 @@ def _load_allowed_edges() -> frozenset[tuple[str, str]]:
             (e["from"], e["to"]) for e in data.get("edges", [])
         )
     return _allowed_edges
+
+
+# ------------------------------------------------------------- locksets
+
+_LOCKSET_MODES = ("off", "record", "enforce")
+_lockset_mode = os.environ.get("COMETBFT_TPU_LOCKSET", "off")
+if _lockset_mode not in _LOCKSET_MODES:
+    _lockset_mode = "off"
+_lockset_fields_path = os.environ.get("COMETBFT_TPU_LOCKSET_FIELDS") or None
+
+# observed ("Class.field", frozenset(held names)) -> first witness
+# "file:line" of the seam
+_lockset_observed: dict[tuple[str, frozenset], str] = {}
+# (guard frozenset, lockfree) per "Class.field", lazy-loaded from the
+# fieldguards artifact
+_field_guards: dict[str, tuple[frozenset, bool]] | None = None
+
+
+def set_lockset_mode(mode: str, fields_path: str | None = None) -> None:
+    """Programmatic analog of ``COMETBFT_TPU_LOCKSET`` (tests).  Only
+    affects locks constructed AFTER the call — seams themselves read
+    the mode live, but the held stacks they sample are only maintained
+    by instrumented locks."""
+    global _lockset_mode, _lockset_fields_path, _field_guards
+    if mode not in _LOCKSET_MODES:
+        raise ValueError(f"lockset mode must be one of {_LOCKSET_MODES}")
+    _lockset_mode = mode
+    if fields_path is not None:
+        _lockset_fields_path = fields_path
+        _field_guards = None
+
+
+def lockset_mode() -> str:
+    return _lockset_mode
+
+
+def observed_locksets() -> dict[tuple[str, frozenset], str]:
+    """Snapshot of recorded (field, held-names) -> witness samples."""
+    with _observed_mtx:
+        return dict(_lockset_observed)
+
+
+def reset_locksets() -> None:
+    with _observed_mtx:
+        _lockset_observed.clear()
+
+
+def _fieldguards_path() -> str:
+    if _lockset_fields_path:
+        return _lockset_fields_path
+    # the artifact cometlint --fields ships inside the package
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "devtools", "lint", "graph", "fieldguards.json",
+    )
+
+
+def _load_field_guards() -> dict[str, tuple[frozenset, bool]]:
+    global _field_guards
+    if _field_guards is None:
+        import json
+
+        with open(_fieldguards_path(), encoding="utf-8") as f:
+            data = json.load(f)
+        _field_guards = {
+            f"{e['class']}.{e['field']}": (
+                frozenset(e.get("guard", ())),
+                bool(e.get("lockfree")),
+            )
+            for e in data.get("fields", [])
+        }
+    return _field_guards
+
+
+def lockset_note(field: str) -> None:
+    """Accessor seam for the lockset sanitizer: sample (``field``, the
+    calling thread's held instrumented-lock names).  Free when the
+    sanitizer is off.  Callers place this INSIDE the critical section
+    that the static guard of ``Class.field`` names, so record mode
+    reproduces the static facts and enforce mode fails the moment a
+    refactor (pipelined heights) drops a guard acquisition."""
+    if _lockset_mode == "off":
+        return
+    held = frozenset(_held_stack())
+    if _lockset_mode == "enforce":
+        info = _load_field_guards().get(field)
+        if info is None:
+            raise LocksetError(
+                f"lockset seam for unknown field {field!r} — regenerate "
+                f"the artifact: python -m cometbft_tpu.devtools.lint "
+                f"--fields {_fieldguards_path()}"
+            )
+        guard, lockfree = info
+        if not lockfree and not guard <= held:
+            raise LocksetError(
+                f"field {field!r} accessed with held locks "
+                f"{sorted(held)!r} but its static guard is "
+                f"{sorted(guard)!r} ({_fieldguards_path()}); take the "
+                f"missing lock(s), or re-run the guarded-field pass if "
+                f"the discipline legitimately changed."
+            )
+    key = (field, held)
+    with _observed_mtx:
+        if key not in _lockset_observed:
+            _lockset_observed[key] = _acquire_site()
 
 
 def _held_stack() -> list:
@@ -303,7 +427,7 @@ class _InstrumentedMutex:
             self._holder = None
             self._holder_stack = ""
             self._depth = 0
-            if _order_mode != "off":
+            if _order_mode != "off" or _lockset_mode != "off":
                 _order_note_released(self._name)
         self._lock.release()
 
@@ -319,7 +443,7 @@ class _InstrumentedMutex:
         self._holder = me
         self._depth = 1
         self._holder_stack = "".join(traceback.format_stack(limit=12)[:-2])
-        if _order_mode != "off":
+        if _order_mode != "off" or _lockset_mode != "off":
             _order_note_acquired(self._name)
 
 
@@ -328,17 +452,17 @@ class _InstrumentedRLock(_InstrumentedMutex):
 
 
 def Mutex(name: str = ""):
-    """A non-reentrant lock; instrumented when deadlock detection or the
-    lock-order sanitizer is on."""
-    if _enabled or _order_mode != "off":
+    """A non-reentrant lock; instrumented when deadlock detection or a
+    sanitizer (lock-order or lockset) is on."""
+    if _enabled or _order_mode != "off" or _lockset_mode != "off":
         return _InstrumentedMutex(name)
     return threading.Lock()
 
 
 def RLock(name: str = ""):
-    """A reentrant lock; instrumented when deadlock detection or the
-    lock-order sanitizer is on."""
-    if _enabled or _order_mode != "off":
+    """A reentrant lock; instrumented when deadlock detection or a
+    sanitizer (lock-order or lockset) is on."""
+    if _enabled or _order_mode != "off" or _lockset_mode != "off":
         return _InstrumentedRLock(name)
     return threading.RLock()
 

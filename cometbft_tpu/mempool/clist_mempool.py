@@ -232,15 +232,21 @@ class CListMempool:
 
     def _res_cb_first_time(self, req, res) -> None:
         tx = req.tx
-        # the key was computed at CheckTx ingress; a socket client
-        # round-trips the tx bytes so the map lookup is by value (a
-        # dict hash, not another SHA-256). The TxKey fallback only
-        # fires for responses whose ingress predates this process
-        # (never in practice — the map is cleared with the pool).
-        key = self._pending_tx_keys.pop(tx, None)
-        if key is None:
-            key = TxKey(tx)
         with self._update_mtx:
+            # the key was computed at CheckTx ingress; a socket client
+            # round-trips the tx bytes so the map lookup is by value (a
+            # dict hash, not another SHA-256). The TxKey fallback only
+            # fires for responses whose ingress predates this process
+            # (never in practice — the map is cleared with the pool).
+            # The pop itself must happen under the update lock: every
+            # other _pending_tx_keys access (check_tx insert, flush
+            # clear) is guarded, and a socket client delivers this
+            # callback from its recv thread — popping lock-free races a
+            # concurrent flush() and can resurrect a just-cleared entry.
+            key = self._pending_tx_keys.pop(tx, None)
+            libsync.lockset_note("CListMempool._pending_tx_keys")
+            if key is None:
+                key = TxKey(tx)
             post_ok = True
             if self.post_check is not None:
                 try:

@@ -73,6 +73,7 @@ class Switch(BaseService):
         return f"{target_id}@{base}" if target_id else base
 
     def set_persistent_peers(self, addrs: list[str]) -> None:
+        # lockfree: wiring-phase setter — the list is frozen before on_start spawns the dial/accept routines that read it
         self._persistent_addrs = [self._normalize_addr(a) for a in addrs]
 
     # -- lifecycle ---------------------------------------------------------
@@ -178,6 +179,7 @@ class Switch(BaseService):
         if not self._health_origin:
             from ..libs import health as libhealth
 
+            # lockfree: lazy interning — register_origin dedupes, so two racing admits store the same id and a double write is idempotent
             self._health_origin = libhealth.register_origin(
                 self.transport.node_info.node_id[:10]
             )
@@ -208,6 +210,7 @@ class Switch(BaseService):
             if peer.id in self._peers:
                 raise SwitchError(f"duplicate peer {peer.id[:10]}")
             self._peers[peer.id] = peer
+            libsync.lockset_note("Switch._peers")
         try:
             for reactor in self.reactors.values():
                 reactor.init_peer(peer)

@@ -223,6 +223,7 @@ def equivocate(net: SimNet, byz_idx: int, targets: list[int]) -> None:
     from ..types.block import BlockID, PartSetHeader
 
     cs = net.nodes[byz_idx].cs
+    # cometlint: disable=CLNT011 -- simnet FSMs are sim_driven: no consensus routine exists, every read runs on the single scheduler thread
     pv = cs.priv_validator
     orig = cs._send_internal
 
@@ -238,6 +239,7 @@ def equivocate(net: SimNet, byz_idx: int, targets: list[int]) -> None:
             b"\xEE" * 32, PartSetHeader(total=1, hash=b"\xDD" * 32)
         )
         evil.signature = b""
+        # cometlint: disable=CLNT011 -- simnet FSMs are sim_driven: the hooked _send_internal runs on the single scheduler thread
         pv.sign_vote(cs.state.chain_id, evil, sign_extension=False)
         raw = ser.dumps(VoteMessage(evil))
         for j in targets:
@@ -470,6 +472,7 @@ def scenario_valset_churn(seed: int, heights_after: int = 4, **_):
     net.nodes[0].core["mempool"].push_tx(add_tx)
 
     def joined() -> bool:
+        # cometlint: disable=CLNT011 -- simnet FSMs are sim_driven: predicates run on the single scheduler thread
         st = net.nodes[0].cs.state
         return st is not None and st.validators.has_address(
             bytes(standby_pk.address())
@@ -514,6 +517,7 @@ def scenario_valset_churn(seed: int, heights_after: int = 4, **_):
     )
 
     def evicted() -> bool:
+        # cometlint: disable=CLNT011 -- simnet FSMs are sim_driven: predicates run on the single scheduler thread
         st = net.nodes[0].cs.state
         return st is not None and not st.validators.has_address(
             bytes(evict_pk.address())
@@ -529,9 +533,9 @@ def scenario_valset_churn(seed: int, heights_after: int = 4, **_):
         f"stall after eviction: {net.heights()}",
     )
     run.notes["evicted_at_height"] = h_evict
-    run.notes["final_valset_size"] = len(
-        net.nodes[0].cs.state.validators.validators
-    )
+    # cometlint: disable=CLNT011 -- simnet FSMs are sim_driven: reads run on the single scheduler thread
+    final_st = net.nodes[0].cs.state
+    run.notes["final_valset_size"] = len(final_st.validators.validators)
     return run.finish()
 
 

@@ -107,6 +107,7 @@ class BlockStore:
         if self._base == 0:
             self._base = height
         self._height = height
+        libsync.lockset_note("BlockStore._height")
         self._save_state(batch)
         batch.write_sync()
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ..crypto import merkle
+from ..libs import sync as libsync
 from ..libs.bits import BitArray
 from .block import BLOCK_PART_SIZE_BYTES, PartSetHeader
 
@@ -60,9 +61,12 @@ class PartSet:
 
     def __init__(self, header: PartSetHeader):
         self.header = header
+        # lockfree: single writer per instance — only the owning routine (FSM receive or blocksync pool) adds parts; gossip readers tolerate a stale snapshot and retry, and slot/count stores are GIL-atomic
         self.parts: list[Part | None] = [None] * header.total
         self.parts_bit_array = BitArray(header.total)
+        # lockfree: single writer per instance (see parts above)
         self.count = 0
+        # lockfree: single writer per instance (see parts above)
         self.byte_size = 0
 
     def has_header(self, header: PartSetHeader) -> bool:
@@ -103,6 +107,10 @@ class PartSet:
         self.parts_bit_array.set_index(part.index, True)
         self.count += 1
         self.byte_size += len(part.bytes_)
+        # exercises the sanitizer's lockfree path: a documented
+        # lock-free plane records its (empty) lockset without tripping
+        # enforce mode
+        libsync.lockset_note("PartSet.count")
         return True
 
     def get_part(self, index: int) -> Part | None:

@@ -112,17 +112,24 @@ class VoteSet:
         return len(self.val_set)
 
     def get_by_index(self, idx: int) -> Vote | None:
-        return self.votes[idx]
+        # queries take the (reentrant) mutex like the reference
+        # (vote_set.go guards every accessor): the gossip routines read
+        # while the FSM thread's add_vote writes
+        with self._mtx:
+            return self.votes[idx]
 
     def get_by_address(self, address: bytes) -> Vote | None:
-        idx, _ = self.val_set.get_by_address(address)
-        return self.votes[idx] if idx >= 0 else None
+        with self._mtx:
+            idx, _ = self.val_set.get_by_address(address)
+            return self.votes[idx] if idx >= 0 else None
 
     def two_thirds_majority(self) -> BlockID | None:
-        return self.maj23
+        with self._mtx:
+            return self.maj23
 
     def has_two_thirds_majority(self) -> bool:
-        return self.maj23 is not None
+        with self._mtx:
+            return self.maj23 is not None
 
     def has_two_thirds_any(self) -> bool:
         # Integer math: float division diverges from the reference's int64
@@ -151,6 +158,7 @@ class VoteSet:
         Returns True if the vote was newly added; raises on invalid votes.
         """
         with self._mtx:
+            libsync.lockset_note("VoteSet.votes")
             self._check_vote(vote)
             val = self.val_set.get_by_index(vote.validator_index)
             self._verify_vote_signature(vote, val.pub_key)

@@ -72,3 +72,22 @@ def pytest_collection_modifyitems(config, items):
             and "slow" not in item.keywords
         ):
             item.add_marker(pytest.mark.quick)
+
+
+@pytest.fixture(autouse=True)
+def _thread_hygiene():
+    """Every test must stop what it starts: a NON-daemon thread that
+    outlives its test can wedge the whole pytest process at interpreter
+    exit and silently serialize later tests behind its locks.  Engine
+    routines are all daemon=True by design, so anything this catches is
+    a missing Service.stop()/join in the test or a genuine engine leak.
+    Named leakers, not just a count, so the culprit is greppable."""
+    import helpers
+
+    before = helpers.nondaemon_thread_snapshot()
+    yield
+    strays = helpers.stray_nondaemon_threads(before)
+    assert not strays, (
+        "test leaked non-daemon thread(s): "
+        + ", ".join(sorted(t.name for t in strays))
+    )

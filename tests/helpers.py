@@ -3,6 +3,7 @@ consensus/common_test.go — validatorStub + randState builders)."""
 
 from __future__ import annotations
 
+import threading
 import time
 
 from cometbft_tpu.types import (
@@ -19,6 +20,36 @@ from cometbft_tpu.crypto.keys import Ed25519PrivKey
 from cometbft_tpu.state import make_genesis_state
 
 CHAIN_ID = "test-chain-tpu"
+
+
+def nondaemon_thread_snapshot() -> set[int]:
+    """idents of live non-daemon threads — taken before a test so the
+    hygiene gate can name exactly what the test leaked."""
+    return {
+        t.ident for t in threading.enumerate() if not t.daemon and t.ident
+    }
+
+
+def stray_nondaemon_threads(
+    before: set[int], grace_s: float = 2.0
+) -> list[threading.Thread]:
+    """Non-daemon threads alive after a test that were not alive before
+    it.  Daemon threads are the engine's norm (every routine sets
+    daemon=True so a wedged node cannot hang interpreter exit); a
+    NON-daemon survivor is a genuine leak — it outlives the test, can
+    wedge the whole pytest process at exit, and usually means a
+    Service.stop()/join path was skipped.  A short grace period lets
+    threads mid-shutdown (already past their run loop) finish dying."""
+    deadline = time.monotonic() + grace_s
+    while True:
+        strays = [
+            t
+            for t in threading.enumerate()
+            if not t.daemon and t.is_alive() and t.ident not in before
+        ]
+        if not strays or time.monotonic() >= deadline:
+            return strays
+        time.sleep(0.05)
 
 try:  # the OpenSSL-backed key types need the `cryptography` wheel;
     # slim containers run ed25519 on the native/pure fallbacks instead

@@ -720,3 +720,48 @@ def test_secp256k1_validator_produces_blocks():
         assert commit is not None and len(commit.signatures) == 1
     finally:
         stop_node(cs, parts)
+
+
+def test_switch_to_consensus_mutates_fsm_under_state_mutex():
+    """Regression (cometlint CLNT011 on ConsensusState.state): the
+    blocksync handoff runs on the pool routine while the node's other
+    threads are live, so the reactor must hold the state mutex across
+    update_to_state / reconstruct_last_commit_if_needed — exactly like
+    the reference (reactor.go:109 takes conS.mtx before updateToState).
+    The probe asks a SIDE thread to try-acquire the mutex while the
+    handoff's update_to_state runs: failure to acquire == held."""
+    import threading
+
+    from cometbft_tpu.consensus.reactor import ConsensusReactor
+
+    genesis, pvs = make_genesis(1)
+    cs, parts = make_consensus_node(genesis, pvs[0])
+    reactor = ConsensusReactor(cs, wait_sync=True)
+    held: list[bool] = []
+    orig = cs.update_to_state
+
+    def probe(state):
+        got: list[bool] = []
+
+        def try_acquire():
+            ok = cs._mtx.acquire(blocking=False)
+            if ok:
+                cs._mtx.release()
+            got.append(ok)
+
+        th = threading.Thread(target=try_acquire, daemon=True)
+        th.start()
+        th.join(2.0)
+        held.append(bool(got) and not got[0])
+        return orig(state)
+
+    cs.update_to_state = probe
+    try:
+        reactor.switch_to_consensus(cs.state, skip_wal=True)
+        assert held == [True], (
+            "update_to_state ran without the consensus.state mutex held"
+        )
+        assert reactor.wait_sync is False
+        assert cs.do_wal_catchup is False
+    finally:
+        stop_node(cs, parts)

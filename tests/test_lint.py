@@ -525,9 +525,10 @@ class TestCLIAndGate:
         assert len(ALL_CHECKERS) == 6
         # the whole-program pass (devtools/lint/graph) owns the rest of
         # the code space; it runs inside lint_root, not as a Checker
-        from cometbft_tpu.devtools.lint.graph import GRAPH_RULES
+        from cometbft_tpu.devtools.lint.graph import FIELD_RULES, GRAPH_RULES
 
         assert sorted(GRAPH_RULES) == ["CLNT008", "CLNT009", "CLNT010"]
+        assert sorted(FIELD_RULES) == ["CLNT011", "CLNT012"]
 
     def test_list_checkers_includes_graph_rules(self):
         proc = subprocess.run(
@@ -542,5 +543,6 @@ class TestCLIAndGate:
             cwd=REPO,
         )
         assert proc.returncode == 0
-        for code in ("CLNT001", "CLNT008", "CLNT009", "CLNT010"):
+        for code in ("CLNT001", "CLNT008", "CLNT009", "CLNT010", "CLNT011",
+                     "CLNT012"):
             assert code in proc.stdout

@@ -96,6 +96,110 @@ def test_recorded_lock_order_is_subgraph_of_static_graph(tmp_path):
     )
 
 
+def test_recorded_locksets_are_subset_of_static_field_guards(tmp_path):
+    """The guarded-field pass and the runtime lockset sanitizer verify
+    each other: drive a real 4-validator consensus burst AND a real TCP
+    p2p exchange with COMETBFT_TPU_LOCKSET=record, then check every
+    sampled (field, held-locks) pair against the statically inferred
+    guards — each touched field must be known to the analysis, and its
+    guard must be fully held at every sample unless the field is a
+    documented ``# lockfree:`` plane."""
+    from cometbft_tpu.devtools.lint.engine import parse_root
+    from cometbft_tpu.devtools.lint.graph import (
+        analyze_contexts,
+        analyze_fields,
+    )
+    from cometbft_tpu.libs import sync as libsync
+
+    import os
+    import test_p2p
+    from helpers import (
+        make_consensus_node,
+        make_genesis,
+        stop_node,
+        wait_for_height,
+        wire_perfect_gossip,
+    )
+
+    # record BEFORE construction: seams read the mode live, but held
+    # stacks are only maintained by locks built while a sanitizer is on
+    libsync.set_lockset_mode("record")
+    libsync.reset_locksets()
+    try:
+        # consensus: four validators gossip to a couple of commits
+        genesis, pvs = make_genesis(4)
+        nodes = [make_consensus_node(genesis, pv) for pv in pvs]
+        wire_perfect_gossip(nodes)
+        for cs, _ in nodes:
+            cs.start()
+        try:
+            assert wait_for_height(nodes[0][1], 2, timeout=120), (
+                f"chain stalled at {nodes[0][1]['block_store'].height()}"
+            )
+        finally:
+            for cs, parts in nodes:
+                stop_node(cs, parts)
+
+        # p2p: two switches handshake over real sockets (Switch._peers)
+        sw1, r1, nk1 = test_p2p._make_switch()
+        sw2, r2, _ = test_p2p._make_switch(echo=False)
+        sw1.start()
+        sw2.start()
+        try:
+            addr = f"{nk1.node_id}@{sw1.transport.listen_addr[len('tcp://'):]}"
+            sw2.dial_peers_async([addr])
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if sw1.peers() and sw2.peers():
+                    break
+                time.sleep(0.05)
+            assert sw2.peers(), "switches failed to connect"
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+        observed = libsync.observed_locksets()
+    finally:
+        libsync.set_lockset_mode("off")
+
+    assert observed, "record mode sampled no seams — instrumentation broken?"
+    touched = {field for field, _held in observed}
+    for expect in (
+        "ConsensusState.state",
+        "VoteSet.votes",
+        "HeightVoteSet._round_vote_sets",
+        "BlockStore._height",
+        "PartSet.count",
+        "Switch._peers",
+    ):
+        assert expect in touched, f"seam {expect} never fired: {touched}"
+
+    pkg = os.path.dirname(
+        os.path.dirname(os.path.abspath(test_p2p.__file__))
+    ) + "/cometbft_tpu"
+    contexts, errors = parse_root(pkg)
+    assert not errors, errors
+    fields = analyze_fields(analyze_contexts(contexts))
+    static = {
+        f"{cls}.{attr}": info for (cls, attr), info in fields.fields.items()
+    }
+    violations = {}
+    for (field, held), site in observed.items():
+        info = static.get(field)
+        if info is None:
+            violations[(field, tuple(sorted(held)))] = (
+                f"unknown to the static pass @ {site}"
+            )
+        elif not info.lockfree and not info.guard <= held:
+            violations[(field, tuple(sorted(held)))] = (
+                f"guard {sorted(info.guard)} not held @ {site}"
+            )
+    assert not violations, (
+        "runtime lockset samples contradict the static field guards: "
+        f"{violations}"
+    )
+
+
 class _NetStatsExchange:
     """Two switches over real TCP with network-plane telemetry on; the
     receiving reactor records the provenance stamp visible during its

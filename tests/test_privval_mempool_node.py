@@ -216,6 +216,48 @@ def test_mempool_sender_tracking(pool):
     assert el.value.senders == {"peer1", "peer2"}
 
 
+def test_mempool_response_cb_pop_serializes_against_flush(pool):
+    """Regression (cometlint CLNT011 on _pending_tx_keys): the first-time
+    CheckTx response callback must pop its pending tx-key entry UNDER
+    the update lock.  A socket client delivers the callback from its
+    recv thread; a lock-free pop races flush() and can resurrect a
+    just-cleared entry."""
+    from cometbft_tpu import abci
+
+    mp, _, _ = pool
+    tx = b"race=1"
+    mp._pending_tx_keys[tx] = TxKey(tx)
+    req = abci.RequestCheckTx(tx=tx, type=abci.CheckTxType.NEW)
+    res = abci.ResponseCheckTx(code=abci.OK, gas_wanted=1)
+    entered = threading.Event()
+    done = threading.Event()
+
+    def recv_thread():
+        entered.set()
+        mp._res_cb_first_time(req, res)
+        done.set()
+
+    t = threading.Thread(target=recv_thread, daemon=True)
+    mp._update_mtx.acquire()  # the commit/flush window
+    try:
+        t.start()
+        assert entered.wait(2.0)
+        # the callback must be parked on the update lock, not mutating
+        assert not done.wait(0.2), (
+            "response callback ran inside the flush window without "
+            "the update lock"
+        )
+        mp.flush()  # reentrant under our hold, clears the pending map
+        assert mp._pending_tx_keys == {}
+    finally:
+        mp._update_mtx.release()
+    assert done.wait(2.0)
+    t.join(2.0)
+    # the late callback found its entry already flushed (fallback key
+    # path) and must not have resurrected it
+    assert mp._pending_tx_keys == {}
+
+
 # -- handshake replay ------------------------------------------------------
 
 
