@@ -2604,6 +2604,241 @@ def bench_device_ledger(
     }
 
 
+def bench_lock_contention(
+    n_heights: int | None = None,
+    device: bool = False,
+    verify_threads: int | None = None,
+    hash_threads: int | None = None,
+):
+    """Config 21: per-lock wait shares + commit-chain serial occupancy.
+
+    One live 4-validator consensus burst (the config-13 harness) runs
+    with the lock-contention profiler on while a routed verify storm
+    and a CheckTx-shaped hash storm pressure the shared coalescer
+    planes — the mixed-tenant shape of config 19, instrumented for
+    locks instead of device time.  Headlines: each engine lock's share
+    of total blocked time, the commit chain's serial occupancy (hold
+    time of consensus.state / consensus.wal._mtx / store.block_store's
+    mutex over burst wall time — the ceiling the pipelined-heights
+    refactor attacks), and a critical-path verdict (stage x lock x
+    plane) for every committed height with its budget coverage.  The
+    record-path overhead is bounded mechanism-level, the config-13
+    methodology: measured per-acquire profiled-vs-raw delta x acquires
+    per commit / commit latency.  This row is the BEFORE baseline the
+    pipelined-heights PR diffs against with ``bench.py --compare``
+    (lock_wait*/contended* fragments classify lower-better there).
+    """
+    import threading as _threading
+
+    from cometbft_tpu.crypto import coalesce as crypto_coalesce
+    from cometbft_tpu.crypto import hashplane as crypto_hashplane
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.libs import lockprof as liblockprof
+    from cometbft_tpu.libs import sync as libsync
+
+    if n_heights is None:
+        n_heights = _sz(12, 3)
+    if verify_threads is None:
+        verify_threads = _sz(8, 2)
+    if hash_threads is None:
+        hash_threads = _sz(4, 1)
+    warm_heights = _sz(2, 1)
+
+    prof_was = liblockprof.enabled()
+    health_was = libhealth.enabled()
+    prev_ring = libhealth.recorder().capacity
+    liblockprof.enable()
+    liblockprof.reset()
+    # a 5 ms slow line (vs the 50 ms default) so the burst's contended
+    # waits actually emit EV_LOCK rows for the per-height lock join
+    liblockprof.set_slow_ms(5.0)
+    libhealth.enable(ring=16384)
+    libhealth.reset()
+
+    co = crypto_coalesce.VerifyCoalescer(
+        device=device,
+        min_device_lanes=8 if device else (1 << 30),
+    )
+    hco = crypto_hashplane.HashCoalescer(
+        device=device, min_device_lanes=8 if device else (1 << 30)
+    )
+    lk = Ed25519PrivKey.from_seed(b"\x55" * 32)
+    lpub = lk.pub_key().data
+    lmsgs = [b"contention-%d" % i for i in range(4)]
+    lsigs = [lk.sign(msg) for msg in lmsgs]
+    lpubs = [lpub] * 4
+    tx = b"\xcd" * 2048
+    stop = _threading.Event()
+
+    def verify_storm():
+        while not stop.is_set():
+            co.try_verify(lpubs, lmsgs, lsigs)
+
+    def hash_storm():
+        while not stop.is_set():
+            hco.try_hash_many([tx] * 8)
+
+    threads = []
+    nodes = []
+    t_burst = 0.0
+    commits = 0
+    routed = False
+    try:
+        try:
+            co.start()
+            crypto_coalesce.push_active(co)
+            hco.start()
+            crypto_hashplane.push_active(hco)
+            routed = True
+            nodes = _perfect_gossip_net("bench-lockprof")
+            store = nodes[0][1]["block_store"]
+            for cs, _ in nodes:
+                cs.start()
+            deadline = time.monotonic() + 240
+            while (
+                store.height() < warm_heights
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+            if store.height() < warm_heights:
+                raise RuntimeError("contention burst never warmed")
+            for fn in (
+                [verify_storm] * verify_threads
+                + [hash_storm] * hash_threads
+            ):
+                t = _threading.Thread(target=fn, daemon=True)
+                t.start()
+                threads.append(t)
+            liblockprof.reset()  # the measured columns start here
+            h0 = store.height()
+            t0 = time.perf_counter()
+            while (
+                store.height() < h0 + n_heights
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+            t_burst = time.perf_counter() - t0
+            commits = store.height() - h0
+            if commits <= 0:
+                raise RuntimeError("contention burst stalled")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            _stop_net(nodes)
+            if routed:
+                crypto_hashplane.pop_active(hco)
+                crypto_coalesce.pop_active(co)
+            for svc in (hco, co):
+                try:
+                    if svc.is_running():
+                        svc.stop()
+                except Exception:
+                    pass
+        # -- derive the row (still inside the restore scope)
+        snap = liblockprof.snapshot()
+        total_wait = snap["total_wait_s"] or 1e-12
+        wait_shares = {
+            name: round(100.0 * row["wait_s"] / total_wait, 1)
+            for name, row in sorted(
+                snap["locks"].items(),
+                key=lambda kv: -kv[1]["wait_s"],
+            )
+            if row["wait_s"] > 0
+        }
+        total_acquires = sum(
+            row["acquires"] for row in snap["locks"].values()
+        )
+        # commit-chain serial occupancy: the single-writer
+        # save->fsync->apply chain's lock holds over burst wall time
+        chain_locks = (
+            "consensus.state", "consensus.wal._mtx",
+            "store.block_store._mtx",
+        )
+        chain_hold_s = sum(
+            snap["locks"].get(name, {}).get("hold_s", 0.0)
+            for name in chain_locks
+        )
+        chain_acquires = sum(
+            snap["locks"].get(name, {}).get("acquires", 0)
+            for name in chain_locks
+        )
+        cp = libhealth.critical_path()
+
+        # mechanism-level record-path overhead (the config-13
+        # methodology): per-acquire profiled-vs-raw delta from tight
+        # uncontended loops x acquires/commit / commit latency
+        reps = _sz(100_000, 5_000)
+        probe = libsync.Mutex(name="bench.lockprof_probe")
+        raw = _threading.Lock()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with probe:
+                pass
+        profiled_ns = (time.perf_counter() - t0) / reps * 1e9
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with raw:
+                pass
+        raw_ns = (time.perf_counter() - t0) / reps * 1e9
+        commit_s = t_burst / commits
+        acquires_per_commit = total_acquires / commits
+        # only commit-chain acquires serialize into commit latency —
+        # storm/plane threads' acquires overlap the FSM wall on other
+        # threads, so charging ALL acquires to the commit would
+        # overstate the record path's cost by the storm's fan-out
+        chain_acquires_per_commit = chain_acquires / commits
+        overhead_pct = (
+            100.0
+            * chain_acquires_per_commit
+            * max(0.0, profiled_ns - raw_ns)
+            / 1e9
+            / commit_s
+        )
+    finally:
+        liblockprof.set_slow_ms(liblockprof.slow_threshold_s() * 1e3)
+        liblockprof.enable() if prof_was else liblockprof.disable()
+        libhealth.enable() if health_was else libhealth.disable()
+        libhealth.set_ring_capacity(prev_ring)
+    return {
+        "heights": commits,
+        "burst_s": round(t_burst, 2),
+        "validators": 4,
+        "verify_threads": verify_threads,
+        "hash_threads": hash_threads,
+        "commit_ms": round(commit_s * 1e3, 2),
+        "lock_wait_total_s": snap["total_wait_s"],
+        "lock_hold_total_s": snap["total_hold_s"],
+        "lock_wait_share_pct": wait_shares,
+        "hottest_lock": snap["hottest"],
+        "contended_acquires": sum(
+            row["contended"] for row in snap["locks"].values()
+        ),
+        # per-validator serial fraction: 4 validators each run their
+        # own save->fsync->apply chain over the one shared wall
+        "commit_chain_occupancy_pct": round(
+            100.0 * chain_hold_s / (t_burst * 4), 1
+        ),
+        "critical_path_heights": cp["commits"],
+        "critical_path_coverage": cp["coverage"],
+        "critical_path_gates": cp["gates"],
+        "verdict_every_commit": cp["commits"] >= commits,
+        "profiled_acquire_ns": round(profiled_ns, 1),
+        "raw_acquire_ns": round(raw_ns, 1),
+        "acquires_per_commit": round(acquires_per_commit, 1),
+        "chain_acquires_per_commit": round(chain_acquires_per_commit, 1),
+        "overhead_pct": round(overhead_pct, 4),
+        "note": "4-val burst + routed verify/hash storms with the lock "
+        "profiler on; wait shares / per-validator chain occupancy from "
+        "the lock-free lockprof columns, per-height verdicts from "
+        "libs/health.critical_path; overhead_pct = commit-chain "
+        "acquires/commit x (profiled - raw) acquire cost / commit "
+        "latency (the config-13 mechanism bound; plane-thread acquires "
+        "overlap the wall and are reported via acquires_per_commit)",
+    }
+
+
 def bench_tx_lifecycle(
     seed: int | None = None, sample: int | None = None
 ):
@@ -2926,6 +3161,14 @@ def _compare_load_rows(path: str) -> dict:
 # any lower-better fragment could claim it; bare "_pct" is deliberately
 # NOT a lower-better fragment (overhead/noise/delta name their
 # lower-better percentage metrics explicitly).
+#
+# Exception checked BEFORE both lists: lock-contention fragments.
+# "lock_wait_share" would otherwise hit "share" (higher-better) — but
+# a bigger share of time blocked on a mutex is always worse, which is
+# the whole point of the 21_lock_contention before/after baseline
+# ("occupancy" is the commit-chain serial fraction the pipelined-
+# heights work exists to shrink).
+_LOCK_LOWER_IS_BETTER = ("lock_wait", "contended", "occupancy")
 _HIGHER_IS_BETTER = (
     "per_sec", "vs_baseline", "vs_serial", "vs_batch_baseline", "rate",
     "hit", "coverage", "util", "value", "window_pct", "share",
@@ -2938,6 +3181,9 @@ _LOWER_IS_BETTER = (
 
 def _metric_direction(key: str) -> int:
     """+1 higher-better, -1 lower-better, 0 unknown (flag any move)."""
+    for frag in _LOCK_LOWER_IS_BETTER:
+        if frag in key:
+            return -1
     for frag in _HIGHER_IS_BETTER:
         if frag in key:
             return 1
@@ -3294,6 +3540,22 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "20_tx_lifecycle", "backend": "host",
                      "error": repr(e)[:200]})
+        lockprof_row = None
+        try:
+            # device pinned off: the routed storms' windows all run
+            # host MSMs / hashlib — lock contention and the critical-
+            # path join are path-independent
+            lockprof_row = bench_lock_contention(device=False)
+            _eprint(
+                {
+                    "config": "21_lock_contention",
+                    "backend": "host",
+                    **lockprof_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "21_lock_contention", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -3404,6 +3666,18 @@ def main() -> None:
                             ],
                         }
                         if txlife_row
+                        else {}
+                    ),
+                    **(
+                        {
+                            "commit_chain_occupancy_pct": lockprof_row[
+                                "commit_chain_occupancy_pct"
+                            ],
+                            "lockprof_overhead_pct": lockprof_row[
+                                "overhead_pct"
+                            ],
+                        }
+                        if lockprof_row
                         else {}
                     ),
                 }
@@ -3593,6 +3867,16 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "20_tx_lifecycle", "error": repr(e)[:200]})
 
+    lockprof_row = None
+    try:
+        # lock-contention burst with the device path live (the routed
+        # storms' windows run real device rounds; contention accounting
+        # itself is path-independent)
+        lockprof_row = bench_lock_contention(device=True)
+        _eprint({"config": "21_lock_contention", **lockprof_row})
+    except Exception as e:
+        _eprint({"config": "21_lock_contention", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -3730,6 +4014,21 @@ def main() -> None:
                         "tx_overhead_pct": txlife_row["overhead_pct"],
                     }
                     if txlife_row
+                    else {}
+                ),
+                # commit-chain serial occupancy (the pipelined-heights
+                # before baseline) + measured lock-profiler record
+                # overhead (config 21_lock_contention; target <1%)
+                **(
+                    {
+                        "commit_chain_occupancy_pct": lockprof_row[
+                            "commit_chain_occupancy_pct"
+                        ],
+                        "lockprof_overhead_pct": lockprof_row[
+                            "overhead_pct"
+                        ],
+                    }
+                    if lockprof_row
                     else {}
                 ),
             }

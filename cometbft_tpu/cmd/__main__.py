@@ -418,6 +418,7 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
             ("net.json", "/debug/net"),
             ("tx.json", "/debug/tx"),
             ("flight.json", "/debug/flight"),
+            ("contention.json", "/debug/contention"),
             ("timeline.json", "/debug/timeline"),
             ("trace.json", "/debug/trace"),
         ):

@@ -82,6 +82,18 @@ ENV_KNOBS: dict[str, str] = {
         "validates against (default: the fieldguards.json shipped in "
         "devtools/lint/graph; libs/sync.py)"
     ),
+    "COMETBFT_TPU_LOCKPROF": (
+        "lock-contention profiler (libs/lockprof): auto (default, on "
+        "while a node runs — refcounted in node boot) | 1/on force | "
+        "0/off kill switch; feeds lock_wait_seconds{lock}, "
+        "/debug/contention and the lock_contended watchdog"
+    ),
+    "COMETBFT_TPU_LOCKPROF_SLOW_MS": (
+        "lock wait/hold duration past which the profiler emits an "
+        "EV_LOCK flight-ring row naming the blocking holder's acquire "
+        "site, and the lock_contended watchdog's windowed-p99 trip "
+        "threshold (default 50; libs/lockprof.py)"
+    ),
     "COMETBFT_TPU_FAIL": (
         "named crash point for fault-injection tests — the process "
         "dies hard when execution reaches it (libs/fail.py)"

@@ -70,6 +70,7 @@ import time
 from array import array
 
 from . import devledger as libdevledger
+from . import lockprof as liblockprof
 from . import metrics as libmetrics
 from . import netstats as libnetstats
 from . import sync as libsync
@@ -126,6 +127,13 @@ EV_BUDGET = 12
 # gossip_recv, ns-since-admit at gossip_send/commit. Stamped from the
 # ring clock, so virtual-domain (simnet) rows stay merge-consistent.
 EV_TX = 13
+# sync.lock: a lock wait or hold crossed the lockprof slow threshold
+# (libs/lockprof, COMETBFT_TPU_LOCKPROF_SLOW_MS) — r=lockorder.json
+# registry slot (decoded to the ``lock`` name), a=duration ns,
+# b=site_idx*2+kind (kind 0 wait / 1 hold; site_idx indexes lockprof's
+# interned holder-acquire-site table, decoded as ``site``). Bundles
+# name the blocker, not just the victim.
+EV_LOCK = 14
 
 _N_CODES = 16  # size of the per-code last-seen vector
 
@@ -201,6 +209,7 @@ _CODE_NAMES = {
     EV_HASH: "hash.flush",
     EV_BUDGET: "plane.budget",
     EV_TX: "tx.stage",
+    EV_LOCK: "sync.lock",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -217,12 +226,13 @@ _CODE_FIELDS = {
     EV_HASH: ("lanes", "device"),
     EV_BUDGET: ("wait_ns", "exec_ns"),
     EV_TX: ("key_fp", "val"),
+    EV_LOCK: ("dur_ns", "ref"),
 }
 
 # codes whose payload is a wall-clock-measured duration: meaningless in
 # a virtual-time (simnet) ring, so the cross-node timeline merge drops
 # them from virtual-domain sources (cometbft_tpu/postmortem)
-WALL_DURATION_CODES = frozenset({EV_FSYNC, EV_BUDGET})
+WALL_DURATION_CODES = frozenset({EV_FSYNC, EV_BUDGET, EV_LOCK})
 
 
 def ring_event_codes() -> dict[str, int]:
@@ -249,6 +259,7 @@ _WATCHDOGS = (
     ("slow_disk", 16),
     ("consensus_starved", 32),
     ("tx_starved", 64),
+    ("lock_contended", 128),
 )
 # tx_starved: an ADMITTED tx is older than COMETBFT_TPU_TX_STARVE_COMMITS
 # commit intervals WHILE heights keep committing — inclusion is broken
@@ -267,6 +278,11 @@ STARVE_MIN_LANES = 64  # ledger lanes per check window before judging
 # fresh MConnection.send drops on a consensus channel = sustained
 # backpressure (a one-off burst drop re-baselines without a trip)
 SATURATION_STREAK = 3
+# lock_contended: an ENGINE mutex's windowed p99 wait (libs/lockprof
+# delta-histogram) at or above the slow threshold in this many
+# CONSECUTIVE checks = a serialized resource actively gating the
+# engine, not one unlucky acquire
+LOCK_CONTENDED_STREAK = 2
 _WATCHDOG_NAMES = {bit: name for name, bit in _WATCHDOGS}
 
 _ON_VALUES = ("1", "on", "true", "yes")
@@ -493,6 +509,14 @@ class FlightRecorder:
                 # its bounded 16-hex-char prefix, never the raw key
                 rec["stage_name"] = TX_STAGES.get(self._r[i], "?")
                 rec["key"] = format(self._a[i] % (1 << 64), "016x")
+            elif code == EV_LOCK:
+                # the registry slot rides the round column; b packs
+                # kind (low bit) + interned holder-acquire-site index
+                rec["lock"] = liblockprof.slot_name(self._r[i])
+                rec["kind_name"] = liblockprof.KIND_NAMES.get(
+                    self._b[i] & 1, "?"
+                )
+                rec["site"] = liblockprof.site_name(self._b[i] >> 1)
             o = self._o[i]
             if o:
                 rec["node"] = origin_name(o)
@@ -835,6 +859,126 @@ def budget(events=None) -> dict:
     return out
 
 
+# ---------------------------------------------------- critical path
+
+# budget stages that are device-plane time — the ``plane`` dimension of
+# the critical-path verdict groups them back into their planes
+_PLANE_STAGES = {
+    "verify": ("verify_queue", "verify_execute"),
+    "hash": ("hash",),
+}
+
+
+def critical_path_from_events(events) -> dict[int, dict]:
+    """Name, per committed height, the resource that gated the commit.
+
+    Joins three views of the same commit window: the per-height budget
+    stage tiles (:func:`budget_from_events` — the coalescer queue waits
+    already ride in via the EV_BUDGET overlay rows), the EV_LOCK slow
+    lock-wait rows (window-assigned by timestamp, exactly like
+    EV_FSYNC), and the device-plane share of the stage tiling.  The
+    verdict is ``stage × lock × plane``: the dominant non-residual
+    budget stage, the lock with the largest in-window slow-wait total
+    (with the blocking holder's acquire site), and the dominant device
+    plane — ``gate`` names whichever dimension explains the most time.
+    Pure function of the decoded event stream (the postmortem timeline
+    merge reuses it for its per-height ``critical_path`` rows)."""
+    budgets = budget_from_events(events)
+    if not budgets:
+        return {}
+    # commit window anchors (earliest commit row per height, the same
+    # anchor budget_from_events uses) + the EV_LOCK wait rows
+    anchors: dict[int, tuple] = {}
+    lock_rows: list[tuple] = []
+    for ev in events:
+        name = ev.get("event")
+        if name == "consensus.commit":
+            h = ev.get("height", 0)
+            if h:
+                cur = anchors.get(h)
+                if cur is None or ev.get("ts", 0) < cur[0]:
+                    anchors[h] = (ev.get("ts", 0), ev.get("dur_ns", 0))
+        elif name == "sync.lock":
+            if ev.get("kind_name") == "wait":
+                lock_rows.append((
+                    ev.get("ts", 0), ev.get("lock", "?"),
+                    ev.get("dur_ns", 0), ev.get("site", "?"),
+                ))
+    out: dict[int, dict] = {}
+    for h, bud in budgets.items():
+        cts, dur = anchors.get(h, (0, 0))
+        if dur <= 0:
+            continue
+        t0 = cts - dur
+        stages = bud["stages"]
+        # dominant non-residual stage tile
+        stage, stage_s = None, -1.0
+        for s, v in stages.items():
+            if s != "residual" and v > stage_s:
+                stage, stage_s = s, v
+        stage_s = max(0.0, stage_s)
+        # dominant device plane (its stages' combined tile)
+        plane, plane_s = None, 0.0
+        for p, names in _PLANE_STAGES.items():
+            v = 0.0
+            for s in names:
+                v += stages.get(s, 0.0)
+            if v > plane_s:
+                plane, plane_s = p, v
+        # hottest lock: largest slow-wait total inside the window
+        waits: dict[str, float] = {}
+        sites: dict[str, str] = {}
+        for ts, lk, d, site in lock_rows:
+            if t0 <= ts <= cts:
+                waits[lk] = waits.get(lk, 0.0) + d / 1e9
+                sites.setdefault(lk, site)
+        lock, lock_wait_s = None, 0.0
+        for lk, v in waits.items():
+            if v > lock_wait_s:
+                lock, lock_wait_s = lk, v
+        gate, gate_s = f"stage:{stage}", stage_s
+        if lock is not None and lock_wait_s > gate_s:
+            gate, gate_s = f"lock:{lock}", lock_wait_s
+        if plane is not None and plane_s > gate_s:
+            gate, gate_s = f"plane:{plane}", plane_s
+        out[h] = {
+            "height": h,
+            "node": bud.get("node"),
+            "latency_s": bud["latency_s"],
+            "coverage": bud["coverage"],
+            "stage": stage,
+            "stage_s": round(stage_s, 6),
+            "lock": lock,
+            "lock_wait_s": round(lock_wait_s, 6),
+            "lock_site": sites.get(lock) if lock else None,
+            "plane": plane,
+            "plane_s": round(plane_s, 6),
+            "gate": gate,
+        }
+    return out
+
+
+def critical_path(events=None) -> dict:
+    """The per-height critical-path view: the ``/debug/contention``
+    and ``contention.json`` verdict body.  ``events`` defaults to the
+    live flight ring."""
+    per = critical_path_from_events(
+        _REC.dump() if events is None else events
+    )
+    heights = [per[h] for h in sorted(per)]
+    gates: dict[str, int] = {}
+    cov = 0.0
+    for hv in heights:
+        gates[hv["gate"]] = gates.get(hv["gate"], 0) + 1
+        cov += hv["coverage"]
+    return {
+        "commits": len(heights),
+        "heights": heights,
+        "gates": dict(sorted(gates.items(), key=lambda kv: -kv[1])),
+        "coverage": round(cov / len(heights), 4) if heights else None,
+    }
+
+
 def acquire() -> None:
     """Reference-counted enable for node lifecycles (the devstats
     pattern): every booting node acquires, so the recorder is on exactly
@@ -942,6 +1086,7 @@ class HealthMonitor(BaseService):
         storm_recompiles: int = STORM_RECOMPILES,
         storm_window_s: float = STORM_WINDOW_S,
         saturation_streak: int = SATURATION_STREAK,
+        lock_wait_s: float | None = None,
         starve_s: float | None = None,
         starve_share: float = STARVE_LANE_SHARE,
         starve_min_lanes: int = STARVE_MIN_LANES,
@@ -1036,6 +1181,22 @@ class HealthMonitor(BaseService):
         cons0, total0 = libdevledger.verify_lanes_split()
         self._sv[0] = cons0  # lanes that predate this monitor don't count
         self._sv[1] = total0
+        # -- lock-contention state (preallocated): the lockprof wait-
+        # histogram watermark the windowed p99 deltas run against, plus
+        # [consecutive-hot-window streak, last hot slot]. The seeding
+        # call advances the watermark so contention that predates this
+        # monitor cannot replay as a fresh trip (the lane posture).
+        # ``lock_wait_s <= 0`` disables the watchdog.
+        self.lock_wait_s = (
+            lock_wait_s
+            if lock_wait_s is not None
+            else liblockprof.slow_threshold_s()
+        )
+        self._lk_hist = array(
+            "q", [0] * (liblockprof.N_SLOTS * liblockprof.N_BUCKETS)
+        )
+        self._lk = array("q", [0, -1])
+        liblockprof.worst_windowed_p99(self._lk_hist)
         self._starve_counts: array | None = None
         if self.starve_s > 0:
             try:
@@ -1233,6 +1394,23 @@ class HealthMonitor(BaseService):
                     st[_ST_TX_STARVED] = 1.0
                 else:
                     st[_ST_TX_STARVED] = 0.0
+        # -- sustained lock contention: the worst registered engine
+        # lock's windowed p99 wait (lockprof delta histogram since the
+        # last check) at or above the threshold in
+        # LOCK_CONTENDED_STREAK consecutive checks. The streak resets
+        # on trip, so a wedged lock re-trips once per streak window,
+        # not per tick; int-only state (the _qfull posture).
+        if self.lock_wait_s > 0:
+            lk = self._lk
+            slot, p99 = liblockprof.worst_windowed_p99(self._lk_hist)
+            if slot >= 0 and p99 >= self.lock_wait_s:
+                lk[1] = slot
+                lk[0] += 1
+                if lk[0] >= LOCK_CONTENDED_STREAK:
+                    mask |= 128
+                    lk[0] = 0
+            else:
+                lk[0] = 0
         return mask
 
     def _consensus_wait_p99(self) -> float:
@@ -1269,6 +1447,12 @@ class HealthMonitor(BaseService):
         """Last-observed tx-starvation state (inclusion broken while
         the chain keeps committing)."""
         return self._st[_ST_TX_STARVED] != 0.0
+
+    def hot_lock(self) -> str | None:
+        """The registered lock the contention watchdog most recently
+        flagged as over-threshold (None until a window crosses it)."""
+        slot = self._lk[1]
+        return liblockprof.slot_name(slot) if slot >= 0 else None
 
     def stalled(self) -> bool:
         return self._st[_ST_STALLED] != 0.0
@@ -1343,6 +1527,8 @@ class HealthMonitor(BaseService):
             "tx_starved": self.tx_starved(),
             "tx_starve_commits": round(self.tx_starve_commits, 2),
             "starve_threshold_s": round(self.starve_s, 4),
+            "lock_wait_s": round(self.lock_wait_s, 4),
+            "hot_lock": self.hot_lock(),
             "trips": dict(self.trips),
             "bundles": self.bundles,
             "bundle_dir": self.bundle_dir,
@@ -1416,6 +1602,19 @@ def write_bundle(
         )
     except Exception as e:
         save("budget.json.err", repr(e))
+    # lock-contention plane + per-height critical path: which mutex the
+    # engine waited on and what actually gated each commit, with every
+    # thread's blocked-on lock at the failure edge
+    try:
+        save(
+            "contention.json",
+            {
+                "lockprof": liblockprof.snapshot(),
+                "critical_path": critical_path(),
+            },
+        )
+    except Exception as e:
+        save("contention.json.err", repr(e))
     # merged cross-node timeline + root-cause attribution: peers' rings
     # are pulled over RPC when COMETBFT_TPU_POSTMORTEM_PEERS names them
     # (reachable or not, the local view is always written) — the knob
@@ -1552,6 +1751,9 @@ def sample(metrics=None) -> dict:
     # (gauges carry the most recent fully-decomposed height; the full
     # per-height table lives on /debug/budget and in budget.json)
     libdevledger.sample(m)
+    # lock-contention bridge: per-lock wait/hold/contended counters
+    # from per-registry watermarks (libs/lockprof)
+    liblockprof.sample(m)
     bud = budget()
     if bud["heights"]:
         last_stages = bud["heights"][-1]["stages"]
@@ -1596,6 +1798,26 @@ def debug_budget_json() -> str:
         {
             "ledger": libdevledger.snapshot(),
             "budget": budget(),
+        },
+        default=str,
+    )
+
+
+def debug_contention_json() -> str:
+    """Body of the pprof server's ``/debug/contention`` route: the
+    per-lock contention ledger (libs/lockprof), the per-height
+    critical-path verdicts, and every thread's held/blocked-on lock
+    state."""
+    mon = active_monitor()
+    return json.dumps(
+        {
+            "lockprof": liblockprof.snapshot(),
+            "critical_path": critical_path(),
+            "hot_lock": mon.hot_lock() if mon is not None else None,
+            "threads": {
+                str(tid): info
+                for tid, info in libsync.held_locks_snapshot().items()
+            },
         },
         default=str,
     )

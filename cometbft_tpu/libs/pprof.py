@@ -11,6 +11,7 @@ Endpoints (all GET, plain text or JSON):
                                     format) capturing kernel launches
   /debug/jax/stop_trace             stop it
   /debug/locks             deadlock-tier status (libs/sync)
+  /debug/contention        per-lock wait/hold profile + critical path
   /debug/devstats          device/XLA telemetry snapshot (libs/devstats)
   /debug/trace             libs/trace ring-buffer dump (JSON)
   /debug/trace/start?file=PATH   enable the span tracer (+ optional
@@ -33,11 +34,43 @@ from .service import HTTPService
 
 
 def thread_dump() -> str:
-    """All live threads' stacks — the goroutine-dump analog."""
+    """All live threads' stacks — the goroutine-dump analog.
+
+    Each header also names the lock the thread is currently blocked on
+    (and for how long), from libs/sync's blocked-on registry, so a
+    bundle's threads.txt answers "who is waiting on whom" without
+    cross-referencing /debug/contention."""
+    import time
+
     names = {t.ident: t.name for t in threading.enumerate()}
+    try:
+        from . import sync as libsync
+
+        held = libsync.held_locks_snapshot()
+    except Exception:
+        held = {}
+    now = time.monotonic_ns()
     out = io.StringIO()
     for tid, frame in sys._current_frames().items():
         out.write(f"--- thread {tid} ({names.get(tid, '?')}) ---\n")
+        info = held.get(tid)
+        if info:
+            if info.get("held"):
+                locks = ", ".join(
+                    name for name, _site in info["held"]
+                )
+                out.write(f"    holding: {locks}\n")
+            blocked = info.get("blocked_on")
+            if blocked is not None:
+                since = info.get("blocked_since_ns")
+                if since:
+                    wait_s = max(0.0, (now - since) / 1e9)
+                    out.write(
+                        f"    blocked on: {blocked} "
+                        f"(for {wait_s:.3f}s)\n"
+                    )
+                else:
+                    out.write(f"    blocked on: {blocked}\n")
         traceback.print_stack(frame, file=out)
         out.write("\n")
     return out.getvalue()
@@ -143,6 +176,9 @@ ROUTE_DOCS: dict[str, str] = {
     "/debug/timeline": (
         "merged height timelines + root-cause verdicts (JSON; "
         "?peer=URL fans in)"
+    ),
+    "/debug/contention": (
+        "per-lock wait/hold profile + per-height critical path (JSON)"
     ),
     "/debug/trace": "span-tracer ring dump",
     "/debug/trace/start": "?file=PATH  enable the span tracer",
@@ -256,6 +292,11 @@ class PprofServer(HTTPService):
                 default=str,
             )
 
+        def contention_dump(q):
+            from . import health as libhealth
+
+            return libhealth.debug_contention_json()
+
         def trace_dump(q):
             from . import trace as libtrace
 
@@ -306,6 +347,7 @@ class PprofServer(HTTPService):
             "/debug/tx": tx_dump,
             "/debug/flight": flight_dump,
             "/debug/timeline": timeline_dump,
+            "/debug/contention": contention_dump,
             "/debug/trace": trace_dump,
             "/debug/trace/start": trace_start,
             "/debug/trace/stop": trace_stop,

@@ -47,6 +47,23 @@ documented ``# lockfree:`` plane); ``enforce`` raises
 guard is not fully held.  Like the other tiers, the mode is read at
 lock construction — flip it (env var or :func:`set_lockset_mode`)
 before building the objects under test.
+
+Contention profiler (``COMETBFT_TPU_LOCKPROF``, libs/lockprof): when NO
+diagnostic tier is on, the factories hand out ``_ProfiledMutex`` /
+``_ProfiledRLock`` — thin ``__slots__`` wrappers that account every
+named lock's acquires, contended acquires, wait and hold time into
+libs/lockprof's preallocated per-registry-slot columns.  The enabled
+record path retains zero allocations and takes no lock (a non-blocking
+probe first; only an acquire that actually blocks pays the timed
+path); disabled, one flag check stands between the caller and the raw
+primitive.  Waits and holds past the slow threshold emit EV_LOCK
+flight-ring rows naming the holder's acquire site.  Unlike the
+instrumented tier, profiled locks implement the stdlib save/restore
+protocol, so :func:`Condition` keeps the wrapper and waiter
+re-acquires stay in the contention ledger.  ``COMETBFT_TPU_LOCKPROF=0``
+is the kill switch back to raw ``threading`` primitives.  Both tiers
+additionally publish each thread's *blocked-on* lock and wait start
+into :func:`held_locks_snapshot` for live starvation diagnosis.
 """
 
 from __future__ import annotations
@@ -54,8 +71,11 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 import traceback
 import faulthandler
+
+from . import lockprof as _lockprof
 
 DEADLOCK_TIMEOUT = float(os.environ.get("COMETBFT_TPU_DEADLOCK_TIMEOUT", "30"))
 
@@ -106,6 +126,12 @@ _tls = threading.local()  # .held: list[str] of instrumented-lock names
 # black-box bundle snapshot which locks every thread held at a watchdog
 # trip without reaching into foreign TLS
 _all_held: dict[int, list] = {}
+# every thread's blocked-on cell ``[lock name | None, wait-start ns]``
+# (the SAME list objects the TLS slots hold, registered at first use —
+# in-place stores keep the record path retention-free): set by a
+# contended acquire in the sanitizer AND profiled tiers, cleared when
+# the wait resolves, so snapshots can say who is parked on what
+_all_blocked: dict[int, list] = {}
 # observed (from, to) -> first witness "file:line" of the inner acquire
 _observed: dict[tuple[str, str], str] = {}
 _observed_mtx = threading.Lock()  # tier-internal meta-lock, never exposed
@@ -276,18 +302,50 @@ def _held_stack() -> list:
     return stack
 
 
-def held_locks_snapshot() -> dict[int, list[str]]:
-    """Per-thread held instrumented-lock names (crash-forensics surface
-    for the health layer's black-box bundle).  Only populated while the
-    lock-order sanitizer runs (``COMETBFT_TPU_LOCK_ORDER``) — plain
-    production locks keep no held stacks.  Dead threads are pruned."""
+def _blocked_cell() -> list:
+    """This thread's preallocated blocked-on cell ``[name | None,
+    wait-start ns]`` — registered once, mutated in place thereafter
+    (the ``_held_stack`` pattern), so setting/clearing the blocked-on
+    marker on a contended acquire retains nothing."""
+    cell = getattr(_tls, "blocked", None)
+    if cell is None:
+        cell = _tls.blocked = [None, 0]
+        with _observed_mtx:
+            _all_blocked[threading.get_ident()] = cell
+    return cell
+
+
+def held_locks_snapshot() -> dict[int, dict]:
+    """Per-thread lock forensics (the health layer's ``locks.json``
+    bundle surface and the thread-dump annotations): ``held`` — the
+    thread's held instrumented-lock names, populated only while a
+    sanitizer tier runs (``COMETBFT_TPU_LOCK_ORDER`` /
+    ``COMETBFT_TPU_LOCKSET``; plain production locks keep no held
+    stacks) — plus ``blocked_on`` / ``blocked_since_ns`` — the lock the
+    thread is parked on right now and the ``monotonic_ns`` its wait
+    began, maintained by BOTH the sanitizer and the lockprof profiled
+    tiers, so live lock starvation is diagnosable in production.  Dead
+    threads are pruned."""
     live = set(sys._current_frames())
     with _observed_mtx:
-        for tid in [t for t in _all_held if t not in live]:
-            del _all_held[tid]
-        return {
-            tid: list(stack) for tid, stack in _all_held.items() if stack
-        }
+        for reg in (_all_held, _all_blocked):
+            for tid in [t for t in reg if t not in live]:
+                del reg[tid]
+        out: dict[int, dict] = {}
+        for tid in set(_all_held) | set(_all_blocked):
+            stack = _all_held.get(tid)
+            cell = _all_blocked.get(tid)
+            blocked = cell[0] if cell is not None else None
+            if not stack and blocked is None:
+                continue
+            out[tid] = {
+                "held": list(stack) if stack else [],
+                "blocked_on": blocked,
+                "blocked_since_ns": (
+                    cell[1] if blocked is not None else None
+                ),
+            }
+        return out
 
 
 def _acquire_site() -> str:
@@ -394,30 +452,41 @@ class _InstrumentedMutex:
             if ok:
                 self._note_acquired(me)
             return ok
+        if self._lock.acquire(False):
+            self._note_acquired(me)
+            return True
         budget = timeout if timeout > 0 else None
         waited = 0.0
         next_report = DEADLOCK_TIMEOUT
         step = min(DEADLOCK_TIMEOUT, 5.0)
-        while True:
-            slice_ = step if budget is None else min(step, budget - waited)
-            if slice_ <= 0:
-                return False  # caller's timeout wins, report or not
-            if self._lock.acquire(True, slice_):
-                self._note_acquired(me)
-                return True
-            waited += slice_
-            if waited >= next_report:
-                holder = self._holder
-                sys.stderr.write(
-                    f"POSSIBLE DEADLOCK: thread {me} waited "
-                    f"{waited:.0f}s for {self._name} "
-                    f"(held by thread {holder})\n"
-                    f"holder acquired at:\n{self._holder_stack}\n"
+        cell = _blocked_cell()
+        cell[1] = time.monotonic_ns()
+        cell[0] = self._name
+        try:
+            while True:
+                slice_ = (
+                    step if budget is None else min(step, budget - waited)
                 )
-                _dump_all_threads()
-                # report-and-continue, re-reporting each further interval
-                # (go-deadlock keeps flagging a wedged lock)
-                next_report += DEADLOCK_TIMEOUT
+                if slice_ <= 0:
+                    return False  # caller's timeout wins, report or not
+                if self._lock.acquire(True, slice_):
+                    self._note_acquired(me)
+                    return True
+                waited += slice_
+                if waited >= next_report:
+                    holder = self._holder
+                    sys.stderr.write(
+                        f"POSSIBLE DEADLOCK: thread {me} waited "
+                        f"{waited:.0f}s for {self._name} "
+                        f"(held by thread {holder})\n"
+                        f"holder acquired at:\n{self._holder_stack}\n"
+                    )
+                    _dump_all_threads()
+                    # report-and-continue, re-reporting each further
+                    # interval (go-deadlock keeps flagging a wedged lock)
+                    next_report += DEADLOCK_TIMEOUT
+        finally:
+            cell[0] = None
 
     def release(self) -> None:
         me = threading.get_ident()
@@ -451,35 +520,332 @@ class _InstrumentedRLock(_InstrumentedMutex):
     _reentrant = True
 
 
+# ------------------------------------------------- contention profiling
+
+# A Condition re-acquire below this wait is treated as uncontended:
+# unlike the ordinary acquire path there is no non-blocking probe
+# available inside the stdlib's _acquire_restore protocol, so a small
+# floor keeps every notify->wakeup from counting as a contended acquire
+_RESTORE_CONTENDED_NS = 20_000
+
+
+def _profile_wait(slot: int, wait_ns: int, site_code, site_line) -> None:
+    """Bank one contended acquire; past the slow threshold, emit the
+    EV_LOCK wait row naming the HOLDER's acquire site (a best-effort
+    racy read of the wrapper's site slots — forensics, not bookkeeping:
+    the blocker is whoever held the lock while we waited)."""
+    _lockprof.note_contended(slot, wait_ns)
+    if wait_ns >= _lockprof._slow_ns:
+        site = (
+            f"{site_code.co_filename}:{site_line}" if site_code else "?"
+        )
+        _lockprof.note_slow(slot, _lockprof.KIND_WAIT, wait_ns, site)
+
+
+def _profile_hold(slot: int, hold_ns: int, site_code, site_line) -> None:
+    """Bank one completed hold; past the slow threshold, emit the
+    EV_LOCK hold row naming our own acquire site."""
+    if hold_ns > 0:
+        _lockprof._hold_ns[slot] += hold_ns
+    if hold_ns >= _lockprof._slow_ns:
+        site = (
+            f"{site_code.co_filename}:{site_line}" if site_code else "?"
+        )
+        _lockprof.note_slow(slot, _lockprof.KIND_HOLD, hold_ns, site)
+
+
+class _ProfiledMutex:
+    """Contention-profiled non-reentrant lock (the production tier).
+
+    The record path is allocation- and lock-free: preallocated
+    libs/lockprof columns take GIL-atomic scalar stores, the holder
+    site is kept as a code-object reference plus a line int in
+    ``__slots__`` (formatted to a string only on the EV_LOCK slow
+    path), and the acquire timestamp lives in a slot whose int is
+    simply replaced each acquire.  Disabled, a single flag check
+    stands between the caller and the raw primitive.
+    """
+
+    __slots__ = (
+        "_name", "_slot", "_lock", "_t_acq", "_site_code", "_site_line",
+    )
+
+    def __init__(self, name: str = ""):
+        self._name = name or f"mutex@{id(self):x}"
+        self._slot = _lockprof.slot_for(self._name)
+        self._lock = threading.Lock()
+        self._t_acq = 0
+        self._site_code = None
+        self._site_line = 0
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _stamp(self) -> None:
+        # the engine frame performing the acquire: skip this module's
+        # own frames (acquire/__enter__) and threading.py's Condition
+        # plumbing — identity-cheap co_filename membership checks
+        f = sys._getframe(1)
+        while f is not None and f.f_code.co_filename in _SKIP_SITE_FILES:
+            f = f.f_back
+        if f is not None:
+            self._site_code = f.f_code
+            self._site_line = f.f_lineno
+        self._t_acq = time.monotonic_ns()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        lock = self._lock
+        if not _lockprof._enabled:
+            return lock.acquire(blocking, timeout)
+        slot = self._slot
+        if lock.acquire(False):  # uncontended fast path: zero wait
+            _lockprof._acquires[slot] += 1
+            self._stamp()
+            return True
+        if not blocking or timeout == 0:
+            return False
+        cell = _blocked_cell()
+        t0 = time.monotonic_ns()
+        cell[1] = t0
+        cell[0] = self._name
+        try:
+            ok = lock.acquire(True, timeout)
+        finally:
+            cell[0] = None
+        wait = time.monotonic_ns() - t0
+        # read the holder's site BEFORE stamping our own: the blocker
+        # we waited behind is the one worth naming in the ring
+        _profile_wait(slot, wait, self._site_code, self._site_line)
+        if ok:
+            _lockprof._acquires[slot] += 1
+            self._stamp()
+        return ok
+
+    def release(self) -> None:
+        t0 = self._t_acq
+        if t0:
+            self._t_acq = 0
+            if _lockprof._enabled:
+                _profile_hold(
+                    self._slot, time.monotonic_ns() - t0,
+                    self._site_code, self._site_line,
+                )
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def _is_owned(self):
+        # Condition's ownership sanity probe — bypasses the ledger (a
+        # probe is not an acquire); release/acquire during wait() go
+        # through the profiled methods and stay accounted
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+
+class _ProfiledRLock:
+    """Contention-profiled reentrant lock.  ``_depth`` (owner-thread
+    mutated, so race-free) marks the outermost acquire/release pair:
+    hold time spans the whole reentrant session, and reentrant
+    re-acquires never count as contention.  Implements the stdlib
+    save/restore protocol by delegating to the inner C RLock, so a
+    Condition keeps the wrapper and waiter re-acquires stay in the
+    ledger."""
+
+    __slots__ = (
+        "_name", "_slot", "_lock", "_depth", "_t_acq",
+        "_site_code", "_site_line",
+    )
+
+    def __init__(self, name: str = ""):
+        self._name = name or f"rlock@{id(self):x}"
+        self._slot = _lockprof.slot_for(self._name)
+        self._lock = threading.RLock()
+        self._depth = 0
+        self._t_acq = 0
+        self._site_code = None
+        self._site_line = 0
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def _stamp(self) -> None:
+        f = sys._getframe(1)
+        while f is not None and f.f_code.co_filename in _SKIP_SITE_FILES:
+            f = f.f_back
+        if f is not None:
+            self._site_code = f.f_code
+            self._site_line = f.f_lineno
+        self._t_acq = time.monotonic_ns()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        lock = self._lock
+        if not _lockprof._enabled or lock._is_owned():
+            ok = lock.acquire(blocking, timeout)
+            if ok:
+                self._depth += 1
+            return ok
+        slot = self._slot
+        if lock.acquire(False):  # uncontended fast path: zero wait
+            self._depth += 1
+            _lockprof._acquires[slot] += 1
+            self._stamp()
+            return True
+        if not blocking or timeout == 0:
+            return False
+        cell = _blocked_cell()
+        t0 = time.monotonic_ns()
+        cell[1] = t0
+        cell[0] = self._name
+        try:
+            ok = lock.acquire(True, timeout)
+        finally:
+            cell[0] = None
+        wait = time.monotonic_ns() - t0
+        _profile_wait(slot, wait, self._site_code, self._site_line)
+        if ok:
+            self._depth += 1
+            _lockprof._acquires[slot] += 1
+            self._stamp()
+        return ok
+
+    def release(self) -> None:
+        d = self._depth
+        if d <= 1:
+            self._depth = 0
+            t0 = self._t_acq
+            if t0:
+                self._t_acq = 0
+                if _lockprof._enabled:
+                    _profile_hold(
+                        self._slot, time.monotonic_ns() - t0,
+                        self._site_code, self._site_line,
+                    )
+        else:
+            self._depth = d - 1
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._depth > 0
+
+    # -- stdlib Condition save/restore protocol ---------------------------
+
+    def _is_owned(self):
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        d = self._depth
+        self._depth = 0
+        t0 = self._t_acq
+        if t0:
+            self._t_acq = 0
+            if _lockprof._enabled:
+                _profile_hold(
+                    self._slot, time.monotonic_ns() - t0,
+                    self._site_code, self._site_line,
+                )
+        return (self._lock._release_save(), d)
+
+    def _acquire_restore(self, state):
+        inner, d = state
+        if not _lockprof._enabled:
+            self._lock._acquire_restore(inner)
+            self._depth = d
+            return
+        slot = self._slot
+        cell = _blocked_cell()
+        t0 = time.monotonic_ns()
+        cell[1] = t0
+        cell[0] = self._name
+        try:
+            self._lock._acquire_restore(inner)
+        finally:
+            cell[0] = None
+        wait = time.monotonic_ns() - t0
+        _lockprof._acquires[slot] += 1
+        if wait >= _RESTORE_CONTENDED_NS:
+            _profile_wait(slot, wait, self._site_code, self._site_line)
+        self._depth = d
+        # keep the pre-wait acquire site: attribution names the frame
+        # that entered the critical section, not threading.Condition
+        self._t_acq = time.monotonic_ns()
+
+
+# co_filename values the acquire-site walk skips (this module's frames
+# and threading.py's Condition plumbing) — identity-stable strings, so
+# the frozenset membership test on the hot stamp path is one hash probe
+_SKIP_SITE_FILES = frozenset({
+    _ProfiledMutex._stamp.__code__.co_filename,
+    threading.Condition.wait.__code__.co_filename,
+})
+
+
+def _profiling_constructed() -> bool:
+    """Whether the factories hand out profiled locks right now: no
+    diagnostic tier active (those take precedence — their wrappers
+    carry the held stacks and self-deadlock checks) and the lockprof
+    kill switch not set.  Read at lock CONSTRUCTION, like the
+    sanitizer modes."""
+    return (
+        not _enabled
+        and _order_mode == "off"
+        and _lockset_mode == "off"
+        and _lockprof._env_mode() != "off"
+    )
+
+
 def Mutex(name: str = ""):
     """A non-reentrant lock; instrumented when deadlock detection or a
-    sanitizer (lock-order or lockset) is on."""
+    sanitizer (lock-order or lockset) is on, contention-profiled
+    (libs/lockprof) otherwise unless ``COMETBFT_TPU_LOCKPROF=0``."""
     if _enabled or _order_mode != "off" or _lockset_mode != "off":
         return _InstrumentedMutex(name)
+    if _lockprof._env_mode() != "off":
+        return _ProfiledMutex(name)
     return threading.Lock()
 
 
 def RLock(name: str = ""):
     """A reentrant lock; instrumented when deadlock detection or a
-    sanitizer (lock-order or lockset) is on."""
+    sanitizer (lock-order or lockset) is on, contention-profiled
+    (libs/lockprof) otherwise unless ``COMETBFT_TPU_LOCKPROF=0``."""
     if _enabled or _order_mode != "off" or _lockset_mode != "off":
         return _InstrumentedRLock(name)
+    if _lockprof._env_mode() != "off":
+        return _ProfiledRLock(name)
     return threading.RLock()
 
 
 def Condition(lock=None, name: str = ""):
     """A condition variable routed through the sync tier.
 
-    Conditions are not themselves instrumented: ``wait()`` must release
-    and re-acquire the underlying primitive with the stdlib's exact
-    save/restore protocol, which the instrumented wrappers deliberately
-    don't implement (their non-reentrant self-deadlock check would
-    misfire inside ``Condition._is_owned``). When handed an
-    instrumented Mutex/RLock the raw lock is unwrapped, so waiters
-    remain visible to the deadlock tier through every ordinary
-    ``acquire`` on the associated mutex; only the wait/notify edge
-    itself is uninstrumented.
+    Conditions are not instrumented by the DIAGNOSTIC tiers: ``wait()``
+    must release and re-acquire the underlying primitive with the
+    stdlib's exact save/restore protocol, which the instrumented
+    wrappers deliberately don't implement (their non-reentrant
+    self-deadlock check would misfire inside ``Condition._is_owned``).
+    When handed an instrumented Mutex/RLock the raw lock is unwrapped,
+    so waiters remain visible to the deadlock tier through every
+    ordinary ``acquire`` on the associated mutex; only the wait/notify
+    edge itself is uninstrumented.
+
+    The PROFILED tier does implement the protocol, so a profiled lock
+    is kept as-is — and a bare ``Condition(name=...)`` gets a profiled
+    RLock under the condition's registry name, putting waiter
+    re-acquires in the contention ledger too.
     """
     if isinstance(lock, _InstrumentedMutex):
         lock = lock._lock
+    elif lock is None and _profiling_constructed():
+        lock = _ProfiledRLock(name)
     return threading.Condition(lock)

@@ -654,6 +654,7 @@ class Node(BaseService):
         # before the switch accepts the first connection, and the boot
         # unwind below releases it on any failure.
         from ..libs import devledger as libdevledger
+        from ..libs import lockprof as liblockprof
         from ..libs import netstats as libnetstats
         from ..libs import txtrace as libtxtrace
 
@@ -667,6 +668,11 @@ class Node(BaseService):
         # this node's mempool joins the oldest-age probe the
         # tx_starved watchdog and mempool_oldest_age_seconds read
         libtxtrace.acquire()
+        # lock-contention profiler (kill switch COMETBFT_TPU_LOCKPROF=0):
+        # per-lock wait/hold columns record exactly while a node runs,
+        # feeding lock_wait_seconds{lock}, /debug/contention and the
+        # lock_contended watchdog
+        liblockprof.acquire()
         libtxtrace.register_mempool(self.mempool)
         try:
             if self.pprof_server is not None:
@@ -739,8 +745,10 @@ class Node(BaseService):
                 raise
         except BaseException:
             # ANY boot failure: release the netstats + ledger + tx-plane
-            # acquires (on_stop never runs on a half-booted node)
+            # + lockprof acquires (on_stop never runs on a half-booted
+            # node)
             libtxtrace.deregister_mempool(self.mempool)
+            liblockprof.release()
             libtxtrace.release()
             libdevledger.release()
             libnetstats.release()
@@ -998,12 +1006,14 @@ class Node(BaseService):
                 pass
         # after the switch (its peers deregister their stats blocks on
         # connection stop): release this node's netstats + device-time
-        # ledger + tx-plane acquires
+        # ledger + tx-plane + lock-profiler acquires
         from ..libs import devledger as libdevledger
+        from ..libs import lockprof as liblockprof
         from ..libs import netstats as libnetstats
         from ..libs import txtrace as libtxtrace
 
         libtxtrace.deregister_mempool(self.mempool)
+        liblockprof.release()
         libtxtrace.release()
         libnetstats.release()
         libdevledger.release()

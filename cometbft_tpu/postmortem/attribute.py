@@ -22,6 +22,10 @@ stack can actually see, and the ranked result is the **verdict**:
     mempool_backlog     sampled txs committed in the window waited far
                         longer in the mempool than the run's typical
                         submit->commit wait (libs/txtrace rows)
+    lock_contention     threads spent a large share of the window
+                        blocked on one engine mutex (libs/lockprof
+                        EV_LOCK wait rows name the hot lock and the
+                        blocking holder's acquire site)
 
 Scores live in [0, 1]; only findings at or above the report threshold
 make the verdict, so a healthy run yields **no verdict at all** — the
@@ -53,6 +57,7 @@ _FAULT = "simnet.fault"
 _BREAKER = "coalesce.breaker"
 _RECOMPILE = "xla.recompile"
 _FSYNC = "wal.fsync"
+_LOCK = "sync.lock"
 _WATCHDOG = "health.watchdog"
 
 
@@ -447,6 +452,37 @@ def _window_findings(
                 {
                     "fsync_max_ms": round(mx_s * 1e3, 3),
                     "window_share": round(frac, 4),
+                },
+            ))
+
+    # -- lock contention (wall-domain rings only, like fsync): slow
+    # EV_LOCK wait rows in the window sum per lock; when the hottest
+    # lock's blocked time is a large share of the window's wall time,
+    # the commit chain was serialized behind it — the verdict names
+    # the lock and the blocking holder's acquire site
+    lock_waits = [
+        a for a in anns
+        if a.get("event") == _LOCK and a.get("kind_name") == "wait"
+    ]
+    if lock_waits:
+        per_lock: dict[str, float] = {}
+        site_of: dict[str, str] = {}
+        for a in lock_waits:
+            lk = a.get("lock", "?")
+            per_lock[lk] = per_lock.get(lk, 0.0) + a.get("dur_ns", 0) / 1e9
+            site_of.setdefault(lk, a.get("site", "?"))
+        hot = max(per_lock, key=lambda k: per_lock[k])
+        frac = per_lock[hot] / dur_s
+        if frac > 0.15:
+            findings.append(Finding(
+                "lock_contention",
+                min(0.9, 2.0 * frac),
+                {
+                    "lock": hot,
+                    "holder_site": site_of.get(hot),
+                    "wait_ms": round(per_lock[hot] * 1e3, 3),
+                    "window_share": round(frac, 4),
+                    "waits": len(lock_waits),
                 },
             ))
 

@@ -534,11 +534,22 @@ def merge(sources: list[Source]) -> Timeline:
     # function of the decoded rows — deterministic per (seed, scenario)
     # like the rest of the canonical serialization.
     budgets = libhealth.budget_from_events([r[3] for r in rows])
+    # critical-path verdicts ride the same merged stream: per height,
+    # the gating resource (dominant stage × hottest in-window lock ×
+    # coalescer plane) from the budget tiles + EV_LOCK wait rows — the
+    # contention plane's answer to "what actually gated this commit".
+    cpaths = libhealth.critical_path_from_events([r[3] for r in rows])
     for hv in ordered:
         b = budgets.get(hv["height"])
         hv["budget"] = (
             {"stages": b["stages"], "coverage": b["coverage"]}
             if b is not None
+            else None
+        )
+        cp = cpaths.get(hv["height"])
+        hv["critical_path"] = (
+            {k: cp[k] for k in cp if k not in ("height", "node")}
+            if cp is not None
             else None
         )
 

@@ -143,6 +143,22 @@ class TestMetricDirection:
         bench = _bench_mod()
         assert bench._metric_direction("mystery_quantity") == 0
 
+    def test_lock_contention_fragments_are_lower_is_better(self):
+        """The contention pre-list must win before the generic
+        fragments: "lock_wait_share_pct" contains "share" (a
+        higher-better fragment) yet more lock waiting is never an
+        improvement — the pipelined-heights PR's compare baseline
+        depends on these classifying as regressions when they rise."""
+        bench = _bench_mod()
+        for key in (
+            "lock_wait_total_s",
+            "lock_wait_share_pct",  # "share" must NOT flip it
+            "contended_acquires",
+            "commit_chain_occupancy_pct",
+            "lockprof_overhead_pct",
+        ):
+            assert bench._metric_direction(key) == -1, key
+
 
 def _write(path, obj):
     path.write_text(json.dumps(obj))

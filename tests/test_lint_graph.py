@@ -502,6 +502,28 @@ class TestEngineWideGate:
         ]
         assert tx_edges == [], tx_edges
 
+    def test_lockprof_recorder_is_lock_free(self, analysis):
+        """The lock-contention profiler must never appear in the very
+        hierarchy it measures: libs/lockprof owns NO lock in the
+        shipped artifact (its slow-path site-intern meta-lock is a
+        deliberately raw, CLNT001-suppressed threading.Lock outside the
+        sync tier), so the record path — called inside every profiled
+        acquire/release — can deadlock with nothing.  A lockprof-owned
+        lock or edge appearing here means someone routed the profiler's
+        internals through the factories it instruments."""
+        d = analysis.graph_dict()
+        owned = [
+            lk["name"] for lk in d["locks"]
+            if "lockprof" in lk.get("path", "") or "lockprof" in lk["name"]
+        ]
+        assert owned == [], owned
+        edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "lockprof" in e["from"] or "lockprof" in e["to"]
+        ]
+        assert edges == [], edges
+
     def test_coalescer_lock_registered_and_flush_never_blocks_under_it(
         self, analysis
     ):

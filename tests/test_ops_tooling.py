@@ -21,8 +21,20 @@ from cometbft_tpu.libs import sync as libsync
 
 
 class TestDeadlockTier:
-    def test_disabled_returns_plain_locks(self):
+    def test_disabled_returns_profiled_then_plain_locks(self, monkeypatch):
+        # with diagnostics off the factories hand out the contention-
+        # profiled production tier (libs/lockprof; constructed even
+        # while recording is off so a later enable() sees every lock)…
         libsync.disable()
+        m = libsync.Mutex()
+        assert type(m).__name__ == "_ProfiledMutex"
+        r = libsync.RLock()
+        with r:
+            with r:  # reentrant
+                pass
+        # …and the COMETBFT_TPU_LOCKPROF=0 kill switch strips the
+        # engine back to raw threading primitives
+        monkeypatch.setenv("COMETBFT_TPU_LOCKPROF", "0")
         m = libsync.Mutex()
         assert type(m).__name__ in ("lock", "LockType")  # raw threading.Lock
         r = libsync.RLock()

@@ -59,7 +59,16 @@ def test_recorded_lock_order_is_subgraph_of_static_graph(tmp_path):
                     break
                 time.sleep(0.05)
             assert sw2.peers(), "switches failed to connect"
-            assert sw2.peers()[0].send(0x42, b"order-check")
+            # the switch lists a peer before its mconnection service
+            # finishes starting, and send() returns False until
+            # is_running() — retry across that startup window
+            deadline = time.monotonic() + 20
+            sent = False
+            while time.monotonic() < deadline and not sent:
+                sent = sw2.peers()[0].send(0x42, b"order-check")
+                if not sent:
+                    time.sleep(0.05)
+            assert sent, "peer send never succeeded after handshake"
             deadline = time.monotonic() + 20
             while time.monotonic() < deadline:
                 if r1.received and r2.received:

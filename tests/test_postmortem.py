@@ -430,6 +430,48 @@ class TestMergeUnits:
         assert tl.tx_samples["heights"][1] == [pytest.approx(0.017)]
         assert tl.tx_samples["depths"][1] == [7]
 
+    def test_lock_rows_become_per_height_critical_path(self):
+        """EV_LOCK slow-wait rows join the budget tiles into each
+        height's ``critical_path`` verdict naming the gating lock; in a
+        virtual-domain merge the wall-measured rows drop (like
+        wal.fsync) and the verdict degrades to the stage view."""
+        evs = (
+            _height_events("node0", 1, 1_000_000_000)
+            + [
+                _ev("sync.lock", 1_010_000_000, node="node0",
+                    dur_ns=15_000_000, lock="consensus.wal._mtx",
+                    kind_name="wait", site="wal.py:42"),
+                _ev("sync.lock", 1_011_000_000, node="node0",
+                    dur_ns=2_000_000, lock="consensus.state",
+                    kind_name="wait", site="state.py:7"),
+            ]
+        )
+        evs.sort(key=lambda e: e["ts"])
+        tl = merge([Source("node0", evs)])
+        h1 = tl.heights[0]
+        cp = h1["critical_path"]
+        assert cp is not None
+        assert cp["lock"] == "consensus.wal._mtx"
+        assert cp["lock_wait_s"] == pytest.approx(0.015)
+        assert cp["lock_site"] == "wal.py:42"
+        assert cp["gate"] == "lock:consensus.wal._mtx"
+        # per-height rows carry no redundant height/node keys
+        assert "height" not in cp and "node" not in cp
+        # wall-domain merges keep the slow-lock rows as annotations
+        assert any(
+            a["event"] == "sync.lock" for a in h1["annotations"]
+        )
+        # a virtual-domain merge drops the wall-measured rows exactly
+        # like wal.fsync, and the verdict falls back to the stage view
+        tlv = merge([Source("node0", evs, domain="virtual")])
+        hv = tlv.heights[0]
+        assert all(
+            a["event"] != "sync.lock"
+            for a in tlv.run["annotations"] + hv["annotations"]
+        )
+        assert hv["critical_path"]["lock"] is None
+        assert hv["critical_path"]["gate"].startswith("stage:")
+
     def test_mempool_backlog_detector_names_the_backlogged_height(self):
         """A slow height whose sampled txs waited >> the run's typical
         submit->commit wait attributes to mempool_backlog; the healthy
@@ -658,6 +700,44 @@ class TestAttributionUnits:
         tl = merge([Source("node0", evs, domain="wall")])
         rep = attribute(tl)
         assert rep.run.verdict.cause == "wal_fsync_outlier"
+
+    def test_lock_contention_names_the_hot_lock(self):
+        """A slow window whose annotations carry EV_LOCK waits
+        dominating the wall scores lock_contention naming the hot lock
+        and the blocking holder's acquire site; hold rows and sub-15%
+        wait shares stay silent."""
+        evs = _height_events("node0", 1, 1_000_000_000) + _height_events(
+            "node0", 2, 1_100_000_000, lat_ns=900_000_000
+        ) + [
+            _ev("sync.lock", 1_500_000_000 + i * 1_000_000,
+                dur_ns=80_000_000, lock="consensus.wal._mtx",
+                kind_name="wait", site="wal.py:88")
+            for i in range(3)
+        ] + [
+            # a hold row never counts toward the wait verdict
+            _ev("sync.lock", 1_510_000_000, dur_ns=500_000_000,
+                lock="consensus.wal._mtx", kind_name="hold",
+                site="wal.py:88"),
+        ]
+        rep = attribute(merge([Source("node0", evs, domain="wall")]))
+        v = rep.run.verdict
+        assert v is not None and v.cause == "lock_contention"
+        assert v.evidence["lock"] == "consensus.wal._mtx"
+        assert v.evidence["holder_site"] == "wal.py:88"
+        assert v.evidence["waits"] == 3
+        # the same waits against a window they cannot dominate: silent
+        quiet = [
+            _ev("sync.lock", 1_115_000_000, dur_ns=10_000_000,
+                lock="consensus.wal._mtx", kind_name="wait",
+                site="wal.py:88"),
+        ]
+        evs2 = _height_events("node0", 1, 1_000_000_000) + _height_events(
+            "node0", 2, 1_100_000_000, lat_ns=900_000_000
+        ) + quiet
+        rep2 = attribute(merge([Source("node0", evs2, domain="wall")]))
+        assert all(
+            f.cause != "lock_contention" for f in rep2.run.findings
+        )
 
     def test_latency_detector_scores_against_baseline(self):
         slow_hops = [
