@@ -412,6 +412,9 @@ def _debug_bundle(args, out_dir: str) -> list[str]:
         for name, path in (
             ("goroutines.txt", "/debug/pprof/goroutine"),
             ("heap.txt", "/debug/pprof/heap"),
+            # no ?seconds=: the recent-sample ring (the seconds BEFORE
+            # the dump), so a post-incident dump needs no live window
+            ("profile.json", "/debug/pprof/profile?format=json"),
             ("locks.json", "/debug/locks"),
             ("devstats.json", "/debug/devstats"),
             ("health.json", "/debug/health"),

@@ -94,6 +94,23 @@ ENV_KNOBS: dict[str, str] = {
         "site, and the lock_contended watchdog's windowed-p99 trip "
         "threshold (default 50; libs/lockprof.py)"
     ),
+    "COMETBFT_TPU_PROF": (
+        "continuous sampling profiler (libs/profile): auto (default, "
+        "on while a node runs — refcounted in node boot) | 1/on force "
+        "| 0/off kill switch; feeds /debug/pprof/profile, "
+        "profile_samples_total{subsystem,state}, EV_PROF critical-path "
+        "rows and the bundle profile.json"
+    ),
+    "COMETBFT_TPU_PROF_HZ": (
+        "sampling-profiler rate in stack walks per second (default "
+        "~67, off the round numbers so the sampler never phase-locks "
+        "with engine timers; libs/profile.py)"
+    ),
+    "COMETBFT_TPU_PROF_RING": (
+        "sampling-profiler recent-sample ring capacity in samples "
+        "(default 32768, ~30 s of pre-trip history for watchdog "
+        "bundles; libs/profile.py)"
+    ),
     "COMETBFT_TPU_FAIL": (
         "named crash point for fault-injection tests — the process "
         "dies hard when execution reaches it (libs/fail.py)"

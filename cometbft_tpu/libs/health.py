@@ -73,6 +73,7 @@ from . import devledger as libdevledger
 from . import lockprof as liblockprof
 from . import metrics as libmetrics
 from . import netstats as libnetstats
+from . import profile as libprofile
 from . import sync as libsync
 from . import trace as libtrace
 from .service import BaseService
@@ -134,6 +135,14 @@ EV_TX = 13
 # interned holder-acquire-site table, decoded as ``site``). Bundles
 # name the blocker, not just the victim.
 EV_LOCK = 14
+# prof.window: one sampling-profiler flush window for one subsystem
+# (libs/profile, ~1/s per subsystem with samples) — r=subsystem index
+# (libs/profile.SUBSYSTEMS, decoded as ``subsystem``), a=estimated
+# on-CPU ns (on-CPU samples x the sampling period), b=total samples
+# (on-CPU + blocked). critical_path_from_events window-assigns these to
+# name commits gated by GIL-bound Python (``cpu:<subsystem>``), and the
+# cpu_saturated postmortem detector scores them.
+EV_PROF = 15
 
 _N_CODES = 16  # size of the per-code last-seen vector
 
@@ -210,6 +219,7 @@ _CODE_NAMES = {
     EV_BUDGET: "plane.budget",
     EV_TX: "tx.stage",
     EV_LOCK: "sync.lock",
+    EV_PROF: "prof.window",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -227,12 +237,14 @@ _CODE_FIELDS = {
     EV_BUDGET: ("wait_ns", "exec_ns"),
     EV_TX: ("key_fp", "val"),
     EV_LOCK: ("dur_ns", "ref"),
+    EV_PROF: ("oncpu_ns", "samples"),
 }
 
 # codes whose payload is a wall-clock-measured duration: meaningless in
 # a virtual-time (simnet) ring, so the cross-node timeline merge drops
-# them from virtual-domain sources (cometbft_tpu/postmortem)
-WALL_DURATION_CODES = frozenset({EV_FSYNC, EV_BUDGET, EV_LOCK})
+# them from virtual-domain sources (cometbft_tpu/postmortem) — EV_PROF
+# rides along because its on-CPU estimate is sampled in wall time
+WALL_DURATION_CODES = frozenset({EV_FSYNC, EV_BUDGET, EV_LOCK, EV_PROF})
 
 
 def ring_event_codes() -> dict[str, int]:
@@ -517,6 +529,9 @@ class FlightRecorder:
                     self._b[i] & 1, "?"
                 )
                 rec["site"] = liblockprof.site_name(self._b[i] >> 1)
+            elif code == EV_PROF:
+                # the subsystem index rides the round column
+                rec["subsystem"] = libprofile.subsystem_name(self._r[i])
             o = self._o[i]
             if o:
                 rec["node"] = origin_name(o)
@@ -877,19 +892,25 @@ def critical_path_from_events(events) -> dict[int, dict]:
     already ride in via the EV_BUDGET overlay rows), the EV_LOCK slow
     lock-wait rows (window-assigned by timestamp, exactly like
     EV_FSYNC), and the device-plane share of the stage tiling.  The
-    verdict is ``stage × lock × plane``: the dominant non-residual
+    verdict is ``stage × lock × plane × cpu``: the dominant non-residual
     budget stage, the lock with the largest in-window slow-wait total
-    (with the blocking holder's acquire site), and the dominant device
-    plane — ``gate`` names whichever dimension explains the most time.
-    Pure function of the decoded event stream (the postmortem timeline
-    merge reuses it for its per-height ``critical_path`` rows)."""
+    (with the blocking holder's acquire site), the dominant device
+    plane, and — when the sampling profiler ran — the subsystem with
+    the largest in-window on-CPU time (EV_PROF window rows, so a commit
+    gated by GIL-bound Python in the FSM says ``cpu:consensus``, not
+    just ``stage:verify_execute``) — ``gate`` names whichever dimension
+    explains the most time.  Pure function of the decoded event stream
+    (the postmortem timeline merge reuses it for its per-height
+    ``critical_path`` rows)."""
     budgets = budget_from_events(events)
     if not budgets:
         return {}
     # commit window anchors (earliest commit row per height, the same
-    # anchor budget_from_events uses) + the EV_LOCK wait rows
+    # anchor budget_from_events uses) + the EV_LOCK wait rows + the
+    # EV_PROF profiler window rows
     anchors: dict[int, tuple] = {}
     lock_rows: list[tuple] = []
+    prof_rows: list[tuple] = []
     for ev in events:
         name = ev.get("event")
         if name == "consensus.commit":
@@ -903,6 +924,13 @@ def critical_path_from_events(events) -> dict[int, dict]:
                 lock_rows.append((
                     ev.get("ts", 0), ev.get("lock", "?"),
                     ev.get("dur_ns", 0), ev.get("site", "?"),
+                ))
+        elif name == "prof.window":
+            # the profiler's own thread never gates a commit
+            if ev.get("subsystem") != "sampler":
+                prof_rows.append((
+                    ev.get("ts", 0), ev.get("subsystem", "?"),
+                    ev.get("oncpu_ns", 0),
                 ))
     out: dict[int, dict] = {}
     for h, bud in budgets.items():
@@ -936,11 +964,25 @@ def critical_path_from_events(events) -> dict[int, dict]:
         for lk, v in waits.items():
             if v > lock_wait_s:
                 lock, lock_wait_s = lk, v
+        # hottest on-CPU subsystem: EV_PROF flush windows are stamped
+        # at window END, so a row belongs to the commit window when its
+        # flush landed inside it (the per-second granularity matches
+        # the ~100 ms-to-seconds commit windows this joins against)
+        cpus: dict[str, float] = {}
+        for ts, subname, oncpu_ns in prof_rows:
+            if t0 <= ts <= cts:
+                cpus[subname] = cpus.get(subname, 0.0) + oncpu_ns / 1e9
+        cpu, cpu_s = None, 0.0
+        for subname, v in cpus.items():
+            if v > cpu_s:
+                cpu, cpu_s = subname, v
         gate, gate_s = f"stage:{stage}", stage_s
         if lock is not None and lock_wait_s > gate_s:
             gate, gate_s = f"lock:{lock}", lock_wait_s
         if plane is not None and plane_s > gate_s:
             gate, gate_s = f"plane:{plane}", plane_s
+        if cpu is not None and cpu_s > gate_s:
+            gate, gate_s = f"cpu:{cpu}", cpu_s
         out[h] = {
             "height": h,
             "node": bud.get("node"),
@@ -953,6 +995,8 @@ def critical_path_from_events(events) -> dict[int, dict]:
             "lock_site": sites.get(lock) if lock else None,
             "plane": plane,
             "plane_s": round(plane_s, 6),
+            "cpu": cpu,
+            "cpu_s": round(cpu_s, 6),
             "gate": gate,
         }
     return out
@@ -1641,6 +1685,13 @@ def write_bundle(
         save("devstats.json", libdevstats.snapshot())
     except Exception as e:
         save("devstats.json.err", repr(e))
+    # sampling-profiler plane: the recent-sample ring covering the
+    # seconds BEFORE the trip — what every subsystem was doing (and
+    # which lock/queue blocked threads were parked on) at the edge
+    try:
+        save("profile.json", libprofile.bundle_snapshot())
+    except Exception as e:
+        save("profile.json.err", repr(e))
     save(
         "locks.json",
         {
@@ -1754,6 +1805,9 @@ def sample(metrics=None) -> dict:
     # lock-contention bridge: per-lock wait/hold/contended counters
     # from per-registry watermarks (libs/lockprof)
     liblockprof.sample(m)
+    # sampling-profiler bridge: per-(subsystem, state) sample counters
+    # into profile_samples_total from per-registry watermarks
+    libprofile.sample(m)
     bud = budget()
     if bud["heights"]:
         last_stages = bud["heights"][-1]["stages"]

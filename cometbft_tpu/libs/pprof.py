@@ -7,6 +7,10 @@ Endpoints (all GET, plain text or JSON):
   /debug/pprof/            index
   /debug/pprof/goroutine   every thread's stack (goroutine dump analog)
   /debug/pprof/heap        tracemalloc top allocations (heap profile)
+  /debug/pprof/profile     sampling profiler (libs/profile): ?seconds=N
+                           captures a live window; without it the
+                           recent-sample ring is served (collapsed
+                           stacks, or &format=json)
   /debug/jax/start_trace?dir=PATH   start a JAX profiler trace (TensorBoard
                                     format) capturing kernel launches
   /debug/jax/stop_trace             stop it
@@ -37,8 +41,11 @@ def thread_dump() -> str:
     """All live threads' stacks — the goroutine-dump analog.
 
     Each header also names the lock the thread is currently blocked on
-    (and for how long), from libs/sync's blocked-on registry, so a
-    bundle's threads.txt answers "who is waiting on whom" without
+    (and for how long), from libs/sync's blocked-on registry, plus the
+    thread's subsystem attribution — resolved by the SAME resolver the
+    sampling profiler uses (libs/profile.subsystem_for), so stack dumps
+    and profiles attribute threads identically — so a bundle's
+    threads.txt answers "who is waiting on whom" without
     cross-referencing /debug/contention."""
     import time
 
@@ -49,10 +56,19 @@ def thread_dump() -> str:
         held = libsync.held_locks_snapshot()
     except Exception:
         held = {}
+    try:
+        from . import profile as libprofile
+
+        resolve = libprofile.subsystem_for
+    except Exception:
+        def resolve(tid, name, frame=None):
+            return "?"
     now = time.monotonic_ns()
     out = io.StringIO()
     for tid, frame in sys._current_frames().items():
-        out.write(f"--- thread {tid} ({names.get(tid, '?')}) ---\n")
+        name = names.get(tid, "?")
+        sub = resolve(tid, name if name != "?" else "", frame)
+        out.write(f"--- thread {tid} ({name}) [{sub}] ---\n")
         info = held.get(tid)
         if info:
             if info.get("held"):
@@ -154,6 +170,10 @@ def stop_jax_trace() -> str:
 ROUTE_DOCS: dict[str, str] = {
     "/debug/pprof/goroutine": "thread stacks",
     "/debug/pprof/heap": "rss + tracemalloc snapshot",
+    "/debug/pprof/profile": (
+        "?seconds=N  sampling profile window (collapsed stacks; "
+        "&format=json; no seconds serves the recent-sample ring)"
+    ),
     "/debug/heap/start": "enable tracemalloc",
     "/debug/heap/stop": "disable tracemalloc",
     "/debug/jax/start_trace": "?dir=PATH  start a JAX profiler trace",
@@ -220,6 +240,15 @@ class PprofServer(HTTPService):
 
         def heap(q):
             return heap_dump(int(q.get("top", ["40"])[0]))
+
+        def profile(q):
+            from . import profile as libprofile
+
+            secs = q.get("seconds")
+            fmt = q.get("format", ["collapsed"])[0]
+            return libprofile.profile_window(
+                float(secs[0]) if secs else 0.0, fmt
+            )
 
         def heap_on(q):
             return heap_start()
@@ -335,6 +364,7 @@ class PprofServer(HTTPService):
             "/debug/pprof": index,
             "/debug/pprof/goroutine": goroutine,
             "/debug/pprof/heap": heap,
+            "/debug/pprof/profile": profile,
             "/debug/heap/start": heap_on,
             "/debug/heap/stop": heap_off,
             "/debug/jax/start_trace": jax_start,

@@ -1,0 +1,889 @@
+"""Continuous sampling profiler plane: on-CPU/off-CPU flame data with
+subsystem attribution (reference: the net/http/pprof CPU profile the Go
+node ships as a first-class operator tool — node/node.go:651-664 — here
+rebuilt for a GIL-bound Python engine where *which subsystem holds the
+interpreter* and *which lock a thread is parked on* are the questions).
+
+A sampler thread (``prof-sampler``) walks ``sys._current_frames()`` at
+``COMETBFT_TPU_PROF_HZ`` (default ~67 Hz, off the round numbers so the
+sampler never phase-locks with 10 ms/100 ms engine timers) and folds
+every thread's stack into an interned frame table.  Each sample carries:
+
+* a **subsystem** — resolved from the engine's stable thread names
+  (``cs-receive`` → consensus, ``mconn-send`` → p2p, ``verify-coalescer``
+  → coalescer, ...) with a frame-module fallback for unnamed threads,
+  the same resolver ``/debug/pprof/goroutine`` uses for its dump rows;
+* a **state** — ``on_cpu`` vs ``blocked``, where blocked is classified
+  by (a) libs/sync's per-thread blocked-on registry (a contended
+  ``Mutex.acquire`` names the registered lock → ``lock:<name>``), then
+  (b) a leaf-frame wait-site registry: ``threading.Condition/Event``
+  waits resolve through their caller (coalescer ticket waits, hash-plane
+  tickets, executor condition loops), ``selectors``/socket receives,
+  ``queue.get``, and the WAL fsync — so off-CPU samples name *which
+  lock or queue* a thread was parked on, not just "blocked".
+
+Surfaces (the house plane pattern throughout):
+
+* ``/debug/pprof/profile?seconds=N`` — flamegraph-compatible collapsed
+  stacks (``subsystem;state[;wait];root;...;leaf N``) or ``&format=json``;
+  without ``seconds`` it serves the bounded recent-sample ring, which is
+  how watchdog black-box bundles and ``cometbft-tpu debug dump`` capture
+  ``profile.json`` covering the seconds *before* a trip.
+* ``profile_samples_total{subsystem,state}`` counters, bridged at scrape
+  from lock-free columns by :func:`sample` (libs/health.sample calls it
+  next to the txtrace/devledger/lockprof bridges).
+* EV_PROF flight-ring rows (~1/s per active subsystem) feeding
+  ``health.critical_path()`` — a commit window gated by GIL-bound Python
+  says ``cpu:<subsystem>`` — and the ``cpu_saturated`` postmortem
+  detector (cometbft_tpu/postmortem/attribute.py).
+* :func:`module_shares` — the simnet ``--profile`` report splitting a
+  scenario run's wall time into scheduler vs verify vs engine, the
+  measurement the parallel-DES ROADMAP item needs.
+
+Like every plane: ``COMETBFT_TPU_PROF`` kill switch (0 pins off, 1 pins
+on, default auto — on while an acquirer holds it), devstats-style
+``acquire()``/``release()`` refcount with leak-safe node-boot unwind,
+an allocation-free *disabled* path (no sampler thread exists, the
+record-free module touches nothing — pinned by the tracemalloc guard in
+tests/test_observability.py; the *enabled* sampler may allocate while
+interning, and attributes that cost to its own ``sampler`` subsystem),
+and one mutex (``libs.profile._mtx``) that serializes only setup paths
+(enable/disable/refcount), never a sample, registered in lockorder.json
+and asserted edge-free in tests/test_lint_graph.py.
+
+Known limitation (documented in docs/observability.md): a thread inside
+a C call that leaves no Python frame (``time.sleep``, a builtin socket
+recv whose caller is not in the wait-site registry) samples as on-CPU at
+its caller's leaf frame — the registry names the engine's known wait
+sites, not every stdlib sleep.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+import time
+from array import array
+
+from . import sync as libsync
+
+# NOTE: this module imports NOTHING from the health layer at module
+# level — libs/health imports it for EV_PROF decode and the scrape
+# bridge, so the one upward call (EV_PROF ring emission) lazily imports
+# health on the once-per-second flush path only (the lockprof posture).
+
+_ENV = "COMETBFT_TPU_PROF"
+_ENV_HZ = "COMETBFT_TPU_PROF_HZ"
+_ENV_RING = "COMETBFT_TPU_PROF_RING"
+
+_ON_VALUES = ("1", "on", "true", "yes")
+_OFF_VALUES = ("0", "off", "false", "no")
+
+# ~67 Hz: high enough that a 100 ms commit window holds ~7 samples,
+# low enough that the walk (~tens of µs across ~20 threads) stays well
+# under the <1% overhead headline; deliberately off 50/60/100 Hz so the
+# sampler never aliases against engine timers ticking at round rates.
+DEFAULT_HZ = 67.0
+# recent-sample ring capacity (samples, all threads pooled): 32768
+# samples at ~67 Hz x ~15 threads is ~30 s of history — the "seconds
+# before the trip" a watchdog bundle wants
+DEFAULT_RING = 1 << 15
+_MAX_DEPTH = 64  # frames walked per stack
+_LEAF_PROBE = 6  # leaf frames examined by the wait-site classifier
+_MAX_FRAMES = 16384  # interned frame-label cap (overflow -> slot 0)
+_MAX_STACKS = 32768  # interned stack cap
+_MAX_WAITS = 512  # interned wait-site cap
+_FLUSH_NS = 1_000_000_000  # EV_PROF window flush cadence
+
+# -- subsystem vocabulary (indexes are the EV_PROF round-column payload
+# and the metric label set; bounded, never caller input) ---------------
+SUBSYSTEMS = (
+    "unknown",  # 0: no name rule and no engine frame matched
+    "consensus",  # FSM + gossip routines + timeout ticker
+    "p2p",  # mconn send/recv, switch, pex, suspicion
+    "mempool",
+    "coalescer",  # verify-coalescer executor + readback
+    "hashplane",
+    "light",
+    "blocksync",
+    "rpc",
+    "statesync",
+    "abci",
+    "privval",
+    "health",  # health monitor, postmortem peer fetch
+    "trace",  # trace file sink
+    "load",  # load-generator threads (bench/simnet drivers)
+    "simnet",
+    "main",  # MainThread (CLI, tests, bench drivers)
+    "sampler",  # the profiler's own thread: its overhead is visible
+    "other",  # a live thread the engine doesn't own
+)
+_SUB_IDS = {name: i for i, name in enumerate(SUBSYSTEMS)}
+_SUB_SAMPLER = _SUB_IDS["sampler"]
+
+STATES = ("on_cpu", "blocked")
+
+# thread-name prefix -> subsystem (first match wins; the engine's
+# thread names are stable service names, the same seam the lock
+# registry and the goroutine dump lean on)
+_NAME_PREFIXES = (
+    ("prof-sampler", "sampler"),
+    ("cs-", "consensus"),
+    ("timeout-ticker", "consensus"),
+    ("prestage-", "consensus"),
+    ("gossip-", "consensus"),
+    ("mconn-", "p2p"),
+    ("switch-", "p2p"),
+    ("pex-", "p2p"),
+    ("p2p-", "p2p"),
+    ("peer-", "p2p"),
+    ("relay-", "p2p"),
+    ("mempool", "mempool"),
+    ("verify-", "coalescer"),
+    ("hash-", "hashplane"),
+    ("light-", "light"),
+    ("blocksync-", "blocksync"),
+    ("rpc-", "rpc"),
+    ("statesync", "statesync"),
+    ("abci-", "abci"),
+    ("privval-", "privval"),
+    ("health-", "health"),
+    ("pm-fetch-", "health"),
+    ("trace-sink", "trace"),
+    ("load-", "load"),
+    ("sim-", "simnet"),
+    ("MainThread", "main"),
+)
+_NAME_SUFFIXES = (("-http", "rpc"),)  # "{node}-http" RPC listeners
+
+# frame-path fragment -> subsystem, leaf-first fallback for threads the
+# name rules don't know (pytest workers, bare threading.Thread targets)
+_FRAME_SUBSYSTEMS = (
+    ("cometbft_tpu/crypto/coalesce", "coalescer"),
+    ("cometbft_tpu/crypto/hashplane", "hashplane"),
+    ("cometbft_tpu/consensus/", "consensus"),
+    ("cometbft_tpu/p2p/", "p2p"),
+    ("cometbft_tpu/mempool", "mempool"),
+    ("cometbft_tpu/light/", "light"),
+    ("cometbft_tpu/blocksync/", "blocksync"),
+    ("cometbft_tpu/rpc/", "rpc"),
+    ("cometbft_tpu/statesync/", "statesync"),
+    ("cometbft_tpu/abci/", "abci"),
+    ("cometbft_tpu/privval/", "privval"),
+    ("cometbft_tpu/simnet/", "simnet"),
+    ("cometbft_tpu/libs/health", "health"),
+)
+
+# (caller-file suffix, caller func or None=any) -> wait-site name, for
+# blocked samples whose leaf is a stdlib Condition/Event wait: the
+# CALLER names the queue.  Order matters (specific before catch-all).
+_WAIT_CALLERS = (
+    ("crypto/coalesce.py", "result", "coalesce.ticket"),
+    ("crypto/coalesce.py", None, "coalesce.executor"),
+    ("crypto/hashplane.py", "result", "hash.ticket"),
+    ("crypto/hashplane.py", None, "hash.executor"),
+    ("libs/clist.py", None, "clist.wait"),
+    ("libs/service.py", None, "service.wait"),
+)
+
+
+def _env_mode() -> str:
+    v = os.environ.get(_ENV, "").lower()
+    if v in _ON_VALUES:
+        return "on"
+    if v in _OFF_VALUES:
+        return "off"
+    return "auto"
+
+
+def _hz_from_env() -> float:
+    try:
+        hz = float(os.environ.get(_ENV_HZ, ""))
+    except ValueError:
+        return DEFAULT_HZ
+    return min(1000.0, max(1.0, hz))
+
+
+def _ring_from_env() -> int:
+    try:
+        n = int(os.environ.get(_ENV_RING, ""))
+    except ValueError:
+        return DEFAULT_RING
+    return max(256, n)
+
+
+# ------------------------------------------------------- intern tables
+#
+# Written ONLY by the sampler thread; readers index append-only lists,
+# so a GIL-consistent racy read sees a prefix, never a torn entry.
+
+_frames: list[str] = ["?"]  # idx -> "module.path:func" (0 = overflow)
+# keyed by id(code), NOT the code object: code hashing re-hashes the
+# bytecode on every lookup (~160ns); an id key is a pointer hash.  The
+# id stays valid because _frame_objs pins every interned code object.
+_frame_ids: dict = {}  # id(code object) -> idx
+_frame_objs: list = [None]  # idx -> code object (strong ref, pins ids)
+_frame_meta: list[tuple] = [("", "")]  # idx -> (co_filename, co_name)
+_stacks: list[tuple] = [()]  # idx -> frame-idx tuple, LEAF first
+_stack_ids: dict = {(): 0}
+_waits: list[str] = [""]  # idx -> wait-site name (0 = none / on-CPU)
+_wait_ids: dict = {"": 0}
+# sid -> (wait site | None, file-fallback subsystem name): both are pure
+# functions of the interned stack, so the sampler classifies each
+# distinct stack once and the warm tick is a single dict hit per thread
+_stack_info: dict = {}
+# thread name -> subsystem name | None (the rule scan, memoized)
+_name_subs: dict = {}
+
+
+def _frame_label(code) -> str:
+    fn = code.co_filename.replace("\\", "/")
+    i = fn.rfind("cometbft_tpu/")
+    if i >= 0:
+        mod = fn[i:]
+    else:
+        mod = fn.rsplit("/", 1)[-1]
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    return f"{mod.replace('/', '.')}:{code.co_name}"
+
+
+def _intern_frame(code) -> int:
+    idx = _frame_ids.get(id(code))
+    if idx is None:
+        if len(_frames) >= _MAX_FRAMES:
+            return 0
+        idx = len(_frames)
+        _frames.append(_frame_label(code))
+        _frame_objs.append(code)
+        _frame_meta.append((code.co_filename, code.co_name))
+        _frame_ids[id(code)] = idx
+    return idx
+
+
+def _intern_stack(t: tuple) -> int:
+    idx = _stack_ids.get(t)
+    if idx is None:
+        if len(_stacks) >= _MAX_STACKS:
+            return 0
+        idx = len(_stacks)
+        _stacks.append(t)
+        _stack_ids[t] = idx
+    return idx
+
+
+def _intern_wait(name: str) -> int:
+    idx = _wait_ids.get(name)
+    if idx is None:
+        if len(_waits) >= _MAX_WAITS:
+            return 0
+        idx = len(_waits)
+        _waits.append(name)
+        _wait_ids[name] = idx
+    return idx
+
+
+# ------------------------------------------------- subsystem resolution
+
+
+def _subsystem_from_name(name: str) -> str | None:
+    for prefix, sub in _NAME_PREFIXES:
+        if name.startswith(prefix):
+            return sub
+    for suffix, sub in _NAME_SUFFIXES:
+        if name.endswith(suffix):
+            return sub
+    return None
+
+
+def _subsystem_from_files(files) -> str | None:
+    """Leaf-first scan of frame file paths for an engine module."""
+    for fn in files:
+        fn = fn.replace("\\", "/")
+        for frag, sub in _FRAME_SUBSYSTEMS:
+            if frag in fn:
+                return sub
+        if "cometbft_tpu/" in fn:
+            # engine code outside the named packages (libs, types, ...)
+            # inherits nothing from the path — keep scanning callers
+            continue
+    return None
+
+
+def subsystem_for(tid: int, name: str, frame=None) -> str:
+    """The shared thread->subsystem resolver: thread-name rules first,
+    then the frame-module fallback when ``frame`` (the thread's current
+    frame) is supplied.  ``/debug/pprof/goroutine`` rows and profiler
+    samples attribute threads through this one function."""
+    sub = _subsystem_from_name(name)
+    if sub is not None:
+        return sub
+    if frame is not None:
+        files = []
+        f, depth = frame, 0
+        while f is not None and depth < _MAX_DEPTH:
+            files.append(f.f_code.co_filename)
+            f = f.f_back
+            depth += 1
+        sub = _subsystem_from_files(files)
+        if sub is not None:
+            return sub
+        if files:
+            return "other"
+    return "unknown"
+
+
+def subsystem_name(idx: int) -> str:
+    """Decode an EV_PROF round-column subsystem index (libs/health)."""
+    return SUBSYSTEMS[idx] if 0 <= idx < len(SUBSYSTEMS) else "?"
+
+
+def wait_name(idx: int) -> str:
+    waits = _waits
+    return waits[idx] if 0 <= idx < len(waits) else "?"
+
+
+# --------------------------------------------------- wait-site registry
+
+
+def _classify_wait(leaf) -> str | None:
+    """Name the wait site from the leaf ``(filename, funcname)`` pairs
+    of a blocked-looking stack, or None for on-CPU.  The libs/sync
+    blocked-on registry is consulted FIRST by the sampler (it names the
+    registered lock exactly); this covers the non-Mutex parks."""
+    for i, (fn, func) in enumerate(leaf):
+        fn = fn.replace("\\", "/")
+        if fn.endswith("threading.py") and func == "wait":
+            # a Condition/Event park: the nearest non-threading caller
+            # names the queue
+            for fn2, func2 in leaf[i + 1:]:
+                fn2 = fn2.replace("\\", "/")
+                if fn2.endswith("threading.py"):
+                    continue
+                for suffix, fname, site in _WAIT_CALLERS:
+                    if fn2.endswith(suffix) and (
+                        fname is None or fname == func2
+                    ):
+                        return site
+                mod = fn2.rsplit("/", 1)[-1]
+                return f"cond:{mod[:-3] if mod.endswith('.py') else mod}"
+            return "cond:?"
+        if fn.endswith("selectors.py") and func == "select":
+            return "socket.select"
+        if fn.endswith("socketserver.py"):
+            return "socket.accept"
+        if fn.endswith("queue.py") and func == "get":
+            return "queue.get"
+        if fn.endswith("consensus/wal.py") and func == "sync":
+            return "wal.fsync"
+        if "/p2p/" in fn and (
+            "recv" in func or "read" in func or func == "accept"
+        ):
+            return "socket.recv"
+    return None
+
+
+# --------------------------------------------------------- sample store
+
+
+class _Tables:
+    """Preallocated sample columns: the bounded recent-sample ring plus
+    the per-(subsystem, state) counter vector the scrape bridge reads.
+    Lock-free single-writer (the sampler); readers tolerate one torn
+    in-flight row via the publish-last stack column (-1 = in progress),
+    the flight-recorder discipline."""
+
+    __slots__ = (
+        "gen", "capacity", "ts", "tid", "stack", "sub", "state",
+        "wait", "seq", "written", "counts",
+    )
+
+    _GEN = itertools.count(1)
+
+    def __init__(self, capacity: int):
+        self.gen = next(self._GEN)
+        self.capacity = max(256, int(capacity))
+        zeros = [0] * self.capacity
+        self.ts = array("q", zeros)
+        self.tid = array("q", zeros)
+        self.stack = array("q", [-1] * self.capacity)
+        self.sub = array("q", zeros)
+        self.state = array("q", zeros)
+        self.wait = array("q", zeros)
+        self.seq = itertools.count()
+        self.written = array("q", [0])
+        self.counts = array("q", [0] * (len(SUBSYSTEMS) * 2))
+
+    def write(self, ts, tid, sid, sub, state, wid) -> None:
+        seq = next(self.seq)
+        i = seq % self.capacity
+        self.stack[i] = -1  # mark in-progress: readers skip torn rows
+        self.ts[i] = ts
+        self.tid[i] = tid
+        self.sub[i] = sub
+        self.state[i] = state
+        self.wait[i] = wid
+        self.stack[i] = sid  # publish last
+        if seq >= self.written[0]:
+            self.written[0] = seq + 1
+        self.counts[sub * 2 + state] += 1
+
+    def rows(self, since_ns: int = 0):
+        """(ts, tid, stack_id, sub, state, wait_id) oldest-first over
+        the filled window, skipping torn rows."""
+        w = self.written[0]
+        n = min(w, self.capacity)
+        for k in range(w - n, w):
+            i = k % self.capacity
+            sid = self.stack[i]
+            if sid < 0 or self.ts[i] < since_ns:
+                continue
+            yield (
+                self.ts[i], self.tid[i], sid,
+                self.sub[i], self.state[i], self.wait[i],
+            )
+
+    def status(self) -> dict:
+        return {"capacity": self.capacity, "recorded": self.written[0]}
+
+
+_T = _Tables(_ring_from_env())
+
+# cumulative (stack_id, sub, state, wait_id) -> samples; sampler-thread
+# writes, snapshot readers copy under the GIL (dict(d) is one C-level
+# copy, safe against a concurrent writer)
+_agg: dict = {}
+
+_mode = _env_mode()
+_acquirers = 0
+_hz = _hz_from_env()
+_sampler = None  # the running _SamplerThread, None while disabled
+
+# setup paths only (enable/disable/refcount + sampler lifecycle); the
+# sample path and every snapshot reader are lock-free — asserted
+# edge-free in tests/test_lint_graph.py like the other plane mutexes
+_mtx = libsync.Mutex("libs.profile._mtx")
+
+
+# ------------------------------------------------------------- sampler
+
+
+class _SamplerThread(threading.Thread):
+    def __init__(self, hz: float):
+        super().__init__(name="prof-sampler", daemon=True)
+        self.period_ns = int(1e9 / hz)
+        self._stop_ev = threading.Event()
+        # EV_PROF window accumulator: per-(sub, state) samples since
+        # the last once-per-second ring flush
+        self._win = [0] * (len(SUBSYSTEMS) * 2)
+        self._last_flush = time.monotonic_ns()
+        # tid -> thread name, refreshed lazily: on a tid we have not
+        # seen (new thread) and at every 1 s flush (drops dead tids)
+        self._names: dict = {}
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+
+    def run(self) -> None:
+        interval = self.period_ns / 1e9
+        while not self._stop_ev.wait(interval):
+            try:
+                self._tick()
+            except Exception:
+                # a sampler crash must never take the node with it
+                pass
+        # flush the tail window so short profiled runs still emit rows
+        try:
+            self._flush(time.monotonic_ns())
+        except Exception:
+            pass
+
+    def _tick(self) -> None:
+        t = _T
+        me = threading.get_ident()
+        now = time.time_ns()
+        names = self._names
+        blocked = libsync._all_blocked
+        win = self._win
+        frame_ids = _frame_ids
+        stack_ids = _stack_ids
+        stack_info = _stack_info
+        name_subs = _name_subs
+        wait_ids = _wait_ids
+        agg = _agg
+        meta = _frame_meta
+        for tid, frame in sys._current_frames().items():
+            fids = []
+            append = fids.append
+            f, depth = frame, 0
+            while f is not None and depth < _MAX_DEPTH:
+                code = f.f_code
+                idx = frame_ids.get(id(code))
+                if idx is None:
+                    idx = _intern_frame(code)
+                append(idx)
+                f = f.f_back
+                depth += 1
+            key = tuple(fids)
+            sid = stack_ids.get(key)
+            if sid is None:
+                sid = _intern_stack(key)
+            info = stack_info.get(sid) if sid else None
+            if info is None:
+                # first sight of this stack: classify the wait site and
+                # the frame-module fallback once, from the interned
+                # frame metadata (never re-walk live frame objects)
+                leaf = [meta[i] for i in fids[:_LEAF_PROBE]]
+                files = [meta[i][0] for i in fids]
+                info = (
+                    _classify_wait(leaf),
+                    _subsystem_from_files(files)
+                    or ("other" if files else "unknown"),
+                )
+                if sid:
+                    stack_info[sid] = info
+            wait_site, files_sub = info
+            if tid == me:
+                sub = _SUB_SAMPLER
+            else:
+                nm = names.get(tid)
+                if nm is None:
+                    names = self._names = {
+                        th.ident: th.name for th in threading.enumerate()
+                    }
+                    nm = names.get(tid, "")
+                try:
+                    subname = name_subs[nm]
+                except KeyError:
+                    subname = _subsystem_from_name(nm)
+                    if len(name_subs) < 4096:
+                        name_subs[nm] = subname
+                if subname is None:
+                    subname = files_sub
+                sub = _SUB_IDS[subname]
+            cell = blocked.get(tid)
+            if cell is not None and cell[0] is not None:
+                wait = "lock:" + cell[0]
+            else:
+                wait = wait_site
+            if wait is not None:
+                state = 1
+                wid = wait_ids.get(wait)
+                if wid is None:
+                    wid = _intern_wait(wait)
+            else:
+                state, wid = 0, 0
+            t.write(now, tid, sid, sub, state, wid)
+            akey = (sid, sub, state, wid)
+            agg[akey] = agg.get(akey, 0) + 1
+            win[sub * 2 + state] += 1
+        mono = time.monotonic_ns()
+        if mono - self._last_flush >= _FLUSH_NS:
+            self._flush(mono)
+
+    def _flush(self, mono: int) -> None:
+        """Emit one EV_PROF flight-ring row per subsystem that sampled
+        in the window: r = subsystem index, a = estimated on-CPU ns
+        (on-CPU samples x the sampling period), b = total samples."""
+        self._names = {th.ident: th.name for th in threading.enumerate()}
+        win = self._win
+        if not any(win):
+            self._last_flush = mono
+            return
+        from . import health  # lazy: health imports this module at top
+
+        if health.enabled():
+            for sub in range(len(SUBSYSTEMS)):
+                on, bl = win[sub * 2], win[sub * 2 + 1]
+                if on or bl:
+                    health.record(
+                        health.EV_PROF, 0, sub,
+                        on * self.period_ns, on + bl,
+                    )
+        for i in range(len(win)):
+            win[i] = 0
+        self._last_flush = mono
+
+
+# ------------------------------------------------------ plane lifecycle
+
+
+def enabled() -> bool:
+    """Whether the sampler thread is live."""
+    s = _sampler
+    return s is not None and s.is_alive()
+
+
+def _start_locked() -> None:
+    global _sampler
+    if _sampler is None or not _sampler.is_alive():
+        _sampler = _SamplerThread(_hz)
+        _sampler.start()
+
+
+def _stop_locked() -> None:
+    global _sampler
+    s, _sampler = _sampler, None
+    if s is not None:
+        s.stop()
+        s.join(timeout=2.0)
+
+
+def enable(hz: float | None = None) -> None:
+    """Force the sampler on (tests, bench, the endpoint's live window).
+    ``hz`` overrides the sampling rate for the new sampler."""
+    global _hz
+    if _env_mode() == "off":
+        return
+    with _mtx:
+        if hz is not None and hz != _hz:
+            _hz = min(1000.0, max(1.0, float(hz)))
+            _stop_locked()
+        _start_locked()
+
+
+def disable() -> None:
+    with _mtx:
+        _stop_locked()
+
+
+def acquire() -> None:
+    """Reference-counted enable for node lifecycles (the devstats
+    pattern): every booting node acquires, so the sampler runs exactly
+    while a node does — unless ``COMETBFT_TPU_PROF=0`` pins it off."""
+    global _acquirers
+    if _env_mode() == "off":
+        return
+    with _mtx:
+        _acquirers += 1
+        _start_locked()
+
+
+def release() -> None:
+    global _acquirers
+    with _mtx:
+        _acquirers = max(0, _acquirers - 1)
+        if _acquirers == 0 and _env_mode() != "on":
+            _stop_locked()
+
+
+def reset(capacity: int | None = None) -> None:
+    """Drop buffered samples and aggregates (tests, bench windows)."""
+    global _T
+    with _mtx:
+        _T = _Tables(capacity if capacity is not None else _T.capacity)
+        _agg.clear()
+
+
+def status() -> dict:
+    return {
+        "enabled": enabled(),
+        "mode": _env_mode(),
+        "hz": _hz,
+        "acquirers": _acquirers,
+        "ring": _T.status(),
+        "frames": len(_frames),
+        "stacks": len(_stacks),
+        "wait_sites": len(_waits),
+    }
+
+
+# ---------------------------------------------------------- aggregates
+
+
+def snapshot_agg() -> dict:
+    """A point-in-time copy of the cumulative aggregate: (stack_id,
+    sub, state, wait_id) -> samples.  Two snapshots subtract into a
+    window (the ``?seconds=N`` endpoint's delta)."""
+    return dict(_agg)
+
+
+def delta_agg(before: dict, after: dict) -> dict:
+    out = {}
+    for k, v in after.items():
+        d = v - before.get(k, 0)
+        if d > 0:
+            out[k] = d
+    return out
+
+
+def collapsed(agg: dict | None = None) -> str:
+    """Flamegraph-compatible collapsed stacks, one line per distinct
+    (subsystem, state, wait, stack): ``sub;state[;wait];root;..;leaf N``
+    — pipe into flamegraph.pl or paste into speedscope as-is."""
+    if agg is None:
+        agg = snapshot_agg()
+    frames, stacks, waits = _frames, _stacks, _waits
+    lines = []
+    for (sid, sub, state, wid), n in sorted(agg.items()):
+        parts = [subsystem_name(sub), STATES[state & 1]]
+        if wid:
+            parts.append(waits[wid] if wid < len(waits) else "?")
+        st = stacks[sid] if sid < len(stacks) else ()
+        parts.extend(frames[f] if f < len(frames) else "?" for f in reversed(st))
+        lines.append(";".join(parts) + f" {n}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def profile_dict(agg: dict | None = None) -> dict:
+    """The JSON shape of a profile window (the ``&format=json`` body
+    and the bundle's ``profile.json`` core): per-(subsystem, state)
+    totals plus every distinct stack with its attribution."""
+    if agg is None:
+        agg = snapshot_agg()
+    frames, stacks, waits = _frames, _stacks, _waits
+    subs: dict = {}
+    out_stacks = []
+    for (sid, sub, state, wid), n in sorted(agg.items()):
+        sname = subsystem_name(sub)
+        st = subs.setdefault(sname, {"on_cpu": 0, "blocked": 0})
+        st[STATES[state & 1]] += n
+        stk = stacks[sid] if sid < len(stacks) else ()
+        out_stacks.append({
+            "subsystem": sname,
+            "state": STATES[state & 1],
+            "wait": (waits[wid] if wid < len(waits) else "?") if wid else None,
+            "samples": n,
+            "stack": [
+                frames[f] if f < len(frames) else "?"
+                for f in reversed(stk)
+            ],
+        })
+    return {
+        "schema": 1,
+        "hz": _hz,
+        "samples": sum(agg.values()),
+        "subsystems": dict(sorted(subs.items())),
+        "stacks": out_stacks,
+    }
+
+
+def recent(last_s: float = 30.0) -> dict:
+    """Aggregate the recent-sample ring's last ``last_s`` seconds — the
+    pre-trip view watchdog bundles and ``debug dump`` capture."""
+    since = time.time_ns() - int(last_s * 1e9)
+    agg: dict = {}
+    for ts, _tid, sid, sub, state, wid in _T.rows(since):
+        key = (sid, sub, state, wid)
+        agg[key] = agg.get(key, 0) + 1
+    out = profile_dict(agg)
+    out["window_s"] = last_s
+    return out
+
+
+def bundle_snapshot(last_s: float = 30.0) -> dict:
+    """The ``profile.json`` black-box artifact: plane status + the
+    ring's pre-trip window in both JSON and collapsed form."""
+    since = time.time_ns() - int(last_s * 1e9)
+    agg: dict = {}
+    for ts, _tid, sid, sub, state, wid in _T.rows(since):
+        key = (sid, sub, state, wid)
+        agg[key] = agg.get(key, 0) + 1
+    out = profile_dict(agg)
+    out["window_s"] = last_s
+    return {
+        "status": status(),
+        "recent": out,
+        "collapsed": collapsed(agg),
+    }
+
+
+def profile_window(seconds: float, fmt: str = "collapsed") -> str:
+    """The ``/debug/pprof/profile`` body.  ``seconds > 0`` holds an
+    acquire (so the sampler runs even on a node with the plane idle),
+    sleeps, and returns the window's delta; ``seconds <= 0`` serves the
+    recent-sample ring without waiting — the pre-trip path bundles and
+    ``debug dump`` use."""
+    import json as _json
+
+    if seconds > 0:
+        if _env_mode() == "off":
+            return f"profiler pinned off ({_ENV}=0)\n"
+        seconds = min(60.0, seconds)
+        acquire()
+        try:
+            before = snapshot_agg()
+            time.sleep(seconds)
+            agg = delta_agg(before, snapshot_agg())
+        finally:
+            release()
+        if fmt == "json":
+            out = profile_dict(agg)
+            out["window_s"] = seconds
+            return _json.dumps(out, default=str)
+        return collapsed(agg)
+    if fmt == "json":
+        return _json.dumps(recent(), default=str)
+    return collapsed()
+
+
+# ------------------------------------------------------- scrape bridge
+
+
+def sample(metrics=None) -> None:
+    """Bridge the per-(subsystem, state) sample counters into
+    ``profile_samples_total`` from a per-registry watermark — pull-time
+    work on the scrape path, zero cost on the sample path (the
+    txtrace/lockprof bridge pattern; libs/health.sample calls this)."""
+    if metrics is not None:
+        m = metrics
+    else:
+        from . import metrics as libmetrics
+
+        m = libmetrics.node_metrics()
+    fam = getattr(m, "profile_samples", None)
+    if fam is None:
+        return
+    t = _T
+    wm = getattr(m, "_profile_wm", None)
+    if wm is None or wm["gen"] != t.gen:
+        wm = m._profile_wm = {
+            "gen": t.gen, "counts": [0] * len(t.counts),
+        }
+    counts = wm["counts"]
+    for i in range(len(t.counts)):
+        v = t.counts[i]
+        d = v - counts[i]
+        if d > 0:
+            fam.labels(SUBSYSTEMS[i // 2], STATES[i % 2]).inc(d)
+        counts[i] = v
+
+
+# ------------------------------------------------- simnet module shares
+
+
+def module_shares(agg: dict) -> dict:
+    """Split a window's samples into scheduler vs verify vs engine wall
+    shares by frame module — the simnet ``--profile`` report.  A simnet
+    run executes on ONE scheduler thread, so thread attribution is
+    useless there; the leaf-most classifiable frame says whose code the
+    interpreter was actually in."""
+    frames, stacks = _frames, _stacks
+    totals = {"scheduler": 0, "verify": 0, "engine": 0, "other": 0}
+    for (sid, _sub, _state, _wid), n in agg.items():
+        bucket = "other"
+        st = stacks[sid] if sid < len(stacks) else ()
+        for f in st:  # leaf first
+            label = frames[f] if f < len(frames) else "?"
+            if label.startswith((
+                "cometbft_tpu.crypto.", "cometbft_tpu.ops.",
+            )):
+                bucket = "verify"
+                break
+            if label.startswith("cometbft_tpu.simnet"):
+                bucket = "scheduler"
+                break
+            if label.startswith("cometbft_tpu."):
+                bucket = "engine"
+                break
+        totals[bucket] += n
+    total = sum(totals.values())
+    return {
+        "samples": total,
+        "shares": {
+            k: round(v / total, 4) if total else 0.0
+            for k, v in totals.items()
+        },
+    }

@@ -656,6 +656,7 @@ class Node(BaseService):
         from ..libs import devledger as libdevledger
         from ..libs import lockprof as liblockprof
         from ..libs import netstats as libnetstats
+        from ..libs import profile as libprofile
         from ..libs import txtrace as libtxtrace
 
         libnetstats.acquire()
@@ -673,6 +674,11 @@ class Node(BaseService):
         # feeding lock_wait_seconds{lock}, /debug/contention and the
         # lock_contended watchdog
         liblockprof.acquire()
+        # sampling profiler (kill switch COMETBFT_TPU_PROF=0): the
+        # prof-sampler thread walks stacks at ~67 Hz exactly while a
+        # node runs, feeding /debug/pprof/profile, the profile.json
+        # bundle artifact and the cpu:<subsystem> critical-path gate
+        libprofile.acquire()
         libtxtrace.register_mempool(self.mempool)
         try:
             if self.pprof_server is not None:
@@ -745,9 +751,10 @@ class Node(BaseService):
                 raise
         except BaseException:
             # ANY boot failure: release the netstats + ledger + tx-plane
-            # + lockprof acquires (on_stop never runs on a half-booted
-            # node)
+            # + lockprof + profiler acquires (on_stop never runs on a
+            # half-booted node)
             libtxtrace.deregister_mempool(self.mempool)
+            libprofile.release()
             liblockprof.release()
             libtxtrace.release()
             libdevledger.release()
@@ -1006,13 +1013,15 @@ class Node(BaseService):
                 pass
         # after the switch (its peers deregister their stats blocks on
         # connection stop): release this node's netstats + device-time
-        # ledger + tx-plane + lock-profiler acquires
+        # ledger + tx-plane + lock-profiler + sampling-profiler acquires
         from ..libs import devledger as libdevledger
         from ..libs import lockprof as liblockprof
         from ..libs import netstats as libnetstats
+        from ..libs import profile as libprofile
         from ..libs import txtrace as libtxtrace
 
         libtxtrace.deregister_mempool(self.mempool)
+        libprofile.release()
         liblockprof.release()
         libtxtrace.release()
         libnetstats.release()

@@ -26,6 +26,10 @@ stack can actually see, and the ranked result is the **verdict**:
                         blocked on one engine mutex (libs/lockprof
                         EV_LOCK wait rows name the hot lock and the
                         blocking holder's acquire site)
+    cpu_saturated       one subsystem's GIL-bound Python burned most
+                        of the window's wall time (libs/profile
+                        EV_PROF sampling windows name the subsystem —
+                        the commit was compute-gated, not waiting)
 
 Scores live in [0, 1]; only findings at or above the report threshold
 make the verdict, so a healthy run yields **no verdict at all** — the
@@ -58,6 +62,7 @@ _BREAKER = "coalesce.breaker"
 _RECOMPILE = "xla.recompile"
 _FSYNC = "wal.fsync"
 _LOCK = "sync.lock"
+_PROF = "prof.window"
 _WATCHDOG = "health.watchdog"
 
 
@@ -483,6 +488,40 @@ def _window_findings(
                     "wait_ms": round(per_lock[hot] * 1e3, 3),
                     "window_share": round(frac, 4),
                     "waits": len(lock_waits),
+                },
+            ))
+
+    # -- CPU saturation (wall-domain rings only, like fsync/lock: the
+    # sampler's on-CPU estimate is wall-measured, so virtual merges
+    # drop EV_PROF rows): the sampling profiler's window rows sum
+    # per-subsystem on-CPU time; when one subsystem's GIL-bound Python
+    # consumed most of the window's wall clock, the commit was
+    # compute-gated — the verdict names the subsystem (the profiler's
+    # own sampler thread never counts)
+    prof_rows = [
+        a for a in anns
+        if a.get("event") == _PROF and a.get("subsystem") != "sampler"
+    ]
+    if prof_rows:
+        per_sub: dict[str, float] = {}
+        for a in prof_rows:
+            sub = a.get("subsystem", "?")
+            per_sub[sub] = per_sub.get(sub, 0.0) + (
+                a.get("oncpu_ns", 0) / 1e9
+            )
+        hot_sub = max(per_sub, key=lambda k: per_sub[k])
+        frac = per_sub[hot_sub] / dur_s
+        if frac > 0.6:
+            findings.append(Finding(
+                "cpu_saturated",
+                min(0.9, 1.2 * frac),
+                {
+                    "subsystem": hot_sub,
+                    "oncpu_ms": round(per_sub[hot_sub] * 1e3, 1),
+                    "window_share": round(frac, 4),
+                    "samples": sum(
+                        a.get("samples", 0) for a in prof_rows
+                    ),
                 },
             ))
 

@@ -44,6 +44,13 @@ def main(argv=None) -> int:
         help="print the cross-node timeline attribution table for the "
         "run (cometbft_tpu/postmortem)",
     )
+    ap.add_argument(
+        "--profile", action="store_true",
+        help="run the sampling profiler (libs/profile) across the "
+        "scenario and report scheduler-vs-verify-vs-engine wall "
+        "shares — a simnet run executes on one scheduler thread, so "
+        "shares are classified by frame module, not thread",
+    )
     args = ap.parse_args(argv)
     if args.list:
         for name in sorted(SCENARIOS):
@@ -52,8 +59,24 @@ def main(argv=None) -> int:
     kw = {}
     if args.nodes is not None:
         kw["n_nodes"] = args.nodes
-    result = run_scenario(args.scenario, args.seed, **kw)
-    print(json.dumps(result.summary(), default=str, indent=1))
+    before = None
+    if args.profile:
+        from ..libs import profile as libprofile
+
+        libprofile.acquire()
+        before = libprofile.snapshot_agg()
+    try:
+        result = run_scenario(args.scenario, args.seed, **kw)
+    finally:
+        if args.profile:
+            shares = libprofile.module_shares(
+                libprofile.delta_agg(before, libprofile.snapshot_agg())
+            )
+            libprofile.release()
+    summary = result.summary()
+    if args.profile:
+        summary["profile"] = shares
+    print(json.dumps(summary, default=str, indent=1))
     if args.postmortem and result.ring is not None:
         from ..postmortem import report_from_ring
 

@@ -420,14 +420,31 @@ class TestWatchdogUnits:
         assert len(os.listdir(tmp_path)) == 2
 
     def test_bundle_contents(self, health, tmp_path):
+        from cometbft_tpu.libs import profile as libprofile
+
         libhealth.record(libhealth.EV_STEP, 3, 0, 8)
         libhealth.record(libhealth.EV_COMMIT, 3, 0, 50_000_000)
-        path = libhealth.write_bundle(str(tmp_path), "unit-test")
+        # the profiler was sampling before the trip: the bundle must
+        # carry those pre-trip samples (the ring, not a fresh window)
+        libprofile.acquire()
+        try:
+            assert _wait_until(
+                lambda: libprofile.status()["ring"]["recorded"] > 0,
+                timeout=10,
+            ), "sampler took no samples"
+            path = libhealth.write_bundle(str(tmp_path), "unit-test")
+        finally:
+            libprofile.release()
         names = set(os.listdir(path))
         assert {
             "manifest.json", "flight.json", "devstats.json",
             "locks.json", "net.json", "threads.txt", "trace.json",
+            "profile.json",
         } <= names, names
+        prof = json.load(open(os.path.join(path, "profile.json")))
+        assert prof["status"]["ring"]["recorded"] > 0
+        assert prof["recent"]["samples"] > 0
+        assert "collapsed" in prof
         net = json.load(open(os.path.join(path, "net.json")))
         assert set(net) >= {
             "enabled", "stamping", "peers", "gossip_lag_p99_s",
@@ -834,6 +851,53 @@ class TestLockContention:
         assert agg["gates"] == {"lock:consensus.wal._mtx": 1}
         assert agg["heights"][0]["height"] == 5
         assert agg["coverage"] == pytest.approx(row["coverage"])
+
+    def test_critical_path_names_the_gating_cpu(self):
+        # a commit window whose dominant budget stage (gossip, 60ms) is
+        # dwarfed by GIL-bound Python in the FSM: the profiler's
+        # in-window flush says consensus burned 170ms on-CPU — the
+        # verdict must say cpu:consensus, not stage:gossip
+        t0 = 1_000_000_000
+        dur = 200_000_000
+        events = [
+            {
+                "event": "consensus.step", "height": 9, "node": "n0",
+                "step": 4, "ts": t0 + 50_000_000,
+            },
+            {
+                "event": "consensus.step", "height": 9, "node": "n0",
+                "step": 8, "ts": t0 + 110_000_000,
+            },
+            {
+                "event": "consensus.commit", "height": 9, "node": "n0",
+                "ts": t0 + dur, "dur_ns": dur,
+            },
+            {
+                "event": "prof.window", "subsystem": "consensus",
+                "ts": t0 + 150_000_000, "oncpu_ns": 170_000_000,
+                "samples": 12,
+            },
+            # the profiler's own thread never gates a commit
+            {
+                "event": "prof.window", "subsystem": "sampler",
+                "ts": t0 + 150_000_000, "oncpu_ns": 999_000_000,
+                "samples": 66,
+            },
+            # flushed outside the commit window: must be ignored
+            {
+                "event": "prof.window", "subsystem": "mempool",
+                "ts": t0 + 10 * dur, "oncpu_ns": 900_000_000,
+                "samples": 60,
+            },
+        ]
+        per = libhealth.critical_path_from_events(events)
+        assert set(per) == {9}
+        row = per[9]
+        assert row["cpu"] == "consensus"
+        assert row["cpu_s"] == pytest.approx(0.17)
+        assert row["gate"] == "cpu:consensus"
+        agg = libhealth.critical_path(events)
+        assert agg["gates"] == {"cpu:consensus": 1}
 
 
 class TestHealthSample:

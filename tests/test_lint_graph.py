@@ -502,6 +502,22 @@ class TestEngineWideGate:
         ]
         assert tx_edges == [], tx_edges
 
+    def test_profile_lock_registered_and_leaf(self, analysis):
+        """The sampling profiler's setup mutex is in the shipped
+        artifact and participates in NO acquisition-order edges: the
+        sample path (the ~67 Hz stack walk) and every snapshot reader
+        are lock-free by construction — a profile.* edge appearing
+        here means someone made the sampler or a snapshot take a lock
+        under (or over) engine mutexes."""
+        d = analysis.graph_dict()
+        assert "libs.profile._mtx" in {lk["name"] for lk in d["locks"]}
+        prof_edges = [
+            (e["from"], e["to"])
+            for e in d["edges"]
+            if "libs.profile._mtx" in (e["from"], e["to"])
+        ]
+        assert prof_edges == [], prof_edges
+
     def test_lockprof_recorder_is_lock_free(self, analysis):
         """The lock-contention profiler must never appear in the very
         hierarchy it measures: libs/lockprof owns NO lock in the

@@ -739,6 +739,52 @@ class TestAttributionUnits:
             f.cause != "lock_contention" for f in rep2.run.findings
         )
 
+    def test_cpu_saturated_names_the_hot_subsystem(self):
+        """A slow window whose profiler flush windows show one
+        subsystem's GIL-bound Python burning most of the wall scores
+        cpu_saturated naming the subsystem; the sampler's own thread
+        never counts, and a small on-CPU share stays silent."""
+        burn = [
+            _ev("prof.window", 1_200_000_000 + i * 250_000_000,
+                subsystem="consensus", oncpu_ns=250_000_000,
+                samples=17)
+            for i in range(3)
+        ] + [
+            # the profiler's own thread never gates a commit
+            _ev("prof.window", 1_300_000_000, subsystem="sampler",
+                oncpu_ns=900_000_000, samples=60),
+        ]
+        evs = _height_events("node0", 1, 1_000_000_000) + _height_events(
+            "node0", 2, 1_100_000_000, lat_ns=900_000_000
+        ) + burn
+        rep = attribute(merge([Source("node0", evs, domain="wall")]))
+        v = rep.run.verdict
+        assert v is not None and v.cause == "cpu_saturated"
+        assert v.evidence["subsystem"] == "consensus"
+        assert v.evidence["oncpu_ms"] == pytest.approx(750.0)
+        assert v.evidence["window_share"] > 0.6
+        assert v.evidence["samples"] == 51
+        # the same rows in a virtual-domain ring (simnet) are dropped
+        # by the merge: wall-measured payloads mean nothing there
+        rep2 = attribute(
+            merge([Source("node0", evs, domain="virtual")])
+        )
+        assert all(
+            f.cause != "cpu_saturated" for f in rep2.run.findings
+        )
+        # a sub-dominant on-CPU share against the same window: silent
+        quiet = [
+            _ev("prof.window", 1_200_000_000, subsystem="consensus",
+                oncpu_ns=100_000_000, samples=7),
+        ]
+        evs3 = _height_events("node0", 1, 1_000_000_000) + _height_events(
+            "node0", 2, 1_100_000_000, lat_ns=900_000_000
+        ) + quiet
+        rep3 = attribute(merge([Source("node0", evs3, domain="wall")]))
+        assert all(
+            f.cause != "cpu_saturated" for f in rep3.run.findings
+        )
+
     def test_latency_detector_scores_against_baseline(self):
         slow_hops = [
             _ev("p2p.gossip", 1_101_000_000 + i * 100_000, 0, 0,
