@@ -1348,11 +1348,25 @@ def bench_coalesce_steady_state(
     }
 
 
-def _perfect_gossip_net(chain_id: str, n_vals: int = 4):
+def _perfect_gossip_net(
+    chain_id: str,
+    n_vals: int = 4,
+    pipeline: bool = True,
+    home_root: str | None = None,
+):
     """One in-process n-validator consensus net with perfect gossip —
-    the shared burst harness of configs 13 and 19.  Returns the
+    the shared burst harness of configs 13, 19, 21 and 23.  Returns the
     ``[(ConsensusState, parts)]`` list; parts carries conns/bus/
-    block_store for teardown."""
+    block_store (plus ``pipe`` when pipelined) for teardown.
+
+    ``pipeline=True`` (the default, matching node boot's
+    COMETBFT_TPU_PIPELINE=auto) wires the pipelined commit chain —
+    threaded commit-writer + speculative execution — so the burst
+    measures the production engine; pass ``pipeline=False`` for the
+    pre-PR serial chain.  ``home_root`` switches the stores and the
+    consensus WAL onto real files so the wal_fsync budget tile carries
+    actual fsync time (config 23 needs that; the MemDB default keeps
+    the overhead configs I/O-free)."""
     from cometbft_tpu import proxy
     from cometbft_tpu.abci.kvstore import KVStoreApplication
     from cometbft_tpu.config import test_config
@@ -1362,6 +1376,8 @@ def _perfect_gossip_net(chain_id: str, n_vals: int = 4):
         ProposalMessage,
         VoteMessage,
     )
+    from cometbft_tpu.consensus.pipeline import CommitPipeline
+    from cometbft_tpu.consensus.wal import WAL
     from cometbft_tpu.crypto.keys import Ed25519PrivKey
     from cometbft_tpu.libs import db as dbm
     from cometbft_tpu.state import BlockExecutor, Store, make_genesis_state
@@ -1385,13 +1401,25 @@ def _perfect_gossip_net(chain_id: str, n_vals: int = 4):
     by_addr = {bytes(pv.get_pub_key().address()): pv for pv in pvs}
     pvs = [by_addr[v.address] for v in vs.validators]
     nodes = []
-    for pv in pvs:
+    for i, pv in enumerate(pvs):
+        if home_root is None:
+            app_db = state_db = block_db = None
+            wal = None
+        else:
+            home = os.path.join(home_root, f"n{i}")
+            os.makedirs(home, exist_ok=True)
+            app_db = dbm.FileDB(f"{home}/app.db")
+            state_db = dbm.FileDB(f"{home}/state.db")
+            block_db = dbm.FileDB(f"{home}/blocks.db")
+            wal = WAL(f"{home}/cs.wal/wal")
         conns = proxy.AppConns(
-            proxy.local_client_creator(KVStoreApplication(dbm.MemDB()))
+            proxy.local_client_creator(
+                KVStoreApplication(app_db or dbm.MemDB())
+            )
         )
         conns.start()
-        state_store = Store(dbm.MemDB())
-        block_store = BlockStore(dbm.MemDB())
+        state_store = Store(state_db or dbm.MemDB())
+        block_store = BlockStore(block_db or dbm.MemDB())
         bus = EventBus()
         bus.start()
         state = make_genesis_state(doc)
@@ -1402,12 +1430,22 @@ def _perfect_gossip_net(chain_id: str, n_vals: int = 4):
         )
         cs = ConsensusState(
             test_config().consensus, state, executor, block_store,
-            event_bus=bus,
+            event_bus=bus, wal=wal,
         )
         cs.set_priv_validator(pv)
-        nodes.append(
-            (cs, dict(conns=conns, bus=bus, block_store=block_store))
+        parts = dict(
+            conns=conns, bus=bus, block_store=block_store,
+            executor=executor,
         )
+        if pipeline:
+            pipe = CommitPipeline(executor, cs.wal)
+            pipe.enabled = True
+            pipe.spec_enabled = conns.consensus.supports_speculation()
+            pipe.note_base(state.last_block_height)
+            executor.prune_gate = pipe.durable_height
+            cs.pipeline = pipe
+            parts["pipe"] = pipe
+        nodes.append((cs, parts))
     css = [cs for cs, _ in nodes]
     for i, cs in enumerate(css):  # perfect gossip, as in the tests
         orig = cs._send_internal
@@ -2624,9 +2662,13 @@ def bench_lock_contention(
     plane) for every committed height with its budget coverage.  The
     record-path overhead is bounded mechanism-level, the config-13
     methodology: measured per-acquire profiled-vs-raw delta x acquires
-    per commit / commit latency.  This row is the BEFORE baseline the
-    pipelined-heights PR diffs against with ``bench.py --compare``
-    (lock_wait*/contended* fragments classify lower-better there).
+    per commit / commit latency.  The burst runs the live default
+    engine — since the pipelined-heights PR that means the pipelined
+    commit chain — so diffing this row against the PR 17 round with
+    ``bench.py --compare`` shows the occupancy drop the refactor
+    bought (lock_wait*/contended*/occupancy fragments classify
+    lower-better there); config 23 carries the explicit
+    serial-vs-pipelined A/B on one net.
     """
     import threading as _threading
 
@@ -2989,6 +3031,166 @@ def bench_profile_overhead(n_heights: int | None = None):
     }
 
 
+def bench_pipelined_commit(n_heights: int | None = None):
+    """Config 23: serial vs pipelined commit chain on ONE live net.
+
+    The pipelined-heights AFTER row: one in-process 4-validator burst
+    over real FileDB stores and a real consensus WAL (so wal_fsync is
+    actual fsync time), with the commit chain toggled serial (knob
+    off) / pipelined (commit-writer + speculative execution) per
+    window — the config-13 alternating-window discipline, so the two
+    modes share threads, page cache and jit state and the delta
+    isolates the chain itself.  Reports per-height commit p50/p99 per
+    mode from the budget plane, the speculation hit rate, and the
+    per-commit budget stage tiles, which must show wal_fsync/apply
+    leaving the serial span (their serial-window milliseconds shrink
+    toward zero in the pipelined windows while the same time reappears
+    in the non-tiled ``overlapped`` credit).  ``bench.py --compare``
+    against the PR 17 round diffs the occupancy drop via config 21,
+    whose burst now runs this engine.
+    """
+    import shutil
+    import tempfile
+
+    from cometbft_tpu.libs import health as libhealth
+    from cometbft_tpu.libs import metrics as libmetrics
+
+    if n_heights is None:
+        n_heights = _sz(10, 3)
+    warm_heights = _sz(2, 1)
+
+    health_was = libhealth.enabled()
+    prev_ring = libhealth.recorder().capacity
+    home_root = tempfile.mkdtemp(prefix="bench-pipelined-")
+    m = libmetrics.node_metrics()
+
+    def _spec_totals():
+        return {
+            k: m.spec_exec.labels(k).value()
+            for k in ("hit", "miss", "abort")
+        }
+
+    lat = {"serial": [], "pipelined": []}  # per-height latency_s
+    tiles = {"serial": {}, "pipelined": {}}  # stage -> summed seconds
+    coverage = {"serial": [], "pipelined": []}
+    overlapped_s = {"wal_fsync": 0.0, "spec_exec": 0.0}
+    nodes = _perfect_gossip_net("bench-pipelined", home_root=home_root)
+    pipes = [parts["pipe"] for _, parts in nodes]
+    spec_support = [p.spec_enabled for p in pipes]
+    store = nodes[0][1]["block_store"]
+    try:
+        libhealth.enable(ring=1 << 15)
+        libhealth.reset()
+        for cs, _ in nodes:
+            cs.start()
+        deadline = time.monotonic() + 300
+        while (
+            store.height() < warm_heights
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+        if store.height() < warm_heights:
+            raise RuntimeError("pipelined burst never warmed")
+        spec_pre = _spec_totals()
+        for rep in range(3):
+            for mode in ("serial", "pipelined"):
+                on = mode == "pipelined"
+                if not on:
+                    # drain in-flight writer jobs before falling back
+                    # to the serial chain, so no window straddles modes
+                    for p in pipes:
+                        p.wait_durable(store.height(), timeout_s=60)
+                for p, sup in zip(pipes, spec_support):
+                    p.enabled = on
+                    p.spec_enabled = on and sup
+                libhealth.reset()
+                h0 = store.height()
+                while (
+                    store.height() < h0 + n_heights
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.002)
+                if store.height() - h0 <= 0:
+                    raise RuntimeError(f"{mode} window stalled")
+                bud = libhealth.budget()
+                for hv in bud["heights"]:
+                    lat[mode].append(hv["latency_s"])
+                    for k, v in hv["stages"].items():
+                        tiles[mode][k] = tiles[mode].get(k, 0.0) + v
+                    ov = hv.get("overlapped")
+                    if on and ov:
+                        for k in overlapped_s:
+                            overlapped_s[k] += ov.get(k, 0.0)
+                if bud["coverage"] is not None:
+                    coverage[mode].append(bud["coverage"])
+        spec_post = _spec_totals()
+    finally:
+        _stop_net(nodes)
+        libhealth.enable() if health_was else libhealth.disable()
+        libhealth.set_ring_capacity(prev_ring)
+        shutil.rmtree(home_root, ignore_errors=True)
+
+    def _q(vals, frac):
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(frac * (len(s) - 1) + 0.5))]
+
+    p50 = {k: _q(v, 0.50) for k, v in lat.items()}
+    p99 = {k: _q(v, 0.99) for k, v in lat.items()}
+    spec = {
+        k: spec_post[k] - spec_pre[k] for k in ("hit", "miss", "abort")
+    }
+    consumed = max(1, spec["hit"] + spec["miss"])
+    # mean per-commit stage milliseconds per mode — THE tile evidence:
+    # wal_fsync/apply milliseconds leave the serial span when pipelined
+    stage_ms = {
+        mode: {
+            k: round(1e3 * v / max(1, len(lat[mode])), 3)
+            for k, v in sorted(t.items())
+        }
+        for mode, t in tiles.items()
+    }
+    pipel_total = sum(lat["pipelined"]) or 1e-12
+    return {
+        "heights_per_window": n_heights,
+        "windows": len(coverage["serial"]) + len(coverage["pipelined"]),
+        "validators": 4,
+        "commit_p50_ms_serial": round(p50["serial"] * 1e3, 2),
+        "commit_p99_ms_serial": round(p99["serial"] * 1e3, 2),
+        "commit_p50_ms_pipelined": round(p50["pipelined"] * 1e3, 2),
+        "commit_p99_ms_pipelined": round(p99["pipelined"] * 1e3, 2),
+        "pipelined_speedup_p50_vs_serial": round(
+            p50["serial"] / (p50["pipelined"] or 1e-12), 2
+        ),
+        "spec_hit_rate": round(spec["hit"] / consumed, 3),
+        "spec_outcomes": spec,
+        "stage_ms_serial": stage_ms["serial"],
+        "stage_ms_pipelined": stage_ms["pipelined"],
+        # overlapped credit as a share of the pipelined windows' total
+        # commit latency (the sidebar is NOT part of the stage tiling,
+        # so this can't double-count)
+        "overlapped_fsync_share": round(
+            overlapped_s["wal_fsync"] / pipel_total, 3
+        ),
+        "overlapped_spec_share": round(
+            overlapped_s["spec_exec"] / pipel_total, 3
+        ),
+        "budget_coverage_serial": round(
+            min(coverage["serial"] or [0.0]), 3
+        ),
+        "budget_coverage_pipelined": round(
+            min(coverage["pipelined"] or [0.0]), 3
+        ),
+        "stat": "3_alternating_window_pairs",
+        "note": "one live 4-validator net over FileDB + real WAL, "
+        "commit chain toggled serial/pipelined per window; p50/p99 "
+        "from per-height budget latencies, stage_ms_* are mean "
+        "per-commit budget tiles (wal_fsync/apply must shrink in the "
+        "pipelined column; the same time reappears as overlapped_* "
+        "credit, recorded outside the tiling sum), spec_hit_rate = "
+        "hits/(hits+misses) across the pipelined windows",
+    }
+
+
 def bench_tx_lifecycle(
     seed: int | None = None, sample: int | None = None
 ):
@@ -3318,14 +3520,14 @@ def _compare_load_rows(path: str) -> dict:
 # the whole point of the 21_lock_contention before/after baseline
 # ("occupancy" is the commit-chain serial fraction the pipelined-
 # heights work exists to shrink).
-_LOCK_LOWER_IS_BETTER = ("lock_wait", "contended", "occupancy")
+_LOCK_LOWER_IS_BETTER = ("lock_wait", "contended", "occupancy", "acquires")
 _HIGHER_IS_BETTER = (
     "per_sec", "vs_baseline", "vs_serial", "vs_batch_baseline", "rate",
     "hit", "coverage", "util", "value", "window_pct", "share",
 )
 _LOWER_IS_BETTER = (
-    "_ms", "_s", "latency", "seconds", "wait", "overhead", "noise",
-    "delta", "bytes", "compile",
+    "_ms", "_s", "_ns", "latency", "seconds", "wait", "overhead",
+    "noise", "delta", "bytes", "compile",
 )
 
 
@@ -3719,6 +3921,21 @@ def main() -> None:
         except Exception as e:
             _eprint({"config": "22_profile_overhead", "backend": "host",
                      "error": repr(e)[:200]})
+        pipeline_row = None
+        try:
+            # serial-vs-pipelined commit chain A/B (pure host engine
+            # work: FileDB fsyncs + kvstore finalize — no device)
+            pipeline_row = bench_pipelined_commit()
+            _eprint(
+                {
+                    "config": "23_pipelined_commit",
+                    "backend": "host",
+                    **pipeline_row,
+                }
+            )
+        except Exception as e:
+            _eprint({"config": "23_pipelined_commit", "backend": "host",
+                     "error": repr(e)[:200]})
         # The host production path IS the native batch verifier now, so
         # the fallback headline measures it (vs_baseline ~1.0 by
         # construction — the chip is what moves it).
@@ -3850,6 +4067,29 @@ def main() -> None:
                             ],
                         }
                         if profile_row
+                        else {}
+                    ),
+                    # serial vs pipelined commit chain on one live net
+                    # (config 23_pipelined_commit; p50 must drop, the
+                    # hits prove the speculative path carried it)
+                    **(
+                        {
+                            "pipelined_commit_p50_ms": pipeline_row[
+                                "commit_p50_ms_pipelined"
+                            ],
+                            "serial_commit_p50_ms": pipeline_row[
+                                "commit_p50_ms_serial"
+                            ],
+                            "pipelined_speedup_p50_vs_serial": (
+                                pipeline_row[
+                                    "pipelined_speedup_p50_vs_serial"
+                                ]
+                            ),
+                            "spec_hit_rate": pipeline_row[
+                                "spec_hit_rate"
+                            ],
+                        }
+                        if pipeline_row
                         else {}
                     ),
                 }
@@ -4060,6 +4300,17 @@ def main() -> None:
     except Exception as e:
         _eprint({"config": "22_profile_overhead", "error": repr(e)[:200]})
 
+    pipeline_row = None
+    try:
+        # serial-vs-pipelined commit chain A/B: the engine work is
+        # host-side (FileDB fsyncs + kvstore finalize) and identical
+        # with or without a chip, but run it on the device round too so
+        # the AFTER row rides the same provenance as the 21 baseline
+        pipeline_row = bench_pipelined_commit()
+        _eprint({"config": "23_pipelined_commit", **pipeline_row})
+    except Exception as e:
+        _eprint({"config": "23_pipelined_commit", "error": repr(e)[:200]})
+
     # Headline: 4096-lane flat ed25519 batch (same SHAPE as every prior
     # round; since round 5 the statistic is min-of-5 — recorded in the
     # row so cross-round readers don't mistake the mean->min methodology
@@ -4223,6 +4474,27 @@ def main() -> None:
                         ],
                     }
                     if profile_row
+                    else {}
+                ),
+                # serial vs pipelined commit chain on one live net
+                # (config 23_pipelined_commit; p50 must drop, the hits
+                # prove the speculative path carried it)
+                **(
+                    {
+                        "pipelined_commit_p50_ms": pipeline_row[
+                            "commit_p50_ms_pipelined"
+                        ],
+                        "serial_commit_p50_ms": pipeline_row[
+                            "commit_p50_ms_serial"
+                        ],
+                        "pipelined_speedup_p50_vs_serial": (
+                            pipeline_row[
+                                "pipelined_speedup_p50_vs_serial"
+                            ]
+                        ),
+                        "spec_hit_rate": pipeline_row["spec_hit_rate"],
+                    }
+                    if pipeline_row
                     else {}
                 ),
             }

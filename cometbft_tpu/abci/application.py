@@ -74,6 +74,21 @@ class Application:
     ) -> abci.ResponseApplySnapshotChunk:
         raise NotImplementedError
 
+    # -- optional speculation extension ------------------------------------
+    #
+    # Apps that want optimistic block execution (consensus/pipeline.py)
+    # implement BOTH of these; a local client then runs FinalizeBlock in
+    # a snapshot/finalize/restore sandwich so a speculation that never
+    # commits leaves no trace. The token is opaque to the engine. An app
+    # must only advertise the pair if a restore really reverts EVERY
+    # side effect its finalize_block has (in particular: no durable
+    # writes inside finalize — persistence belongs in Commit). There is
+    # deliberately NO default implementation: a no-op inherited pair on
+    # a stateful subclass would silently corrupt it.
+    #
+    # def snapshot_spec_state(self): ...
+    # def restore_spec_state(self, token): ...
+
 
 class BaseApplication(Application):
     """Accept-everything defaults; concrete apps override what they need."""

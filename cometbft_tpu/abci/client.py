@@ -64,6 +64,17 @@ class ReqRes:
         return self.response
 
 
+class SpeculationUnsupported(Exception):
+    """The client/app pair cannot run speculative finalization.
+
+    Raised by ``Client.speculate_finalize`` when the transport is remote
+    (socket/grpc — no way to sandbox the app) or the application does
+    not implement the optional ``snapshot_spec_state`` /
+    ``restore_spec_state`` extension. Callers fall back to the serial
+    FinalizeBlock path; the error carries no app-state consequences.
+    """
+
+
 class Client(BaseService):
     """Service + Application surface + async CheckTx + global callback."""
 
@@ -138,6 +149,29 @@ class Client(BaseService):
     def apply_snapshot_chunk(self, req) -> abci.ResponseApplySnapshotChunk:
         raise NotImplementedError
 
+    # -- optional speculation extension (consensus/pipeline.py) ------------
+
+    def supports_speculation(self) -> bool:
+        """Whether speculate_finalize can work at all for this
+        client/app pair (node boot keys COMETBFT_TPU_SPEC_EXEC=auto
+        off this)."""
+        return False
+
+    def speculate_finalize(self, req) -> tuple:
+        """Run FinalizeBlock speculatively and leave the app UNCHANGED.
+
+        Returns ``(response, post_token)`` where ``post_token`` is an
+        opaque snapshot of the post-finalize app state; a later
+        ``apply_speculation(post_token)`` makes the speculative result
+        real without re-executing. Only local clients over apps that
+        implement the snapshot/restore extension support this — remote
+        transports cannot sandbox the app, so the base client refuses.
+        """
+        raise SpeculationUnsupported(f"{self.name}: remote ABCI transport")
+
+    def apply_speculation(self, post_token) -> None:
+        raise SpeculationUnsupported(f"{self.name}: remote ABCI transport")
+
 
 class LocalClient(Client):
     """In-process app behind one mutex (local_client.go:186). The mutex may
@@ -209,3 +243,41 @@ class LocalClient(Client):
 
     def apply_snapshot_chunk(self, req):
         return self._call("apply_snapshot_chunk", req)
+
+    # -- speculation (consensus/pipeline.py's cs-spec-exec worker) ---------
+
+    def supports_speculation(self) -> bool:
+        return callable(
+            getattr(self.app, "snapshot_spec_state", None)
+        ) and callable(getattr(self.app, "restore_spec_state", None))
+
+    def speculate_finalize(self, req) -> tuple:
+        """FinalizeBlock inside a snapshot/restore sandwich, atomic under
+        the shared proxy mutex: snapshot pre → finalize → snapshot post →
+        restore pre. The app comes out exactly as it went in, so a
+        speculation that never wins (different block, round change, node
+        restart) needs no cleanup, and concurrent connections never see
+        half-speculated state."""
+        with self.mtx:  # cometlint: disable=CLNT009 -- the snapshot/finalize/restore sandwich must be atomic against the other proxy connections
+            if not self.supports_speculation():
+                raise SpeculationUnsupported(
+                    f"{type(self.app).__name__} lacks snapshot_spec_state/"
+                    "restore_spec_state"
+                )
+            pre = self.app.snapshot_spec_state()
+            try:
+                resp = self.app.finalize_block(req)
+                post = self.app.snapshot_spec_state()
+            finally:
+                self.app.restore_spec_state(pre)
+            return resp, post
+
+    def apply_speculation(self, post_token) -> None:
+        """Make a speculative finalize real: restore the memoized
+        post-finalize state so the following Commit persists it."""
+        with self.mtx:  # cometlint: disable=CLNT009 -- restoring the memoized post-state must serialize against the other proxy connections
+            if not self.supports_speculation():
+                raise SpeculationUnsupported(
+                    f"{type(self.app).__name__} lacks restore_spec_state"
+                )
+            self.app.restore_spec_state(post_token)

@@ -184,6 +184,34 @@ class KVStoreApplication(BaseApplication):
                 app_hash=self.app_hash,
             )
 
+    # -- speculation extension (consensus/pipeline.py) ---------------------
+    #
+    # finalize_block mutates exactly these fields and touches no storage
+    # (persistence happens in commit), so a snapshot/restore pair over
+    # them makes speculative execution state-neutral: speculate →
+    # restore(pre) leaves the app bit-identical, and a winning
+    # speculation replays as restore(post) + commit.
+
+    def snapshot_spec_state(self) -> dict:
+        with self._mtx:
+            return {
+                "staged": dict(self._staged),
+                "val_updates": list(self._val_updates),
+                "validators": dict(self._validators),
+                "height": self.height,
+                "size": self.size,
+                "app_hash": self.app_hash,
+            }
+
+    def restore_spec_state(self, token: dict) -> None:
+        with self._mtx:
+            self._staged = dict(token["staged"])
+            self._val_updates = list(token["val_updates"])
+            self._validators = dict(token["validators"])
+            self.height = token["height"]
+            self.size = token["size"]
+            self.app_hash = token["app_hash"]
+
     def _stage_state(self, batch) -> None:
         batch.set(
             _STATE_KEY,

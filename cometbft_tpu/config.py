@@ -115,6 +115,21 @@ ENV_KNOBS: dict[str, str] = {
         "named crash point for fault-injection tests — the process "
         "dies hard when execution reaches it (libs/fail.py)"
     ),
+    "COMETBFT_TPU_PIPELINE": (
+        "pipelined commit chain (consensus/pipeline.py): save-block + "
+        "WAL EndHeight fsync + app commit move onto an ordered "
+        "commit-writer worker behind a durability barrier — auto "
+        "(default: on for live nodes, inline for sim-driven FSMs) | "
+        "1/on force | inline run jobs synchronously on the FSM thread "
+        "| 0/off fully serial reference chain"
+    ),
+    "COMETBFT_TPU_SPEC_EXEC": (
+        "speculative block execution at prevote time "
+        "(consensus/pipeline.py): auto (default — on when the ABCI "
+        "client supports the snapshot/restore speculation extension) "
+        "| 1/on force | 0/off; a precommit win consumes the memoized "
+        "FinalizeBlock instead of re-executing"
+    ),
     "COMETBFT_TPU_TRACE": (
         "span/event tracer: off (default) | on/1 — consensus "
         "height/round/step spans, verify phase events, mempool/p2p/"

@@ -209,6 +209,11 @@ class ConsensusState(BaseService):
         # Fail-stop hook for FatalConsensusError (node wires this to a
         # full node stop; None → os._exit, never a silent dead thread).
         self.on_fatal = None
+        # Pipelined-heights engine (consensus/pipeline.CommitPipeline):
+        # speculative execution + ordered commit-writer + durability
+        # barrier. None => the fully serial reference commit chain.
+        # lockfree: wired once at node boot before any routine starts; steady-state an immutable reference (the pipeline has its own mutex)
+        self.pipeline = None
 
         # libs/trace spans for the current height/round/step. Manual
         # (begin/end) because the FSM is event-driven — the intervals
@@ -343,6 +348,10 @@ class ConsensusState(BaseService):
         # teardown can abort the process; give each a bounded drain.
         for pt in getattr(self, "_prestage_threads", []):
             pt.join(timeout=2)
+        # Drain the commit-writer BEFORE the WAL can be closed under it:
+        # pending jobs fsync through self.wal.
+        if self.pipeline is not None:
+            self.pipeline.stop()
         self.wal.flush_and_sync()
         # close any open trace spans so a stopped node's trace has no
         # dangling intervals
@@ -1010,8 +1019,38 @@ class ConsensusState(BaseService):
         if rs.proposal_complete():
             self._enter_prevote(height, round_)
 
+    def _wait_pipeline_durable(self, height: int) -> None:
+        """The durability barrier (docs/perf.md "Pipelined heights"):
+        block until every height <= ``height`` is fsynced + applied by
+        the commit-writer.  The FSM may PROCESS H+1 messages while H's
+        durable suffix drains, but it must not SIGN for H+1 (a crash
+        would forget votes the network already saw — double-sign risk)
+        nor feed the app H+1 proposals before Commit(H) landed.  Called
+        holding 'consensus.state' by design — not advancing is the
+        point; the writer never takes the FSM mutex, so this cannot
+        deadlock, and the wait is bounded (a wedged writer fail-stops
+        the node, same as any commit-chain failure)."""
+        pipe = self.pipeline
+        if (
+            pipe is None
+            or not pipe.enabled
+            or self.replay_mode
+            or height <= 0
+        ):
+            return
+        try:
+            pipe.wait_durable(height)
+        except Exception as e:
+            raise FatalConsensusError(
+                f"durability barrier failed waiting for height "
+                f"{height}: {e!r}"
+            ) from e
+
     def _decide_proposal(self, height: int, round_: int) -> None:
         """state.go:1244 defaultDecideProposal."""
+        # barrier: the proposal for H reaps the mempool and builds on
+        # state(H-1) — both must reflect a durable H-1
+        self._wait_pipeline_durable(height - 1)
         rs = self.rs
         if rs.valid_block is not None:
             block, parts = rs.valid_block, rs.valid_block_parts
@@ -1165,6 +1204,10 @@ class ConsensusState(BaseService):
         if rs.proposal_block is None or rs.proposal is None:
             self._sign_add_vote(canonical.PREVOTE_TYPE, b"", None)
             return
+        # barrier: ProcessProposal below consults the app, which must
+        # already hold Commit(H-1) — never show it H's proposal while
+        # H-1's commit is still draining on the writer
+        self._wait_pipeline_durable(height - 1)
         try:
             self.block_exec.validate_block(self.state, rs.proposal_block)
         except Exception:
@@ -1173,6 +1216,32 @@ class ConsensusState(BaseService):
             return
 
         def prevote_proposal() -> None:
+            # Every prevote-the-block path funnels through here, always
+            # AFTER validate_block above — start executing it
+            # speculatively so a precommit win finds FinalizeBlock
+            # already memoized (consensus/pipeline.py).
+            pipe = self.pipeline
+            if (
+                pipe is not None
+                and pipe.spec_enabled
+                and not self.replay_mode
+            ):
+                blk, st, be = rs.proposal_block, self.state, self.block_exec
+                try:
+                    pipe.submit_speculation(
+                        height,
+                        blk.hash(),
+                        lambda: be.speculate_block(st, blk),
+                    )
+                except Exception as e:
+                    # Only the cs-spec-exec CRASH SEAM escapes an inline
+                    # submit (real speculation failures are absorbed
+                    # inside the pipeline and degrade to a serial
+                    # commit) — treat it like any simulated process
+                    # death: fail-stop the node.
+                    raise FatalConsensusError(
+                        f"crash seam in speculative execution: {e!r}"
+                    ) from e
             self._sign_add_vote(
                 canonical.PREVOTE_TYPE,
                 rs.proposal_block.hash(),
@@ -1394,29 +1463,119 @@ class ConsensusState(BaseService):
         block_id = precommits.two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
         block.validate_basic()
-        self.block_exec.validate_block(self.state, block)
 
         from ..libs.fail import fail_point
 
-        fail_point("cs-before-save-block")
-        if self.block_store.height() < block.header.height:
-            seen_commit = precommits.make_commit()
-            if self.state.consensus_params.vote_extensions_enabled(height):
-                self.block_store.save_block_with_extended_commit(
-                    block, parts, precommits.make_extended_commit(True)
+        # Claim the speculative FinalizeBlock if we executed this exact
+        # block at prevote time (records hit/miss/abort either way). A
+        # hit skips re-validation: speculation is only ever submitted
+        # from _do_prevote AFTER validate_block passed on this block.
+        pipe = self.pipeline
+        spec = None
+        if pipe is not None and not self.replay_mode:
+            spec = pipe.consume_speculation(
+                height, rs.commit_round, block.hash()
+            )
+        if spec is None:
+            self.block_exec.validate_block(self.state, block)
+
+        pipelined = (
+            pipe is not None and pipe.enabled and not self.replay_mode
+        )
+        if pipelined:
+            # Pipelined commit (docs/perf.md "Pipelined heights"): the
+            # FSM runs only the in-memory half — FinalizeBlock (or the
+            # memoized speculation) and the State(H+1) derivation — and
+            # hands the ENTIRE durable suffix to the ordered
+            # commit-writer in the exact serial order, so every crash
+            # window maps onto the reference recovery matrix and the
+            # app is never durably ahead of the block store
+            # (consensus/replay.py's handshake invariant).  The FSM
+            # then advances to H+1 immediately; _wait_pipeline_durable
+            # fences signing until this job completes.  WAL note: H+1
+            # peer/timeout records may land BEFORE the worker's
+            # EndHeight(H) marker and so are invisible to replay —
+            # harmless, they are re-gossiped/re-armed; own messages
+            # cannot, because signing waits on the barrier.
+            spec_resp, spec_post = spec if spec is not None else (None, None)
+            new_state, resp = self.block_exec.begin_apply(
+                self.state, block_id, block, spec_resp=spec_resp
+            )
+            extended = self.state.consensus_params.vote_extensions_enabled(
+                height
+            )
+            seen_commit = None if extended else precommits.make_commit()
+            ext_commit = (
+                precommits.make_extended_commit(True) if extended else None
+            )
+            store, wal, block_exec = self.block_store, self.wal, self.block_exec
+
+            def _durable_suffix():
+                fail_point("cs-pipeline-save")
+                fail_point("cs-before-save-block")
+                if store.height() < block.header.height:
+                    if ext_commit is not None:
+                        store.save_block_with_extended_commit(
+                            block, parts, ext_commit
+                        )
+                    else:
+                        store.save_block(block, parts, seen_commit)
+                fail_point("cs-after-save-block")
+                # crash window between the durable block and its fsynced
+                # EndHeight marker — recovered by the handshake replay
+                # of the stored-but-unapplied tip
+                fail_point("cs-pipeline-fsync")
+                wal.write_end_height(height, overlapped=True)
+                fail_point("cs-after-end-height")
+                block_exec.complete_apply(
+                    new_state, block_id, block, resp, spec_token=spec_post
+                )
+                fail_point("cs-after-apply-block")
+
+            pipe.enqueue_commit(height, _durable_suffix)
+            # warm H+1's device windows while the suffix drains
+            pipe.prestage_next(new_state.validators)
+        else:
+            fail_point("cs-before-save-block")
+            if self.block_store.height() < block.header.height:
+                seen_commit = precommits.make_commit()
+                if self.state.consensus_params.vote_extensions_enabled(height):
+                    self.block_store.save_block_with_extended_commit(
+                        block, parts, precommits.make_extended_commit(True)
+                    )
+                else:
+                    self.block_store.save_block(block, parts, seen_commit)
+
+            fail_point("cs-after-save-block")
+            # EndHeight AFTER the block is saved, BEFORE ApplyBlock: a crash
+            # in between recovers via the ABCI handshake replay, not the WAL
+            # (state.go:1753-1820 fail points).
+            self.wal.write_end_height(height)
+            fail_point("cs-after-end-height")
+
+            if spec is None:
+                new_state = self.block_exec.apply_block(
+                    self.state, block_id, block
                 )
             else:
-                self.block_store.save_block(block, parts, seen_commit)
-
-        fail_point("cs-after-save-block")
-        # EndHeight AFTER the block is saved, BEFORE ApplyBlock: a crash
-        # in between recovers via the ABCI handshake replay, not the WAL
-        # (state.go:1753-1820 fail points).
-        self.wal.write_end_height(height)
-        fail_point("cs-after-end-height")
-
-        new_state = self.block_exec.apply_block(self.state, block_id, block)
-        fail_point("cs-after-apply-block")
+                # serial durable order, speculative execution result:
+                # same chain, minus the redundant FinalizeBlock
+                spec_resp, spec_post = spec
+                t0 = time.perf_counter()
+                new_state, resp = self.block_exec.begin_apply(
+                    self.state, block_id, block, spec_resp=spec_resp
+                )
+                self.block_exec.complete_apply(
+                    new_state, block_id, block, resp,
+                    spec_token=spec_post, t0=t0,
+                )
+            fail_point("cs-after-apply-block")
+            if pipe is not None:
+                # a serially-committed height (WAL catchup replay, the
+                # pipeline knob off) is durable HERE — advance the mark
+                # so the barrier, the prune gate and the lag gauge
+                # never wait on a debt the writer was never handed
+                pipe.note_base(height)
 
         # per-height commit latency into the flight recorder (the
         # health engine's commit SLI; commit_round+1 = rounds needed;
@@ -1641,6 +1800,14 @@ class ConsensusState(BaseService):
     ) -> Vote | None:
         """state.go:2355 signVote."""
         rs = self.rs
+        # barrier (defense in depth — _decide_proposal and _do_prevote
+        # already fence): NO vote for H leaves this node until H-1 is
+        # durable, so a crash can never forget a signature the network
+        # already counted (the WAL double-sign guarantee, preserved
+        # across the pipelined commit chain).  This is also what keeps
+        # WAL replay sound: own H messages are always logged after the
+        # worker's EndHeight(H-1) marker.
+        self._wait_pipeline_durable(rs.height - 1)
         addr = bytes(self.priv_validator_pub_key.address())
         idx, val = rs.validators.get_by_address(addr)
         if val is None:
@@ -1683,6 +1850,8 @@ class ConsensusState(BaseService):
             return
         try:
             vote = self._sign_vote(msg_type, block_hash, part_set_header)
+        except FatalConsensusError:
+            raise  # durability-barrier failure: fail-stop, never absorbed
         except Exception:
             # FilePV double-sign refusal — silent in replay, where the WAL
             # already carries the originally-signed vote (state.go:2426+).

@@ -124,8 +124,11 @@ class WAL:
             self.group.write(frame)
             self.group.flush()
 
-    def write_sync(self, msg) -> None:
-        """fsync before returning — required before signing own msgs."""
+    def write_sync(self, msg, overlapped: bool = False) -> None:
+        """fsync before returning — required before signing own msgs.
+        ``overlapped=True`` marks an fsync that runs OFF the FSM critical
+        section (the pipelined commit-writer): the flight-recorder row is
+        flagged so the budget plane credits it outside the serial span."""
         self.write(msg)
         timed = libtrace.enabled() or libhealth.enabled()
         t0 = time.perf_counter() if timed else 0.0
@@ -135,7 +138,9 @@ class WAL:
         if timed:
             dur_ns = int((time.perf_counter() - t0) * 1e9)
             self._note_fsync(dur_ns)
-            libhealth.record(libhealth.EV_FSYNC, a=dur_ns)
+            libhealth.record(
+                libhealth.EV_FSYNC, a=dur_ns, b=1 if overlapped else 0
+            )
             if libtrace.enabled():
                 libtrace.event("wal.fsync", dur_ns=dur_ns)
 
@@ -178,8 +183,8 @@ class WAL:
         """Whether this WAL's disk is in the degraded (slow) state."""
         return self._disk[1] != 0.0
 
-    def write_end_height(self, height: int) -> None:
-        self.write_sync(EndHeightMessage(height))
+    def write_end_height(self, height: int, overlapped: bool = False) -> None:
+        self.write_sync(EndHeightMessage(height), overlapped=overlapped)
         self.group.check_head_size_limit()
 
     # -- read --------------------------------------------------------------
@@ -237,13 +242,13 @@ class NopWAL:
     def write(self, msg) -> None:
         pass
 
-    def write_sync(self, msg) -> None:
+    def write_sync(self, msg, overlapped: bool = False) -> None:
         pass
 
     def flush_and_sync(self) -> None:
         pass
 
-    def write_end_height(self, height: int) -> None:
+    def write_end_height(self, height: int, overlapped: bool = False) -> None:
         pass
 
     def iter_messages(self):

@@ -1043,6 +1043,26 @@ def _routed_device() -> HashCoalescer | None:
     return None
 
 
+def prewarm() -> bool:
+    """Warm the routed device path: push one tiny synthetic window
+    through the coalescer so the compiled hash kernels and transfer
+    buffers for the next height's PartSet/merkle work are resident
+    before the proposer needs them.  Returns True if a device window
+    was actually exercised; silently a no-op (False) when hashing is
+    unrouted or device-less.  Digests are discarded — this changes
+    latency, never results — so the pipelined prestage path may call
+    it speculatively."""
+    co = _routed_device()
+    if co is None:
+        return False
+    try:
+        ticket = co.submit_many([[b"\x00" * 64] * 4])[0]
+        ticket.result(timeout=0.5)
+        return True
+    except Exception:
+        return False
+
+
 def hash_bytes(bz: bytes) -> bytes:
     """Single-message SHA-256, coalesced when it can win.
 

@@ -205,6 +205,7 @@ SHARED_CLASSES: frozenset[str] = frozenset(
         "VoteSet",
         "HeightVoteSet",
         "PartSet",
+        "CommitPipeline",
     }
 )
 

@@ -143,8 +143,22 @@ EV_LOCK = 14
 # name commits gated by GIL-bound Python (``cpu:<subsystem>``), and the
 # cpu_saturated postmortem detector scores them.
 EV_PROF = 15
+# spec.exec: one speculative block execution resolved by the commit
+# pipeline (consensus/pipeline) — a=outcome code (_SPEC_OUTCOMES:
+# 1 hit / 2 miss / 3 abort), b=speculative FinalizeBlock execute ns
+# (0 for miss/abort rows — there is nothing to credit). Recorded at
+# consumption/discard time on the FSM thread, so the row sits inside
+# the commit window budget_from_events assigns it to.
+EV_SPEC = 16
 
-_N_CODES = 16  # size of the per-code last-seen vector
+_N_CODES = 17  # size of the per-code last-seen vector
+
+# EV_SPEC outcome vocabulary (recorded by consensus/pipeline)
+SPEC_HIT = 1  # precommitted block matched the memoized speculation
+SPEC_MISS = 2  # nothing memoized for the committed block — serial path
+SPEC_ABORT = 3  # speculation discarded (superseded / failed) unconsumed
+
+_SPEC_OUTCOMES = {SPEC_HIT: "hit", SPEC_MISS: "miss", SPEC_ABORT: "abort"}
 
 # EV_TX stage vocabulary (the decode side of libs/txtrace's stage
 # codes — the decoder lives here with the rest of the ring vocabulary,
@@ -220,6 +234,7 @@ _CODE_NAMES = {
     EV_TX: "tx.stage",
     EV_LOCK: "sync.lock",
     EV_PROF: "prof.window",
+    EV_SPEC: "spec.exec",
 }
 # decode the free-form a/b columns per code
 _CODE_FIELDS = {
@@ -229,7 +244,11 @@ _CODE_FIELDS = {
     EV_COMMIT: ("dur_ns", "txs"),
     EV_BREAKER: ("open", None),
     EV_RECOMPILE: ("bucket", None),
-    EV_FSYNC: ("dur_ns", None),
+    # overlapped=1 marks an fsync that ran OFF the FSM critical section
+    # (the pipelined commit-writer, consensus/pipeline): the budget
+    # plane excludes it from the serial wal_fsync stage and reports it
+    # in the per-height ``overlapped`` credit instead
+    EV_FSYNC: ("dur_ns", "overlapped"),
     EV_WATCHDOG: ("watchdog", None),
     EV_GOSSIP: ("phase", "lag_ns"),
     EV_FAULT: ("kind", "detail"),
@@ -238,13 +257,16 @@ _CODE_FIELDS = {
     EV_TX: ("key_fp", "val"),
     EV_LOCK: ("dur_ns", "ref"),
     EV_PROF: ("oncpu_ns", "samples"),
+    EV_SPEC: ("outcome", "dur_ns"),
 }
 
 # codes whose payload is a wall-clock-measured duration: meaningless in
 # a virtual-time (simnet) ring, so the cross-node timeline merge drops
 # them from virtual-domain sources (cometbft_tpu/postmortem) — EV_PROF
 # rides along because its on-CPU estimate is sampled in wall time
-WALL_DURATION_CODES = frozenset({EV_FSYNC, EV_BUDGET, EV_LOCK, EV_PROF})
+WALL_DURATION_CODES = frozenset(
+    {EV_FSYNC, EV_BUDGET, EV_LOCK, EV_PROF, EV_SPEC}
+)
 
 
 def ring_event_codes() -> dict[str, int]:
@@ -532,6 +554,8 @@ class FlightRecorder:
             elif code == EV_PROF:
                 # the subsystem index rides the round column
                 rec["subsystem"] = libprofile.subsystem_name(self._r[i])
+            elif code == EV_SPEC:
+                rec["outcome_name"] = _SPEC_OUTCOMES.get(self._a[i], "?")
             o = self._o[i]
             if o:
                 rec["node"] = origin_name(o)
@@ -682,7 +706,8 @@ BUDGET_STAGES = (
     "verify_queue",  # consensus-caller coalescer queue wait
     "verify_execute",  # consensus-caller pro-rata verify execute
     "hash",  # FSM-adjacent hash-plane time (merkle/mempool)
-    "wal_fsync",  # WAL fsync durations in the height window
+    "spec_exec",  # speculative FinalizeBlock time consumed by a hit
+    "wal_fsync",  # FSM-blocking WAL fsync durations in the height window
     "apply",  # Commit step -> applied, net of fsync overlay
     "residual",  # whatever the named stages don't explain
 )
@@ -709,6 +734,7 @@ def budget_from_events(events) -> dict[int, dict]:
     steps: dict[tuple, dict] = {}
     planes: list[tuple] = []
     fsyncs: list[tuple] = []
+    specs: list[tuple] = []
     for ev in events:
         name = ev.get("event")
         if name == "consensus.commit":
@@ -733,7 +759,15 @@ def budget_from_events(events) -> dict[int, dict]:
                 ev.get("wait_ns", 0), ev.get("exec_ns", 0),
             ))
         elif name == "wal.fsync":
-            fsyncs.append((ev.get("ts", 0), ev.get("dur_ns", 0)))
+            fsyncs.append((
+                ev.get("ts", 0), ev.get("dur_ns", 0),
+                ev.get("overlapped", 0),
+            ))
+        elif name == "spec.exec":
+            specs.append((
+                ev.get("ts", 0), ev.get("outcome", 0),
+                ev.get("dur_ns", 0),
+            ))
     out: dict[int, dict] = {}
     for h in sorted(commits):
         cts, dur, node = commits[h]
@@ -752,8 +786,14 @@ def budget_from_events(events) -> dict[int, dict]:
                 return 0
             return 1 if ts <= e2 else 2
 
-        # per span: [verify_wait, verify_exec, hash, fsync]
-        ov = [[0, 0, 0, 0], [0, 0, 0, 0], [0, 0, 0, 0]]
+        # per span: [verify_wait, verify_exec, hash, fsync, spec_exec]
+        ov = [[0] * 5, [0] * 5, [0] * 5]
+        # overlapped credit: work the pipelined commit moved OFF the
+        # serial span (flagged fsyncs; a winning speculation's execute
+        # time beyond what the span clamp can absorb). Reported beside
+        # the stages — never inside them — so the tiling still covers
+        # exactly the FSM-blocking latency without double-counting.
+        overlapped_fsync = 0
         for ts, plane, w, x in planes:
             if t0 <= ts <= cts:
                 k = _span(ts)
@@ -762,28 +802,40 @@ def budget_from_events(events) -> dict[int, dict]:
                     ov[k][1] += x
                 else:
                     ov[k][2] += w + x
-        for ts, d in fsyncs:
+        for ts, d, lap in fsyncs:
             if t0 <= ts <= cts:
-                ov[_span(ts)][3] += d
+                if lap:
+                    overlapped_fsync += d
+                else:
+                    ov[_span(ts)][3] += d
+        for ts, outcome, d in specs:
+            if t0 <= ts <= cts and outcome == SPEC_HIT:
+                ov[_span(ts)][4] += d
         # Clamp each span's overlay total to the span's wall length:
         # FSM-blocking time inside a span cannot exceed the span, but
         # a shared multi-node ring (in-process nets, simnet) assigns
         # every node's plane rows to the one committing node's window,
-        # and concurrent-thread callers (CheckTx hashing) overlap the
-        # FSM wall — scaling the components pro-rata keeps the stage
-        # tiling honest (coverage ~1.0) instead of double-counting.
+        # and concurrent-thread callers (CheckTx hashing, the spec-exec
+        # worker) overlap the FSM wall — scaling the components
+        # pro-rata keeps the stage tiling honest (coverage ~1.0)
+        # instead of double-counting.
         spans = (e1 - t0, e2 - e1, cts - e2)
+        overlapped_spec = 0
         for k in range(3):
-            tot = ov[k][0] + ov[k][1] + ov[k][2] + ov[k][3]
+            tot = sum(ov[k])
             if tot > spans[k] > 0:
-                for j in range(4):
+                scaled_spec = ov[k][4] * spans[k] // tot
+                overlapped_spec += ov[k][4] - scaled_spec
+                for j in range(5):
                     ov[k][j] = ov[k][j] * spans[k] // tot
             elif tot > 0 and spans[k] <= 0:
-                ov[k] = [0, 0, 0, 0]
+                overlapped_spec += ov[k][4]
+                ov[k] = [0] * 5
         vq = ov[0][0] + ov[1][0] + ov[2][0]
         vx = ov[0][1] + ov[1][1] + ov[2][1]
         hs = ov[0][2] + ov[1][2] + ov[2][2]
         fs = ov[0][3] + ov[1][3] + ov[2][3]
+        sp = ov[0][4] + ov[1][4] + ov[2][4]
         # a height with NO step rows cannot attribute its wall time to
         # a protocol stage — the unexplained remainder goes to
         # `residual`, not `proposal_wait`, so residual is the honest
@@ -794,7 +846,7 @@ def budget_from_events(events) -> dict[int, dict]:
         )
         gossip = max(0, (e2 - e1) - sum(ov[1]))
         apply_ = max(0, (cts - e2) - sum(ov[2]))
-        named = proposal_wait + gossip + apply_ + vq + vx + hs + fs
+        named = proposal_wait + gossip + apply_ + vq + vx + hs + fs + sp
         residual = max(0, dur - named)
         stages_ns = {
             "proposal_wait": proposal_wait,
@@ -802,11 +854,12 @@ def budget_from_events(events) -> dict[int, dict]:
             "verify_queue": vq,
             "verify_execute": vx,
             "hash": hs,
+            "spec_exec": sp,
             "wal_fsync": fs,
             "apply": apply_,
             "residual": residual,
         }
-        out[h] = {
+        hv = {
             "height": h,
             "node": node,
             "latency_s": round(dur / 1e9, 9),
@@ -815,6 +868,12 @@ def budget_from_events(events) -> dict[int, dict]:
             },
             "coverage": round((named + residual) / dur, 4),
         }
+        if overlapped_fsync or overlapped_spec:
+            hv["overlapped"] = {
+                "wal_fsync": round(overlapped_fsync / 1e9, 9),
+                "spec_exec": round(overlapped_spec / 1e9, 9),
+            }
+        out[h] = hv
     return out
 
 

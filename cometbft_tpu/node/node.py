@@ -214,6 +214,30 @@ class Node(BaseService):
         # panics in finalizeCommit) — same posture as _on_app_error.
         self.consensus.on_fatal = self._on_app_error
 
+        # 8b. Pipelined heights (consensus/pipeline.py): speculative
+        # execution + ordered commit-writer behind a durability barrier.
+        # Knob-gated (COMETBFT_TPU_PIPELINE / COMETBFT_TPU_SPEC_EXEC);
+        # the commit-writer fsyncs through the consensus WAL, so it must
+        # be wired to the SAME instance the FSM logs to.
+        from ..consensus.pipeline import CommitPipeline, pipeline_mode, spec_mode
+
+        pipe = CommitPipeline(
+            self.block_exec, self.consensus.wal, on_fatal=self._on_app_error
+        )
+        pmode = pipeline_mode()
+        pipe.enabled = pmode in ("auto", "on", "inline")
+        pipe.inline = pmode == "inline"
+        smode = spec_mode()
+        pipe.spec_enabled = smode == "on" or (
+            smode == "auto"
+            and getattr(
+                self.proxy_app.consensus, "supports_speculation", lambda: False
+            )()
+        )
+        pipe.note_base(state.last_block_height)
+        self.block_exec.prune_gate = pipe.durable_height
+        self.consensus.pipeline = pipe
+
         # 9. P2P: transport + switch + reactors (setup.go:325,394)
         self.node_key = NodeKey.load_or_generate(
             config.base.resolve(config.base.node_key_file)
@@ -227,6 +251,9 @@ class Node(BaseService):
         self.consensus.health_origin = libhealth.register_origin(
             self.node_key.node_id[:10]
         )
+        # the commit-writer/spec workers record ring rows for the same
+        # node as the receive routine
+        pipe.health_origin = self.consensus.health_origin
         # Blocksync only when it can help: enabled in config and we're not
         # the sole validator (node.go onlyValidatorIsUs check).
         only_us = (

@@ -211,6 +211,21 @@ def build_core(
     )
     cs.set_priv_validator(pv)
     cs.sim_driven = True
+    # Pipelined-heights engine in INLINE mode: speculation and the
+    # commit-writer job run synchronously on the FSM thread, so the
+    # (seed, scenario) determinism pairs stay bit-identical — same
+    # orderings as the serial chain — while the speculation protocol
+    # and the new crash seams (cs-spec-exec, cs-pipeline-save,
+    # cs-pipeline-fsync) stay reachable from simnet scenarios.
+    from ..consensus.pipeline import CommitPipeline
+
+    pipe = CommitPipeline(executor, cs.wal)
+    pipe.inline = True
+    pipe.enabled = True
+    pipe.spec_enabled = conns.consensus.supports_speculation()
+    pipe.note_base(state.last_block_height)
+    executor.prune_gate = pipe.durable_height
+    cs.pipeline = pipe
 
     consensus_reactor = ConsensusReactor(
         cs, wait_sync=block_sync or statesync

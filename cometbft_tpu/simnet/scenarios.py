@@ -425,6 +425,10 @@ def scenario_crash_restart(seed: int, n_nodes: int = 4,
         net.run_until_height(2, max_virtual_ms=60_000),
         f"no baseline progress {net.heights()}",
     )
+    # a committed tx makes the app hash non-trivial, so the post-replay
+    # convergence check below compares real execution state, not the
+    # genesis zero-hash
+    net.nodes[0].core["mempool"].push_tx(b"crash=restart")
     victim = 2
     net.arm_crash_point(victim, crash_point)
     died = net.run(
@@ -445,6 +449,36 @@ def scenario_crash_restart(seed: int, n_nodes: int = 4,
     )
     run.check(net.nodes[victim].restarts == 1, "restart not recorded")
     run.notes["crashed_at_height"] = h_dead
+    # WAL-replay convergence: after the victim's catchup replay every
+    # node must hold the SAME app hash at the last height they all
+    # share — the restarted node's re-execution (WAL replay + ABCI
+    # handshake) landed on the identical application state the
+    # survivors committed.  The hex lands in notes so determinism
+    # tests can pin it bit-identical across (seed, scenario) reruns.
+    # the tx pushed at node 0 commits once node 0 proposes (round-robin,
+    # no mempool gossip in simnet) — advance until the shared height's
+    # header carries the resulting non-zero app hash, so the comparison
+    # below can never pass vacuously on the genesis zero-hash
+    def _tx_reflected() -> bool:
+        blk = net.nodes[0].block_store.load_block(min(net.heights()))
+        return blk is not None and any(blk.header.app_hash)
+
+    run.check(
+        net.run(until=_tx_reflected, max_virtual_ms=240_000),
+        f"tx never reflected in a shared app hash: {net.heights()}",
+    )
+    h_sync = min(net.heights())
+    hashes = {
+        bytes(net.nodes[i].block_store.load_block(h_sync).header.app_hash)
+        for i in range(n_nodes)
+    }
+    run.check(
+        len(hashes) == 1,
+        f"app hash diverged at height {h_sync} after replay: "
+        f"{sorted(h.hex() for h in hashes)}",
+    )
+    run.notes["app_hash_height"] = h_sync
+    run.notes["app_hash"] = min(hashes).hex()
     return run.finish()
 
 

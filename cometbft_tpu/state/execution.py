@@ -251,6 +251,10 @@ class BlockExecutor:
         self.block_store = block_store
         self.event_bus = event_bus if event_bus is not None else NopEventBus()
         self.metrics = metrics
+        # Pipelined commits (consensus/pipeline.py) set this to the
+        # durability barrier: pruning must never outrun the fsynced
+        # suffix, or a crash could lose a block the WAL marker claims.
+        self.prune_gate = None  # lockfree: set once at pipeline wiring, before the worker starts; read-only afterwards
 
     # -- proposal ----------------------------------------------------------
 
@@ -330,6 +334,42 @@ class BlockExecutor:
 
     # -- apply -------------------------------------------------------------
 
+    def finalize_request(
+        self, state: State, block: Block
+    ) -> abci.RequestFinalizeBlock:
+        """The RequestFinalizeBlock apply_block sends — shared with the
+        speculative path so both execute bit-identical requests."""
+        return abci.RequestFinalizeBlock(
+            txs=list(block.data.txs),
+            decided_last_commit=build_last_commit_info(
+                block, self.state_store, state
+            ),
+            misbehavior=_abci_misbehavior(block.evidence, state),
+            hash=block.hash(),
+            height=block.header.height,
+            time_ns=block.header.time_ns,
+            next_validators_hash=block.header.next_validators_hash,
+            proposer_address=block.header.proposer_address,
+        )
+
+    def speculate_block(self, state: State, block: Block):
+        """Run FinalizeBlock speculatively (consensus/pipeline.py's
+        cs-spec-exec worker): the app comes out unchanged; returns
+        ``(resp, post_token)`` for a later winning ``complete_apply``.
+        Raises abci.client.SpeculationUnsupported on remote transports or
+        apps without the snapshot/restore extension. The caller validated
+        this exact block before prevoting it — speculation never runs an
+        unvalidated block."""
+        resp, post = self.proxy_app.speculate_finalize(
+            self.finalize_request(state, block)
+        )
+        if len(resp.tx_results) != len(block.data.txs):
+            raise RuntimeError(
+                "speculative FinalizeBlock returned wrong number of "
+                "tx results"
+            )
+        return resp, post
+
     def apply_block(
         self, state: State, block_id: BlockID, block: Block
     ) -> State:
@@ -337,21 +377,26 @@ class BlockExecutor:
         state → Commit → prune → events. Returns the next State."""
         t0 = time.perf_counter()
         self.validate_block(state, block)
+        new_state, resp = self.begin_apply(state, block_id, block)
+        self.complete_apply(new_state, block_id, block, resp, t0=t0)
+        return new_state
 
-        resp = self.proxy_app.finalize_block(
-            abci.RequestFinalizeBlock(
-                txs=list(block.data.txs),
-                decided_last_commit=build_last_commit_info(
-                    block, self.state_store, state
-                ),
-                misbehavior=_abci_misbehavior(block.evidence, state),
-                hash=block.hash(),
-                height=block.header.height,
-                time_ns=block.header.time_ns,
-                next_validators_hash=block.header.next_validators_hash,
-                proposer_address=block.header.proposer_address,
+    def begin_apply(
+        self, state: State, block_id: BlockID, block: Block, spec_resp=None
+    ):
+        """The FSM-side half of ApplyBlock: FinalizeBlock (or the
+        memoized speculative response), response persistence, and the
+        pure State(H+1) derivation. Returns ``(new_state, resp)``; no
+        durable app/consensus state advances — ``complete_apply`` owns
+        that, so a pipelined caller may run it on the commit-writer
+        worker AFTER the block itself is durable (the handshake refuses
+        an app ahead of the block store, consensus/replay.py)."""
+        if spec_resp is not None:
+            resp = spec_resp
+        else:
+            resp = self.proxy_app.finalize_block(
+                self.finalize_request(state, block)
             )
-        )
         if len(resp.tx_results) != len(block.data.txs):
             raise RuntimeError(
                 "FinalizeBlock returned wrong number of tx results"
@@ -366,11 +411,28 @@ class BlockExecutor:
         fail_point("exec-after-save-responses")
 
         new_state = self._update_state(state, block_id, block, resp)
+        new_state.app_hash = resp.app_hash
+        return new_state, resp
 
+    def complete_apply(
+        self,
+        new_state: State,
+        block_id: BlockID,
+        block: Block,
+        resp,
+        spec_token=None,
+        t0: float | None = None,
+    ) -> None:
+        """The durable half of ApplyBlock: app Commit (mempool locked),
+        state persistence, evidence update, pruning, events. A winning
+        speculation passes ``spec_token`` — the memoized post-finalize
+        app state is restored in place of re-execution, then Commit
+        persists it."""
+        if spec_token is not None:
+            self.proxy_app.apply_speculation(spec_token)
         # Commit: lock mempool so no CheckTx races the app's state commit
         # (execution.go:360).
         app_hash = self._commit(new_state, block, resp)
-        new_state.app_hash = resp.app_hash
         assert app_hash is not None
 
         self.state_store.save(new_state)
@@ -378,11 +440,10 @@ class BlockExecutor:
         self.evidence_pool.update(new_state, block.evidence)
         self._prune(new_state)
         self._fire_events(block, block_id, resp)
-        if self.metrics is not None:
+        if self.metrics is not None and t0 is not None:
             self.metrics.block_processing_time.observe(
                 time.perf_counter() - t0
             )
-        return new_state
 
     def _commit(self, state: State, block: Block, resp) -> bytes:
         self.mempool.lock()
@@ -400,6 +461,10 @@ class BlockExecutor:
 
     def _prune(self, state: State) -> None:
         retain = getattr(self, "_retain_height", 0)
+        if retain > 0 and self.prune_gate is not None:
+            # never prune past the durability barrier: the pruned block
+            # must not be the one a crash replay would need to re-serve
+            retain = min(retain, self.prune_gate())
         if retain > 0 and self.block_store is not None:
             base = self.block_store.base()
             if retain > base:

@@ -26,6 +26,12 @@ CRASH_POINTS = [
     "exec-after-finalize",
     "exec-after-save-responses",
     "cs-after-apply-block",
+    # pipelined-heights seams (consensus/pipeline.py): speculation
+    # in-flight at kill, commit-writer killed before save, and killed
+    # between save_block and the EndHeight fsync ack
+    "cs-spec-exec",
+    "cs-pipeline-save",
+    "cs-pipeline-fsync",
 ]
 
 

@@ -293,6 +293,30 @@ def test_scenario_crash_restart():
     assert r.notes["crashed_at_height"] >= 2
 
 
+@pytest.mark.parametrize(
+    "point", ["cs-spec-exec", "cs-pipeline-save", "cs-pipeline-fsync"]
+)
+def test_crash_restart_pipeline_seams_converge(point):
+    """The pipelined-heights crash seams (speculation in flight,
+    commit-writer before save, and between save and the EndHeight
+    fsync ack) through the simnet crash_restart scenario: the node
+    dies AT the seam, WAL replay brings it back, and every node —
+    the replayed victim included — converges to the identical app
+    hash, bit-reproducibly per (seed, scenario)."""
+    r1 = run_scenario("crash_restart", 23, crash_point=point)
+    assert r1.ok, r1.failures
+    assert r1.notes["crashed_at_height"] >= 2
+    # the scenario committed a tx, so the convergent hash reflects real
+    # execution state, not the genesis zero-hash
+    assert int(r1.notes["app_hash"], 16) != 0
+    r2 = run_scenario("crash_restart", 23, crash_point=point)
+    assert r2.ok, r2.failures
+    assert r1.signature == r2.signature
+    assert r1.heights == r2.heights
+    assert r1.notes["app_hash"] == r2.notes["app_hash"]
+    assert r1.notes["app_hash_height"] == r2.notes["app_hash_height"]
+
+
 def test_scenario_valset_churn():
     r = run_scenario("valset_churn", 7)
     assert r.ok, r.failures
